@@ -1,0 +1,25 @@
+"""asyncflow_tpu — a TPU-native scenario simulator for async distributed backends.
+
+Same capability surface as the reference AsyncFlow project (YAML/builder front
+doors, event-loop server model, event injection, metrics/plots), re-designed
+around a batched JAX next-event engine so Monte-Carlo scenario sweeps run as
+one vmapped, mesh-sharded kernel. A sequential CPU "oracle" DES provides the
+behavioral reference and single-scenario runs.
+"""
+
+from asyncflow_tpu.builder.flow import AsyncFlow
+
+__version__ = "0.1.0"
+
+__all__ = ["AsyncFlow", "SimulationRunner", "__version__"]
+
+
+def __getattr__(name: str):
+    # SimulationRunner pulls in the engines (and thus jax); import lazily so
+    # schema-only users never pay for it.
+    if name == "SimulationRunner":
+        from asyncflow_tpu.runtime.runner import SimulationRunner
+
+        return SimulationRunner
+    msg = f"module 'asyncflow_tpu' has no attribute {name!r}"
+    raise AttributeError(msg)

@@ -1,0 +1,5 @@
+"""Python front door: the fluent scenario builder."""
+
+from asyncflow_tpu.builder.flow import AsyncFlow
+
+__all__ = ["AsyncFlow"]

@@ -1,0 +1,160 @@
+"""Fluent Python builder producing a validated :class:`SimulationPayload`.
+
+One of the two front doors of the framework (the other is YAML through
+``SimulationRunner.from_yaml``), mirroring the reference builder surface
+(``/root/reference/src/asyncflow/builder/asyncflow_builder.py:22-177``).
+"""
+
+from __future__ import annotations
+
+from typing import Self
+
+from asyncflow_tpu.config.constants import EventDescription
+from asyncflow_tpu.schemas.edges import Edge
+from asyncflow_tpu.schemas.events import End, EventInjection, Start
+from asyncflow_tpu.schemas.graph import TopologyGraph
+from asyncflow_tpu.schemas.nodes import Client, LoadBalancer, Server, TopologyNodes
+from asyncflow_tpu.schemas.payload import SimulationPayload
+from asyncflow_tpu.schemas.settings import SimulationSettings
+from asyncflow_tpu.schemas.workload import RqsGenerator
+
+
+def _require(value: object, cls: type, label: str) -> None:
+    if not isinstance(value, cls):
+        msg = f"You must add a {cls.__name__} instance as {label}"
+        raise TypeError(msg)
+
+
+class AsyncFlow:
+    """Accumulates scenario pieces and validates them into one payload."""
+
+    def __init__(self) -> None:
+        self._generator: RqsGenerator | None = None
+        self._client: Client | None = None
+        self._servers: list[Server] = []
+        self._edges: list[Edge] = []
+        self._sim_settings: SimulationSettings | None = None
+        self._load_balancer: LoadBalancer | None = None
+        self._events: list[EventInjection] = []
+
+    # -- nodes & wiring -----------------------------------------------------
+
+    def add_generator(self, rqs_generator: RqsGenerator) -> Self:
+        """Set the stochastic request generator."""
+        _require(rqs_generator, RqsGenerator, "the generator")
+        self._generator = rqs_generator
+        return self
+
+    def add_client(self, client: Client) -> Self:
+        """Set the client node."""
+        _require(client, Client, "the client")
+        self._client = client
+        return self
+
+    def add_servers(self, *servers: Server) -> Self:
+        """Append one or more servers."""
+        for server in servers:
+            _require(server, Server, "a server")
+            self._servers.append(server)
+        return self
+
+    def add_edges(self, *edges: Edge) -> Self:
+        """Append one or more directed edges."""
+        for edge in edges:
+            _require(edge, Edge, "an edge")
+            self._edges.append(edge)
+        return self
+
+    def add_load_balancer(self, load_balancer: LoadBalancer) -> Self:
+        """Set the (single) load balancer."""
+        _require(load_balancer, LoadBalancer, "the load balancer")
+        self._load_balancer = load_balancer
+        return self
+
+    def add_simulation_settings(self, sim_settings: SimulationSettings) -> Self:
+        """Set the global settings."""
+        _require(sim_settings, SimulationSettings, "the settings")
+        self._sim_settings = sim_settings
+        return self
+
+    # -- events -------------------------------------------------------------
+
+    def add_network_spike(
+        self,
+        *,
+        event_id: str,
+        edge_id: str,
+        t_start: float,
+        t_end: float,
+        spike_s: float,
+    ) -> Self:
+        """Add a latency spike of ``spike_s`` seconds on ``edge_id`` over a window."""
+        self._events.append(
+            EventInjection(
+                event_id=event_id,
+                target_id=edge_id,
+                start=Start(
+                    kind=EventDescription.NETWORK_SPIKE_START,
+                    t_start=t_start,
+                    spike_s=spike_s,
+                ),
+                end=End(kind=EventDescription.NETWORK_SPIKE_END, t_end=t_end),
+            ),
+        )
+        return self
+
+    def add_server_outage(
+        self,
+        *,
+        event_id: str,
+        server_id: str,
+        t_start: float,
+        t_end: float,
+    ) -> Self:
+        """Add a SERVER_DOWN -> SERVER_UP window for ``server_id``."""
+        self._events.append(
+            EventInjection(
+                event_id=event_id,
+                target_id=server_id,
+                start=Start(kind=EventDescription.SERVER_DOWN, t_start=t_start),
+                end=End(kind=EventDescription.SERVER_UP, t_end=t_end),
+            ),
+        )
+        return self
+
+    # -- build --------------------------------------------------------------
+
+    def build_payload(self) -> SimulationPayload:
+        """Validate the accumulated pieces into one :class:`SimulationPayload`."""
+        if self._generator is None:
+            msg = "The generator input must be instantiated before the simulation"
+            raise ValueError(msg)
+        if self._client is None:
+            msg = "The client input must be instantiated before the simulation"
+            raise ValueError(msg)
+        if not self._servers:
+            msg = "You must instantiate at least one server before the simulation"
+            raise ValueError(msg)
+        if not self._edges:
+            msg = "You must instantiate edges before the simulation"
+            raise ValueError(msg)
+        if self._sim_settings is None:
+            msg = "The simulation settings must be instantiated before the simulation"
+            raise ValueError(msg)
+
+        graph = TopologyGraph(
+            nodes=TopologyNodes(
+                servers=self._servers,
+                client=self._client,
+                load_balancer=self._load_balancer,
+            ),
+            edges=self._edges,
+        )
+        return SimulationPayload.model_validate(
+            {
+                "rqs_input": self._generator,
+                "topology_graph": graph,
+                "sim_settings": self._sim_settings,
+                "events": self._events or None,
+            },
+        )

@@ -1,0 +1,17 @@
+"""Curated public surface for topology building blocks."""
+
+from asyncflow_tpu.schemas.edges import Edge
+from asyncflow_tpu.schemas.endpoint import Endpoint, Step
+from asyncflow_tpu.schemas.events import EventInjection
+from asyncflow_tpu.schemas.nodes import Client, LoadBalancer, Server, ServerResources
+
+__all__ = [
+    "Client",
+    "Edge",
+    "Endpoint",
+    "EventInjection",
+    "LoadBalancer",
+    "Server",
+    "ServerResources",
+    "Step",
+]

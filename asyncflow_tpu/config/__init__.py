@@ -1,0 +1,5 @@
+"""Constants and defaults shared by every layer."""
+
+from asyncflow_tpu.config import constants
+
+__all__ = ["constants"]

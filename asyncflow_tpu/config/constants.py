@@ -1,0 +1,214 @@
+"""Single source of truth for every string that appears in scenario files.
+
+Every enum *value* below is part of the on-disk contract: YAML scenarios written
+for the reference implementation (AsyncFlow, see ``/root/reference/src/asyncflow/
+config/constants.py``) must validate unchanged against this framework.  Only the
+values are shared — they are the public file format, not code.
+
+Organisation:
+    - workload + distribution enums (request generator),
+    - endpoint step vocabulary (the per-request server program),
+    - topology node/edge kinds,
+    - load-balancer algorithms,
+    - event-injection kinds,
+    - metric names (sampled / event / aggregated) and latency-stat keys,
+    - default values grouped in small frozen namespaces.
+"""
+
+from __future__ import annotations
+
+from enum import Enum, IntEnum, StrEnum
+
+# ---------------------------------------------------------------------------
+# Random variables & workload
+# ---------------------------------------------------------------------------
+
+
+class Distribution(StrEnum):
+    """Sampling distributions accepted by :class:`RVConfig`."""
+
+    POISSON = "poisson"
+    NORMAL = "normal"
+    LOG_NORMAL = "log_normal"
+    EXPONENTIAL = "exponential"
+    UNIFORM = "uniform"
+
+
+class TimeDefaults(IntEnum):
+    """Time-related defaults and validation bounds (seconds)."""
+
+    MIN_TO_SEC = 60
+    USER_SAMPLING_WINDOW = 60
+    SIMULATION_TIME = 3_600
+    MIN_SIMULATION_TIME = 5
+    MIN_USER_SAMPLING_WINDOW = 1
+    MAX_USER_SAMPLING_WINDOW = 120
+
+
+# ---------------------------------------------------------------------------
+# Endpoint step vocabulary
+# ---------------------------------------------------------------------------
+
+
+class EndpointStepIO(StrEnum):
+    """I/O-bound step categories (the event loop yields, no core is held)."""
+
+    TASK_SPAWN = "io_task_spawn"
+    LLM = "io_llm"
+    WAIT = "io_wait"
+    DB = "io_db"
+    CACHE = "io_cache"
+
+
+class EndpointStepCPU(StrEnum):
+    """CPU-bound step categories (a core / the GIL is held)."""
+
+    INITIAL_PARSING = "initial_parsing"
+    CPU_BOUND_OPERATION = "cpu_bound_operation"
+
+
+class EndpointStepRAM(StrEnum):
+    """Memory reservation steps (working set held for the whole request)."""
+
+    RAM = "ram"
+
+
+class StepOperation(StrEnum):
+    """Quantity keys allowed inside a step definition."""
+
+    CPU_TIME = "cpu_time"
+    IO_WAITING_TIME = "io_waiting_time"
+    NECESSARY_RAM = "necessary_ram"
+
+
+# ---------------------------------------------------------------------------
+# Topology
+# ---------------------------------------------------------------------------
+
+
+class SystemNodes(StrEnum):
+    """Macro-component categories of the topology graph."""
+
+    GENERATOR = "generator"
+    SERVER = "server"
+    CLIENT = "client"
+    LOAD_BALANCER = "load_balancer"
+
+
+class SystemEdges(StrEnum):
+    """Edge categories connecting system nodes."""
+
+    NETWORK_CONNECTION = "network_connection"
+
+
+class LbAlgorithmsName(StrEnum):
+    """Routing policies available on the load balancer."""
+
+    ROUND_ROBIN = "round_robin"
+    LEAST_CONNECTIONS = "least_connection"
+
+
+class ServerResourcesDefaults:
+    """Defaults / minima for per-server resources."""
+
+    CPU_CORES = 1
+    MINIMUM_CPU_CORES = 1
+    RAM_MB = 1024
+    MINIMUM_RAM_MB = 256
+    DB_CONNECTION_POOL = None
+
+
+class NetworkParameters:
+    """Defaults / bounds for network edges."""
+
+    MIN_DROPOUT_RATE = 0.0
+    DROPOUT_RATE = 0.01
+    MAX_DROPOUT_RATE = 1.0
+
+
+# ---------------------------------------------------------------------------
+# Event injection
+# ---------------------------------------------------------------------------
+
+
+class EventDescription(StrEnum):
+    """Kinds of events that can be injected in a simulation window."""
+
+    SERVER_UP = "server_up"
+    SERVER_DOWN = "server_down"
+    NETWORK_SPIKE_START = "network_spike_start"
+    NETWORK_SPIKE_END = "network_spike_end"
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+
+
+class SampledMetricName(StrEnum):
+    """Fixed-cadence time-series metrics."""
+
+    READY_QUEUE_LEN = "ready_queue_len"
+    EVENT_LOOP_IO_SLEEP = "event_loop_io_sleep"
+    RAM_IN_USE = "ram_in_use"
+    EDGE_CONCURRENT_CONNECTION = "edge_concurrent_connection"
+
+
+class SamplePeriods(float, Enum):
+    """Allowed range for the sampling cadence of time-series metrics."""
+
+    STANDARD_TIME = 0.01
+    MINIMUM_TIME = 0.001
+    MAXIMUM_TIME = 0.1
+
+
+class EventMetricName(StrEnum):
+    """Per-request (event-triggered) metrics."""
+
+    RQS_CLOCK = "rqs_clock"
+    LLM_COST = "llm_cost"
+
+
+class AggregatedMetricName(StrEnum):
+    """Post-run aggregated metrics."""
+
+    LATENCY_STATS = "latency_stats"
+    THROUGHPUT = "throughput_rps"
+    LLM_STATS = "llm_stats"
+
+
+class ServerResourceName(StrEnum):
+    """Keys identifying each server resource container."""
+
+    CPU = "CPU"
+    RAM = "RAM"
+
+
+class LatencyKey(StrEnum):
+    """Keys of the latency statistics dictionary."""
+
+    TOTAL_REQUESTS = "total_requests"
+    MEAN = "mean"
+    MEDIAN = "median"
+    STD_DEV = "std_dev"
+    P95 = "p95"
+    P99 = "p99"
+    MIN = "min"
+    MAX = "max"
+
+
+# ---------------------------------------------------------------------------
+# Engine selection (new in this framework — the reference is single-engine)
+# ---------------------------------------------------------------------------
+
+
+class Backend(StrEnum):
+    """Execution engines available behind :class:`SimulationRunner`.
+
+    ``ORACLE`` is the sequential CPU discrete-event engine (the behavioral
+    reference, replacing the SimPy loop of the original project).  ``JAX`` is
+    the batched TPU next-event engine used for Monte-Carlo sweeps.
+    """
+
+    ORACLE = "oracle"
+    JAX = "jax"

@@ -1,0 +1,41 @@
+"""Pydantic schema layer: the validation-first contract of the framework.
+
+Everything downstream (compiler, engines, metrics) assumes payloads passed
+validation here, mirroring the reference's validation-first design
+(``/root/reference/src/asyncflow/schemas/``).
+"""
+
+from asyncflow_tpu.schemas.edges import Edge
+from asyncflow_tpu.schemas.endpoint import Endpoint, Step
+from asyncflow_tpu.schemas.events import End, EventInjection, Start
+from asyncflow_tpu.schemas.graph import TopologyGraph
+from asyncflow_tpu.schemas.nodes import (
+    Client,
+    LoadBalancer,
+    Server,
+    ServerResources,
+    TopologyNodes,
+)
+from asyncflow_tpu.schemas.payload import SimulationPayload
+from asyncflow_tpu.schemas.random_variables import RVConfig
+from asyncflow_tpu.schemas.settings import SimulationSettings
+from asyncflow_tpu.schemas.workload import RqsGenerator
+
+__all__ = [
+    "Client",
+    "Edge",
+    "End",
+    "Endpoint",
+    "EventInjection",
+    "LoadBalancer",
+    "RVConfig",
+    "RqsGenerator",
+    "Server",
+    "ServerResources",
+    "SimulationPayload",
+    "SimulationSettings",
+    "Start",
+    "Step",
+    "TopologyGraph",
+    "TopologyNodes",
+]

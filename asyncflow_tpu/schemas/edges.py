@@ -1,0 +1,53 @@
+"""Edge schema: a directed stochastic network link between two nodes.
+
+Contract mirrored from the reference
+(``/root/reference/src/asyncflow/schemas/topology/edges.py:25-99``): latency
+mean must be positive and variance non-negative, dropout is a probability
+(default 1%), and self-loops are rejected.
+"""
+
+from __future__ import annotations
+
+from pydantic import BaseModel, Field, field_validator, model_validator
+from pydantic_core.core_schema import ValidationInfo
+
+from asyncflow_tpu.config.constants import NetworkParameters, SystemEdges
+from asyncflow_tpu.schemas.random_variables import RVConfig
+
+
+class Edge(BaseModel):
+    """A directed connection in the topology graph."""
+
+    id: str
+    source: str
+    target: str
+    latency: RVConfig
+    edge_type: SystemEdges = SystemEdges.NETWORK_CONNECTION
+    dropout_rate: float = Field(
+        NetworkParameters.DROPOUT_RATE,
+        ge=NetworkParameters.MIN_DROPOUT_RATE,
+        le=NetworkParameters.MAX_DROPOUT_RATE,
+        description="Per-message probability that this link drops the request.",
+    )
+
+    @field_validator("latency", mode="after")
+    @classmethod
+    def _latency_is_positive(cls, value: RVConfig, info: ValidationInfo) -> RVConfig:
+        edge_id = info.data.get("id", "unknown")
+        if value.mean <= 0:
+            msg = f"The mean latency of the edge '{edge_id}' must be positive"
+            raise ValueError(msg)
+        if value.variance is not None and value.variance < 0:
+            msg = (
+                f"The variance of the latency of the edge {edge_id}"
+                "must be non negative"
+            )
+            raise ValueError(msg)
+        return value
+
+    @model_validator(mode="after")
+    def _no_self_loop(self) -> Edge:
+        if self.source == self.target:
+            msg = "source and target must be different nodes"
+            raise ValueError(msg)
+        return self

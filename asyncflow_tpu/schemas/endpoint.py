@@ -1,0 +1,88 @@
+"""Endpoint / step schemas: the per-request program a server executes.
+
+Contract mirrored from the reference ``Step``/``Endpoint``
+(``/root/reference/src/asyncflow/schemas/topology/endpoint.py:19-102``): every
+step carries exactly one quantity, and the quantity key must agree with the
+step kind (CPU <-> cpu_time, RAM <-> necessary_ram, I/O <-> io_waiting_time).
+Endpoint names are normalised to lowercase.
+"""
+
+from __future__ import annotations
+
+from pydantic import BaseModel, PositiveFloat, PositiveInt, field_validator, model_validator
+
+from asyncflow_tpu.config.constants import (
+    EndpointStepCPU,
+    EndpointStepIO,
+    EndpointStepRAM,
+    StepOperation,
+)
+
+StepKind = EndpointStepIO | EndpointStepCPU | EndpointStepRAM
+
+_EXPECTED_OPERATION: dict[type, StepOperation] = {
+    EndpointStepCPU: StepOperation.CPU_TIME,
+    EndpointStepRAM: StepOperation.NECESSARY_RAM,
+    EndpointStepIO: StepOperation.IO_WAITING_TIME,
+}
+
+
+class Step(BaseModel):
+    """One unit of work inside an endpoint."""
+
+    kind: StepKind
+    step_operation: dict[StepOperation, PositiveFloat | PositiveInt]
+
+    @field_validator("step_operation", mode="before")
+    @classmethod
+    def _non_empty(cls, value: object) -> object:
+        if not value:
+            msg = "step_operation cannot be empty"
+            raise ValueError(msg)
+        return value
+
+    @model_validator(mode="after")
+    def _kind_matches_operation(self) -> Step:
+        keys = set(self.step_operation)
+        if len(keys) != 1:
+            msg = "step_operation must contain exactly one entry"
+            raise ValueError(msg)
+        for kind_cls, expected in _EXPECTED_OPERATION.items():
+            if isinstance(self.kind, kind_cls) and keys != {expected}:
+                msg = (
+                    f"A step of kind '{self.kind}' must use exactly "
+                    f"the '{expected}' operation"
+                )
+                raise ValueError(msg)
+        return self
+
+    # -- typed accessors used by the compiler / engines --------------------
+
+    @property
+    def quantity(self) -> float:
+        """The single numeric payload of this step."""
+        return float(next(iter(self.step_operation.values())))
+
+    @property
+    def is_cpu(self) -> bool:
+        return isinstance(self.kind, EndpointStepCPU)
+
+    @property
+    def is_io(self) -> bool:
+        return isinstance(self.kind, EndpointStepIO)
+
+    @property
+    def is_ram(self) -> bool:
+        return isinstance(self.kind, EndpointStepRAM)
+
+
+class Endpoint(BaseModel):
+    """A named sequence of steps exposed by a server."""
+
+    endpoint_name: str
+    steps: list[Step]
+
+    @field_validator("endpoint_name", mode="before")
+    @classmethod
+    def _lowercase_name(cls, value: str) -> str:
+        return value.lower()

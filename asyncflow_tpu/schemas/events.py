@@ -1,0 +1,92 @@
+"""Event-injection schemas: scheduled latency spikes and server outages.
+
+Contract mirrored from the reference
+(``/root/reference/src/asyncflow/schemas/events/injection.py:25-119``): start
+and end markers are frozen and reject unknown fields, start/end kinds must
+pair (SERVER_DOWN->SERVER_UP, NETWORK_SPIKE_START->NETWORK_SPIKE_END),
+t_start < t_end, and spike_s is required exactly for network spikes.
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+from pydantic import (
+    BaseModel,
+    ConfigDict,
+    NonNegativeFloat,
+    PositiveFloat,
+    model_validator,
+)
+
+from asyncflow_tpu.config.constants import EventDescription
+
+_START_TO_END: dict[EventDescription, EventDescription] = {
+    EventDescription.SERVER_DOWN: EventDescription.SERVER_UP,
+    EventDescription.NETWORK_SPIKE_START: EventDescription.NETWORK_SPIKE_END,
+}
+
+
+class Start(BaseModel):
+    """Opening marker of an event window."""
+
+    model_config = ConfigDict(extra="forbid", frozen=True)
+
+    kind: Literal[
+        EventDescription.SERVER_DOWN,
+        EventDescription.NETWORK_SPIKE_START,
+    ]
+    t_start: NonNegativeFloat
+    spike_s: None | PositiveFloat = None
+
+
+class End(BaseModel):
+    """Closing marker of an event window."""
+
+    model_config = ConfigDict(extra="forbid", frozen=True)
+
+    kind: Literal[
+        EventDescription.SERVER_UP,
+        EventDescription.NETWORK_SPIKE_END,
+    ]
+    t_end: PositiveFloat
+
+
+class EventInjection(BaseModel):
+    """A deterministic what-if window applied to one topology component."""
+
+    event_id: str
+    target_id: str
+    start: Start
+    end: End
+
+    @model_validator(mode="after")
+    def _start_end_compatible(self) -> EventInjection:
+        expected = _START_TO_END[self.start.kind]
+        if self.end.kind != expected:
+            msg = (
+                f"The event {self.event_id} must have "
+                f"as value of kind in end {expected}"
+            )
+            raise ValueError(msg)
+        if self.start.t_start >= self.end.t_end:
+            msg = (
+                f"The starting time for the event {self.event_id} "
+                "must be smaller than the ending time"
+            )
+            raise ValueError(msg)
+        return self
+
+    @model_validator(mode="after")
+    def _spike_iff_network_event(self) -> EventInjection:
+        is_spike = self.start.kind == EventDescription.NETWORK_SPIKE_START
+        if is_spike and self.start.spike_s is None:
+            msg = (
+                f"The field spike_s for the event {self.event_id} "
+                "must be defined as a positive float"
+            )
+            raise ValueError(msg)
+        if not is_spike and self.start.spike_s is not None:
+            msg = f"Event {self.event_id}: spike_s must be omitted"
+            raise ValueError(msg)
+        return self

@@ -1,0 +1,106 @@
+"""Topology graph schema with global consistency validators.
+
+Contract mirrored from the reference
+(``/root/reference/src/asyncflow/schemas/topology/graph.py:33-159``):
+unique edge ids; every edge target must be a declared node; external sources
+(the generator) may never appear as targets; the LB cover-set must be declared
+servers each reachable via an LB edge; only the LB may fan out.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from pydantic import BaseModel, model_validator
+
+from asyncflow_tpu.schemas.edges import Edge
+from asyncflow_tpu.schemas.nodes import TopologyNodes
+
+
+class TopologyGraph(BaseModel):
+    """Directed graph of the whole system under simulation."""
+
+    nodes: TopologyNodes
+    edges: list[Edge]
+
+    def declared_node_ids(self) -> set[str]:
+        """Ids of every node declared in ``nodes`` (servers, client, LB)."""
+        ids = {server.id for server in self.nodes.servers}
+        ids.add(self.nodes.client.id)
+        if self.nodes.load_balancer is not None:
+            ids.add(self.nodes.load_balancer.id)
+        return ids
+
+    @model_validator(mode="after")
+    def _unique_edge_ids(self) -> TopologyGraph:
+        duplicates = [
+            edge_id
+            for edge_id, count in Counter(edge.id for edge in self.edges).items()
+            if count > 1
+        ]
+        if duplicates:
+            msg = f"There are multiple edges with the following ids {duplicates}"
+            raise ValueError(msg)
+        return self
+
+    @model_validator(mode="after")
+    def _edge_refs_valid(self) -> TopologyGraph:
+        node_ids = self.declared_node_ids()
+        external_sources: set[str] = set()
+        for edge in self.edges:
+            if edge.target not in node_ids:
+                msg = (
+                    f"Edge {edge.source}->{edge.target} references "
+                    f"unknown target node '{edge.target}'."
+                )
+                raise ValueError(msg)
+            if edge.source not in node_ids:
+                external_sources.add(edge.source)
+
+        forbidden = external_sources & {edge.target for edge in self.edges}
+        if forbidden:
+            msg = f"External IDs cannot be used as targets as well:{sorted(forbidden)}"
+            raise ValueError(msg)
+        return self
+
+    @model_validator(mode="after")
+    def _valid_load_balancer(self) -> TopologyGraph:
+        lb = self.nodes.load_balancer
+        if lb is None:
+            return self
+
+        server_ids = {server.id for server in self.nodes.servers}
+        missing = lb.server_covered - server_ids
+        if missing:
+            msg = f"Load balancer '{lb.id}'references unknown servers: {sorted(missing)}"
+            raise ValueError(msg)
+
+        targets_from_lb = {edge.target for edge in self.edges if edge.source == lb.id}
+        not_linked = lb.server_covered - targets_from_lb
+        if not_linked:
+            msg = (
+                f"Servers {sorted(not_linked)} are covered by LB '{lb.id}' "
+                "but have no outgoing edge from it."
+            )
+            raise ValueError(msg)
+        return self
+
+    @model_validator(mode="after")
+    def _no_fanout_except_lb(self) -> TopologyGraph:
+        lb = self.nodes.load_balancer
+        lb_id = lb.id if lb is not None else None
+        node_ids = self.declared_node_ids()
+
+        out_degree: Counter[str] = Counter(
+            edge.source for edge in self.edges if edge.source in node_ids
+        )
+        offenders = [
+            source for source, count in out_degree.items() if count > 1 and source != lb_id
+        ]
+        if offenders:
+            msg = (
+                "Only the load balancer can have multiple outgoing edges. "
+                f"Offending sources: {offenders}"
+            )
+            raise ValueError(msg)
+        return self

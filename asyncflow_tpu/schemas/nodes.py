@@ -1,0 +1,106 @@
+"""Node schemas of the topology graph: client, server (+resources), LB.
+
+Contract mirrored from the reference
+(``/root/reference/src/asyncflow/schemas/topology/nodes.py:34-166``): node
+``type`` fields are fixed to their standard value, resources are bounded below
+(>=1 core, >=256 MB RAM), node ids must be unique, and the node collection
+rejects unknown fields.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from pydantic import BaseModel, ConfigDict, Field, PositiveInt, field_validator, model_validator
+
+from asyncflow_tpu.config.constants import (
+    LbAlgorithmsName,
+    ServerResourcesDefaults,
+    SystemNodes,
+)
+from asyncflow_tpu.schemas.endpoint import Endpoint
+
+
+def _fixed_type(expected: SystemNodes):
+    """Validator factory: the ``type`` discriminator must keep its standard value."""
+
+    def _check(cls: type, value: SystemNodes) -> SystemNodes:  # noqa: ARG001
+        if value != expected:
+            msg = f"The type should have a standard value: {expected}"
+            raise ValueError(msg)
+        return value
+
+    return _check
+
+
+class Client(BaseModel):
+    """Entry/exit point of every request."""
+
+    id: str
+    type: SystemNodes = SystemNodes.CLIENT
+
+    _check_type = field_validator("type", mode="after")(_fixed_type(SystemNodes.CLIENT))
+
+
+class ServerResources(BaseModel):
+    """Finite resources available on one server."""
+
+    cpu_cores: PositiveInt = Field(
+        ServerResourcesDefaults.CPU_CORES,
+        ge=ServerResourcesDefaults.MINIMUM_CPU_CORES,
+        description="Number of CPU cores available for processing.",
+    )
+    db_connection_pool: PositiveInt | None = Field(
+        ServerResourcesDefaults.DB_CONNECTION_POOL,
+        description="Size of the database connection pool, if applicable.",
+    )
+    ram_mb: PositiveInt = Field(
+        ServerResourcesDefaults.RAM_MB,
+        ge=ServerResourcesDefaults.MINIMUM_RAM_MB,
+        description="Total available RAM in Megabytes.",
+    )
+
+
+class Server(BaseModel):
+    """An event-loop server exposing one or more endpoints."""
+
+    id: str
+    type: SystemNodes = SystemNodes.SERVER
+    server_resources: ServerResources
+    endpoints: list[Endpoint]
+
+    _check_type = field_validator("type", mode="after")(_fixed_type(SystemNodes.SERVER))
+
+
+class LoadBalancer(BaseModel):
+    """Single fan-out point of the topology."""
+
+    id: str
+    type: SystemNodes = SystemNodes.LOAD_BALANCER
+    algorithms: LbAlgorithmsName = LbAlgorithmsName.ROUND_ROBIN
+    server_covered: set[str] = Field(default_factory=set)
+
+    _check_type = field_validator("type", mode="after")(
+        _fixed_type(SystemNodes.LOAD_BALANCER),
+    )
+
+
+class TopologyNodes(BaseModel):
+    """All nodes of a scenario; ids must be globally unique."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    servers: list[Server]
+    client: Client
+    load_balancer: LoadBalancer | None = None
+
+    @model_validator(mode="after")
+    def _unique_ids(self) -> TopologyNodes:
+        ids = [server.id for server in self.servers] + [self.client.id]
+        if self.load_balancer is not None:
+            ids.append(self.load_balancer.id)
+        duplicates = [node_id for node_id, count in Counter(ids).items() if count > 1]
+        if duplicates:
+            msg = f"The following node ids are duplicate {duplicates}"
+            raise ValueError(msg)
+        return self

@@ -1,0 +1,195 @@
+"""Full simulation input with cross-cutting event validators.
+
+Contract mirrored from the reference ``SimulationPayload``
+(``/root/reference/src/asyncflow/schemas/payload.py:12-252``): event ids are
+unique; each event targets a declared server or edge of the right kind; event
+windows sit inside the simulation horizon; at no instant are all servers down;
+outage windows on one server never overlap.
+"""
+
+from __future__ import annotations
+
+from pydantic import BaseModel, field_validator, model_validator
+
+from asyncflow_tpu.config.constants import EventDescription
+from asyncflow_tpu.schemas.events import EventInjection
+from asyncflow_tpu.schemas.graph import TopologyGraph
+from asyncflow_tpu.schemas.settings import SimulationSettings
+from asyncflow_tpu.schemas.workload import RqsGenerator
+
+_END = "end"
+_START = "start"
+
+
+def _sweep_marks(
+    windows: list[tuple[float, float, str]],
+) -> list[tuple[float, str, str]]:
+    """Flatten (t_start, t_end, tag) windows into a sweep-line.
+
+    END sorts before START on time ties, which is what makes back-to-back
+    windows (one ending exactly when the next starts) legal.
+    """
+    marks: list[tuple[float, str, str]] = []
+    for t_start, t_end, tag in windows:
+        marks.append((t_start, _START, tag))
+        marks.append((t_end, _END, tag))
+    marks.sort(key=lambda mark: (mark[0], mark[1] == _START))
+    return marks
+
+
+class SimulationPayload(BaseModel):
+    """Everything needed to run one scenario."""
+
+    rqs_input: RqsGenerator
+    topology_graph: TopologyGraph
+    sim_settings: SimulationSettings
+    events: list[EventInjection] | None = None
+
+    # ------------------------------------------------------------------
+    # Event validators
+    # ------------------------------------------------------------------
+
+    @field_validator("events", mode="after")
+    @classmethod
+    def _unique_event_ids(
+        cls,
+        value: list[EventInjection] | None,
+    ) -> list[EventInjection] | None:
+        if value is None:
+            return value
+        ids = [event.event_id for event in value]
+        if len(ids) != len(set(ids)):
+            msg = "The id's representing different events must be unique"
+            raise ValueError(msg)
+        return value
+
+    @model_validator(mode="after")
+    def _event_targets_exist(self) -> SimulationPayload:
+        if self.events is None:
+            return self
+        valid_ids = {server.id for server in self.topology_graph.nodes.servers} | {
+            edge.id for edge in self.topology_graph.edges
+        }
+        for event in self.events:
+            if event.target_id not in valid_ids:
+                msg = (
+                    f"The target id {event.target_id} related to "
+                    f"the event {event.event_id} does not exist"
+                )
+                raise ValueError(msg)
+        return self
+
+    @model_validator(mode="after")
+    def _event_windows_inside_horizon(self) -> SimulationPayload:
+        if self.events is None:
+            return self
+        horizon = float(self.sim_settings.total_simulation_time)
+        for event in self.events:
+            t_start, t_end = event.start.t_start, event.end.t_end
+            if t_start < 0.0:
+                msg = (
+                    f"Event '{event.event_id}': start time t_start={t_start:.6f} "
+                    "must be >= 0.0"
+                )
+                raise ValueError(msg)
+            if t_start > horizon:
+                msg = (
+                    f"Event '{event.event_id}': start time t_start={t_start:.6f} "
+                    f"exceeds simulation horizon T={horizon:.6f}"
+                )
+                raise ValueError(msg)
+            if t_end > horizon:
+                msg = (
+                    f"Event '{event.event_id}': end time t_end={t_end:.6f} "
+                    f"exceeds simulation horizon T={horizon:.6f}"
+                )
+                raise ValueError(msg)
+        return self
+
+    @model_validator(mode="after")
+    def _event_kind_matches_target(self) -> SimulationPayload:
+        if self.events is None:
+            return self
+        server_ids = {server.id for server in self.topology_graph.nodes.servers}
+        edge_ids = {edge.id for edge in self.topology_graph.edges}
+        for event in self.events:
+            kind = event.start.kind
+            if kind == EventDescription.SERVER_DOWN and event.target_id not in server_ids:
+                msg = (
+                    f"The event {event.event_id} regarding a server does not have "
+                    "a compatible target id"
+                )
+                raise ValueError(msg)
+            if (
+                kind == EventDescription.NETWORK_SPIKE_START
+                and event.target_id not in edge_ids
+            ):
+                msg = (
+                    f"The event {event.event_id} regarding an edge does not have "
+                    "a compatible target id"
+                )
+                raise ValueError(msg)
+        return self
+
+    @model_validator(mode="after")
+    def _never_all_servers_down(self) -> SimulationPayload:
+        if self.events is None:
+            return self
+        server_ids = {server.id for server in self.topology_graph.nodes.servers}
+        # Filter on the event *kind*, not only the target id: an edge whose id
+        # collides with a server id must not make a network spike count as an
+        # outage.
+        outages = [
+            event
+            for event in self.events
+            if event.start.kind == EventDescription.SERVER_DOWN
+            and event.target_id in server_ids
+        ]
+
+        marks = _sweep_marks(
+            [(ev.start.t_start, ev.end.t_end, ev.target_id) for ev in outages],
+        )
+
+        down: set[str] = set()
+        for time, mark, server_id in marks:
+            if mark == _END:
+                down.discard(server_id)
+            else:
+                down.add(server_id)
+                if len(down) == len(server_ids):
+                    msg = (
+                        f"At time {time:.6f} all servers are down; keep at least one up"
+                    )
+                    raise ValueError(msg)
+        return self
+
+    @model_validator(mode="after")
+    def _no_overlapping_outages_per_server(self) -> SimulationPayload:
+        if not self.events:
+            return self
+        server_ids = {server.id for server in self.topology_graph.nodes.servers}
+
+        per_server: dict[str, list[tuple[float, float, str]]] = {}
+        for event in self.events:
+            if (
+                event.target_id in server_ids
+                and event.start.kind == EventDescription.SERVER_DOWN
+            ):
+                per_server.setdefault(event.target_id, []).append(
+                    (event.start.t_start, event.end.t_end, event.target_id),
+                )
+
+        for server_id, windows in per_server.items():
+            active = 0
+            for time, mark, _tag in _sweep_marks(windows):
+                if mark == _END:
+                    active = max(0, active - 1)
+                else:
+                    if active >= 1:
+                        msg = (
+                            f"Overlapping events for server '{server_id}' at "
+                            f"t={time:.6f}; server outage windows must not overlap."
+                        )
+                        raise ValueError(msg)
+                    active += 1
+        return self

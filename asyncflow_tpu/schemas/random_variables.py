@@ -1,0 +1,38 @@
+"""Random-variable configuration shared by workload and edge-latency schemas.
+
+Behavioral contract mirrors the reference ``RVConfig``
+(``/root/reference/src/asyncflow/schemas/common/random_variables.py:8-37``):
+``mean`` must be numeric; ``variance`` defaults to ``mean`` for the
+distributions that need one (normal, log-normal).
+"""
+
+from __future__ import annotations
+
+from pydantic import BaseModel, field_validator, model_validator
+
+from asyncflow_tpu.config.constants import Distribution
+
+_NEEDS_VARIANCE = frozenset({Distribution.NORMAL, Distribution.LOG_NORMAL})
+
+
+class RVConfig(BaseModel):
+    """Declarative description of a scalar random variable."""
+
+    mean: float
+    distribution: Distribution = Distribution.POISSON
+    variance: float | None = None
+
+    @field_validator("mean", mode="before")
+    @classmethod
+    def _mean_is_numeric(cls, value: object) -> float:
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            msg = "mean must be a number (int or float)"
+            raise ValueError(msg)
+        return float(value)
+
+    @model_validator(mode="after")
+    def _default_variance(self) -> RVConfig:
+        """Distributions with a free second moment default variance to mean."""
+        if self.variance is None and self.distribution in _NEEDS_VARIANCE:
+            self.variance = self.mean
+        return self

@@ -1,0 +1,45 @@
+"""Global simulation settings schema.
+
+Contract mirrored from the reference ``SimulationSettings``
+(``/root/reference/src/asyncflow/schemas/settings/simulation.py:13-46``).
+"""
+
+from __future__ import annotations
+
+from pydantic import BaseModel, Field
+
+from asyncflow_tpu.config.constants import (
+    EventMetricName,
+    SampledMetricName,
+    SamplePeriods,
+    TimeDefaults,
+)
+
+
+class SimulationSettings(BaseModel):
+    """Parameters that apply to the whole run."""
+
+    total_simulation_time: int = Field(
+        default=int(TimeDefaults.SIMULATION_TIME),
+        ge=int(TimeDefaults.MIN_SIMULATION_TIME),
+        description="Simulation horizon in seconds.",
+    )
+    enabled_sample_metrics: set[SampledMetricName] = Field(
+        default_factory=lambda: {
+            SampledMetricName.READY_QUEUE_LEN,
+            SampledMetricName.EVENT_LOOP_IO_SLEEP,
+            SampledMetricName.RAM_IN_USE,
+            SampledMetricName.EDGE_CONCURRENT_CONNECTION,
+        },
+        description="Which time-series KPIs to collect.",
+    )
+    enabled_event_metrics: set[EventMetricName] = Field(
+        default_factory=lambda: {EventMetricName.RQS_CLOCK},
+        description="Which per-request KPIs to collect.",
+    )
+    sample_period_s: float = Field(
+        default=SamplePeriods.STANDARD_TIME.value,
+        ge=SamplePeriods.MINIMUM_TIME.value,
+        le=SamplePeriods.MAXIMUM_TIME.value,
+        description="Fixed interval between time-series snapshots.",
+    )

@@ -1,0 +1,45 @@
+"""Workload (request-generator) input schema.
+
+Contract mirrored from the reference ``RqsGenerator``
+(``/root/reference/src/asyncflow/schemas/workload/rqs_generator.py:10-59``):
+active users must be Poisson or Normal, per-user request rate must be Poisson,
+and the user re-sampling window is bounded to [1, 120] seconds.
+"""
+
+from __future__ import annotations
+
+from pydantic import BaseModel, Field, field_validator
+
+from asyncflow_tpu.config.constants import Distribution, SystemNodes, TimeDefaults
+from asyncflow_tpu.schemas.random_variables import RVConfig
+
+
+class RqsGenerator(BaseModel):
+    """Compound stochastic arrival process: users x per-user request rate."""
+
+    id: str
+    type: SystemNodes = SystemNodes.GENERATOR
+    avg_active_users: RVConfig
+    avg_request_per_minute_per_user: RVConfig
+    user_sampling_window: int = Field(
+        default=int(TimeDefaults.USER_SAMPLING_WINDOW),
+        ge=int(TimeDefaults.MIN_USER_SAMPLING_WINDOW),
+        le=int(TimeDefaults.MAX_USER_SAMPLING_WINDOW),
+        description="Seconds between re-draws of the active-user count.",
+    )
+
+    @field_validator("avg_request_per_minute_per_user", mode="after")
+    @classmethod
+    def _request_rate_is_poisson(cls, value: RVConfig) -> RVConfig:
+        if value.distribution != Distribution.POISSON:
+            msg = "At the moment the variable avg request must be Poisson"
+            raise ValueError(msg)
+        return value
+
+    @field_validator("avg_active_users", mode="after")
+    @classmethod
+    def _users_poisson_or_gaussian(cls, value: RVConfig) -> RVConfig:
+        if value.distribution not in {Distribution.POISSON, Distribution.NORMAL}:
+            msg = "At the moment the variable active user must be Poisson or Gaussian"
+            raise ValueError(msg)
+        return value

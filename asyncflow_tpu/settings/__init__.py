@@ -1,0 +1,5 @@
+"""Curated public surface for simulation settings."""
+
+from asyncflow_tpu.schemas.settings import SimulationSettings
+
+__all__ = ["SimulationSettings"]

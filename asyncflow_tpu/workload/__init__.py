@@ -1,0 +1,6 @@
+"""Curated public surface for workload definition."""
+
+from asyncflow_tpu.schemas.random_variables import RVConfig
+from asyncflow_tpu.schemas.workload import RqsGenerator
+
+__all__ = ["RVConfig", "RqsGenerator"]

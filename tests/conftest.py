@@ -1,0 +1,111 @@
+"""Global test fixtures.
+
+JAX runs on a virtual 8-device CPU mesh so every sharding/pjit test works
+without TPU hardware (the env vars must be set before jax is imported
+anywhere, hence the assignment at module import time).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+from asyncflow_tpu.schemas.edges import Edge
+from asyncflow_tpu.schemas.endpoint import Endpoint, Step
+from asyncflow_tpu.schemas.graph import TopologyGraph
+from asyncflow_tpu.schemas.nodes import Client, Server, ServerResources, TopologyNodes
+from asyncflow_tpu.schemas.payload import SimulationPayload
+from asyncflow_tpu.schemas.random_variables import RVConfig
+from asyncflow_tpu.schemas.settings import SimulationSettings
+from asyncflow_tpu.schemas.workload import RqsGenerator
+
+SEED = 1337
+
+
+@pytest.fixture(scope="session")
+def rng() -> np.random.Generator:
+    """Session-scoped seeded RNG for deterministic tests."""
+    return np.random.default_rng(SEED)
+
+
+@pytest.fixture
+def minimal_generator() -> RqsGenerator:
+    return RqsGenerator(
+        id="rqs-1",
+        avg_active_users=RVConfig(mean=10),
+        avg_request_per_minute_per_user=RVConfig(mean=30),
+        user_sampling_window=60,
+    )
+
+
+@pytest.fixture
+def minimal_server() -> Server:
+    return Server(
+        id="srv-1",
+        server_resources=ServerResources(cpu_cores=1, ram_mb=1024),
+        endpoints=[
+            Endpoint(
+                endpoint_name="ep-1",
+                steps=[
+                    Step(kind="initial_parsing", step_operation={"cpu_time": 0.001}),
+                    Step(kind="ram", step_operation={"necessary_ram": 64}),
+                    Step(kind="io_wait", step_operation={"io_waiting_time": 0.01}),
+                ],
+            ),
+        ],
+    )
+
+
+@pytest.fixture
+def minimal_topology(minimal_server: Server) -> TopologyGraph:
+    return TopologyGraph(
+        nodes=TopologyNodes(servers=[minimal_server], client=Client(id="client-1")),
+        edges=[
+            Edge(
+                id="gen-client",
+                source="rqs-1",
+                target="client-1",
+                latency=RVConfig(mean=0.003, distribution="exponential"),
+                dropout_rate=0.0,
+            ),
+            Edge(
+                id="client-srv",
+                source="client-1",
+                target="srv-1",
+                latency=RVConfig(mean=0.003, distribution="exponential"),
+                dropout_rate=0.0,
+            ),
+            Edge(
+                id="srv-client",
+                source="srv-1",
+                target="client-1",
+                latency=RVConfig(mean=0.003, distribution="exponential"),
+                dropout_rate=0.0,
+            ),
+        ],
+    )
+
+
+@pytest.fixture
+def minimal_settings() -> SimulationSettings:
+    return SimulationSettings(total_simulation_time=30, sample_period_s=0.01)
+
+
+@pytest.fixture
+def minimal_payload(
+    minimal_generator: RqsGenerator,
+    minimal_topology: TopologyGraph,
+    minimal_settings: SimulationSettings,
+) -> SimulationPayload:
+    return SimulationPayload(
+        rqs_input=minimal_generator,
+        topology_graph=minimal_topology,
+        sim_settings=minimal_settings,
+    )

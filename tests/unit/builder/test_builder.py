@@ -1,0 +1,86 @@
+"""Unit tests for the fluent AsyncFlow builder."""
+
+import pytest
+
+from asyncflow_tpu.builder import AsyncFlow
+from asyncflow_tpu.schemas.edges import Edge
+from asyncflow_tpu.schemas.nodes import Client
+from asyncflow_tpu.schemas.payload import SimulationPayload
+from asyncflow_tpu.schemas.random_variables import RVConfig
+
+
+def _flow(minimal_generator, minimal_server, minimal_settings) -> AsyncFlow:
+    edges = [
+        Edge(
+            id="g-c",
+            source="rqs-1",
+            target="client-1",
+            latency=RVConfig(mean=0.003, distribution="exponential"),
+        ),
+        Edge(
+            id="c-s",
+            source="client-1",
+            target="srv-1",
+            latency=RVConfig(mean=0.003, distribution="exponential"),
+        ),
+        Edge(
+            id="s-c",
+            source="srv-1",
+            target="client-1",
+            latency=RVConfig(mean=0.003, distribution="exponential"),
+        ),
+    ]
+    return (
+        AsyncFlow()
+        .add_generator(minimal_generator)
+        .add_client(Client(id="client-1"))
+        .add_servers(minimal_server)
+        .add_edges(*edges)
+        .add_simulation_settings(minimal_settings)
+    )
+
+
+def test_build_payload_roundtrip(
+    minimal_generator, minimal_server, minimal_settings,
+) -> None:
+    payload = _flow(minimal_generator, minimal_server, minimal_settings).build_payload()
+    assert isinstance(payload, SimulationPayload)
+    assert payload.topology_graph.nodes.servers[0].id == "srv-1"
+    assert payload.events is None
+
+
+def test_builder_rejects_wrong_types(minimal_generator) -> None:
+    flow = AsyncFlow()
+    with pytest.raises(TypeError):
+        flow.add_generator("not a generator")
+    with pytest.raises(TypeError):
+        flow.add_client(minimal_generator)
+    with pytest.raises(TypeError):
+        flow.add_servers(minimal_generator)
+    with pytest.raises(TypeError):
+        flow.add_edges("edge")
+    with pytest.raises(TypeError):
+        flow.add_simulation_settings(42)
+    with pytest.raises(TypeError):
+        flow.add_load_balancer("lb")
+
+
+def test_build_requires_all_pieces(minimal_generator) -> None:
+    with pytest.raises(ValueError, match="generator"):
+        AsyncFlow().build_payload()
+    with pytest.raises(ValueError, match="client"):
+        AsyncFlow().add_generator(minimal_generator).build_payload()
+
+
+def test_builder_events(minimal_generator, minimal_server, minimal_settings) -> None:
+    flow = _flow(minimal_generator, minimal_server, minimal_settings)
+    flow.add_network_spike(
+        event_id="spike-1",
+        edge_id="c-s",
+        t_start=2.0,
+        t_end=10.0,
+        spike_s=0.05,
+    )
+    payload = flow.build_payload()
+    assert payload.events is not None
+    assert payload.events[0].start.spike_s == 0.05

@@ -1,0 +1,70 @@
+"""Unit tests for Step/Endpoint coherence validators."""
+
+import pytest
+from pydantic import ValidationError
+
+from asyncflow_tpu.schemas.endpoint import Endpoint, Step
+
+
+def test_cpu_step_requires_cpu_time() -> None:
+    step = Step(kind="initial_parsing", step_operation={"cpu_time": 0.002})
+    assert step.is_cpu
+    assert step.quantity == 0.002
+
+
+def test_ram_step_requires_necessary_ram() -> None:
+    step = Step(kind="ram", step_operation={"necessary_ram": 128})
+    assert step.is_ram
+    assert step.quantity == 128.0
+
+
+def test_io_step_requires_io_waiting_time() -> None:
+    step = Step(kind="io_db", step_operation={"io_waiting_time": 0.01})
+    assert step.is_io
+
+
+@pytest.mark.parametrize(
+    ("kind", "operation"),
+    [
+        ("initial_parsing", {"io_waiting_time": 0.1}),
+        ("initial_parsing", {"necessary_ram": 10}),
+        ("ram", {"cpu_time": 0.1}),
+        ("ram", {"io_waiting_time": 0.1}),
+        ("io_wait", {"cpu_time": 0.1}),
+        ("io_wait", {"necessary_ram": 10}),
+    ],
+)
+def test_mismatched_kind_operation_rejected(kind: str, operation: dict) -> None:
+    with pytest.raises(ValidationError):
+        Step(kind=kind, step_operation=operation)
+
+
+def test_empty_operation_rejected() -> None:
+    with pytest.raises(ValidationError):
+        Step(kind="initial_parsing", step_operation={})
+
+
+def test_multiple_operations_rejected() -> None:
+    with pytest.raises(ValidationError):
+        Step(
+            kind="initial_parsing",
+            step_operation={"cpu_time": 0.1, "io_waiting_time": 0.1},
+        )
+
+
+def test_non_positive_quantity_rejected() -> None:
+    with pytest.raises(ValidationError):
+        Step(kind="initial_parsing", step_operation={"cpu_time": 0.0})
+
+
+def test_unknown_kind_rejected() -> None:
+    with pytest.raises(ValidationError):
+        Step(kind="gpu_burn", step_operation={"cpu_time": 0.1})
+
+
+def test_endpoint_name_lowercased() -> None:
+    ep = Endpoint(
+        endpoint_name="/API",
+        steps=[Step(kind="initial_parsing", step_operation={"cpu_time": 0.1})],
+    )
+    assert ep.endpoint_name == "/api"

@@ -1,0 +1,88 @@
+"""Unit tests for event-injection schemas."""
+
+import pytest
+from pydantic import ValidationError
+
+from asyncflow_tpu.config.constants import EventDescription
+from asyncflow_tpu.schemas.events import End, EventInjection, Start
+
+
+def _outage(eid: str = "ev-1", t0: float = 1.0, t1: float = 2.0) -> EventInjection:
+    return EventInjection(
+        event_id=eid,
+        target_id="srv-1",
+        start=Start(kind=EventDescription.SERVER_DOWN, t_start=t0),
+        end=End(kind=EventDescription.SERVER_UP, t_end=t1),
+    )
+
+
+def _spike(
+    eid: str = "ev-1",
+    t0: float = 1.0,
+    t1: float = 2.0,
+    spike: float | None = 0.05,
+) -> EventInjection:
+    return EventInjection(
+        event_id=eid,
+        target_id="edge-1",
+        start=Start(
+            kind=EventDescription.NETWORK_SPIKE_START,
+            t_start=t0,
+            spike_s=spike,
+        ),
+        end=End(kind=EventDescription.NETWORK_SPIKE_END, t_end=t1),
+    )
+
+
+def test_valid_outage_and_spike() -> None:
+    assert _outage().start.kind == EventDescription.SERVER_DOWN
+    assert _spike().start.spike_s == 0.05
+
+
+def test_mismatched_start_end_kind_rejected() -> None:
+    with pytest.raises(ValidationError):
+        EventInjection(
+            event_id="ev",
+            target_id="srv-1",
+            start=Start(kind=EventDescription.SERVER_DOWN, t_start=0.0),
+            end=End(kind=EventDescription.NETWORK_SPIKE_END, t_end=1.0),
+        )
+
+
+def test_start_after_end_rejected() -> None:
+    with pytest.raises(ValidationError):
+        _outage(t0=2.0, t1=1.0)
+    with pytest.raises(ValidationError):
+        _outage(t0=2.0, t1=2.0)
+
+
+def test_spike_requires_spike_s() -> None:
+    with pytest.raises(ValidationError):
+        _spike(spike=None)
+
+
+def test_outage_forbids_spike_s() -> None:
+    with pytest.raises(ValidationError):
+        EventInjection(
+            event_id="ev",
+            target_id="srv-1",
+            start=Start(
+                kind=EventDescription.SERVER_DOWN,
+                t_start=0.0,
+                spike_s=0.1,
+            ),
+            end=End(kind=EventDescription.SERVER_UP, t_end=1.0),
+        )
+
+
+def test_markers_frozen_and_strict() -> None:
+    start = Start(kind=EventDescription.SERVER_DOWN, t_start=0.0)
+    with pytest.raises(ValidationError):
+        start.t_start = 5.0
+    with pytest.raises(ValidationError):
+        Start(kind=EventDescription.SERVER_DOWN, t_strat=0.0)
+
+
+def test_negative_start_rejected() -> None:
+    with pytest.raises(ValidationError):
+        Start(kind=EventDescription.SERVER_DOWN, t_start=-1.0)

@@ -1,0 +1,135 @@
+"""Unit tests for TopologyGraph consistency validators."""
+
+import pytest
+from pydantic import ValidationError
+
+from asyncflow_tpu.schemas.edges import Edge
+from asyncflow_tpu.schemas.graph import TopologyGraph
+from asyncflow_tpu.schemas.nodes import (
+    Client,
+    LoadBalancer,
+    Server,
+    ServerResources,
+    TopologyNodes,
+)
+from asyncflow_tpu.schemas.random_variables import RVConfig
+
+
+def _edge(eid: str, src: str, dst: str) -> Edge:
+    return Edge(
+        id=eid,
+        source=src,
+        target=dst,
+        latency=RVConfig(mean=0.01, distribution="exponential"),
+    )
+
+
+def _server(sid: str) -> Server:
+    return Server(id=sid, server_resources=ServerResources(), endpoints=[])
+
+
+def _nodes(*server_ids: str, lb: LoadBalancer | None = None) -> TopologyNodes:
+    return TopologyNodes(
+        servers=[_server(s) for s in server_ids],
+        client=Client(id="client-1"),
+        load_balancer=lb,
+    )
+
+
+def test_valid_minimal_graph() -> None:
+    graph = TopologyGraph(
+        nodes=_nodes("srv-1"),
+        edges=[
+            _edge("g-c", "rqs-1", "client-1"),
+            _edge("c-s", "client-1", "srv-1"),
+            _edge("s-c", "srv-1", "client-1"),
+        ],
+    )
+    assert graph.declared_node_ids() == {"srv-1", "client-1"}
+
+
+def test_duplicate_edge_ids_rejected() -> None:
+    with pytest.raises(ValidationError, match="multiple edges"):
+        TopologyGraph(
+            nodes=_nodes("srv-1"),
+            edges=[
+                _edge("dup", "client-1", "srv-1"),
+                _edge("dup", "srv-1", "client-1"),
+            ],
+        )
+
+
+def test_unknown_target_rejected() -> None:
+    with pytest.raises(ValidationError, match="unknown target"):
+        TopologyGraph(
+            nodes=_nodes("srv-1"),
+            edges=[_edge("e", "client-1", "ghost")],
+        )
+
+
+def test_external_source_as_target_rejected() -> None:
+    # The unknown-target rule already covers external ids appearing as targets.
+    with pytest.raises(ValidationError, match="unknown target"):
+        TopologyGraph(
+            nodes=_nodes("srv-1"),
+            edges=[
+                _edge("g-c", "rqs-1", "client-1"),
+                _edge("s-g", "srv-1", "rqs-1"),
+            ],
+        )
+
+
+def test_lb_covering_unknown_server_rejected() -> None:
+    lb = LoadBalancer(id="lb-1", server_covered={"srv-1", "ghost"})
+    with pytest.raises(ValidationError, match="unknown servers"):
+        TopologyGraph(
+            nodes=_nodes("srv-1", lb=lb),
+            edges=[
+                _edge("c-lb", "client-1", "lb-1"),
+                _edge("lb-s1", "lb-1", "srv-1"),
+                _edge("s1-c", "srv-1", "client-1"),
+            ],
+        )
+
+
+def test_lb_covered_server_without_edge_rejected() -> None:
+    lb = LoadBalancer(id="lb-1", server_covered={"srv-1", "srv-2"})
+    with pytest.raises(ValidationError, match="no outgoing edge"):
+        TopologyGraph(
+            nodes=_nodes("srv-1", "srv-2", lb=lb),
+            edges=[
+                _edge("c-lb", "client-1", "lb-1"),
+                _edge("lb-s1", "lb-1", "srv-1"),
+                _edge("s1-c", "srv-1", "client-1"),
+                _edge("s2-c", "srv-2", "client-1"),
+            ],
+        )
+
+
+def test_fanout_from_non_lb_rejected() -> None:
+    with pytest.raises(ValidationError, match="Only the load balancer"):
+        TopologyGraph(
+            nodes=_nodes("srv-1", "srv-2"),
+            edges=[
+                _edge("c-s1", "client-1", "srv-1"),
+                _edge("c-s2", "client-1", "srv-2"),
+                _edge("s1-c", "srv-1", "client-1"),
+                _edge("s2-c", "srv-2", "client-1"),
+            ],
+        )
+
+
+def test_lb_fanout_allowed() -> None:
+    lb = LoadBalancer(id="lb-1", server_covered={"srv-1", "srv-2"})
+    graph = TopologyGraph(
+        nodes=_nodes("srv-1", "srv-2", lb=lb),
+        edges=[
+            _edge("g-c", "rqs-1", "client-1"),
+            _edge("c-lb", "client-1", "lb-1"),
+            _edge("lb-s1", "lb-1", "srv-1"),
+            _edge("lb-s2", "lb-1", "srv-2"),
+            _edge("s1-c", "srv-1", "client-1"),
+            _edge("s2-c", "srv-2", "client-1"),
+        ],
+    )
+    assert len(graph.edges) == 6

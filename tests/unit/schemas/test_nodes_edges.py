@@ -1,0 +1,99 @@
+"""Unit tests for node and edge schemas."""
+
+import pytest
+from pydantic import ValidationError
+
+from asyncflow_tpu.config.constants import LbAlgorithmsName, SystemNodes
+from asyncflow_tpu.schemas.edges import Edge
+from asyncflow_tpu.schemas.nodes import (
+    Client,
+    LoadBalancer,
+    Server,
+    ServerResources,
+    TopologyNodes,
+)
+from asyncflow_tpu.schemas.random_variables import RVConfig
+
+
+def _server(sid: str = "srv-1") -> Server:
+    return Server(
+        id=sid,
+        server_resources=ServerResources(),
+        endpoints=[],
+    )
+
+
+class TestNodes:
+    def test_client_type_fixed(self) -> None:
+        assert Client(id="c").type == SystemNodes.CLIENT
+        with pytest.raises(ValidationError):
+            Client(id="c", type=SystemNodes.SERVER)
+
+    def test_server_resources_defaults(self) -> None:
+        res = ServerResources()
+        assert res.cpu_cores == 1
+        assert res.ram_mb == 1024
+        assert res.db_connection_pool is None
+
+    def test_server_resources_minima(self) -> None:
+        with pytest.raises(ValidationError):
+            ServerResources(cpu_cores=0)
+        with pytest.raises(ValidationError):
+            ServerResources(ram_mb=128)
+
+    def test_lb_defaults(self) -> None:
+        lb = LoadBalancer(id="lb-1")
+        assert lb.algorithms == LbAlgorithmsName.ROUND_ROBIN
+        assert lb.server_covered == set()
+
+    def test_duplicate_node_ids_rejected(self) -> None:
+        with pytest.raises(ValidationError):
+            TopologyNodes(
+                servers=[_server("x"), _server("x")],
+                client=Client(id="c"),
+            )
+        with pytest.raises(ValidationError):
+            TopologyNodes(servers=[_server("c")], client=Client(id="c"))
+
+    def test_extra_fields_forbidden(self) -> None:
+        with pytest.raises(ValidationError):
+            TopologyNodes(
+                servers=[_server()],
+                client=Client(id="c"),
+                router="nope",
+            )
+
+
+class TestEdges:
+    def _edge(self, **overrides) -> Edge:
+        base = {
+            "id": "e-1",
+            "source": "a",
+            "target": "b",
+            "latency": RVConfig(mean=0.01, distribution="exponential"),
+        }
+        base.update(overrides)
+        return Edge(**base)
+
+    def test_default_dropout(self) -> None:
+        assert self._edge().dropout_rate == 0.01
+
+    def test_dropout_bounds(self) -> None:
+        with pytest.raises(ValidationError):
+            self._edge(dropout_rate=-0.1)
+        with pytest.raises(ValidationError):
+            self._edge(dropout_rate=1.5)
+
+    def test_self_loop_rejected(self) -> None:
+        with pytest.raises(ValidationError):
+            self._edge(source="a", target="a")
+
+    def test_non_positive_latency_mean_rejected(self) -> None:
+        with pytest.raises(ValidationError):
+            self._edge(latency=RVConfig(mean=0.0, distribution="exponential"))
+
+    def test_negative_variance_rejected(self) -> None:
+        with pytest.raises(ValidationError):
+            self._edge(
+                latency=RVConfig(mean=1.0, distribution="normal", variance=-1.0),
+            )

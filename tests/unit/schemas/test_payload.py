@@ -1,0 +1,148 @@
+"""Unit tests for SimulationPayload cross-cutting validators."""
+
+import pytest
+from pydantic import ValidationError
+
+from asyncflow_tpu.config.constants import EventDescription
+from asyncflow_tpu.schemas.events import End, EventInjection, Start
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+
+def _outage(eid: str, target: str, t0: float, t1: float) -> EventInjection:
+    return EventInjection(
+        event_id=eid,
+        target_id=target,
+        start=Start(kind=EventDescription.SERVER_DOWN, t_start=t0),
+        end=End(kind=EventDescription.SERVER_UP, t_end=t1),
+    )
+
+
+def _spike(eid: str, target: str, t0: float, t1: float) -> EventInjection:
+    return EventInjection(
+        event_id=eid,
+        target_id=target,
+        start=Start(
+            kind=EventDescription.NETWORK_SPIKE_START,
+            t_start=t0,
+            spike_s=0.05,
+        ),
+        end=End(kind=EventDescription.NETWORK_SPIKE_END, t_end=t1),
+    )
+
+
+def _with_events(minimal_payload: SimulationPayload, events) -> SimulationPayload:
+    data = minimal_payload.model_dump()
+    data["events"] = [e.model_dump() for e in events]
+    return SimulationPayload.model_validate(data)
+
+
+def test_payload_without_events_valid(minimal_payload: SimulationPayload) -> None:
+    assert minimal_payload.events is None
+
+
+def test_valid_events_accepted(minimal_payload: SimulationPayload) -> None:
+    payload = _with_events(
+        minimal_payload,
+        [_spike("ev-1", "client-srv", 1.0, 5.0)],
+    )
+    assert payload.events is not None
+    assert len(payload.events) == 1
+
+
+def test_duplicate_event_ids_rejected(minimal_payload: SimulationPayload) -> None:
+    with pytest.raises(ValidationError, match="unique"):
+        _with_events(
+            minimal_payload,
+            [
+                _spike("ev-1", "client-srv", 1.0, 5.0),
+                _spike("ev-1", "srv-client", 2.0, 6.0),
+            ],
+        )
+
+
+def test_unknown_event_target_rejected(minimal_payload: SimulationPayload) -> None:
+    with pytest.raises(ValidationError, match="does not exist"):
+        _with_events(minimal_payload, [_spike("ev-1", "ghost-edge", 1.0, 5.0)])
+
+
+def test_event_outside_horizon_rejected(minimal_payload: SimulationPayload) -> None:
+    horizon = minimal_payload.sim_settings.total_simulation_time
+    with pytest.raises(ValidationError, match="horizon"):
+        _with_events(
+            minimal_payload,
+            [_spike("ev-1", "client-srv", 1.0, horizon + 10.0)],
+        )
+    with pytest.raises(ValidationError, match="horizon"):
+        _with_events(
+            minimal_payload,
+            [_spike("ev-1", "client-srv", horizon + 1.0, horizon + 2.0)],
+        )
+
+
+def test_server_event_on_edge_rejected(minimal_payload: SimulationPayload) -> None:
+    with pytest.raises(ValidationError):
+        _with_events(minimal_payload, [_outage("ev-1", "client-srv", 1.0, 5.0)])
+
+
+def test_spike_event_on_server_rejected(minimal_payload: SimulationPayload) -> None:
+    with pytest.raises(ValidationError):
+        _with_events(minimal_payload, [_spike("ev-1", "srv-1", 1.0, 5.0)])
+
+
+def test_all_servers_down_rejected(minimal_payload: SimulationPayload) -> None:
+    # single-server topology: any outage would take all servers down
+    with pytest.raises(ValidationError, match="all servers are down"):
+        _with_events(minimal_payload, [_outage("ev-1", "srv-1", 1.0, 5.0)])
+
+
+def test_overlapping_outages_rejected(minimal_payload: SimulationPayload) -> None:
+    # single-server topology: the all-down sweep fires first here
+    with pytest.raises(ValidationError):
+        _with_events(
+            minimal_payload,
+            [
+                _outage("ev-1", "srv-1", 1.0, 5.0),
+                _outage("ev-2", "srv-1", 3.0, 8.0),
+            ],
+        )
+
+
+def _add_second_server(minimal_payload: SimulationPayload) -> dict:
+    data = minimal_payload.model_dump()
+    srv2 = dict(data["topology_graph"]["nodes"]["servers"][0], id="srv-2")
+    data["topology_graph"]["nodes"]["servers"].append(srv2)
+    return data
+
+
+def test_overlapping_outages_rejected_two_servers(minimal_payload) -> None:
+    """With a second server up, the overlap validator itself must fire."""
+    data = _add_second_server(minimal_payload)
+    data["events"] = [
+        _outage("ev-1", "srv-1", 1.0, 5.0).model_dump(),
+        _outage("ev-2", "srv-1", 3.0, 8.0).model_dump(),
+    ]
+    with pytest.raises(ValidationError, match="must not overlap"):
+        SimulationPayload.model_validate(data)
+
+
+def test_spike_on_edge_named_like_server_not_an_outage(minimal_payload) -> None:
+    """An edge id colliding with a server id must not turn spikes into outages."""
+    data = minimal_payload.model_dump()
+    # rename an edge to collide with the (single) server id
+    data["topology_graph"]["edges"][1]["id"] = "srv-1"
+    data["events"] = [_spike("ev-1", "srv-1", 1.0, 5.0).model_dump()]
+    payload = SimulationPayload.model_validate(data)
+    assert payload.events is not None
+
+
+def test_back_to_back_outages_allowed_two_servers(minimal_payload) -> None:
+    """END at t and START at t on the same server is legal (END sorts first)."""
+    data = _add_second_server(minimal_payload)
+    # srv-2 unreachable by edges is fine for schema-level validation
+    data["events"] = [
+        _outage("ev-1", "srv-1", 1.0, 5.0).model_dump(),
+        _outage("ev-2", "srv-1", 5.0, 8.0).model_dump(),
+    ]
+    payload = SimulationPayload.model_validate(data)
+    assert payload.events is not None
+    assert len(payload.events) == 2

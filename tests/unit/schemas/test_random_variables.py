@@ -1,0 +1,49 @@
+"""Unit tests for RVConfig."""
+
+import pytest
+from pydantic import ValidationError
+
+from asyncflow_tpu.config.constants import Distribution
+from asyncflow_tpu.schemas.random_variables import RVConfig
+
+
+def test_default_distribution_is_poisson() -> None:
+    rv = RVConfig(mean=3.0)
+    assert rv.distribution == Distribution.POISSON
+    assert rv.variance is None
+
+
+@pytest.mark.parametrize("dist", [Distribution.NORMAL, Distribution.LOG_NORMAL])
+def test_variance_defaults_to_mean_when_needed(dist: Distribution) -> None:
+    rv = RVConfig(mean=5.0, distribution=dist)
+    assert rv.variance == 5.0
+
+
+@pytest.mark.parametrize(
+    "dist",
+    [Distribution.POISSON, Distribution.EXPONENTIAL, Distribution.UNIFORM],
+)
+def test_variance_stays_none_otherwise(dist: Distribution) -> None:
+    assert RVConfig(mean=5.0, distribution=dist).variance is None
+
+
+def test_explicit_variance_is_kept() -> None:
+    rv = RVConfig(mean=5.0, distribution=Distribution.NORMAL, variance=2.0)
+    assert rv.variance == 2.0
+
+
+@pytest.mark.parametrize("bad", ["three", None, [1], {"m": 1}, True])
+def test_non_numeric_mean_rejected(bad: object) -> None:
+    with pytest.raises(ValidationError):
+        RVConfig(mean=bad)
+
+
+def test_int_mean_coerced_to_float() -> None:
+    rv = RVConfig(mean=4)
+    assert isinstance(rv.mean, float)
+    assert rv.mean == 4.0
+
+
+def test_unknown_distribution_rejected() -> None:
+    with pytest.raises(ValidationError):
+        RVConfig(mean=1.0, distribution="zipf")
