@@ -1,0 +1,5 @@
+"""Curated public surface for post-run analysis."""
+
+from asyncflow_tpu.metrics.analyzer import ResultsAnalyzer
+
+__all__ = ["ResultsAnalyzer"]

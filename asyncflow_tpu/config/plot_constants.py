@@ -1,0 +1,49 @@
+"""Frozen styling for the built-in matplotlib charts.
+
+Mirrors the reference plot configuration surface
+(``/root/reference/src/asyncflow/config/plot_constants.py:6-47``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class PlotCfg:
+    """Static configuration of one chart."""
+
+    title: str
+    x_label: str
+    y_label: str
+    color: str = "tab:blue"
+    alpha: float = 0.85
+
+
+LATENCY_PLOT = PlotCfg(
+    title="Latency distribution",
+    x_label="Latency (s)",
+    y_label="Requests",
+    color="tab:blue",
+)
+
+THROUGHPUT_PLOT = PlotCfg(
+    title="Throughput (completed requests per window)",
+    x_label="Time (s)",
+    y_label="Requests / s",
+    color="tab:green",
+)
+
+SERVER_QUEUES_PLOT = PlotCfg(
+    title="Server event-loop queues",
+    x_label="Time (s)",
+    y_label="Queue length",
+    color="tab:orange",
+)
+
+RAM_PLOT = PlotCfg(
+    title="Server RAM in use",
+    x_label="Time (s)",
+    y_label="RAM (MB)",
+    color="tab:red",
+)

@@ -1,0 +1,5 @@
+"""Execution engines: the sequential CPU oracle and the batched JAX engine."""
+
+from asyncflow_tpu.engines.results import SimulationResults, SweepResults
+
+__all__ = ["SimulationResults", "SweepResults"]

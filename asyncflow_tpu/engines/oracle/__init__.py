@@ -1,0 +1,5 @@
+"""Sequential CPU discrete-event oracle engine."""
+
+from asyncflow_tpu.engines.oracle.engine import OracleEngine
+
+__all__ = ["OracleEngine"]

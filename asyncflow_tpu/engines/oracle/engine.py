@@ -1,0 +1,438 @@
+"""Sequential CPU discrete-event engine — the behavioral oracle.
+
+Re-derives the reference actor model (generator, edges, client, LB, servers,
+event injection, sampled-metric collector) on the kernel in
+:mod:`asyncflow_tpu.engines.oracle.kernel`.  Semantics cloned from the
+reference runtime (`/root/reference/src/asyncflow/runtime/`):
+
+- edges: dropout before anything else, latency + active spike at send time,
+  per-edge concurrent-connection gauge (`actors/edge.py:73-116`);
+- servers: RAM-first admission, lazy core lock across consecutive CPU steps,
+  core released on I/O, FIFO ready queue counting only core-waiters
+  (`actors/server.py:79-276`);
+- client: a request completes on its second client visit, recorded via the
+  hop history exactly like the reference's ``len(history) > 3`` protocol
+  (`actors/client.py:43-71`);
+- LB: round-robin rotates an ordered mapping, least-connections takes the
+  first minimum in rotation order; a down server's edge is removed and
+  re-inserted at the end of the rotation on revival
+  (`actors/routing/lb_algorithms.py:10-36`, `events/injection.py:201-226`);
+- spikes superpose over overlapping windows (`events/injection.py:167-198`);
+- one sampling coroutine snapshots gauges every ``sample_period_s``
+  (`metrics/collector.py:50-67`).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from asyncflow_tpu.config.constants import (
+    EventDescription,
+    LbAlgorithmsName,
+    SampledMetricName,
+    SystemEdges,
+    SystemNodes,
+)
+from asyncflow_tpu.engines.oracle.kernel import (
+    AcquireAmount,
+    AcquireToken,
+    FifoContainer,
+    FifoTokens,
+    Sim,
+    Timeout,
+)
+from asyncflow_tpu.engines.results import SimulationResults
+from asyncflow_tpu.samplers.arrivals import arrival_gaps
+from asyncflow_tpu.samplers.variates import sample_rv
+from asyncflow_tpu.schemas.edges import Edge
+from asyncflow_tpu.schemas.nodes import Server
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+
+@dataclass
+class Hop:
+    """A single traversal of a node or edge (per-request tracing)."""
+
+    component_type: str
+    component_id: str
+    timestamp: float
+
+
+@dataclass
+class Request:
+    """Mutable state carried by one request through the system."""
+
+    id: int
+    initial_time: float
+    finish_time: float | None = None
+    history: list[Hop] = field(default_factory=list)
+
+    def record_hop(self, kind: str, component_id: str, now: float) -> None:
+        self.history.append(Hop(kind, component_id, now))
+
+
+class _EdgeRuntime:
+    """Unidirectional link: dropout, stochastic latency, spike, delivery."""
+
+    def __init__(self, engine: OracleEngine, cfg: Edge) -> None:
+        self.engine = engine
+        self.cfg = cfg
+        self.concurrent = 0
+        self.series: list[float] = []
+        self.deliver_to = None  # set during wiring: callable(Request)
+
+    def transport(self, req: Request) -> None:
+        engine = self.engine
+        if engine.rng.uniform() < self.cfg.dropout_rate:
+            req.finish_time = engine.sim.now
+            req.record_hop(
+                SystemEdges.NETWORK_CONNECTION,
+                f"{self.cfg.id}-dropped",
+                engine.sim.now,
+            )
+            engine.total_dropped += 1
+            return
+
+        self.concurrent += 1
+        transit = sample_rv(self.cfg.latency, engine.rng)
+        transit += engine.edge_spike.get(self.cfg.id, 0.0)
+
+        def deliver() -> None:
+            req.record_hop(
+                SystemEdges.NETWORK_CONNECTION,
+                self.cfg.id,
+                engine.sim.now,
+            )
+            self.concurrent -= 1
+            assert self.deliver_to is not None
+            self.deliver_to(req)
+
+        engine.sim.after(transit, deliver)
+
+
+class _ServerRuntime:
+    """Event-loop server: RAM-first admission, lazy core lock, FIFO queues."""
+
+    def __init__(self, engine: OracleEngine, cfg: Server) -> None:
+        self.engine = engine
+        self.cfg = cfg
+        self.cpu = FifoTokens(engine.sim, cfg.server_resources.cpu_cores)
+        self.ram = FifoContainer(engine.sim, float(cfg.server_resources.ram_mb))
+        self.ready_queue_len = 0
+        self.io_queue_len = 0
+        self.ram_in_use = 0.0
+        self.out_edge: _EdgeRuntime | None = None
+        self.series: dict[SampledMetricName, list[float]] = {
+            SampledMetricName.READY_QUEUE_LEN: [],
+            SampledMetricName.EVENT_LOOP_IO_SLEEP: [],
+            SampledMetricName.RAM_IN_USE: [],
+        }
+
+    def receive(self, req: Request) -> None:
+        self.engine.sim.process(self._handle(req))
+
+    def _handle(self, req: Request):
+        engine = self.engine
+        req.record_hop(SystemNodes.SERVER, self.cfg.id, engine.sim.now)
+
+        endpoints = self.cfg.endpoints
+        endpoint = endpoints[int(engine.rng.integers(0, len(endpoints)))]
+        total_ram = sum(step.quantity for step in endpoint.steps if step.is_ram)
+
+        if total_ram:
+            yield AcquireAmount(self.ram, total_ram)
+            self.ram_in_use += total_ram
+
+        core_locked = False
+        in_io_queue = False
+        waiting_cpu = False
+
+        for step in endpoint.steps:
+            if step.is_cpu:
+                if in_io_queue:
+                    in_io_queue = False
+                    self.io_queue_len -= 1
+                if not core_locked:
+                    if self.cpu.would_block:
+                        waiting_cpu = True
+                        self.ready_queue_len += 1
+                    yield AcquireToken(self.cpu)
+                    if waiting_cpu:
+                        waiting_cpu = False
+                        self.ready_queue_len -= 1
+                    core_locked = True
+                yield Timeout(step.quantity)
+            elif step.is_io:
+                if core_locked:
+                    self.cpu.release()
+                    core_locked = False
+                    if not in_io_queue:
+                        in_io_queue = True
+                        self.io_queue_len += 1
+                elif not in_io_queue:
+                    in_io_queue = True
+                    self.io_queue_len += 1
+                yield Timeout(step.quantity)
+
+        if core_locked:
+            self.cpu.release()
+        if in_io_queue:
+            self.io_queue_len -= 1
+        if total_ram:
+            self.ram_in_use -= total_ram
+            self.ram.release(total_ram)
+
+        assert self.out_edge is not None
+        self.out_edge.transport(req)
+
+
+class OracleEngine:
+    """Builds and runs one scenario sequentially on the CPU."""
+
+    def __init__(self, payload: SimulationPayload, *, seed: int | None = None) -> None:
+        self.payload = payload
+        self.settings = payload.sim_settings
+        self.sim = Sim()
+        self.rng = np.random.default_rng(seed)
+
+        self.total_generated = 0
+        self.total_dropped = 0
+        self.rqs_clock: list[tuple[float, float]] = []
+        self.edge_spike: dict[str, float] = {}
+
+        graph = payload.topology_graph
+        self.servers = {
+            server.id: _ServerRuntime(self, server) for server in graph.nodes.servers
+        }
+        self.edges = {edge.id: _EdgeRuntime(self, edge) for edge in graph.edges}
+        self.client_id = graph.nodes.client.id
+        self.client_out: _EdgeRuntime | None = None
+        self.lb = graph.nodes.load_balancer
+        # rotation order of LB out-edges; mutated by routing and outages
+        self.lb_out_edges: OrderedDict[str, _EdgeRuntime] = OrderedDict()
+        self.generator_out: _EdgeRuntime | None = None
+
+        self._wire()
+
+    # ------------------------------------------------------------------
+    # build phase
+    # ------------------------------------------------------------------
+
+    def _wire(self) -> None:
+        graph = self.payload.topology_graph
+        lb_id = self.lb.id if self.lb is not None else None
+
+        for edge in graph.edges:
+            runtime = self.edges[edge.id]
+
+            if edge.target in self.servers:
+                runtime.deliver_to = self.servers[edge.target].receive
+            elif edge.target == self.client_id:
+                runtime.deliver_to = self._client_receive
+            elif edge.target == lb_id:
+                runtime.deliver_to = self._lb_receive
+            else:  # pragma: no cover - schema validation forbids this
+                msg = f"Unknown edge target {edge.target!r}"
+                raise ValueError(msg)
+
+            if edge.source == self.payload.rqs_input.id:
+                self.generator_out = runtime
+            elif edge.source == self.client_id:
+                self.client_out = runtime
+            elif edge.source == lb_id:
+                self.lb_out_edges[edge.id] = runtime
+            elif edge.source in self.servers:
+                self.servers[edge.source].out_edge = runtime
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+
+    def _generator_process(self):
+        assert self.generator_out is not None
+        for gap in arrival_gaps(
+            self.payload.rqs_input,
+            self.settings,
+            rng=self.rng,
+        ):
+            yield Timeout(gap)
+            self.total_generated += 1
+            req = Request(id=self.total_generated, initial_time=self.sim.now)
+            req.record_hop(
+                SystemNodes.GENERATOR,
+                self.payload.rqs_input.id,
+                self.sim.now,
+            )
+            self.generator_out.transport(req)
+
+    def _client_receive(self, req: Request) -> None:
+        req.record_hop(SystemNodes.CLIENT, self.client_id, self.sim.now)
+        # Second client visit == round trip done (reference hop-count protocol:
+        # generator + edge + first client visit leave exactly 3 hops).
+        if len(req.history) > 3:
+            req.finish_time = self.sim.now
+            self.rqs_clock.append((req.initial_time, req.finish_time))
+        else:
+            assert self.client_out is not None
+            self.client_out.transport(req)
+
+    def _lb_receive(self, req: Request) -> None:
+        assert self.lb is not None
+        req.record_hop(SystemNodes.LOAD_BALANCER, self.lb.id, self.sim.now)
+        if not self.lb_out_edges:
+            # Every covered server is down (possible when the LB covers a
+            # subset of the declared servers): the request has nowhere to go.
+            req.finish_time = self.sim.now
+            self.total_dropped += 1
+            return
+        out = self._pick_lb_edge()
+        out.transport(req)
+
+    def _pick_lb_edge(self) -> _EdgeRuntime:
+        assert self.lb is not None
+        edges = self.lb_out_edges
+        if self.lb.algorithms == LbAlgorithmsName.LEAST_CONNECTIONS:
+            best_id = min(edges, key=lambda eid: edges[eid].concurrent)
+            return edges[best_id]
+        # round robin: take the head, rotate it to the tail
+        head_id, head = next(iter(edges.items()))
+        edges.move_to_end(head_id)
+        return head
+
+    # ------------------------------------------------------------------
+    # event injection
+    # ------------------------------------------------------------------
+
+    def _schedule_events(self) -> None:
+        events = self.payload.events or []
+        server_ids = set(self.servers)
+        timeline: list[tuple[float, int, str, str, str, float]] = []
+        # mark order within identical timestamps: END (0) before START (1)
+        for event in events:
+            if event.target_id in server_ids and (
+                event.start.kind == EventDescription.SERVER_DOWN
+            ):
+                timeline.append(
+                    (event.start.t_start, 1, event.event_id, "server", event.target_id, 0.0),
+                )
+                timeline.append(
+                    (event.end.t_end, 0, event.event_id, "server", event.target_id, 0.0),
+                )
+            elif event.start.kind == EventDescription.NETWORK_SPIKE_START:
+                spike = float(event.start.spike_s or 0.0)
+                timeline.append(
+                    (event.start.t_start, 1, event.event_id, "edge", event.target_id, spike),
+                )
+                timeline.append(
+                    (event.end.t_end, 0, event.event_id, "edge", event.target_id, -spike),
+                )
+        timeline.sort(key=lambda entry: (entry[0], entry[1], entry[2], entry[4]))
+
+        server_to_lb_edge = {
+            runtime.cfg.target: (edge_id, runtime)
+            for edge_id, runtime in self.lb_out_edges.items()
+        }
+
+        for time, mark, _event_id, kind, target, delta in timeline:
+            if kind == "edge":
+                def apply_spike(edge_id: str = target, amount: float = delta) -> None:
+                    self.edge_spike[edge_id] = (
+                        self.edge_spike.get(edge_id, 0.0) + amount
+                    )
+
+                self.sim.at(time, apply_spike)
+            else:
+                is_down = mark == 1
+
+                def apply_outage(server_id: str = target, down: bool = is_down) -> None:
+                    info = server_to_lb_edge.get(server_id)
+                    if info is None:
+                        return
+                    edge_id, runtime = info
+                    if down:
+                        self.lb_out_edges.pop(edge_id, None)
+                    else:
+                        self.lb_out_edges[edge_id] = runtime
+                        self.lb_out_edges.move_to_end(edge_id)
+
+                self.sim.at(time, apply_outage)
+
+    # ------------------------------------------------------------------
+    # metric collection
+    # ------------------------------------------------------------------
+
+    def _schedule_collector(self) -> None:
+        period = self.settings.sample_period_s
+        enabled = self.settings.enabled_sample_metrics
+        sample_edges = SampledMetricName.EDGE_CONCURRENT_CONNECTION in enabled
+        sample_servers = {
+            SampledMetricName.READY_QUEUE_LEN,
+            SampledMetricName.EVENT_LOOP_IO_SLEEP,
+            SampledMetricName.RAM_IN_USE,
+        } <= enabled
+
+        def sample() -> None:
+            if sample_edges:
+                for edge in self.edges.values():
+                    edge.series.append(edge.concurrent)
+            if sample_servers:
+                for server in self.servers.values():
+                    server.series[SampledMetricName.RAM_IN_USE].append(
+                        server.ram_in_use,
+                    )
+                    server.series[SampledMetricName.EVENT_LOOP_IO_SLEEP].append(
+                        server.io_queue_len,
+                    )
+                    server.series[SampledMetricName.READY_QUEUE_LEN].append(
+                        server.ready_queue_len,
+                    )
+            self.sim.after(period, sample)
+
+        self.sim.after(period, sample)
+
+    # ------------------------------------------------------------------
+    # run
+    # ------------------------------------------------------------------
+
+    def run(self) -> SimulationResults:
+        """Execute the scenario and reduce to :class:`SimulationResults`."""
+        self._schedule_events()
+        self.sim.process(self._generator_process())
+        self._schedule_collector()
+        self.sim.run(until=float(self.settings.total_simulation_time))
+
+        sampled: dict[str, dict[str, np.ndarray]] = {}
+        enabled = self.settings.enabled_sample_metrics
+        if SampledMetricName.EDGE_CONCURRENT_CONNECTION in enabled:
+            sampled[SampledMetricName.EDGE_CONCURRENT_CONNECTION.value] = {
+                edge_id: np.asarray(edge.series, dtype=np.float64)
+                for edge_id, edge in self.edges.items()
+            }
+        for metric in (
+            SampledMetricName.READY_QUEUE_LEN,
+            SampledMetricName.EVENT_LOOP_IO_SLEEP,
+            SampledMetricName.RAM_IN_USE,
+        ):
+            if metric in enabled:
+                sampled[metric.value] = {
+                    server_id: np.asarray(server.series[metric], dtype=np.float64)
+                    for server_id, server in self.servers.items()
+                }
+
+        clock = (
+            np.asarray(self.rqs_clock, dtype=np.float64)
+            if self.rqs_clock
+            else np.empty((0, 2), dtype=np.float64)
+        )
+        return SimulationResults(
+            settings=self.settings,
+            rqs_clock=clock,
+            sampled=sampled,
+            total_generated=self.total_generated,
+            total_dropped=self.total_dropped,
+            server_ids=list(self.servers),
+            edge_ids=list(self.edges),
+        )

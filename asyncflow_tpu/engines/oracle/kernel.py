@@ -1,0 +1,167 @@
+"""Minimal discrete-event kernel for the oracle engine.
+
+A self-contained replacement for the SimPy machinery the reference builds on
+(`simpy.Environment` heap + coroutine processes + FIFO `Container`s, see
+`/root/reference/src/asyncflow/runtime/simulation_runner.py:369` and
+`resources/server_containers.py:34-70`): a binary-heap event loop, a
+generator-coroutine driver, and two FIFO resources.
+
+Processes are plain Python generators that yield *awaitables*:
+
+    yield Timeout(0.5)              # resume 0.5 simulated seconds later
+    yield AcquireToken(cpu)         # resume when one token is granted
+    yield AcquireAmount(ram, 128)   # resume when 128 units are granted
+
+Releases are synchronous (``tokens.release()``, ``container.release(x)``);
+woken waiters are scheduled at the current timestamp so ordering stays
+heap-driven and FIFO.
+"""
+
+from __future__ import annotations
+
+import heapq
+from collections import deque
+from collections.abc import Callable, Generator
+from dataclasses import dataclass
+from typing import Any
+
+Process = Generator["Awaitable", Any, None]
+
+
+class Sim:
+    """Binary-heap event loop: (time, seq) ordered callbacks."""
+
+    def __init__(self) -> None:
+        self.now: float = 0.0
+        self._heap: list[tuple[float, int, Callable[[], None]]] = []
+        self._seq: int = 0
+
+    def at(self, time: float, fn: Callable[[], None]) -> None:
+        """Schedule ``fn`` at absolute simulated ``time``."""
+        heapq.heappush(self._heap, (time, self._seq, fn))
+        self._seq += 1
+
+    def after(self, delay: float, fn: Callable[[], None]) -> None:
+        """Schedule ``fn`` ``delay`` seconds from now."""
+        self.at(self.now + delay, fn)
+
+    def run(self, until: float) -> None:
+        """Pop-and-call until the next event would be at ``time >= until``.
+
+        Events scheduled exactly at ``until`` are not executed, matching
+        SimPy's ``env.run(until=...)`` semantics the reference relies on.
+        """
+        while self._heap and self._heap[0][0] < until:
+            time, _, fn = heapq.heappop(self._heap)
+            self.now = time
+            fn()
+        self.now = until
+
+    # -- coroutine driver ---------------------------------------------------
+
+    def process(self, gen: Process) -> None:
+        """Start driving a generator process from its first yield."""
+
+        def step(value: Any = None) -> None:
+            try:
+                awaitable = gen.send(value)
+            except StopIteration:
+                return
+            awaitable.arrange(self, step)
+
+        step()
+
+
+@dataclass(frozen=True)
+class Timeout:
+    """Resume after a fixed simulated delay."""
+
+    delay: float
+
+    def arrange(self, sim: Sim, resume: Callable[[Any], None]) -> None:
+        sim.after(self.delay, resume)
+
+
+class FifoTokens:
+    """Counted tokens with a strict-FIFO wait queue (the CPU-core resource)."""
+
+    def __init__(self, sim: Sim, capacity: int) -> None:
+        self._sim = sim
+        self.capacity = capacity
+        self.available = capacity
+        self._waiters: deque[Callable[[Any], None]] = deque()
+
+    @property
+    def would_block(self) -> bool:
+        """True if an acquire issued right now could not be granted immediately."""
+        return self.available <= 0 or bool(self._waiters)
+
+    def _acquire(self, resume: Callable[[Any], None]) -> None:
+        if self.available > 0 and not self._waiters:
+            self.available -= 1
+            self._sim.at(self._sim.now, resume)
+        else:
+            self._waiters.append(resume)
+
+    def release(self) -> None:
+        """Return one token; the longest-waiting acquirer is granted first."""
+        if self._waiters:
+            resume = self._waiters.popleft()
+            self._sim.at(self._sim.now, resume)
+        else:
+            self.available = min(self.capacity, self.available + 1)
+
+
+@dataclass(frozen=True)
+class AcquireToken:
+    """Awaitable wrapper over :class:`FifoTokens`."""
+
+    tokens: FifoTokens
+
+    def arrange(self, sim: Sim, resume: Callable[[Any], None]) -> None:  # noqa: ARG002
+        self.tokens._acquire(resume)
+
+
+class FifoContainer:
+    """Continuous-level container with strict-FIFO, head-of-line blocking gets.
+
+    Mirrors the semantics of a pre-filled ``simpy.Container`` used for RAM in
+    the reference: a large request at the queue head blocks smaller later
+    requests even when they would fit.
+    """
+
+    def __init__(self, sim: Sim, capacity: float) -> None:
+        self._sim = sim
+        self.capacity = capacity
+        self.level = capacity
+        self._waiters: deque[tuple[float, Callable[[Any], None]]] = deque()
+
+    @property
+    def would_block(self) -> bool:
+        return bool(self._waiters)
+
+    def _acquire(self, amount: float, resume: Callable[[Any], None]) -> None:
+        if not self._waiters and self.level >= amount:
+            self.level -= amount
+            self._sim.at(self._sim.now, resume)
+        else:
+            self._waiters.append((amount, resume))
+
+    def release(self, amount: float) -> None:
+        """Return ``amount`` units and grant queued head-of-line requests."""
+        self.level = min(self.capacity, self.level + amount)
+        while self._waiters and self.level >= self._waiters[0][0]:
+            head_amount, resume = self._waiters.popleft()
+            self.level -= head_amount
+            self._sim.at(self._sim.now, resume)
+
+
+@dataclass(frozen=True)
+class AcquireAmount:
+    """Awaitable wrapper over :class:`FifoContainer`."""
+
+    container: FifoContainer
+    amount: float
+
+    def arrange(self, sim: Sim, resume: Callable[[Any], None]) -> None:  # noqa: ARG002
+        self.container._acquire(self.amount, resume)

@@ -1,0 +1,85 @@
+"""Engine-agnostic result containers.
+
+Both engines (oracle DES and the batched JAX engine) reduce to this common
+shape so the analyzer, plots, and parity tests are backend-blind.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from asyncflow_tpu.schemas.settings import SimulationSettings
+
+
+@dataclass
+class SimulationResults:
+    """Raw outputs of one simulated scenario."""
+
+    settings: SimulationSettings
+    #: (N, 2) float array of [start, finish] for each *completed* request.
+    rqs_clock: np.ndarray
+    #: metric name -> component id -> fixed-cadence series.
+    sampled: dict[str, dict[str, np.ndarray]]
+    #: requests emitted by the generator.
+    total_generated: int = 0
+    #: requests lost to edge dropout.
+    total_dropped: int = 0
+    #: requests lost because the engine's request pool was full (JAX engine
+    #: only; non-zero values mean the pool must be enlarged).
+    overflow_dropped: int = 0
+    #: server ids in topology order (stable ordering for accessors/plots).
+    server_ids: list[str] = field(default_factory=list)
+    #: edge ids in topology order.
+    edge_ids: list[str] = field(default_factory=list)
+
+    @property
+    def latencies(self) -> np.ndarray:
+        """Per-completed-request latency in seconds."""
+        if self.rqs_clock.size == 0:
+            return np.empty(0, dtype=np.float64)
+        return self.rqs_clock[:, 1] - self.rqs_clock[:, 0]
+
+
+@dataclass
+class SweepResults:
+    """Stacked outputs of a Monte-Carlo scenario sweep (JAX engine)."""
+
+    settings: SimulationSettings
+    #: (S,) completed-request counts per scenario.
+    completed: np.ndarray
+    #: (S, B) latency histogram counts per scenario (log-spaced bins).
+    latency_hist: np.ndarray
+    #: (B + 1,) shared histogram bin edges (seconds).
+    hist_edges: np.ndarray
+    #: (S,) sums of latency / squared latency for exact mean/std.
+    latency_sum: np.ndarray
+    latency_sumsq: np.ndarray
+    #: (S,) min / max latency per scenario.
+    latency_min: np.ndarray
+    latency_max: np.ndarray
+    #: (S, T) completions per 1-second window.
+    throughput: np.ndarray
+    #: (S,) generated / dropped / overflow counters.
+    total_generated: np.ndarray = field(default_factory=lambda: np.empty(0))
+    total_dropped: np.ndarray = field(default_factory=lambda: np.empty(0))
+    overflow_dropped: np.ndarray = field(default_factory=lambda: np.empty(0))
+
+    def percentile(self, q: float) -> np.ndarray:
+        """Per-scenario latency percentile estimated from the histograms."""
+        counts = self.latency_hist.astype(np.float64)
+        totals = counts.sum(axis=1, keepdims=True)
+        cdf = np.cumsum(counts, axis=1) / np.maximum(totals, 1.0)
+        # linear interpolation inside the first bin whose cdf crosses q
+        idx = np.argmax(cdf >= q / 100.0, axis=1)
+        lo = self.hist_edges[idx]
+        hi = self.hist_edges[idx + 1]
+        prev = np.take_along_axis(
+            np.pad(cdf, ((0, 0), (1, 0)))[:, :-1],
+            idx[:, None],
+            axis=1,
+        )[:, 0]
+        cur = np.take_along_axis(cdf, idx[:, None], axis=1)[:, 0]
+        frac = np.where(cur > prev, (q / 100.0 - prev) / (cur - prev), 0.0)
+        return lo + frac * (hi - lo)

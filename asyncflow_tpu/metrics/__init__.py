@@ -1,0 +1,5 @@
+"""Metric analysis layer."""
+
+from asyncflow_tpu.metrics.analyzer import ResultsAnalyzer
+
+__all__ = ["ResultsAnalyzer"]

@@ -1,0 +1,280 @@
+"""Post-run statistics and visualization.
+
+API mirrors the reference ``ResultsAnalyzer``
+(``/root/reference/src/asyncflow/metrics/analyzer.py:36-589``): the same
+accessor names (`get_latency_stats`, `format_latency_stats`,
+`get_throughput_series`, `get_sampled_metrics`, `get_metric_map`,
+`get_series`, `list_server_ids`) and the same stats/throughput semantics
+(1-second completion buckets scanned up to the horizon inclusive), but it
+consumes the engine-agnostic :class:`SimulationResults` instead of live actor
+objects, so both backends share it.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+from asyncflow_tpu.config.constants import LatencyKey, SampledMetricName
+from asyncflow_tpu.config.plot_constants import (
+    LATENCY_PLOT,
+    RAM_PLOT,
+    SERVER_QUEUES_PLOT,
+    THROUGHPUT_PLOT,
+    PlotCfg,
+)
+from asyncflow_tpu.engines.results import SimulationResults
+
+if TYPE_CHECKING:
+    from matplotlib.axes import Axes
+    from matplotlib.figure import Figure
+
+Series = tuple[list[float], list[float]]
+
+_STAT_ORDER = [
+    LatencyKey.TOTAL_REQUESTS,
+    LatencyKey.MEAN,
+    LatencyKey.MEDIAN,
+    LatencyKey.STD_DEV,
+    LatencyKey.P95,
+    LatencyKey.P99,
+    LatencyKey.MIN,
+    LatencyKey.MAX,
+]
+
+
+def _bucket_throughput(
+    finish_times: np.ndarray,
+    end_time: float,
+    window_s: float,
+) -> Series:
+    """Completions per window, one bucket per ``window_s`` up to the horizon.
+
+    Matches the reference scan (`analyzer.py:107-125`): bucket k covers
+    completions with ``finish <= (k+1) * window_s`` not counted earlier, and
+    buckets stop at the last window whose end is ``<= end_time``.
+    """
+    finished = np.sort(finish_times)
+    timestamps: list[float] = []
+    rps: list[float] = []
+    idx = 0
+    current_end = window_s
+    while current_end <= end_time:
+        count = 0
+        while idx < finished.size and finished[idx] <= current_end:
+            count += 1
+            idx += 1
+        timestamps.append(current_end)
+        rps.append(count / window_s)
+        current_end += window_s
+    return timestamps, rps
+
+
+class ResultsAnalyzer:
+    """Analyze and visualize the results of a completed simulation."""
+
+    _WINDOW_SIZE_S: float = 1.0
+
+    def __init__(self, results: SimulationResults) -> None:
+        self._results = results
+        self._settings = results.settings
+        self.latency_stats: dict[LatencyKey, float] | None = None
+        self.throughput_series: Series | None = None
+
+    # -- core ---------------------------------------------------------------
+
+    @property
+    def results(self) -> SimulationResults:
+        """The raw engine output backing this analyzer."""
+        return self._results
+
+    def process_all_metrics(self) -> None:
+        """Compute cached aggregates if not already done."""
+        if self.latency_stats is None:
+            latencies = self._results.latencies
+            if latencies.size:
+                self.latency_stats = {
+                    LatencyKey.TOTAL_REQUESTS: float(latencies.size),
+                    LatencyKey.MEAN: float(np.mean(latencies)),
+                    LatencyKey.MEDIAN: float(np.median(latencies)),
+                    LatencyKey.STD_DEV: float(np.std(latencies)),
+                    LatencyKey.P95: float(np.percentile(latencies, 95)),
+                    LatencyKey.P99: float(np.percentile(latencies, 99)),
+                    LatencyKey.MIN: float(np.min(latencies)),
+                    LatencyKey.MAX: float(np.max(latencies)),
+                }
+            else:
+                self.latency_stats = {}
+        if self.throughput_series is None:
+            self.throughput_series = _bucket_throughput(
+                self._finish_times(),
+                float(self._settings.total_simulation_time),
+                self._WINDOW_SIZE_S,
+            )
+
+    def _finish_times(self) -> np.ndarray:
+        clock = self._results.rqs_clock
+        return clock[:, 1] if clock.size else np.empty(0)
+
+    # -- accessors ----------------------------------------------------------
+
+    def list_server_ids(self) -> list[str]:
+        """Server ids in topology order."""
+        return list(self._results.server_ids)
+
+    def get_latency_stats(self) -> dict[LatencyKey, float]:
+        """Latency statistics keyed by :class:`LatencyKey`."""
+        self.process_all_metrics()
+        return self.latency_stats or {}
+
+    def format_latency_stats(self) -> str:
+        """Human-readable latency-stats block."""
+        stats = self.get_latency_stats()
+        if not stats:
+            return "Latency stats: (empty)"
+        lines = ["======== LATENCY STATS ========"]
+        lines += [
+            f"{key.name:<20} = {stats[key]:.6f}" for key in _STAT_ORDER if key in stats
+        ]
+        return "\n".join(lines)
+
+    def get_throughput_series(self, window_s: float | None = None) -> Series:
+        """(timestamps, requests/s); recomputed on the fly for custom windows."""
+        self.process_all_metrics()
+        if window_s is None or window_s == self._WINDOW_SIZE_S:
+            return self.throughput_series or ([], [])
+        return _bucket_throughput(
+            self._finish_times(),
+            float(self._settings.total_simulation_time),
+            float(window_s),
+        )
+
+    def get_sampled_metrics(self) -> dict[str, dict[str, np.ndarray]]:
+        """All sampled time series: metric -> component id -> values."""
+        return self._results.sampled
+
+    def get_metric_map(
+        self,
+        key: SampledMetricName | str,
+    ) -> dict[str, np.ndarray]:
+        """Series map for one metric; tolerant to enum or string keys."""
+        sampled = self._results.sampled
+        if isinstance(key, SampledMetricName):
+            key = key.value
+        return sampled.get(key, {})
+
+    def get_series(
+        self,
+        key: SampledMetricName | str,
+        entity_id: str,
+    ) -> tuple[list[float], np.ndarray]:
+        """(times, values) of one sampled metric for one component."""
+        values = self.get_metric_map(key).get(entity_id)
+        if values is None:
+            values = np.empty(0)
+        # reference labels sample k at k * period starting from zero
+        times = (np.arange(len(values)) * self._settings.sample_period_s).tolist()
+        return times, values
+
+    # -- plotting -----------------------------------------------------------
+
+    @staticmethod
+    def _styled_axis(ax: Axes, cfg: PlotCfg) -> None:
+        ax.set_title(cfg.title)
+        ax.set_xlabel(cfg.x_label)
+        ax.set_ylabel(cfg.y_label)
+        ax.grid(visible=True)
+
+    def plot_latency_distribution(self, ax: Axes, bins: int = 50) -> None:
+        """Histogram of completed-request latencies."""
+        latencies = self._results.latencies
+        cfg = LATENCY_PLOT
+        if latencies.size:
+            ax.hist(latencies, bins=bins, color=cfg.color, alpha=cfg.alpha)
+            stats = self.get_latency_stats()
+            for key, style in (
+                (LatencyKey.MEAN, "--"),
+                (LatencyKey.P95, ":"),
+                (LatencyKey.P99, "-."),
+            ):
+                ax.axvline(
+                    stats[key],
+                    linestyle=style,
+                    color="black",
+                    label=f"{key.name.lower()}={stats[key] * 1e3:.1f} ms",
+                )
+            ax.legend()
+        self._styled_axis(ax, cfg)
+
+    def plot_throughput(self, ax: Axes, window_s: float | None = None) -> None:
+        """Completed requests per second over time."""
+        times, values = self.get_throughput_series(window_s)
+        cfg = THROUGHPUT_PLOT
+        ax.plot(times, values, color=cfg.color, alpha=cfg.alpha)
+        self._styled_axis(ax, cfg)
+
+    def _plot_server_series(
+        self,
+        ax: Axes,
+        metric: SampledMetricName,
+        server_id: str,
+        cfg: PlotCfg,
+        label: str,
+    ) -> None:
+        times, values = self.get_series(metric, server_id)
+        ax.plot(times, values, color=cfg.color, alpha=cfg.alpha, label=label)
+        self._styled_axis(ax, cfg)
+        ax.legend()
+
+    def plot_single_server_ready_queue(self, ax: Axes, server_id: str) -> None:
+        """Ready-queue length for one server."""
+        self._plot_server_series(
+            ax,
+            SampledMetricName.READY_QUEUE_LEN,
+            server_id,
+            SERVER_QUEUES_PLOT,
+            f"{server_id} ready",
+        )
+
+    def plot_single_server_io_queue(self, ax: Axes, server_id: str) -> None:
+        """I/O-queue length for one server."""
+        self._plot_server_series(
+            ax,
+            SampledMetricName.EVENT_LOOP_IO_SLEEP,
+            server_id,
+            SERVER_QUEUES_PLOT,
+            f"{server_id} io",
+        )
+
+    def plot_single_server_ram(self, ax: Axes, server_id: str) -> None:
+        """RAM in use for one server."""
+        self._plot_server_series(
+            ax,
+            SampledMetricName.RAM_IN_USE,
+            server_id,
+            RAM_PLOT,
+            f"{server_id} ram",
+        )
+
+    def plot_base_dashboard(self) -> Figure:
+        """2x2 dashboard: latency, throughput, ready queues, RAM."""
+        import matplotlib.pyplot as plt
+
+        fig, axes = plt.subplots(2, 2, figsize=(12, 8))
+        self.plot_latency_distribution(axes[0][0])
+        self.plot_throughput(axes[0][1])
+        for server_id in self.list_server_ids():
+            times, values = self.get_series(
+                SampledMetricName.READY_QUEUE_LEN,
+                server_id,
+            )
+            axes[1][0].plot(times, values, label=server_id)
+            times, values = self.get_series(SampledMetricName.RAM_IN_USE, server_id)
+            axes[1][1].plot(times, values, label=server_id)
+        self._styled_axis(axes[1][0], SERVER_QUEUES_PLOT)
+        self._styled_axis(axes[1][1], RAM_PLOT)
+        axes[1][0].legend()
+        axes[1][1].legend()
+        fig.tight_layout()
+        return fig

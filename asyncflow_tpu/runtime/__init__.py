@@ -1,0 +1,5 @@
+"""Runner orchestration layer."""
+
+from asyncflow_tpu.runtime.runner import SimulationRunner
+
+__all__ = ["SimulationRunner"]

@@ -1,0 +1,64 @@
+"""Simulation runner: one front door over both engines.
+
+Mirrors the reference orchestration surface
+(``/root/reference/src/asyncflow/runtime/simulation_runner.py:49-398``) minus
+the SimPy environment argument: building/wiring happens inside the selected
+engine, and ``run()`` returns a :class:`ResultsAnalyzer` with the same
+accessor API.  The ``backend`` switch selects the sequential CPU oracle or
+the batched JAX engine (single scenario); Monte-Carlo sweeps live in
+:mod:`asyncflow_tpu.parallel.sweep`.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import yaml
+
+from asyncflow_tpu.config.constants import Backend
+from asyncflow_tpu.metrics.analyzer import ResultsAnalyzer
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+
+class SimulationRunner:
+    """Validate once, then build, run, and analyze one scenario."""
+
+    def __init__(
+        self,
+        *,
+        simulation_input: SimulationPayload,
+        backend: Backend | str = Backend.ORACLE,
+        seed: int | None = None,
+    ) -> None:
+        self.simulation_input = simulation_input
+        self.backend = Backend(backend)
+        self.seed = seed
+
+    def run(self) -> ResultsAnalyzer:
+        """Execute the scenario on the selected engine."""
+        if self.backend == Backend.ORACLE:
+            from asyncflow_tpu.engines.oracle.engine import OracleEngine
+
+            results = OracleEngine(self.simulation_input, seed=self.seed).run()
+        else:
+            try:
+                from asyncflow_tpu.engines.jaxsim.engine import run_single
+            except ImportError as exc:  # pragma: no cover - scaffolding guard
+                msg = "The JAX engine is not available in this build"
+                raise NotImplementedError(msg) from exc
+
+            results = run_single(self.simulation_input, seed=self.seed or 0)
+        return ResultsAnalyzer(results)
+
+    @classmethod
+    def from_yaml(
+        cls,
+        yaml_path: str | Path,
+        *,
+        backend: Backend | str = Backend.ORACLE,
+        seed: int | None = None,
+    ) -> SimulationRunner:
+        """Load, validate, and wrap a YAML scenario file."""
+        data = yaml.safe_load(Path(yaml_path).read_text())
+        payload = SimulationPayload.model_validate(data)
+        return cls(simulation_input=payload, backend=backend, seed=seed)

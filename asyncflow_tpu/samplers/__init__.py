@@ -1,0 +1,6 @@
+"""NumPy stochastic samplers used by the oracle engine and the compiler."""
+
+from asyncflow_tpu.samplers.arrivals import arrival_gaps, arrival_times
+from asyncflow_tpu.samplers.variates import sample_rv
+
+__all__ = ["arrival_gaps", "arrival_times", "sample_rv"]

@@ -1,0 +1,94 @@
+"""Compound arrival processes: windowed user draws x exponential gaps.
+
+One implementation covers both reference samplers
+(``/root/reference/src/asyncflow/samplers/poisson_poisson.py:20-82`` and
+``gaussian_poisson.py:23-94``), which differ only in how the active-user count
+``U`` is drawn each window:
+
+1. every ``user_sampling_window`` seconds draw ``U`` (Poisson or truncated
+   Gaussian),
+2. aggregate rate ``lam = U * rpm / 60`` requests/second,
+3. inside the window draw exponential gaps via inverse CDF,
+4. gaps crossing a window boundary jump to the boundary (no arrival),
+5. stop at the horizon.
+"""
+
+from __future__ import annotations
+
+import math
+from collections.abc import Generator
+
+import numpy as np
+
+from asyncflow_tpu.config.constants import Distribution, TimeDefaults
+from asyncflow_tpu.schemas.settings import SimulationSettings
+from asyncflow_tpu.schemas.workload import RqsGenerator
+
+_U_EPS = 1e-15
+
+
+def _draw_users(workload: RqsGenerator, rng: np.random.Generator) -> float:
+    users_rv = workload.avg_active_users
+    if users_rv.distribution == Distribution.NORMAL:
+        assert users_rv.variance is not None
+        return max(0.0, float(rng.normal(users_rv.mean, users_rv.variance)))
+    return float(rng.poisson(users_rv.mean))
+
+
+def arrival_gaps(
+    workload: RqsGenerator,
+    settings: SimulationSettings,
+    *,
+    rng: np.random.Generator,
+) -> Generator[float, None, None]:
+    """Yield inter-arrival gaps (seconds) of the compound process."""
+    horizon = float(settings.total_simulation_time)
+    window = float(workload.user_sampling_window)
+    rate_per_user = (
+        float(workload.avg_request_per_minute_per_user.mean) / TimeDefaults.MIN_TO_SEC
+    )
+
+    now = 0.0
+    window_end = 0.0
+    lam = 0.0
+
+    while now < horizon:
+        if now >= window_end:
+            window_end = now + window
+            lam = _draw_users(workload, rng) * rate_per_user
+
+        if lam <= 0.0:
+            now = window_end
+            continue
+
+        u_raw = max(float(rng.random()), _U_EPS)
+        gap = -math.log(1.0 - u_raw) / lam
+
+        if now + gap > horizon:
+            break
+        if now + gap >= window_end:
+            now = window_end
+            continue
+
+        now += gap
+        yield gap
+
+
+def arrival_times(
+    workload: RqsGenerator,
+    settings: SimulationSettings,
+    *,
+    rng: np.random.Generator,
+) -> np.ndarray:
+    """Absolute arrival timestamps over the whole horizon (vector form).
+
+    Simulated arrival time is the cumulative sum of *yielded* gaps only: the
+    sampler's internal window-boundary jumps advance its own clock but emit no
+    gap, exactly as the reference generator consumes the stream
+    (``/root/reference/src/asyncflow/runtime/actors/rqs_generator.py:106``).
+    """
+    gaps = np.fromiter(
+        arrival_gaps(workload, settings, rng=rng),
+        dtype=np.float64,
+    )
+    return np.cumsum(gaps)

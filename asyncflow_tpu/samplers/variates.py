@@ -1,0 +1,34 @@
+"""Single-draw samplers for the five supported distributions.
+
+Behavioral contract mirrors the reference dispatch
+(``/root/reference/src/asyncflow/samplers/common_helpers.py:49-89``):
+uniform is U(0,1) ignoring the mean; poisson returns integers; normal is
+truncated at zero; log-normal passes (mean, variance) straight through as the
+underlying normal's parameters.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from asyncflow_tpu.config.constants import Distribution
+from asyncflow_tpu.schemas.random_variables import RVConfig
+
+
+def sample_rv(rv: RVConfig, rng: np.random.Generator) -> float:
+    """Draw one sample from the distribution described by ``rv``."""
+    dist = rv.distribution
+    if dist == Distribution.UNIFORM:
+        return float(rng.random())
+    if dist == Distribution.POISSON:
+        return float(rng.poisson(rv.mean))
+    if dist == Distribution.EXPONENTIAL:
+        return float(rng.exponential(rv.mean))
+    if dist == Distribution.NORMAL:
+        assert rv.variance is not None
+        return max(0.0, float(rng.normal(rv.mean, rv.variance)))
+    if dist == Distribution.LOG_NORMAL:
+        assert rv.variance is not None
+        return float(rng.lognormal(rv.mean, rv.variance))
+    msg = f"Unsupported distribution: {dist}"
+    raise ValueError(msg)

@@ -1,0 +1,28 @@
+"""Shared factory for integration scenarios (YAML front door)."""
+
+from pathlib import Path
+
+import pytest
+
+from asyncflow_tpu.runtime.runner import SimulationRunner
+
+DATA_DIR = Path(__file__).parent / "data"
+
+
+@pytest.fixture
+def make_runner():
+    """Factory: scenario file name -> runner on the requested backend."""
+
+    def _make(
+        name: str,
+        *,
+        backend: str = "oracle",
+        seed: int | None = 1337,
+    ) -> SimulationRunner:
+        return SimulationRunner.from_yaml(
+            DATA_DIR / name,
+            backend=backend,
+            seed=seed,
+        )
+
+    return _make

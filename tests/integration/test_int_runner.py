@@ -1,0 +1,90 @@
+"""End-to-end integration runs through the real runner (oracle backend)."""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+import yaml
+from pydantic import ValidationError
+
+from asyncflow_tpu.config.constants import LatencyKey
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+pytestmark = pytest.mark.integration
+
+INVALID_DIR = Path(__file__).parent / "data" / "invalid"
+
+
+def test_single_server_end_to_end(make_runner) -> None:
+    analyzer = make_runner("single_server.yml").run()
+    stats = analyzer.get_latency_stats()
+    assert stats
+    assert stats[LatencyKey.TOTAL_REQUESTS] > 0
+    assert 0.0 < stats[LatencyKey.MEAN] < 1.0
+    assert stats[LatencyKey.P99] >= stats[LatencyKey.P95] >= stats[LatencyKey.MEDIAN]
+
+    times, rps = analyzer.get_throughput_series()
+    assert len(times) == 60
+    assert float(np.mean(rps)) > 0.0
+
+    sampled = analyzer.get_sampled_metrics()
+    assert set(sampled) == {
+        "edge_concurrent_connection",
+        "ready_queue_len",
+        "event_loop_io_sleep",
+        "ram_in_use",
+    }
+    assert analyzer.list_server_ids() == ["srv-1"]
+
+
+def test_lb_end_to_end(make_runner) -> None:
+    analyzer = make_runner("two_servers_lb.yml").run()
+    stats = analyzer.get_latency_stats()
+    assert stats[LatencyKey.TOTAL_REQUESTS] > 0
+    assert set(analyzer.list_server_ids()) == {"srv-1", "srv-2"}
+    cc = analyzer.get_metric_map("edge_concurrent_connection")
+    assert set(cc) == {
+        "gen-client",
+        "client-lb",
+        "lb-srv1",
+        "lb-srv2",
+        "srv1-client",
+        "srv2-client",
+    }
+
+
+def test_custom_throughput_window(make_runner) -> None:
+    analyzer = make_runner("single_server.yml").run()
+    t1, r1 = analyzer.get_throughput_series()
+    t5, r5 = analyzer.get_throughput_series(window_s=5.0)
+    assert len(t5) == 12
+    # total completions must agree between windows
+    assert np.isclose(np.sum(r1), np.sum(np.asarray(r5) * 5.0))
+
+
+def test_get_series_times(make_runner) -> None:
+    analyzer = make_runner("single_server.yml").run()
+    times, values = analyzer.get_series("ram_in_use", "srv-1")
+    assert len(times) == len(values)
+    assert times[0] == 0.0
+
+
+@pytest.mark.parametrize(
+    "name",
+    sorted(p.name for p in INVALID_DIR.glob("*.yml")),
+)
+def test_invalid_payloads_rejected(name: str) -> None:
+    data = yaml.safe_load((INVALID_DIR / name).read_text())
+    with pytest.raises(ValidationError):
+        SimulationPayload.model_validate(data)
+
+
+def test_dashboard_renders(tmp_path, make_runner) -> None:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    analyzer = make_runner("single_server.yml").run()
+    fig = analyzer.plot_base_dashboard()
+    out = tmp_path / "dashboard.png"
+    fig.savefig(out)
+    assert out.stat().st_size > 10_000

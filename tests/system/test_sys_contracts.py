@@ -1,0 +1,231 @@
+"""System tests: the seeded tolerance contracts from BASELINE.md.
+
+These reproduce the reference's de-facto behavioral baseline
+(`/root/reference/tests/system/`): mean-latency windows, throughput vs the
+nominal rate, round-robin balance, and event-impact differentials.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from asyncflow_tpu.builder import AsyncFlow
+from asyncflow_tpu.components import (
+    Client,
+    Edge,
+    Endpoint,
+    LoadBalancer,
+    Server,
+    ServerResources,
+    Step,
+)
+from asyncflow_tpu.config.constants import LatencyKey
+from asyncflow_tpu.runtime.runner import SimulationRunner
+from asyncflow_tpu.schemas.payload import SimulationPayload
+from asyncflow_tpu.settings import SimulationSettings
+from asyncflow_tpu.workload import RVConfig, RqsGenerator
+
+pytestmark = pytest.mark.system
+
+
+def _rel_diff(a: float, b: float) -> float:
+    return abs(a - b) / max(1e-9, (abs(a) + abs(b)) / 2.0)
+
+
+def _exp(mean: float) -> RVConfig:
+    return RVConfig(mean=mean, distribution="exponential")
+
+
+def _endpoint(cpu_s: float, ram_mb: int, io_s: float) -> Endpoint:
+    return Endpoint(
+        endpoint_name="/api",
+        steps=[
+            Step(kind="initial_parsing", step_operation={"cpu_time": cpu_s}),
+            Step(kind="ram", step_operation={"necessary_ram": ram_mb}),
+            Step(kind="io_wait", step_operation={"io_waiting_time": io_s}),
+        ],
+    )
+
+
+def _single_server_payload(horizon: int = 400) -> SimulationPayload:
+    return (
+        AsyncFlow()
+        .add_generator(
+            RqsGenerator(
+                id="rqs-1",
+                avg_active_users=RVConfig(mean=80),
+                avg_request_per_minute_per_user=RVConfig(mean=20),
+                user_sampling_window=60,
+            ),
+        )
+        .add_client(Client(id="client-1"))
+        .add_servers(
+            Server(
+                id="srv-1",
+                server_resources=ServerResources(cpu_cores=1, ram_mb=2048),
+                endpoints=[_endpoint(0.001, 64, 0.010)],
+            ),
+        )
+        .add_edges(
+            Edge(id="gen-client", source="rqs-1", target="client-1", latency=_exp(0.003)),
+            Edge(id="client-srv", source="client-1", target="srv-1", latency=_exp(0.002)),
+            Edge(id="srv-client", source="srv-1", target="client-1", latency=_exp(0.003)),
+        )
+        .add_simulation_settings(
+            SimulationSettings(total_simulation_time=horizon, sample_period_s=0.05),
+        )
+        .build_payload()
+    )
+
+
+def _lb_payload(horizon: int = 400) -> AsyncFlow:
+    flow = (
+        AsyncFlow()
+        .add_generator(
+            RqsGenerator(
+                id="rqs-1",
+                avg_active_users=RVConfig(mean=120),
+                avg_request_per_minute_per_user=RVConfig(mean=20),
+                user_sampling_window=60,
+            ),
+        )
+        .add_client(Client(id="client-1"))
+        .add_load_balancer(
+            LoadBalancer(
+                id="lb-1",
+                algorithms="round_robin",
+                server_covered={"srv-1", "srv-2"},
+            ),
+        )
+        .add_servers(
+            Server(
+                id="srv-1",
+                server_resources=ServerResources(cpu_cores=1, ram_mb=2048),
+                endpoints=[_endpoint(0.002, 128, 0.012)],
+            ),
+            Server(
+                id="srv-2",
+                server_resources=ServerResources(cpu_cores=1, ram_mb=2048),
+                endpoints=[_endpoint(0.002, 128, 0.012)],
+            ),
+        )
+        .add_edges(
+            Edge(id="gen-client", source="rqs-1", target="client-1", latency=_exp(0.003)),
+            Edge(id="client-lb", source="client-1", target="lb-1", latency=_exp(0.002)),
+            Edge(id="lb-srv1", source="lb-1", target="srv-1", latency=_exp(0.002)),
+            Edge(id="lb-srv2", source="lb-1", target="srv-2", latency=_exp(0.002)),
+            Edge(id="srv1-client", source="srv-1", target="client-1", latency=_exp(0.003)),
+            Edge(id="srv2-client", source="srv-2", target="client-1", latency=_exp(0.003)),
+        )
+        .add_simulation_settings(
+            SimulationSettings(total_simulation_time=horizon, sample_period_s=0.05),
+        )
+    )
+    return flow
+
+
+def test_system_single_server_contract() -> None:
+    """Mean latency in [0.015, 0.060] s; throughput within 35% of 26.7 rps."""
+    runner = SimulationRunner(simulation_input=_single_server_payload(), seed=1337)
+    analyzer = runner.run()
+
+    stats = analyzer.get_latency_stats()
+    assert stats
+    mean_latency = stats[LatencyKey.MEAN]
+    assert 0.015 <= mean_latency <= 0.060
+
+    _, rps = analyzer.get_throughput_series()
+    nominal = 80 * 20 / 60.0
+    assert abs(float(np.mean(rps)) - nominal) / nominal <= 0.35
+
+    sampled = analyzer.get_sampled_metrics()
+    assert np.max(sampled["ram_in_use"]["srv-1"]) > 0
+
+
+def test_system_lb_two_servers_contract() -> None:
+    """Mean latency in [0.020, 0.060] s; throughput within 30% of 40 rps;
+    round-robin balance within 25% on edge concurrency and RAM means."""
+    payload = _lb_payload().build_payload()
+    analyzer = SimulationRunner(simulation_input=payload, seed=4242).run()
+
+    stats = analyzer.get_latency_stats()
+    mean_latency = stats[LatencyKey.MEAN]
+    assert 0.020 <= mean_latency <= 0.060
+
+    _, rps = analyzer.get_throughput_series()
+    nominal = 120 * 20 / 60.0
+    assert abs(float(np.mean(rps)) - nominal) / nominal <= 0.30
+
+    sampled = analyzer.get_sampled_metrics()
+    cc = sampled["edge_concurrent_connection"]
+    assert _rel_diff(float(np.mean(cc["lb-srv1"])), float(np.mean(cc["lb-srv2"]))) <= 0.25
+    ram = sampled["ram_in_use"]
+    assert _rel_diff(float(np.mean(ram["srv-1"])), float(np.mean(ram["srv-2"]))) <= 0.25
+    assert set(analyzer.list_server_ids()) == {"srv-1", "srv-2"}
+
+
+def test_system_event_impact_contract() -> None:
+    """+50ms spike on lb->srv-1 (t in [2,12]) plus srv-2 outage (t in [5,20]):
+    mean latency rises by >= 3ms and throughput stays in [30%, 125%] of the
+    no-event baseline."""
+    horizon = 60
+    baseline = SimulationRunner(
+        simulation_input=_lb_payload(horizon).build_payload(),
+        seed=7778,
+    ).run()
+
+    flow = _lb_payload(horizon)
+    flow.add_network_spike(
+        event_id="spike-1",
+        edge_id="lb-srv1",
+        t_start=2.0,
+        t_end=12.0,
+        spike_s=0.050,
+    )
+    flow.add_server_outage(
+        event_id="outage-1",
+        server_id="srv-2",
+        t_start=5.0,
+        t_end=20.0,
+    )
+    with_events = SimulationRunner(
+        simulation_input=flow.build_payload(),
+        seed=7778,
+    ).run()
+
+    base_mean = baseline.get_latency_stats()[LatencyKey.MEAN]
+    event_mean = with_events.get_latency_stats()[LatencyKey.MEAN]
+    assert event_mean >= base_mean + 0.003
+
+    _, base_rps = baseline.get_throughput_series()
+    _, event_rps = with_events.get_throughput_series()
+    ratio = float(np.mean(event_rps)) / float(np.mean(base_rps))
+    assert 0.30 <= ratio <= 1.25
+
+
+def test_system_single_server_spike_contract() -> None:
+    """Single-server spike: mean latency >= 1.02x the no-event baseline."""
+    horizon = 60
+    base_payload = _single_server_payload(horizon)
+    baseline = SimulationRunner(simulation_input=base_payload, seed=555).run()
+
+    data = base_payload.model_dump()
+    data["events"] = [
+        {
+            "event_id": "spike-1",
+            "target_id": "client-srv",
+            "start": {
+                "kind": "network_spike_start",
+                "t_start": 5.0,
+                "spike_s": 0.040,
+            },
+            "end": {"kind": "network_spike_end", "t_end": 45.0},
+        },
+    ]
+    spiked_payload = SimulationPayload.model_validate(data)
+    spiked = SimulationRunner(simulation_input=spiked_payload, seed=555).run()
+
+    base_mean = baseline.get_latency_stats()[LatencyKey.MEAN]
+    spike_mean = spiked.get_latency_stats()[LatencyKey.MEAN]
+    assert spike_mean >= 1.02 * base_mean

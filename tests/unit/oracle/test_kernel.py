@@ -1,0 +1,118 @@
+"""Unit tests for the oracle DES kernel primitives."""
+
+from asyncflow_tpu.engines.oracle.kernel import (
+    AcquireAmount,
+    AcquireToken,
+    FifoContainer,
+    FifoTokens,
+    Sim,
+    Timeout,
+)
+
+
+def test_heap_ordering_and_until_exclusive() -> None:
+    sim = Sim()
+    seen: list[tuple[float, str]] = []
+    sim.at(2.0, lambda: seen.append((sim.now, "b")))
+    sim.at(1.0, lambda: seen.append((sim.now, "a")))
+    sim.at(5.0, lambda: seen.append((sim.now, "never")))
+    sim.run(until=5.0)
+    assert seen == [(1.0, "a"), (2.0, "b")]
+    assert sim.now == 5.0
+
+
+def test_same_time_fifo_order() -> None:
+    sim = Sim()
+    seen: list[str] = []
+    sim.at(1.0, lambda: seen.append("first"))
+    sim.at(1.0, lambda: seen.append("second"))
+    sim.run(until=2.0)
+    assert seen == ["first", "second"]
+
+
+def test_process_timeout_chain() -> None:
+    sim = Sim()
+    marks: list[float] = []
+
+    def proc():
+        yield Timeout(1.0)
+        marks.append(sim.now)
+        yield Timeout(2.5)
+        marks.append(sim.now)
+
+    sim.process(proc())
+    sim.run(until=10.0)
+    assert marks == [1.0, 3.5]
+
+
+def test_tokens_fifo_wakeup_order() -> None:
+    sim = Sim()
+    tokens = FifoTokens(sim, capacity=1)
+    order: list[str] = []
+
+    def proc(name: str, hold: float):
+        yield AcquireToken(tokens)
+        order.append(f"{name}@{sim.now}")
+        yield Timeout(hold)
+        tokens.release()
+
+    sim.process(proc("p1", 1.0))
+    sim.process(proc("p2", 1.0))
+    sim.process(proc("p3", 1.0))
+    sim.run(until=10.0)
+    assert order == ["p1@0.0", "p2@1.0", "p3@2.0"]
+
+
+def test_tokens_would_block() -> None:
+    sim = Sim()
+    tokens = FifoTokens(sim, capacity=2)
+    assert not tokens.would_block
+
+    def hold():
+        yield AcquireToken(tokens)
+        yield Timeout(5.0)
+        tokens.release()
+
+    sim.process(hold())
+    sim.process(hold())
+    sim.run(until=1.0)
+    assert tokens.would_block
+
+
+def test_container_head_of_line_blocking() -> None:
+    """A large waiting request blocks later smaller ones (strict FIFO)."""
+    sim = Sim()
+    ram = FifoContainer(sim, capacity=100.0)
+    granted: list[str] = []
+
+    def taker(name: str, amount: float, hold: float):
+        yield AcquireAmount(ram, amount)
+        granted.append(f"{name}@{sim.now}")
+        yield Timeout(hold)
+        ram.release(amount)
+
+    sim.process(taker("big0", 80.0, 4.0))     # holds 80 until t=4
+    sim.process(taker("big1", 50.0, 1.0))     # blocks (only 20 free)
+    sim.process(taker("small", 10.0, 1.0))    # would fit, must wait behind big1
+    sim.run(until=20.0)
+    assert granted == ["big0@0.0", "big1@4.0", "small@4.0"]
+    assert ram.level == 100.0
+
+
+def test_container_multiple_grants_on_release() -> None:
+    sim = Sim()
+    ram = FifoContainer(sim, capacity=100.0)
+    granted: list[str] = []
+
+    def taker(name: str, amount: float, hold: float):
+        yield AcquireAmount(ram, amount)
+        granted.append(name)
+        yield Timeout(hold)
+        ram.release(amount)
+
+    sim.process(taker("a", 100.0, 2.0))
+    sim.process(taker("b", 40.0, 10.0))
+    sim.process(taker("c", 40.0, 10.0))
+    sim.run(until=3.0)
+    # releasing 100 at t=2 must grant both b and c
+    assert granted == ["a", "b", "c"]

@@ -1,0 +1,158 @@
+"""Unit tests for LB routing and event injection in the oracle engine."""
+
+import numpy as np
+import pytest
+
+from asyncflow_tpu.engines.oracle.engine import OracleEngine
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+
+def _lb_payload(algorithm: str = "round_robin", horizon: int = 40) -> SimulationPayload:
+    def server(sid: str) -> dict:
+        return {
+            "id": sid,
+            "server_resources": {"cpu_cores": 1, "ram_mb": 1024},
+            "endpoints": [
+                {
+                    "endpoint_name": "/api",
+                    "steps": [
+                        {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.001}},
+                        {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.01}},
+                    ],
+                },
+            ],
+        }
+
+    def edge(eid: str, src: str, dst: str) -> dict:
+        return {
+            "id": eid,
+            "source": src,
+            "target": dst,
+            "latency": {"mean": 0.002, "distribution": "exponential"},
+            "dropout_rate": 0.0,
+        }
+
+    return SimulationPayload.model_validate(
+        {
+            "rqs_input": {
+                "id": "rqs-1",
+                "avg_active_users": {"mean": 60},
+                "avg_request_per_minute_per_user": {"mean": 20},
+                "user_sampling_window": 60,
+            },
+            "topology_graph": {
+                "nodes": {
+                    "client": {"id": "client-1"},
+                    "load_balancer": {
+                        "id": "lb-1",
+                        "algorithms": algorithm,
+                        "server_covered": ["srv-1", "srv-2"],
+                    },
+                    "servers": [server("srv-1"), server("srv-2")],
+                },
+                "edges": [
+                    edge("gen-client", "rqs-1", "client-1"),
+                    edge("client-lb", "client-1", "lb-1"),
+                    edge("lb-srv1", "lb-1", "srv-1"),
+                    edge("lb-srv2", "lb-1", "srv-2"),
+                    edge("srv1-client", "srv-1", "client-1"),
+                    edge("srv2-client", "srv-2", "client-1"),
+                ],
+            },
+            "sim_settings": {
+                "total_simulation_time": horizon,
+                "sample_period_s": 0.01,
+            },
+        },
+    )
+
+
+def test_round_robin_balances_identical_servers() -> None:
+    payload = _lb_payload("round_robin")
+    results = OracleEngine(payload, seed=21).run()
+    cc = results.sampled["edge_concurrent_connection"]
+    m1 = float(np.mean(cc["lb-srv1"]))
+    m2 = float(np.mean(cc["lb-srv2"]))
+    assert m1 > 0 and m2 > 0
+    assert abs(m1 - m2) / ((m1 + m2) / 2) < 0.25
+
+
+def test_least_connection_prefers_first_edge_on_ties() -> None:
+    """Reference-faithful tie behavior: `min` picks the first edge in order,
+    so with short transits (mostly-idle edges) traffic skews heavily to the
+    first LB edge (`/root/reference/src/asyncflow/runtime/actors/routing/
+    lb_algorithms.py:10-20`)."""
+    payload = _lb_payload("least_connection")
+    results = OracleEngine(payload, seed=21).run()
+    cc = results.sampled["edge_concurrent_connection"]
+    m1 = float(np.mean(cc["lb-srv1"]))
+    m2 = float(np.mean(cc["lb-srv2"]))
+    assert m1 > m2
+
+
+def test_outage_redirects_traffic() -> None:
+    payload = _lb_payload()
+    data = payload.model_dump()
+    data["events"] = [
+        {
+            "event_id": "ev-1",
+            "target_id": "srv-2",
+            "start": {"kind": "server_down", "t_start": 0.0},
+            "end": {"kind": "server_up", "t_end": 40.0},
+        },
+    ]
+    payload = SimulationPayload.model_validate(data)
+    results = OracleEngine(payload, seed=23).run()
+    ram2 = results.sampled["ram_in_use"]["srv-2"]
+    cc = results.sampled["edge_concurrent_connection"]
+    # srv-2 receives nothing for the whole run
+    assert float(np.max(cc["lb-srv2"])) == 0.0
+    assert float(np.max(ram2)) == 0.0
+    assert float(np.mean(cc["lb-srv1"])) > 0.0
+    # system still completes requests through srv-1
+    assert results.rqs_clock.shape[0] > 0
+
+
+def test_outage_window_recovers() -> None:
+    payload = _lb_payload(horizon=60)
+    data = payload.model_dump()
+    data["events"] = [
+        {
+            "event_id": "ev-1",
+            "target_id": "srv-2",
+            "start": {"kind": "server_down", "t_start": 10.0},
+            "end": {"kind": "server_up", "t_end": 30.0},
+        },
+    ]
+    payload = SimulationPayload.model_validate(data)
+    results = OracleEngine(payload, seed=29).run()
+    cc2 = results.sampled["edge_concurrent_connection"]["lb-srv2"]
+    period = payload.sim_settings.sample_period_s
+    # samples land at k*period starting after one period
+    during = cc2[int(12 / period) : int(28 / period)]
+    after = cc2[int(32 / period) :]
+    assert float(np.max(during)) == 0.0
+    assert float(np.max(after)) > 0.0
+
+
+def test_spike_superposition_raises_latency() -> None:
+    payload = _lb_payload(horizon=30)
+    data = payload.model_dump()
+    data["events"] = [
+        {
+            "event_id": f"ev-{i}",
+            "target_id": "client-lb",
+            "start": {
+                "kind": "network_spike_start",
+                "t_start": 5.0,
+                "spike_s": 0.05,
+            },
+            "end": {"kind": "network_spike_end", "t_end": 25.0},
+        }
+        for i in range(2)
+    ]
+    payload = SimulationPayload.model_validate(data)
+    base = OracleEngine(_lb_payload(horizon=30), seed=31).run()
+    spiked = OracleEngine(payload, seed=31).run()
+    # two superposed 50ms spikes: mean latency up by roughly 100ms * active share
+    assert float(np.mean(spiked.latencies)) > float(np.mean(base.latencies)) + 0.05

@@ -1,0 +1,122 @@
+"""Unit tests for server semantics through the oracle engine.
+
+Mirrors the reference's stub-actor technique
+(`/root/reference/tests/unit/runtime/actors/test_server.py`): tiny scenarios
+with deterministic pieces isolate one behavior at a time.
+"""
+
+import numpy as np
+
+from asyncflow_tpu.engines.oracle.engine import OracleEngine
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+
+def _payload(minimal_payload: SimulationPayload, **server_overrides) -> SimulationPayload:
+    data = minimal_payload.model_dump()
+    server = data["topology_graph"]["nodes"]["servers"][0]
+    server.update(server_overrides)
+    return SimulationPayload.model_validate(data)
+
+
+def _zero_dropout(data: dict) -> None:
+    for edge in data["topology_graph"]["edges"]:
+        edge["dropout_rate"] = 0.0
+
+
+def test_single_server_latency_composition(minimal_payload) -> None:
+    """Latency ~= edge delays + cpu + io under light load."""
+    engine = OracleEngine(minimal_payload, seed=7)
+    results = engine.run()
+    assert results.total_generated > 0
+    lat = results.latencies
+    assert lat.size > 0
+    # cpu 1ms + io 10ms + 3 exponential edges with 3ms mean each ≈ 20ms
+    assert 0.011 < float(np.mean(lat)) < 0.045
+    # no latency below the deterministic service floor
+    assert float(np.min(lat)) >= 0.011
+
+
+def test_cpu_contention_grows_ready_queue(minimal_payload) -> None:
+    """A cpu-bound endpoint at saturation must show ready-queue > 0 samples."""
+    data = minimal_payload.model_dump()
+    _zero_dropout(data)
+    server = data["topology_graph"]["nodes"]["servers"][0]
+    server["endpoints"] = [
+        {
+            "endpoint_name": "cpu-heavy",
+            "steps": [
+                {"kind": "cpu_bound_operation", "step_operation": {"cpu_time": 0.08}},
+            ],
+        },
+    ]
+    data["rqs_input"]["avg_active_users"]["mean"] = 60  # ~20 rps vs 12.5 capacity
+    payload = SimulationPayload.model_validate(data)
+    results = OracleEngine(payload, seed=3).run()
+    ready = results.sampled["ready_queue_len"]["srv-1"]
+    assert float(np.max(ready)) >= 1.0
+    # saturated single core: io queue must stay empty (no io steps)
+    io = results.sampled["event_loop_io_sleep"]["srv-1"]
+    assert float(np.max(io)) == 0.0
+
+
+def test_ram_blocking_limits_concurrency(minimal_payload) -> None:
+    """RAM capacity caps concurrent in-server requests."""
+    data = minimal_payload.model_dump()
+    _zero_dropout(data)
+    server = data["topology_graph"]["nodes"]["servers"][0]
+    server["server_resources"]["ram_mb"] = 256  # only 2 x 100MB fit
+    server["endpoints"] = [
+        {
+            "endpoint_name": "ram-heavy",
+            "steps": [
+                {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.0001}},
+                {"kind": "ram", "step_operation": {"necessary_ram": 100}},
+                {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.05}},
+            ],
+        },
+    ]
+    data["rqs_input"]["avg_active_users"]["mean"] = 200
+    payload = SimulationPayload.model_validate(data)
+    results = OracleEngine(payload, seed=11).run()
+    ram = results.sampled["ram_in_use"]["srv-1"]
+    assert float(np.max(ram)) <= 200.0  # never above two concurrent working sets
+    assert float(np.max(ram)) > 0.0
+
+
+def test_io_queue_counts_sleeping_requests(minimal_payload) -> None:
+    """Long io with fast cpu: io queue sees many concurrent sleepers."""
+    data = minimal_payload.model_dump()
+    _zero_dropout(data)
+    data["rqs_input"]["avg_active_users"]["mean"] = 100
+    server = data["topology_graph"]["nodes"]["servers"][0]
+    server["endpoints"][0]["steps"] = [
+        {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.0001}},
+        {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.2}},
+    ]
+    payload = SimulationPayload.model_validate(data)
+    results = OracleEngine(payload, seed=5).run()
+    io = results.sampled["event_loop_io_sleep"]["srv-1"]
+    # ~33 rps * 0.2 s io ≈ 6-7 concurrent sleepers on average
+    assert float(np.mean(io)) > 2.0
+
+
+def test_dropout_excludes_requests_from_clock(minimal_payload) -> None:
+    data = minimal_payload.model_dump()
+    for edge in data["topology_graph"]["edges"]:
+        edge["dropout_rate"] = 0.5
+    payload = SimulationPayload.model_validate(data)
+    results = OracleEngine(payload, seed=13).run()
+    assert results.total_dropped > 0
+    # completions + drops cannot exceed generated (some still in flight at T)
+    assert results.rqs_clock.shape[0] + results.total_dropped <= results.total_generated
+    # with 50% dropout on each of 3 hops, completions << generated
+    assert results.rqs_clock.shape[0] < results.total_generated * 0.3
+
+
+def test_full_dropout_completes_nothing(minimal_payload) -> None:
+    data = minimal_payload.model_dump()
+    data["topology_graph"]["edges"][0]["dropout_rate"] = 1.0
+    payload = SimulationPayload.model_validate(data)
+    results = OracleEngine(payload, seed=17).run()
+    assert results.rqs_clock.shape[0] == 0
+    assert results.total_dropped == results.total_generated
