@@ -1,0 +1,5 @@
+"""Payload -> StaticPlan lowering for the batched engine."""
+
+from asyncflow_tpu.compiler.plan import StaticPlan, compile_payload
+
+__all__ = ["StaticPlan", "compile_payload"]

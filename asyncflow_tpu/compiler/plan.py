@@ -1,0 +1,432 @@
+"""Compile a validated payload into a dense, static execution plan.
+
+This layer is new relative to the reference (which wires actors dynamically in
+``simulation_runner.py:205-296``): everything the batched engine needs is
+lowered to fixed-shape NumPy arrays once, so the JAX engine jits a single
+next-event kernel over them.
+
+Lowering decisions:
+
+- **Endpoint programs become alternating CPU/IO segments.**  The reference's
+  lazy core lock keeps the core across consecutive CPU steps and releases it
+  on I/O (``actors/server.py:199-255``), so merging runs of CPU steps (and
+  runs of I/O steps) into single segments is semantics-preserving.  RAM steps
+  contribute to an up-front working-set total (RAM-first admission,
+  ``server.py:147-149``).
+- **The pre-server path is a static edge chain.**  From the generator the
+  route is deterministic until the first LB or server, so the spawn event can
+  walk it in one shot.  After each server the single out-edge leads to a
+  server, the LB, or the client (second client visit = completion).
+- **Network spikes become a breakpoint table** (piecewise-constant cumulative
+  spike per edge, superposition included) consulted with ``searchsorted`` at
+  send time — no runtime events needed.  Server outages remain true timeline
+  events because they mutate the LB rotation order
+  (``events/injection.py:201-226``).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from asyncflow_tpu.config.constants import (
+    Distribution,
+    EventDescription,
+    LbAlgorithmsName,
+)
+from asyncflow_tpu.schemas.endpoint import Endpoint
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+# segment kinds
+SEG_END = 0
+SEG_CPU = 1
+SEG_IO = 2
+
+# node kinds a hop can land on
+TARGET_SERVER = 1
+TARGET_LB = 2
+TARGET_CLIENT = 3
+
+_DIST_IDS = {
+    Distribution.UNIFORM: 0,
+    Distribution.POISSON: 1,
+    Distribution.EXPONENTIAL: 2,
+    Distribution.NORMAL: 3,
+    Distribution.LOG_NORMAL: 4,
+}
+
+
+def _compile_endpoint(endpoint: Endpoint) -> tuple[list[tuple[int, float]], float]:
+    """Merge step runs into alternating (kind, duration) segments + RAM total."""
+    segments: list[tuple[int, float]] = []
+    total_ram = 0.0
+    for step in endpoint.steps:
+        if step.is_ram:
+            total_ram += step.quantity
+            continue
+        kind = SEG_CPU if step.is_cpu else SEG_IO
+        if segments and segments[-1][0] == kind:
+            segments[-1] = (kind, segments[-1][1] + step.quantity)
+        else:
+            segments.append((kind, step.quantity))
+    return segments, total_ram
+
+
+@dataclass
+class StaticPlan:
+    """Dense arrays describing one scenario family for the batched engine."""
+
+    # ---- sizes ----
+    n_servers: int
+    n_edges: int
+    n_lb_edges: int
+    max_endpoints: int
+    max_segments: int
+
+    # ---- edges ----
+    edge_dist: np.ndarray  # (NE,) i32
+    edge_mean: np.ndarray  # (NE,) f32
+    edge_var: np.ndarray  # (NE,) f32 (0 when unused)
+    edge_dropout: np.ndarray  # (NE,) f32
+
+    # ---- entry chain: generator -> ... -> first stateful node ----
+    entry_edges: np.ndarray  # (K,) i32
+    entry_target_kind: int  # TARGET_LB or TARGET_SERVER
+    entry_target: int  # server index when TARGET_SERVER else -1
+
+    # ---- servers ----
+    server_cores: np.ndarray  # (NS,) i32
+    server_ram: np.ndarray  # (NS,) f32
+    n_endpoints: np.ndarray  # (NS,) i32
+    seg_kind: np.ndarray  # (NS, NEP, NSEG+1) i32 (END-terminated)
+    seg_dur: np.ndarray  # (NS, NEP, NSEG+1) f32
+    endpoint_ram: np.ndarray  # (NS, NEP) f32
+    exit_edge: np.ndarray  # (NS,) i32
+    exit_kind: np.ndarray  # (NS,) i32 (TARGET_*)
+    exit_target: np.ndarray  # (NS,) i32 (server idx when TARGET_SERVER)
+
+    # ---- load balancer ----
+    lb_algo: int  # 0 = round robin, 1 = least connections
+    lb_edge_index: np.ndarray  # (EL,) i32 edge index per LB slot
+    lb_target: np.ndarray  # (EL,) i32 server index per LB slot
+
+    # ---- event injection ----
+    # spike breakpoints: cumulative spike per edge on [t_k, t_{k+1})
+    spike_times: np.ndarray  # (NB,) f32, spike_times[0] == 0
+    spike_values: np.ndarray  # (NB, NE) f32
+    # outage timeline (END before START on ties)
+    timeline_times: np.ndarray  # (NTL,) f32
+    timeline_down: np.ndarray  # (NTL,) i32 (1 = down, 0 = up)
+    timeline_slot: np.ndarray  # (NTL,) i32 LB slot affected (-1 none)
+
+    # ---- workload ----
+    user_mean: float
+    user_var: float  # < 0 => Poisson users, else truncated-Gaussian variance
+    user_window: float
+    req_per_user_per_sec: float
+
+    # ---- run geometry ----
+    horizon: float
+    sample_period: float
+    n_samples: int
+    max_requests: int
+    pool_size: int
+    max_iterations: int
+
+    # ---- id maps (for reporting) ----
+    server_ids: list[str] = field(default_factory=list)
+    edge_ids: list[str] = field(default_factory=list)
+
+    @property
+    def n_gauges(self) -> int:
+        """Gauge layout: [edge conns | ready | io | ram] per component."""
+        return self.n_edges + 3 * self.n_servers
+
+
+def _estimate_capacity(payload: SimulationPayload) -> tuple[int, int]:
+    """(max_requests, pool_size) estimates.
+
+    The pool must hold every concurrently-live request, including queue
+    backlog when a server resource saturates.  We bound backlog by a fluid
+    model: sustained overload accumulates ``(rate - capacity) * horizon``
+    waiting requests, and bursty user re-draws add a transient term over one
+    sampling window.  Overflow is still possible in pathological scenarios —
+    the engine counts and surfaces it (``overflow_dropped``) rather than
+    silently skewing percentiles.
+    """
+    workload = payload.rqs_input
+    settings = payload.sim_settings
+    users = float(workload.avg_active_users.mean)
+    rate = users * float(workload.avg_request_per_minute_per_user.mean) / 60.0
+    horizon = float(settings.total_simulation_time)
+    window = float(workload.user_sampling_window)
+    expected = rate * horizon
+    max_requests = int(expected + 6.0 * math.sqrt(max(expected, 1.0)) + 64)
+
+    # ~3-sigma burst of the windowed user draw
+    burst_rate = rate * (1.0 + 3.0 / math.sqrt(max(users, 1.0)))
+
+    residence_max = 0.0
+    backlog = 0.0
+    burst_backlog = 0.0
+    for server in payload.topology_graph.nodes.servers:
+        cpu_req = 0.0
+        io_req = 0.0
+        ram_req = 0.0
+        for endpoint in server.endpoints:
+            segs, ram = _compile_endpoint(endpoint)
+            cpu_req = max(
+                cpu_req,
+                sum(dur for kind, dur in segs if kind == SEG_CPU),
+            )
+            io_req = max(io_req, sum(dur for kind, dur in segs if kind == SEG_IO))
+            ram_req = max(ram_req, ram)
+        residence = cpu_req + io_req
+        residence_max = max(residence_max, residence)
+        capacity = math.inf
+        if cpu_req > 0:
+            capacity = min(capacity, server.server_resources.cpu_cores / cpu_req)
+        if ram_req > 0 and residence > 0:
+            concurrent = server.server_resources.ram_mb / ram_req
+            capacity = min(capacity, concurrent / residence)
+        if capacity < math.inf:
+            backlog += max(0.0, rate - capacity) * horizon
+            burst_backlog += max(0.0, burst_rate - capacity) * min(window, horizon)
+
+    # spikes park in-flight requests on an edge, and their release floods the
+    # downstream queue: budget rate x (max concurrent spike) per edge, twice
+    spike_delay = 0.0
+    for event in payload.events or []:
+        if event.start.spike_s is not None:
+            spike_delay += float(event.start.spike_s)
+
+    edge_delay = sum(edge.latency.mean for edge in payload.topology_graph.edges)
+    in_flight = rate * (residence_max + edge_delay + 2.0 * spike_delay)
+    want = 4.0 * in_flight + 1.5 * (backlog + burst_backlog) + 64.0
+    pool = int(2 ** math.ceil(math.log2(max(64.0, want))))
+    return max_requests, min(pool, 32768)
+
+
+def compile_payload(
+    payload: SimulationPayload,
+    *,
+    pool_size: int | None = None,
+) -> StaticPlan:
+    """Lower a validated payload to a :class:`StaticPlan`."""
+    graph = payload.topology_graph
+    settings = payload.sim_settings
+    servers = graph.nodes.servers
+    edges = graph.edges
+    client_id = graph.nodes.client.id
+    lb = graph.nodes.load_balancer
+    lb_id = lb.id if lb is not None else None
+
+    server_index = {server.id: i for i, server in enumerate(servers)}
+    edge_index = {edge.id: i for i, edge in enumerate(edges)}
+    n_servers, n_edges = len(servers), len(edges)
+
+    # ---- edges ----
+    edge_dist = np.array(
+        [_DIST_IDS[edge.latency.distribution] for edge in edges],
+        dtype=np.int32,
+    )
+    edge_mean = np.array([edge.latency.mean for edge in edges], dtype=np.float32)
+    edge_var = np.array(
+        [edge.latency.variance or 0.0 for edge in edges],
+        dtype=np.float32,
+    )
+    edge_dropout = np.array([edge.dropout_rate for edge in edges], dtype=np.float32)
+
+    # ---- walk maps ----
+    def _target_of(node_id: str) -> tuple[int, int]:
+        if node_id in server_index:
+            return TARGET_SERVER, server_index[node_id]
+        if node_id == lb_id:
+            return TARGET_LB, -1
+        if node_id == client_id:
+            return TARGET_CLIENT, -1
+        msg = f"unroutable node {node_id!r}"
+        raise ValueError(msg)
+
+    out_edge_of: dict[str, int] = {}
+    for edge in edges:
+        if edge.source != lb_id:
+            out_edge_of[edge.source] = edge_index[edge.id]
+
+    # entry chain: generator -> (client ->)* first LB/server
+    entry_edges: list[int] = []
+    cursor = payload.rqs_input.id
+    kind, target = TARGET_CLIENT, -1
+    for _ in range(n_edges + 1):
+        if cursor not in out_edge_of:
+            msg = f"node {cursor!r} has no outgoing edge on the entry path"
+            raise ValueError(msg)
+        eidx = out_edge_of[cursor]
+        entry_edges.append(eidx)
+        next_id = edges[eidx].target
+        kind, target = _target_of(next_id)
+        if kind in (TARGET_LB, TARGET_SERVER):
+            break
+        cursor = next_id
+    else:  # pragma: no cover - graph validators prevent cycles here
+        msg = "entry path does not reach a server or load balancer"
+        raise ValueError(msg)
+
+    # ---- servers ----
+    max_endpoints = max(len(server.endpoints) for server in servers)
+    compiled: list[list[tuple[list[tuple[int, float]], float]]] = [
+        [_compile_endpoint(ep) for ep in server.endpoints] for server in servers
+    ]
+    max_segments = max(
+        (len(segs) for per_server in compiled for segs, _ in per_server),
+        default=0,
+    )
+
+    seg_kind = np.zeros((n_servers, max_endpoints, max_segments + 1), dtype=np.int32)
+    seg_dur = np.zeros((n_servers, max_endpoints, max_segments + 1), dtype=np.float32)
+    endpoint_ram = np.zeros((n_servers, max_endpoints), dtype=np.float32)
+    n_endpoints = np.zeros(n_servers, dtype=np.int32)
+    for s, per_server in enumerate(compiled):
+        n_endpoints[s] = len(per_server)
+        for e, (segs, ram) in enumerate(per_server):
+            endpoint_ram[s, e] = ram
+            for k, (seg_k, dur) in enumerate(segs):
+                seg_kind[s, e, k] = seg_k
+                seg_dur[s, e, k] = dur
+
+    server_cores = np.array(
+        [server.server_resources.cpu_cores for server in servers],
+        dtype=np.int32,
+    )
+    server_ram = np.array(
+        [server.server_resources.ram_mb for server in servers],
+        dtype=np.float32,
+    )
+
+    exit_edge = np.full(n_servers, -1, dtype=np.int32)
+    exit_kind = np.full(n_servers, TARGET_CLIENT, dtype=np.int32)
+    exit_target = np.full(n_servers, -1, dtype=np.int32)
+    for server in servers:
+        s = server_index[server.id]
+        if server.id not in out_edge_of:
+            msg = f"server {server.id!r} has no outgoing edge"
+            raise ValueError(msg)
+        eidx = out_edge_of[server.id]
+        exit_edge[s] = eidx
+        kind_s, target_s = _target_of(edges[eidx].target)
+        exit_kind[s] = kind_s
+        exit_target[s] = target_s
+
+    # ---- LB ----
+    lb_slots = [edge_index[e.id] for e in edges if lb_id is not None and e.source == lb_id]
+    lb_edge_index = np.array(lb_slots, dtype=np.int32)
+    lb_target = np.array(
+        [server_index[edges[eidx].target] for eidx in lb_slots],
+        dtype=np.int32,
+    )
+    lb_algo = (
+        1
+        if lb is not None and lb.algorithms == LbAlgorithmsName.LEAST_CONNECTIONS
+        else 0
+    )
+
+    # ---- events ----
+    spikes: list[tuple[float, float, int]] = []  # (time, delta, edge)
+    outages: list[tuple[float, int, int, int]] = []  # (time, start_mark, down, slot)
+    lb_slot_of_server = {
+        int(lb_target[slot]): slot for slot in range(len(lb_slots))
+    }
+    for event in payload.events or []:
+        if event.start.kind == EventDescription.NETWORK_SPIKE_START:
+            eidx = edge_index[event.target_id]
+            spike = float(event.start.spike_s or 0.0)
+            spikes.append((event.start.t_start, spike, eidx))
+            spikes.append((event.end.t_end, -spike, eidx))
+        else:
+            sidx = server_index[event.target_id]
+            slot = lb_slot_of_server.get(sidx, -1)
+            outages.append((event.start.t_start, 1, 1, slot))
+            outages.append((event.end.t_end, 0, 0, slot))
+
+    # spike breakpoints (cumulative, superposed)
+    change_times = sorted({0.0} | {t for t, _, _ in spikes})
+    spike_times = np.array(change_times, dtype=np.float32)
+    spike_values = np.zeros((len(change_times), n_edges), dtype=np.float32)
+    time_pos = {t: i for i, t in enumerate(change_times)}
+    deltas = np.zeros((len(change_times), n_edges), dtype=np.float32)
+    for t, delta, eidx in spikes:
+        deltas[time_pos[t], eidx] += delta
+    spike_values = np.cumsum(deltas, axis=0).astype(np.float32)
+
+    # outage timeline, END (up) before START (down) on ties
+    outages.sort(key=lambda entry: (entry[0], entry[1]))
+    timeline_times = np.array([t for t, _, _, _ in outages], dtype=np.float32)
+    timeline_down = np.array([down for _, _, down, _ in outages], dtype=np.int32)
+    timeline_slot = np.array([slot for _, _, _, slot in outages], dtype=np.int32)
+
+    # ---- capacities ----
+    max_requests, pool_estimate = _estimate_capacity(payload)
+    pool = pool_size or pool_estimate
+    events_per_request = (
+        2 * (len(entry_edges) + 2)  # spawn + entry hops + lb + exits
+        + 3 * (max_segments + 1)  # segment starts/ends + grants
+        + 4
+    )
+    max_iterations = max_requests * events_per_request + len(outages) + 1024
+
+    horizon = float(settings.total_simulation_time)
+    sample_period = float(settings.sample_period_s)
+    n_samples = max(0, math.ceil(round(horizon / sample_period, 9)) - 1)
+
+    return StaticPlan(
+        n_servers=n_servers,
+        n_edges=n_edges,
+        n_lb_edges=len(lb_slots),
+        max_endpoints=max_endpoints,
+        max_segments=max_segments,
+        edge_dist=edge_dist,
+        edge_mean=edge_mean,
+        edge_var=edge_var,
+        edge_dropout=edge_dropout,
+        entry_edges=np.array(entry_edges, dtype=np.int32),
+        entry_target_kind=kind,
+        entry_target=target,
+        server_cores=server_cores,
+        server_ram=server_ram,
+        n_endpoints=n_endpoints,
+        seg_kind=seg_kind,
+        seg_dur=seg_dur,
+        endpoint_ram=endpoint_ram,
+        exit_edge=exit_edge,
+        exit_kind=exit_kind,
+        exit_target=exit_target,
+        lb_algo=lb_algo,
+        lb_edge_index=lb_edge_index,
+        lb_target=lb_target,
+        spike_times=spike_times,
+        spike_values=spike_values,
+        timeline_times=timeline_times,
+        timeline_down=timeline_down,
+        timeline_slot=timeline_slot,
+        user_mean=float(payload.rqs_input.avg_active_users.mean),
+        user_var=(
+            float(payload.rqs_input.avg_active_users.variance)
+            if payload.rqs_input.avg_active_users.distribution == Distribution.NORMAL
+            and payload.rqs_input.avg_active_users.variance is not None
+            else -1.0
+        ),
+        user_window=float(payload.rqs_input.user_sampling_window),
+        req_per_user_per_sec=(
+            float(payload.rqs_input.avg_request_per_minute_per_user.mean) / 60.0
+        ),
+        horizon=horizon,
+        sample_period=sample_period,
+        n_samples=n_samples,
+        max_requests=max_requests,
+        pool_size=pool,
+        max_iterations=max_iterations,
+        server_ids=[server.id for server in servers],
+        edge_ids=[edge.id for edge in edges],
+    )

@@ -1,0 +1,5 @@
+"""Batched JAX/XLA next-event engine."""
+
+from asyncflow_tpu.engines.jaxsim.engine import Engine, run_single, scenario_keys
+
+__all__ = ["Engine", "run_single", "scenario_keys"]

@@ -1,0 +1,869 @@
+"""Batched next-event simulation engine on JAX/XLA.
+
+This is the TPU-native replacement for the reference's SimPy coroutine loop
+(`/root/reference/src/asyncflow/runtime/simulation_runner.py:369`): instead of
+one Python heap per scenario, every scenario's state lives in fixed-shape
+arrays and a single `lax.while_loop` advances each scenario to its next event;
+`jax.vmap` over the scenario axis turns Monte-Carlo sweeps into one compiled
+kernel.
+
+Engine shape (see SURVEY.md §7):
+
+- **Requests are pool slots.**  A slot carries (next-event time, event code,
+  server, endpoint, segment, ram, FIFO ticket, start time, LB slot).  The
+  next event of a scenario is the min over slot times, the next arrival, and
+  the next outage-timeline entry.
+- **One event per iteration, predicated updates.**  Every mutation is masked
+  by its (disjoint) branch predicate, so the loop body is pure vector code —
+  exactly what `vmap` wants.  Zero-time cascades (resource grants) fold into
+  the releasing event, keeping iterations at ~6-9 per completed request.
+- **Randomness is counter-based.**  Every draw derives from
+  `fold_in(scenario_key, iteration)` — no RNG state beyond the loop counter.
+  Parity with the oracle is distributional, not bit-exact (SURVEY.md §7).
+- **Metrics are reconstructed, not collected.**  Gauges (queue lengths, RAM,
+  edge concurrency) are scatter-added as deltas at transition times into
+  per-sample-tick buckets and cumsum-ed post-run — the reference's collector
+  coroutine (`metrics/collector.py:50-67`) becomes a single post-pass.
+  Latencies go to a log-histogram + exact moments (sweeps) or an exact clock
+  table (single runs / parity tests).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from asyncflow_tpu.compiler.plan import (
+    SEG_CPU,
+    SEG_END,
+    SEG_IO,
+    TARGET_CLIENT,
+    TARGET_LB,
+    TARGET_SERVER,
+    StaticPlan,
+    compile_payload,
+)
+from asyncflow_tpu.config.constants import SampledMetricName
+from asyncflow_tpu.engines.results import SimulationResults, SweepResults
+from asyncflow_tpu.schemas.payload import SimulationPayload
+from asyncflow_tpu.engines.jaxsim.params import (
+    EV_ARRIVE_LB,
+    EV_ARRIVE_SRV,
+    EV_IDLE,
+    EV_RESUME,
+    EV_SEG_END,
+    EV_WAIT_CPU,
+    EV_WAIT_RAM,
+    INF,
+    NO_TICKET,
+    EngineState,
+    ScenarioOverrides,
+    base_overrides,
+    params_from_plan,
+)
+
+# distribution ids (compiler order)
+_D_UNIFORM, _D_POISSON, _D_EXPONENTIAL, _D_NORMAL, _D_LOGNORMAL = range(5)
+
+_TINY = 1e-15
+
+
+class Engine:
+    """One compiled batched engine for one :class:`StaticPlan`.
+
+    Static configuration (pool size, metric modes, bin counts) is baked into
+    the jitted kernel; per-scenario randomness and parameter overrides flow in
+    as arguments.
+    """
+
+    def __init__(
+        self,
+        plan: StaticPlan,
+        *,
+        collect_gauges: bool = False,
+        collect_clocks: bool = False,
+        n_hist_bins: int = 1024,
+        pool_size: int | None = None,
+        max_requests: int | None = None,
+    ) -> None:
+        self.plan = plan
+        self.collect_gauges = collect_gauges
+        self.collect_clocks = collect_clocks
+        self.n_hist_bins = n_hist_bins
+        self.pool = pool_size or plan.pool_size
+        self.max_requests = max_requests or plan.max_requests
+        self.params = params_from_plan(plan)
+        self.hist_lo = float(np.log(1e-4))
+        self.hist_scale = float(n_hist_bins / (np.log(1e3) - np.log(1e-4)))
+        self.n_thr = int(np.ceil(plan.horizon)) or 1
+        self._dists_present = sorted(set(plan.edge_dist.tolist()))
+        self._compiled: dict = {}
+
+    # ==================================================================
+    # small helpers
+    # ==================================================================
+
+    def _bucket(self, t):
+        """Sample-tick bucket: a delta at ``t`` affects samples at ticks >= t."""
+        b = jnp.ceil(t / self.plan.sample_period).astype(jnp.int32)
+        return jnp.clip(b, 0, self.plan.n_samples + 1)
+
+    def _g_edge(self, e):
+        return e
+
+    def _g_ready(self, s):
+        return self.plan.n_edges + s
+
+    def _g_io(self, s):
+        return self.plan.n_edges + self.plan.n_servers + s
+
+    def _g_ram(self, s):
+        return self.plan.n_edges + 2 * self.plan.n_servers + s
+
+    def _spike(self, edge, t):
+        if len(self.plan.spike_times) == 1:
+            return jnp.float32(0.0)
+        idx = (
+            jnp.searchsorted(self.params.spike_times, t, side="right").astype(jnp.int32)
+            - 1
+        )
+        return self.params.spike_values[idx, edge]
+
+    def _sample_delay(self, edge, key, ov):
+        """One latency draw for ``edge``; branches statically pruned to the
+        distributions this plan actually uses."""
+        dist = self.params.edge_dist[edge]
+        mean = ov.edge_mean[edge]
+        var = ov.edge_var[edge]
+        u = jax.random.uniform(jax.random.fold_in(key, 1))
+        delay = jnp.float32(0.0)
+        if _D_UNIFORM in self._dists_present:
+            delay = jnp.where(dist == _D_UNIFORM, u, delay)
+        if _D_EXPONENTIAL in self._dists_present:
+            exp = -mean * jnp.log(jnp.maximum(1.0 - u, _TINY))
+            delay = jnp.where(dist == _D_EXPONENTIAL, exp, delay)
+        if {_D_NORMAL, _D_LOGNORMAL} & set(self._dists_present):
+            z = jax.random.normal(jax.random.fold_in(key, 2))
+            if _D_NORMAL in self._dists_present:
+                # reference contract: the variance field is numpy's scale arg
+                norm = jnp.maximum(0.0, mean + var * z)
+                delay = jnp.where(dist == _D_NORMAL, norm, delay)
+            if _D_LOGNORMAL in self._dists_present:
+                delay = jnp.where(dist == _D_LOGNORMAL, jnp.exp(mean + var * z), delay)
+        if _D_POISSON in self._dists_present:
+            pois = jax.random.poisson(
+                jax.random.fold_in(key, 3),
+                jnp.maximum(mean, _TINY),
+            ).astype(jnp.float32)
+            delay = jnp.where(dist == _D_POISSON, pois, delay)
+        return delay
+
+    def _sample_edge(self, edge, t_send, key, ov):
+        """(dropped, effective delay incl. active spike) for one traversal."""
+        u = jax.random.uniform(jax.random.fold_in(key, 0))
+        dropped = u < ov.edge_dropout[edge]
+        return dropped, self._sample_delay(edge, key, ov) + self._spike(edge, t_send)
+
+    # ==================================================================
+    # metric write primitives (masked; index clamped)
+    # ==================================================================
+
+    def _gauge_add(self, st: EngineState, t, gidx, val, pred) -> EngineState:
+        if not self.collect_gauges:
+            return st
+        v = jnp.where(pred, val, 0.0)
+        return st._replace(gauge=st.gauge.at[self._bucket(t), gidx].add(v))
+
+    def _edge_interval(self, st, edge, t0, t1, pred) -> EngineState:
+        st = self._gauge_add(st, t0, self._g_edge(edge), 1.0, pred)
+        return self._gauge_add(st, t1, self._g_edge(edge), -1.0, pred)
+
+    def _complete(self, st: EngineState, start, finish, pred) -> EngineState:
+        """Record one completed request: histogram, moments, throughput, clock."""
+        latency = finish - start
+        lbin = jnp.clip(
+            ((jnp.log(jnp.maximum(latency, 1e-6)) - self.hist_lo) * self.hist_scale)
+            .astype(jnp.int32),
+            0,
+            self.n_hist_bins - 1,
+        )
+        tbin = jnp.clip(jnp.ceil(finish).astype(jnp.int32) - 1, 0, self.n_thr - 1)
+        one = jnp.where(pred, 1, 0)
+        lat = jnp.where(pred, latency, 0.0)
+        st = st._replace(
+            hist=st.hist.at[lbin].add(one),
+            thr=st.thr.at[tbin].add(one),
+            lat_count=st.lat_count + one,
+            lat_sum=st.lat_sum + lat,
+            lat_sumsq=st.lat_sumsq + lat * lat,
+            lat_min=jnp.where(pred, jnp.minimum(st.lat_min, latency), st.lat_min),
+            lat_max=jnp.where(pred, jnp.maximum(st.lat_max, latency), st.lat_max),
+        )
+        if self.collect_clocks:
+            idx = jnp.where(pred, st.clock_n, jnp.int32(st.clock.shape[0]))
+            st = st._replace(
+                clock=st.clock.at[idx].set(
+                    jnp.stack([start, finish]),
+                    mode="drop",
+                ),
+                clock_n=st.clock_n + one,
+            )
+        return st
+
+    # ==================================================================
+    # arrival sampler (window-jump semantics cloned from the reference)
+    # ==================================================================
+
+    def _advance_arrival(self, st: EngineState, key, ov, pred) -> EngineState:
+        """Compute the next emitted gap; sim arrival time += gap (no jump time).
+
+        `/root/reference/src/asyncflow/samplers/poisson_poisson.py:56-82`.
+        """
+        plan = self.plan
+        horizon = jnp.float32(plan.horizon)
+        window = jnp.float32(plan.user_window)
+        poisson_users = plan.user_var < 0
+
+        def cond(carry):
+            return carry[4] == 0
+
+        def body(carry):
+            smp_now, window_end, lam, dctr, _status, gap = carry
+            kd = jax.random.fold_in(key, 64 + dctr)
+            need_window = smp_now >= window_end
+            if poisson_users:
+                users = jax.random.poisson(
+                    jax.random.fold_in(kd, 0),
+                    jnp.maximum(ov.user_mean, _TINY),
+                ).astype(jnp.float32)
+            else:
+                z = jax.random.normal(jax.random.fold_in(kd, 1))
+                users = jnp.maximum(0.0, ov.user_mean + self.params.user_var * z)
+            window_end = jnp.where(need_window, smp_now + window, window_end)
+            lam = jnp.where(need_window, users * ov.req_rate, lam)
+
+            no_users = lam <= 0.0
+            u = jnp.maximum(jax.random.uniform(jax.random.fold_in(kd, 2)), _TINY)
+            g = -jnp.log(1.0 - u) / jnp.maximum(lam, _TINY)
+            beyond = smp_now + g > horizon
+            crosses = smp_now + g >= window_end
+
+            smp_next = jnp.where(
+                no_users,
+                window_end,
+                jnp.where(beyond, smp_now, jnp.where(crosses, window_end, smp_now + g)),
+            )
+            status = jnp.where(
+                no_users,
+                0,
+                jnp.where(beyond, 2, jnp.where(crosses, 0, 1)),
+            ).astype(jnp.int32)
+            return (smp_next, window_end, lam, dctr + 1, status, jnp.where(status == 1, g, gap))
+
+        init = (
+            st.smp_now,
+            st.smp_window_end,
+            st.smp_lam,
+            jnp.int32(0),
+            jnp.where(pred, jnp.int32(0), jnp.int32(1)),  # inactive lanes: done
+            jnp.float32(0.0),
+        )
+        smp_now, window_end, lam, _, status, gap = jax.lax.while_loop(cond, body, init)
+        exhausted = status == 2
+        next_t = jnp.where(exhausted, INF, st.next_arrival + gap)
+        return st._replace(
+            smp_now=jnp.where(pred, smp_now, st.smp_now),
+            smp_window_end=jnp.where(pred, window_end, st.smp_window_end),
+            smp_lam=jnp.where(pred, lam, st.smp_lam),
+            next_arrival=jnp.where(pred, next_t, st.next_arrival),
+        )
+
+    # ==================================================================
+    # LB rotation (dense prefix of lb_order, length lb_len)
+    # ==================================================================
+
+    def _lb_pick(self, st: EngineState):
+        """(slot, rotated order) per algorithm; caller guards empty rotation."""
+        el = max(self.plan.n_lb_edges, 1)
+        pos = jnp.arange(el, dtype=jnp.int32)
+        valid = pos < st.lb_len
+        if self.plan.lb_algo == 0:  # round robin: head out, rotate left
+            slot = st.lb_order[0]
+            shifted = st.lb_order[(pos + 1) % jnp.maximum(st.lb_len, 1)]
+            return slot, jnp.where(valid, shifted, st.lb_order)
+        conn = st.lb_conn[st.lb_order]
+        order_key = jnp.where(valid, conn * el + pos, jnp.int32(2**30))
+        best = jnp.argmin(order_key).astype(jnp.int32)
+        return st.lb_order[best], st.lb_order
+
+    def _lb_remove(self, order, length, slot, pred):
+        el = max(self.plan.n_lb_edges, 1)
+        pos = jnp.arange(el, dtype=jnp.int32)
+        hit = jnp.where((order == slot) & (pos < length), pos, el)
+        at = jnp.min(hit).astype(jnp.int32)
+        act = pred & (at < el)
+        shifted = order[jnp.minimum(pos + 1, el - 1)]
+        new_order = jnp.where((pos >= at) & act, shifted, order)
+        return new_order, jnp.where(act, length - 1, length)
+
+    def _lb_insert(self, order, length, slot, pred):
+        el = max(self.plan.n_lb_edges, 1)
+        idx = jnp.where(pred, jnp.clip(length, 0, el - 1), jnp.int32(el))
+        new_order = order.at[idx].set(slot, mode="drop")
+        return new_order, jnp.where(pred, jnp.minimum(length + 1, el), length)
+
+    # ==================================================================
+    # branches (all updates masked by disjoint predicates)
+    # ==================================================================
+
+    def _timeline_branch(self, st: EngineState, pred) -> EngineState:
+        if len(self.plan.timeline_times) == 0:
+            return st
+        p = self.params
+        ptr = jnp.clip(st.tl_ptr, 0, len(self.plan.timeline_times) - 1)
+        slot = p.timeline_slot[ptr]
+        down = p.timeline_down[ptr] == 1
+        act = pred & (slot >= 0)
+        order, length = self._lb_remove(st.lb_order, st.lb_len, slot, act & down)
+        order, length = self._lb_insert(order, length, slot, act & ~down)
+        return st._replace(
+            lb_order=order,
+            lb_len=length,
+            tl_ptr=st.tl_ptr + jnp.where(pred, 1, 0),
+        )
+
+    def _spawn_branch(self, st: EngineState, now, key, ov, pred) -> EngineState:
+        """Generator emits one request: walk the static entry chain, allocate
+        a pool slot at the first stateful node, schedule the next arrival."""
+        plan = self.plan
+        st = st._replace(n_generated=st.n_generated + jnp.where(pred, 1, 0))
+
+        alive = pred
+        t_cur = now
+        for j, eidx in enumerate(plan.entry_edges.tolist()):
+            e = jnp.int32(eidx)
+            dropped, delay = self._sample_edge(
+                e,
+                t_cur,
+                jax.random.fold_in(key, 8 + j),
+                ov,
+            )
+            survives = alive & ~dropped
+            st = self._edge_interval(st, e, t_cur, t_cur + delay, survives)
+            st = st._replace(
+                n_dropped=st.n_dropped + jnp.where(alive & dropped, 1, 0),
+            )
+            t_cur = jnp.where(survives, t_cur + delay, t_cur)
+            alive = survives
+
+        free_mask = st.req_ev == EV_IDLE
+        slot = jnp.argmax(free_mask).astype(jnp.int32)
+        has_free = free_mask[slot]
+        overflow = alive & ~has_free
+        place = alive & has_free
+        ev0 = EV_ARRIVE_LB if plan.entry_target_kind == TARGET_LB else EV_ARRIVE_SRV
+        idx = jnp.where(place, slot, jnp.int32(self.pool))
+        st = st._replace(
+            req_ev=st.req_ev.at[idx].set(ev0, mode="drop"),
+            req_t=st.req_t.at[idx].set(t_cur, mode="drop"),
+            req_srv=st.req_srv.at[idx].set(
+                jnp.int32(max(plan.entry_target, 0)),
+                mode="drop",
+            ),
+            req_start=st.req_start.at[idx].set(now, mode="drop"),
+            req_lbslot=st.req_lbslot.at[idx].set(-1, mode="drop"),
+            req_ram=st.req_ram.at[idx].set(0.0, mode="drop"),
+            req_ticket=st.req_ticket.at[idx].set(NO_TICKET, mode="drop"),
+            n_overflow=st.n_overflow + jnp.where(overflow, 1, 0),
+        )
+        return self._advance_arrival(st, key, ov, pred)
+
+    def _seg_start(self, st, i, s, ep, seg, now, key, ov, pred) -> EngineState:
+        """Begin segment ``seg`` for slot ``i``: CPU acquire-or-wait, IO sleep,
+        or endpoint completion (exit flow)."""
+        p = self.params
+        kind = p.seg_kind[s, ep, seg]
+        dur = p.seg_dur[s, ep, seg]
+        is_cpu = pred & (kind == SEG_CPU)
+        is_io = pred & (kind == SEG_IO)
+        is_end = pred & (kind == SEG_END)
+
+        has_waiters = jnp.any(
+            (st.req_ev == EV_WAIT_CPU) & (st.req_srv == s) & (st.req_ticket < NO_TICKET),
+        )
+        can_take = (st.cores_free[s] > 0) & ~has_waiters
+        cpu_run = is_cpu & can_take
+        cpu_wait = is_cpu & ~can_take
+
+        run_now = cpu_run | is_io
+        st = st._replace(
+            cores_free=st.cores_free.at[s].add(jnp.where(cpu_run, -1, 0)),
+            cpu_ticket=st.cpu_ticket.at[s].add(jnp.where(cpu_wait, 1, 0)),
+            req_ev=st.req_ev.at[i].set(
+                jnp.where(
+                    run_now,
+                    EV_SEG_END,
+                    jnp.where(cpu_wait, EV_WAIT_CPU, st.req_ev[i]),
+                ),
+            ),
+            req_t=st.req_t.at[i].set(
+                jnp.where(run_now, now + dur, jnp.where(cpu_wait, INF, st.req_t[i])),
+            ),
+            req_ticket=st.req_ticket.at[i].set(
+                jnp.where(cpu_wait, st.cpu_ticket[s], st.req_ticket[i]),
+            ),
+            req_seg=st.req_seg.at[i].set(jnp.where(pred, seg, st.req_seg[i])),
+        )
+        st = self._gauge_add(st, now, self._g_ready(s), 1.0, cpu_wait)
+        st = self._gauge_add(st, now, self._g_io(s), 1.0, is_io)
+        return self._exit_flow(st, i, s, now, key, ov, is_end)
+
+    def _exit_flow(self, st, i, s, now, key, ov, pred) -> EngineState:
+        """Endpoint finished: release RAM (FIFO grants), route the exit edge,
+        complete / forward / drop."""
+        p = self.params
+        plan = self.plan
+        ram_amt = st.req_ram[i]
+
+        st = st._replace(
+            ram_free=st.ram_free.at[s].add(jnp.where(pred, ram_amt, 0.0)),
+        )
+        st = self._gauge_add(
+            st,
+            now,
+            self._g_ram(s),
+            -ram_amt,
+            pred & (ram_amt > 0),
+        )
+
+        # strict-FIFO RAM grant loop: grant heads while they fit
+        def gcond(carry):
+            req_ev, _t, req_tk, ram_free_s, go = carry
+            waiting = (req_ev == EV_WAIT_RAM) & (st.req_srv == s)
+            tick = jnp.where(waiting, req_tk, NO_TICKET)
+            head = jnp.argmin(tick).astype(jnp.int32)
+            return go & (tick[head] < NO_TICKET) & (st.req_ram[head] <= ram_free_s)
+
+        def gbody(carry):
+            req_ev, req_t, req_tk, ram_free_s, go = carry
+            waiting = (req_ev == EV_WAIT_RAM) & (st.req_srv == s)
+            tick = jnp.where(waiting, req_tk, NO_TICKET)
+            head = jnp.argmin(tick).astype(jnp.int32)
+            return (
+                req_ev.at[head].set(EV_RESUME),
+                req_t.at[head].set(now),
+                req_tk.at[head].set(NO_TICKET),
+                ram_free_s - st.req_ram[head],
+                go,
+            )
+
+        req_ev, req_t, req_tk, ram_free_s, _ = jax.lax.while_loop(
+            gcond,
+            gbody,
+            (st.req_ev, st.req_t, st.req_ticket, st.ram_free[s], pred),
+        )
+        st = st._replace(
+            req_ev=req_ev,
+            req_t=req_t,
+            req_ticket=req_tk,
+            ram_free=st.ram_free.at[s].set(ram_free_s),
+        )
+
+        # route the single exit edge of this server
+        e = p.exit_edge[s]
+        kind = p.exit_kind[s]
+        dropped, delay = self._sample_edge(e, now, jax.random.fold_in(key, 48), ov)
+        arrive = now + delay
+        to_server = pred & (kind == TARGET_SERVER) & ~dropped
+        to_lb = pred & (kind == TARGET_LB) & ~dropped
+        to_client = pred & (kind == TARGET_CLIENT) & ~dropped
+        drop_here = pred & dropped
+
+        st = self._edge_interval(st, e, now, arrive, pred & ~dropped)
+        st = self._complete(
+            st,
+            st.req_start[i],
+            arrive,
+            to_client & (arrive < plan.horizon),
+        )
+
+        free = drop_here | to_client
+        st = st._replace(
+            req_ev=st.req_ev.at[i].set(
+                jnp.where(
+                    free,
+                    EV_IDLE,
+                    jnp.where(
+                        to_server,
+                        EV_ARRIVE_SRV,
+                        jnp.where(to_lb, EV_ARRIVE_LB, st.req_ev[i]),
+                    ),
+                ),
+            ),
+            req_t=st.req_t.at[i].set(
+                jnp.where(
+                    free,
+                    INF,
+                    jnp.where(to_server | to_lb, arrive, st.req_t[i]),
+                ),
+            ),
+            req_srv=st.req_srv.at[i].set(
+                jnp.where(to_server, p.exit_target[s], st.req_srv[i]),
+            ),
+            req_lbslot=st.req_lbslot.at[i].set(
+                jnp.where(pred, -1, st.req_lbslot[i]),
+            ),
+            req_ram=st.req_ram.at[i].set(jnp.where(pred, 0.0, st.req_ram[i])),
+            n_dropped=st.n_dropped + jnp.where(drop_here, 1, 0),
+        )
+        return st
+
+    def _arrive_lb_branch(self, st, i, now, key, ov, pred) -> EngineState:
+        """Route one request at the LB (empty rotation drops the request)."""
+        if self.plan.n_lb_edges == 0:
+            return st
+        p = self.params
+        empty = st.lb_len <= 0
+        drop_empty = pred & empty
+        route = pred & ~empty
+
+        slot, rotated = self._lb_pick(st)
+        order = jnp.where(route, rotated, st.lb_order)
+        e = p.lb_edge_index[slot]
+        dropped, delay = self._sample_edge(e, now, jax.random.fold_in(key, 32), ov)
+        arrive = now + delay
+        ok = route & ~dropped
+        drop_edge = route & dropped
+
+        st = self._edge_interval(st, e, now, arrive, ok)
+        free = drop_empty | drop_edge
+        st = st._replace(
+            lb_order=order,
+            lb_conn=st.lb_conn.at[slot].add(jnp.where(ok, 1, 0)),
+            req_ev=st.req_ev.at[i].set(
+                jnp.where(free, EV_IDLE, jnp.where(ok, EV_ARRIVE_SRV, st.req_ev[i])),
+            ),
+            req_t=st.req_t.at[i].set(
+                jnp.where(free, INF, jnp.where(ok, arrive, st.req_t[i])),
+            ),
+            req_srv=st.req_srv.at[i].set(
+                jnp.where(ok, p.lb_target[slot], st.req_srv[i]),
+            ),
+            req_lbslot=st.req_lbslot.at[i].set(
+                jnp.where(ok, slot, st.req_lbslot[i]),
+            ),
+            n_dropped=st.n_dropped + jnp.where(free, 1, 0),
+        )
+        return st
+
+    def _arrive_srv_branch(self, st, i, now, key, ov, pred) -> EngineState:
+        """Arrival at a server: endpoint pick, RAM-first admission."""
+        p = self.params
+        s = st.req_srv[i]
+
+        # close the LB edge traversal (live least-connections counter)
+        lbslot = st.req_lbslot[i]
+        if self.plan.n_lb_edges > 0:
+            dec = pred & (lbslot >= 0)
+            st = st._replace(
+                lb_conn=st.lb_conn.at[jnp.clip(lbslot, 0, None)].add(
+                    jnp.where(dec, -1, 0),
+                ),
+                req_lbslot=st.req_lbslot.at[i].set(
+                    jnp.where(pred, -1, st.req_lbslot[i]),
+                ),
+            )
+
+        u = jax.random.uniform(jax.random.fold_in(key, 16))
+        ep = jnp.minimum(
+            (u * p.n_endpoints[s]).astype(jnp.int32),
+            p.n_endpoints[s] - 1,
+        )
+        need = p.endpoint_ram[s, ep]
+        st = st._replace(
+            req_ep=st.req_ep.at[i].set(jnp.where(pred, ep, st.req_ep[i])),
+            req_ram=st.req_ram.at[i].set(jnp.where(pred, need, st.req_ram[i])),
+        )
+
+        ram_waiters = jnp.any(
+            (st.req_ev == EV_WAIT_RAM) & (st.req_srv == s) & (st.req_ticket < NO_TICKET),
+        )
+        granted = pred & ((need <= 0) | (~ram_waiters & (st.ram_free[s] >= need)))
+        blocked = pred & ~granted
+
+        st = st._replace(
+            ram_free=st.ram_free.at[s].add(jnp.where(granted, -need, 0.0)),
+            ram_ticket=st.ram_ticket.at[s].add(jnp.where(blocked, 1, 0)),
+            req_ev=st.req_ev.at[i].set(
+                jnp.where(blocked, EV_WAIT_RAM, st.req_ev[i]),
+            ),
+            req_t=st.req_t.at[i].set(jnp.where(blocked, INF, st.req_t[i])),
+            req_ticket=st.req_ticket.at[i].set(
+                jnp.where(blocked, st.ram_ticket[s], st.req_ticket[i]),
+            ),
+        )
+        st = self._gauge_add(st, now, self._g_ram(s), need, granted & (need > 0))
+        return self._seg_start(st, i, s, ep, jnp.int32(0), now, key, ov, granted)
+
+    def _resume_branch(self, st, i, now, key, ov, pred) -> EngineState:
+        """RAM was granted by a releasing request: start the endpoint."""
+        s = st.req_srv[i]
+        ep = st.req_ep[i]
+        st = self._gauge_add(
+            st,
+            now,
+            self._g_ram(s),
+            st.req_ram[i],
+            pred & (st.req_ram[i] > 0),
+        )
+        return self._seg_start(st, i, s, ep, jnp.int32(0), now, key, ov, pred)
+
+    def _seg_end_branch(self, st, i, now, key, ov, pred) -> EngineState:
+        """A CPU burst or IO sleep finished: hand off the core / leave the IO
+        queue, then start the next segment."""
+        p = self.params
+        s = st.req_srv[i]
+        ep = st.req_ep[i]
+        seg = st.req_seg[i]
+        kind = p.seg_kind[s, ep, seg]
+        was_cpu = pred & (kind == SEG_CPU)
+        was_io = pred & (kind == SEG_IO)
+
+        # CPU handoff: grant the longest-waiting request on this server
+        waiting = (st.req_ev == EV_WAIT_CPU) & (st.req_srv == s)
+        tick = jnp.where(waiting, st.req_ticket, NO_TICKET)
+        j = jnp.argmin(tick).astype(jnp.int32)
+        grant = was_cpu & (tick[j] < NO_TICKET)
+        release = was_cpu & ~grant
+        jdur = p.seg_dur[st.req_srv[j], st.req_ep[j], st.req_seg[j]]
+        jidx = jnp.where(grant, j, jnp.int32(self.pool))
+        st = st._replace(
+            cores_free=st.cores_free.at[s].add(jnp.where(release, 1, 0)),
+            req_ev=st.req_ev.at[jidx].set(EV_SEG_END, mode="drop"),
+            req_t=st.req_t.at[jidx].set(now + jdur, mode="drop"),
+            req_ticket=st.req_ticket.at[jidx].set(NO_TICKET, mode="drop"),
+        )
+        st = self._gauge_add(st, now, self._g_ready(s), -1.0, grant)
+
+        # leave the IO queue
+        st = self._gauge_add(st, now, self._g_io(s), -1.0, was_io)
+
+        return self._seg_start(st, i, s, ep, seg + 1, now, key, ov, pred)
+
+    # ==================================================================
+    # main loop
+    # ==================================================================
+
+    def _init_state(self, key, ov) -> EngineState:
+        plan = self.plan
+        pool = self.pool
+        elp = max(plan.n_lb_edges, 1)
+        n_gauge_rows = plan.n_samples + 2 if self.collect_gauges else 1
+        n_gauges = plan.n_gauges if self.collect_gauges else 1
+        maxn = self.max_requests if self.collect_clocks else 1
+        st = EngineState(
+            req_t=jnp.full(pool, INF, jnp.float32),
+            req_ev=jnp.zeros(pool, jnp.int32),
+            req_srv=jnp.zeros(pool, jnp.int32),
+            req_ep=jnp.zeros(pool, jnp.int32),
+            req_seg=jnp.zeros(pool, jnp.int32),
+            req_ram=jnp.zeros(pool, jnp.float32),
+            req_ticket=jnp.full(pool, NO_TICKET, jnp.int32),
+            req_start=jnp.zeros(pool, jnp.float32),
+            req_lbslot=jnp.full(pool, -1, jnp.int32),
+            cores_free=jnp.asarray(plan.server_cores),
+            ram_free=jnp.asarray(plan.server_ram),
+            cpu_ticket=jnp.zeros(plan.n_servers, jnp.int32),
+            ram_ticket=jnp.zeros(plan.n_servers, jnp.int32),
+            lb_order=jnp.arange(elp, dtype=jnp.int32),
+            lb_len=jnp.int32(plan.n_lb_edges),
+            lb_conn=jnp.zeros(elp, jnp.int32),
+            smp_now=jnp.float32(0.0),
+            smp_window_end=jnp.float32(0.0),
+            smp_lam=jnp.float32(0.0),
+            next_arrival=jnp.float32(0.0),
+            tl_ptr=jnp.int32(0),
+            key=key,
+            it=jnp.int32(1),
+            hist=jnp.zeros(self.n_hist_bins, jnp.int32),
+            lat_count=jnp.int32(0),
+            lat_sum=jnp.float32(0.0),
+            lat_sumsq=jnp.float32(0.0),
+            lat_min=INF,
+            lat_max=jnp.float32(0.0),
+            thr=jnp.zeros(self.n_thr, jnp.int32),
+            gauge=jnp.zeros((n_gauge_rows, n_gauges), jnp.float32),
+            clock=jnp.zeros((maxn, 2), jnp.float32),
+            clock_n=jnp.int32(0),
+            n_generated=jnp.int32(0),
+            n_dropped=jnp.int32(0),
+            n_overflow=jnp.int32(0),
+        )
+        # first arrival (gap from t=0)
+        return self._advance_arrival(
+            st,
+            jax.random.fold_in(key, 0),
+            ov,
+            jnp.bool_(True),
+        )
+
+    def _next_times(self, st: EngineState):
+        t_pool = jnp.min(st.req_t)
+        if len(self.plan.timeline_times) > 0:
+            ptr = jnp.clip(st.tl_ptr, 0, len(self.plan.timeline_times) - 1)
+            t_tl = jnp.where(
+                st.tl_ptr < len(self.plan.timeline_times),
+                self.params.timeline_times[ptr],
+                INF,
+            )
+        else:
+            t_tl = INF
+        return t_pool, st.next_arrival, t_tl
+
+    def _cond(self, st: EngineState):
+        t_pool, t_arr, t_tl = self._next_times(st)
+        t_min = jnp.minimum(jnp.minimum(t_pool, t_arr), t_tl)
+        return (t_min < self.plan.horizon) & (st.it < self.plan.max_iterations)
+
+    def _body(self, st: EngineState, ov) -> EngineState:
+        t_pool, t_arr, t_tl = self._next_times(st)
+        now = jnp.minimum(jnp.minimum(t_pool, t_arr), t_tl)
+        in_horizon = now < self.plan.horizon
+        is_tl = in_horizon & (t_tl <= now)
+        is_pool = in_horizon & ~is_tl & (t_pool <= now)
+        is_arr = in_horizon & ~is_tl & ~is_pool
+
+        kit = jax.random.fold_in(st.key, st.it)
+        st = st._replace(it=st.it + 1)
+
+        st = self._timeline_branch(st, is_tl)
+        st = self._spawn_branch(st, now, kit, ov, is_arr)
+
+        i = jnp.argmin(st.req_t).astype(jnp.int32)
+        ev = st.req_ev[i]
+        st = self._arrive_lb_branch(st, i, now, kit, ov, is_pool & (ev == EV_ARRIVE_LB))
+        st = self._arrive_srv_branch(st, i, now, kit, ov, is_pool & (ev == EV_ARRIVE_SRV))
+        st = self._resume_branch(st, i, now, kit, ov, is_pool & (ev == EV_RESUME))
+        st = self._seg_end_branch(st, i, now, kit, ov, is_pool & (ev == EV_SEG_END))
+        return st
+
+    def _run_one(self, key, ov: ScenarioOverrides) -> EngineState:
+        st = self._init_state(key, ov)
+        return jax.lax.while_loop(self._cond, lambda s: self._body(s, ov), st)
+
+    # ==================================================================
+    # public entry points
+    # ==================================================================
+
+    def run_batch(
+        self,
+        keys: jnp.ndarray,
+        overrides: ScenarioOverrides | None = None,
+    ) -> EngineState:
+        """Run |keys| scenarios in one vmapped kernel.
+
+        ``overrides`` fields may carry a leading scenario axis or be base
+        values shared by every scenario.
+        """
+        ov = overrides if overrides is not None else base_overrides(self.plan)
+        axes = ScenarioOverrides(
+            *[0 if o.ndim > b.ndim else None
+              for o, b in zip(ov, base_overrides(self.plan))],
+        )
+        sig = tuple(axes)
+        if sig not in self._compiled:
+            self._compiled[sig] = jax.jit(
+                jax.vmap(self._run_one, in_axes=(0, axes)),
+            )
+        return self._compiled[sig](keys, ov)
+
+
+def scenario_keys(seed: int, n: int) -> jnp.ndarray:
+    """Independent per-scenario PRNG keys."""
+    return jax.random.split(jax.random.PRNGKey(seed), n)
+
+
+def run_single(
+    payload: SimulationPayload,
+    *,
+    seed: int = 0,
+    **engine_kw,
+) -> SimulationResults:
+    """Run one scenario on the JAX engine, reduced to SimulationResults."""
+    plan = compile_payload(payload)
+    engine_kw.setdefault("collect_gauges", True)
+    engine_kw.setdefault("collect_clocks", True)
+    engine = Engine(plan, **engine_kw)
+    final = engine.run_batch(scenario_keys(seed, 1))
+    state = jax.tree.map(lambda x: np.asarray(x[0]), final)
+
+    if int(state.n_overflow) > 0:
+        import warnings
+
+        warnings.warn(
+            f"request pool overflowed {int(state.n_overflow)} times; "
+            "latency percentiles are truncated — rerun with a larger "
+            "pool_size",
+            stacklevel=2,
+        )
+
+    clock_n = int(state.clock_n)
+    clock = state.clock[:clock_n].astype(np.float64)
+
+    sampled: dict[str, dict[str, np.ndarray]] = {}
+    if engine.collect_gauges:
+        series = np.cumsum(state.gauge, axis=0)[1 : plan.n_samples + 1]
+        sampled = {
+            SampledMetricName.EDGE_CONCURRENT_CONNECTION.value: {
+                eid: series[:, e] for e, eid in enumerate(plan.edge_ids)
+            },
+            SampledMetricName.READY_QUEUE_LEN.value: {
+                sid: series[:, plan.n_edges + s]
+                for s, sid in enumerate(plan.server_ids)
+            },
+            SampledMetricName.EVENT_LOOP_IO_SLEEP.value: {
+                sid: series[:, plan.n_edges + plan.n_servers + s]
+                for s, sid in enumerate(plan.server_ids)
+            },
+            SampledMetricName.RAM_IN_USE.value: {
+                sid: series[:, plan.n_edges + 2 * plan.n_servers + s]
+                for s, sid in enumerate(plan.server_ids)
+            },
+        }
+    return SimulationResults(
+        settings=payload.sim_settings,
+        rqs_clock=clock,
+        sampled=sampled,
+        total_generated=int(state.n_generated),
+        total_dropped=int(state.n_dropped),
+        overflow_dropped=int(state.n_overflow),
+        server_ids=plan.server_ids,
+        edge_ids=plan.edge_ids,
+    )
+
+
+def sweep_results(
+    engine: Engine,
+    final: EngineState,
+    settings=None,
+) -> SweepResults:
+    """Reduce a batched final state to host-side SweepResults."""
+    from asyncflow_tpu.engines.jaxsim.params import hist_edges as _edges
+
+    return SweepResults(
+        settings=settings,
+        completed=np.asarray(final.lat_count),
+        latency_hist=np.asarray(final.hist),
+        hist_edges=_edges(engine.n_hist_bins),
+        latency_sum=np.asarray(final.lat_sum),
+        latency_sumsq=np.asarray(final.lat_sumsq),
+        latency_min=np.asarray(final.lat_min),
+        latency_max=np.asarray(final.lat_max),
+        throughput=np.asarray(final.thr),
+        total_generated=np.asarray(final.n_generated),
+        total_dropped=np.asarray(final.n_dropped),
+        overflow_dropped=np.asarray(final.n_overflow),
+    )

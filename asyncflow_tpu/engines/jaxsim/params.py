@@ -1,0 +1,157 @@
+"""Device-side parameter and state containers for the batched JAX engine."""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from asyncflow_tpu.compiler.plan import StaticPlan
+
+INF = jnp.float32(1e30)
+NO_TICKET = jnp.int32(2**30)
+
+# request-slot event codes
+EV_IDLE = 0
+EV_ARRIVE_LB = 1
+EV_ARRIVE_SRV = 2
+EV_SEG_END = 3
+EV_RESUME = 4  # RAM granted; start endpoint segments at time t
+EV_WAIT_CPU = 5
+EV_WAIT_RAM = 6
+
+
+class PlanParams(NamedTuple):
+    """Scenario-invariant plan arrays resident on device."""
+
+    edge_dist: jnp.ndarray
+    edge_mean: jnp.ndarray
+    edge_var: jnp.ndarray
+    edge_dropout: jnp.ndarray
+    server_cores: jnp.ndarray
+    server_ram: jnp.ndarray
+    n_endpoints: jnp.ndarray
+    seg_kind: jnp.ndarray
+    seg_dur: jnp.ndarray
+    endpoint_ram: jnp.ndarray
+    exit_edge: jnp.ndarray
+    exit_kind: jnp.ndarray
+    exit_target: jnp.ndarray
+    lb_edge_index: jnp.ndarray
+    lb_target: jnp.ndarray
+    spike_times: jnp.ndarray
+    spike_values: jnp.ndarray
+    timeline_times: jnp.ndarray
+    timeline_down: jnp.ndarray
+    timeline_slot: jnp.ndarray
+    user_mean: jnp.ndarray  # scalar, overridable per scenario
+    user_var: jnp.ndarray
+    req_rate: jnp.ndarray  # requests / user / second
+
+
+def params_from_plan(plan: StaticPlan) -> PlanParams:
+    """Upload the per-scenario-invariant arrays."""
+    return PlanParams(
+        edge_dist=jnp.asarray(plan.edge_dist),
+        edge_mean=jnp.asarray(plan.edge_mean),
+        edge_var=jnp.asarray(plan.edge_var),
+        edge_dropout=jnp.asarray(plan.edge_dropout),
+        server_cores=jnp.asarray(plan.server_cores),
+        server_ram=jnp.asarray(plan.server_ram),
+        n_endpoints=jnp.asarray(plan.n_endpoints),
+        seg_kind=jnp.asarray(plan.seg_kind),
+        seg_dur=jnp.asarray(plan.seg_dur),
+        endpoint_ram=jnp.asarray(plan.endpoint_ram),
+        exit_edge=jnp.asarray(plan.exit_edge),
+        exit_kind=jnp.asarray(plan.exit_kind),
+        exit_target=jnp.asarray(plan.exit_target),
+        lb_edge_index=jnp.asarray(plan.lb_edge_index),
+        lb_target=jnp.asarray(plan.lb_target),
+        spike_times=jnp.asarray(plan.spike_times),
+        spike_values=jnp.asarray(plan.spike_values),
+        timeline_times=jnp.asarray(plan.timeline_times),
+        timeline_down=jnp.asarray(plan.timeline_down),
+        timeline_slot=jnp.asarray(plan.timeline_slot),
+        user_mean=jnp.float32(plan.user_mean),
+        user_var=jnp.float32(plan.user_var),
+        req_rate=jnp.float32(plan.req_per_user_per_sec),
+    )
+
+
+class EngineState(NamedTuple):
+    """Loop-carried state of one scenario (vmapped over the batch axis)."""
+
+    # request pool
+    req_t: jnp.ndarray  # (P,) f32
+    req_ev: jnp.ndarray  # (P,) i32
+    req_srv: jnp.ndarray  # (P,) i32
+    req_ep: jnp.ndarray  # (P,) i32
+    req_seg: jnp.ndarray  # (P,) i32
+    req_ram: jnp.ndarray  # (P,) f32
+    req_ticket: jnp.ndarray  # (P,) i32
+    req_start: jnp.ndarray  # (P,) f32
+    req_lbslot: jnp.ndarray  # (P,) i32
+    # servers
+    cores_free: jnp.ndarray  # (NS,) i32
+    ram_free: jnp.ndarray  # (NS,) f32
+    cpu_ticket: jnp.ndarray  # (NS,) i32
+    ram_ticket: jnp.ndarray  # (NS,) i32
+    # load balancer
+    lb_order: jnp.ndarray  # (EL,) i32
+    lb_len: jnp.ndarray  # scalar i32
+    lb_conn: jnp.ndarray  # (EL,) i32
+    # arrival sampler
+    smp_now: jnp.ndarray  # scalar f32 (sampler clock)
+    smp_window_end: jnp.ndarray
+    smp_lam: jnp.ndarray
+    next_arrival: jnp.ndarray  # scalar f32 (simulation clock)
+    # outage timeline cursor
+    tl_ptr: jnp.ndarray  # scalar i32
+    # rng
+    key: jnp.ndarray
+    it: jnp.ndarray  # scalar i32 iteration counter (rng stream + safety)
+    # metrics
+    hist: jnp.ndarray  # (B,) i32
+    lat_count: jnp.ndarray
+    lat_sum: jnp.ndarray
+    lat_sumsq: jnp.ndarray
+    lat_min: jnp.ndarray
+    lat_max: jnp.ndarray
+    thr: jnp.ndarray  # (TH,) i32
+    gauge: jnp.ndarray  # (n_samples + 2, NG) f32 deltas (or (0,0))
+    clock: jnp.ndarray  # (maxN, 2) f32 (or (0, 2))
+    clock_n: jnp.ndarray
+    n_generated: jnp.ndarray
+    n_dropped: jnp.ndarray
+    n_overflow: jnp.ndarray
+
+
+class ScenarioOverrides(NamedTuple):
+    """Per-scenario parameter overrides for Monte-Carlo sweeps.
+
+    Each field either matches the base plan (scalar broadcast) or carries a
+    leading scenario axis.  ``None``-like sentinel is the base value itself.
+    """
+
+    edge_mean: jnp.ndarray  # (NE,) or (S, NE)
+    edge_var: jnp.ndarray
+    edge_dropout: jnp.ndarray
+    user_mean: jnp.ndarray  # scalar or (S,)
+    req_rate: jnp.ndarray
+
+
+def base_overrides(plan: StaticPlan) -> ScenarioOverrides:
+    """Overrides equal to the base plan (no sweep variation)."""
+    return ScenarioOverrides(
+        edge_mean=jnp.asarray(plan.edge_mean),
+        edge_var=jnp.asarray(plan.edge_var),
+        edge_dropout=jnp.asarray(plan.edge_dropout),
+        user_mean=jnp.float32(plan.user_mean),
+        req_rate=jnp.float32(plan.req_per_user_per_sec),
+    )
+
+
+def hist_edges(n_bins: int) -> np.ndarray:
+    """Shared log-spaced latency histogram bin edges (seconds)."""
+    return np.logspace(-4, 3, n_bins + 1)

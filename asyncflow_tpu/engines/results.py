@@ -66,6 +66,23 @@ class SweepResults:
     total_dropped: np.ndarray = field(default_factory=lambda: np.empty(0))
     overflow_dropped: np.ndarray = field(default_factory=lambda: np.empty(0))
 
+    def __getitem__(self, idx) -> SweepResults:
+        """Slice along the scenario axis."""
+        return SweepResults(
+            settings=self.settings,
+            completed=self.completed[idx],
+            latency_hist=self.latency_hist[idx],
+            hist_edges=self.hist_edges,
+            latency_sum=self.latency_sum[idx],
+            latency_sumsq=self.latency_sumsq[idx],
+            latency_min=self.latency_min[idx],
+            latency_max=self.latency_max[idx],
+            throughput=self.throughput[idx],
+            total_generated=self.total_generated[idx],
+            total_dropped=self.total_dropped[idx],
+            overflow_dropped=self.overflow_dropped[idx],
+        )
+
     def percentile(self, q: float) -> np.ndarray:
         """Per-scenario latency percentile estimated from the histograms."""
         counts = self.latency_hist.astype(np.float64)

@@ -1,0 +1,12 @@
+"""Scenario-parallel sweep execution over device meshes."""
+
+from asyncflow_tpu.parallel.mesh import scenario_mesh, scenario_sharding
+from asyncflow_tpu.parallel.sweep import SweepReport, SweepRunner, make_overrides
+
+__all__ = [
+    "SweepReport",
+    "SweepRunner",
+    "make_overrides",
+    "scenario_mesh",
+    "scenario_sharding",
+]

@@ -1,0 +1,32 @@
+"""Device-mesh helpers for scenario-parallel sweeps.
+
+The sweep's parallelism is pure scenario-batch data parallelism (SURVEY.md
+§2.2): scenarios never communicate during simulation, so the mesh has a
+single ``scenario`` axis and the only collectives are terminal metric
+reductions (histogram psums) riding ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+SCENARIO_AXIS = "scenario"
+
+
+def scenario_mesh(n_devices: int | None = None) -> Mesh:
+    """A 1-D mesh over (the first ``n_devices``) local devices."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (SCENARIO_AXIS,))
+
+
+def scenario_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (scenario) axis across the mesh."""
+    return NamedSharding(mesh, PartitionSpec(SCENARIO_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

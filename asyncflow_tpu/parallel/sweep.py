@@ -1,0 +1,212 @@
+"""Monte-Carlo scenario sweeps: the capability the reference only roadmapped.
+
+A sweep runs N independent scenarios of one compiled plan with per-scenario
+parameter overrides (RTT/jitter scales, workload intensity) and per-scenario
+PRNG keys, batched through the JAX engine and sharded over a device mesh.
+Memory is bounded by chunking; metric reduction is an ICI-friendly psum of
+histograms/counters (no inter-scenario communication exists during the run).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from asyncflow_tpu.compiler.plan import StaticPlan, compile_payload
+from asyncflow_tpu.engines.jaxsim.engine import Engine, scenario_keys, sweep_results
+from asyncflow_tpu.engines.jaxsim.params import ScenarioOverrides, base_overrides
+from asyncflow_tpu.engines.results import SweepResults
+from asyncflow_tpu.parallel.mesh import scenario_mesh, scenario_sharding
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+
+def make_overrides(
+    plan: StaticPlan,
+    n_scenarios: int,
+    *,
+    edge_mean_scale: np.ndarray | None = None,
+    edge_var_scale: np.ndarray | None = None,
+    dropout_scale: np.ndarray | None = None,
+    user_mean: np.ndarray | None = None,
+    req_per_minute: np.ndarray | None = None,
+) -> ScenarioOverrides:
+    """Per-scenario parameter overrides; every scale is (S,) or (S, NE)."""
+    base = base_overrides(plan)
+
+    def _edges(scale: np.ndarray | None, base_arr: jnp.ndarray) -> jnp.ndarray:
+        if scale is None:
+            return base_arr
+        scale = jnp.asarray(scale, jnp.float32)
+        if scale.ndim == 1:
+            scale = scale[:, None]
+        if scale.shape[0] != n_scenarios:
+            msg = f"scale must have leading axis {n_scenarios}"
+            raise ValueError(msg)
+        return base_arr[None, :] * scale
+
+    user = (
+        base.user_mean
+        if user_mean is None
+        else jnp.asarray(user_mean, jnp.float32)
+    )
+    rate = (
+        base.req_rate
+        if req_per_minute is None
+        else jnp.asarray(req_per_minute, jnp.float32) / 60.0
+    )
+    return ScenarioOverrides(
+        edge_mean=_edges(edge_mean_scale, base.edge_mean),
+        edge_var=_edges(edge_var_scale, base.edge_var),
+        edge_dropout=jnp.clip(_edges(dropout_scale, base.edge_dropout), 0.0, 1.0),
+        user_mean=user,
+        req_rate=rate,
+    )
+
+
+@dataclass
+class SweepReport:
+    """Host-side sweep summary with per-scenario and aggregate statistics."""
+
+    results: SweepResults
+    n_scenarios: int
+    wall_seconds: float
+
+    @property
+    def scenarios_per_second(self) -> float:
+        return self.n_scenarios / max(self.wall_seconds, 1e-9)
+
+    def aggregate_percentile(self, q: float) -> float:
+        """Percentile of the pooled latency distribution across scenarios."""
+        import dataclasses
+
+        pooled_hist = self.results.latency_hist.sum(axis=0, keepdims=True)
+        if pooled_hist.sum() == 0:
+            return float("nan")
+        pooled = dataclasses.replace(self.results, latency_hist=pooled_hist)
+        return float(pooled.percentile(q)[0])
+
+    def summary(self) -> dict:
+        res = self.results
+        completed = res.completed.sum()
+        mean = res.latency_sum.sum() / max(completed, 1)
+        return {
+            "n_scenarios": self.n_scenarios,
+            "scenarios_per_second": self.scenarios_per_second,
+            "completed_total": int(completed),
+            "dropped_total": int(res.total_dropped.sum()),
+            "overflow_total": int(res.overflow_dropped.sum()),
+            "latency_mean_s": float(mean),
+            "latency_p50_s": self.aggregate_percentile(50),
+            "latency_p95_s": self.aggregate_percentile(95),
+            "latency_p99_s": self.aggregate_percentile(99),
+        }
+
+
+class SweepRunner:
+    """Chunked, mesh-sharded Monte-Carlo sweep over one scenario family."""
+
+    def __init__(
+        self,
+        payload: SimulationPayload,
+        *,
+        pool_size: int | None = None,
+        n_hist_bins: int = 1024,
+        use_mesh: bool = True,
+    ) -> None:
+        self.payload = payload
+        self.plan = compile_payload(payload, pool_size=pool_size)
+        self.engine = Engine(
+            self.plan,
+            collect_gauges=False,
+            collect_clocks=False,
+            n_hist_bins=n_hist_bins,
+        )
+        self.mesh = scenario_mesh() if use_mesh and len(jax.devices()) > 1 else None
+
+    # Default chunk: bounds both device memory and single-kernel runtime
+    # (tunneled TPU workers kill executions running longer than ~1 minute).
+    DEFAULT_CHUNK = 64
+
+    def run(
+        self,
+        n_scenarios: int,
+        *,
+        seed: int = 0,
+        overrides: ScenarioOverrides | None = None,
+        chunk_size: int | None = None,
+    ) -> SweepReport:
+        """Execute the sweep, chunking to bound memory and kernel runtime."""
+        import time
+
+        n_dev = len(self.mesh.devices.flat) if self.mesh is not None else 1
+        chunk = chunk_size or min(self.DEFAULT_CHUNK * n_dev, n_scenarios)
+        chunk = max(n_dev, (chunk // n_dev) * n_dev)
+
+        t0 = time.time()
+        partials = []
+        done = 0
+        while done < n_scenarios:
+            take = min(chunk, n_scenarios - done)
+            take = max(n_dev, (take // n_dev) * n_dev)  # pad to device multiple
+            keys = scenario_keys(seed, done + take)[done : done + take]
+            ov = (
+                _slice_overrides(overrides, base_overrides(self.plan), done, take)
+                if overrides
+                else None
+            )
+            if self.mesh is not None:
+                keys = jax.device_put(keys, scenario_sharding(self.mesh))
+            final = self.engine.run_batch(keys, ov)
+            partials.append(sweep_results(self.engine, final, self.payload.sim_settings))
+            done += take
+        wall = time.time() - t0
+
+        merged = _concat_sweeps(partials)[:n_scenarios]
+        return SweepReport(results=merged, n_scenarios=n_scenarios, wall_seconds=wall)
+
+
+def _slice_overrides(
+    ov: ScenarioOverrides,
+    base: ScenarioOverrides,
+    start: int,
+    count: int,
+) -> ScenarioOverrides:
+    """Slice the scenario axis of batched fields; pass base-shaped ones through."""
+
+    def _take(x, b):
+        arr = jnp.asarray(x)
+        if arr.ndim > jnp.asarray(b).ndim:  # leading axis is the scenario axis
+            # rows may be requested past the end when the chunk is padded to a
+            # device multiple: clamp (repeat the last scenario's parameters)
+            idx = jnp.clip(start + jnp.arange(count), 0, arr.shape[0] - 1)
+            return arr[idx]
+        return x
+
+    return ScenarioOverrides(*[_take(f, b) for f, b in zip(ov, base)])
+
+
+def _concat_sweeps(parts: list[SweepResults]) -> SweepResults:
+    first = parts[0]
+    if len(parts) == 1:
+        merged = first
+    else:
+        merged = SweepResults(
+            settings=first.settings,
+            completed=np.concatenate([p.completed for p in parts]),
+            latency_hist=np.concatenate([p.latency_hist for p in parts]),
+            hist_edges=first.hist_edges,
+            latency_sum=np.concatenate([p.latency_sum for p in parts]),
+            latency_sumsq=np.concatenate([p.latency_sumsq for p in parts]),
+            latency_min=np.concatenate([p.latency_min for p in parts]),
+            latency_max=np.concatenate([p.latency_max for p in parts]),
+            throughput=np.concatenate([p.throughput for p in parts]),
+            total_generated=np.concatenate([p.total_generated for p in parts]),
+            total_dropped=np.concatenate([p.total_dropped for p in parts]),
+            overflow_dropped=np.concatenate([p.overflow_dropped for p in parts]),
+        )
+    return merged
+
+

@@ -29,10 +29,12 @@ class SimulationRunner:
         simulation_input: SimulationPayload,
         backend: Backend | str = Backend.ORACLE,
         seed: int | None = None,
+        engine_options: dict | None = None,
     ) -> None:
         self.simulation_input = simulation_input
         self.backend = Backend(backend)
         self.seed = seed
+        self.engine_options = engine_options or {}
 
     def run(self) -> ResultsAnalyzer:
         """Execute the scenario on the selected engine."""
@@ -41,13 +43,13 @@ class SimulationRunner:
 
             results = OracleEngine(self.simulation_input, seed=self.seed).run()
         else:
-            try:
-                from asyncflow_tpu.engines.jaxsim.engine import run_single
-            except ImportError as exc:  # pragma: no cover - scaffolding guard
-                msg = "The JAX engine is not available in this build"
-                raise NotImplementedError(msg) from exc
+            from asyncflow_tpu.engines.jaxsim.engine import run_single
 
-            results = run_single(self.simulation_input, seed=self.seed or 0)
+            results = run_single(
+                self.simulation_input,
+                seed=self.seed or 0,
+                **self.engine_options,
+            )
         return ResultsAnalyzer(results)
 
     @classmethod
@@ -57,8 +59,14 @@ class SimulationRunner:
         *,
         backend: Backend | str = Backend.ORACLE,
         seed: int | None = None,
+        engine_options: dict | None = None,
     ) -> SimulationRunner:
         """Load, validate, and wrap a YAML scenario file."""
         data = yaml.safe_load(Path(yaml_path).read_text())
         payload = SimulationPayload.model_validate(data)
-        return cls(simulation_input=payload, backend=backend, seed=seed)
+        return cls(
+            simulation_input=payload,
+            backend=backend,
+            seed=seed,
+            engine_options=engine_options,
+        )

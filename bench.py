@@ -1,0 +1,92 @@
+"""Benchmark: Monte-Carlo sweep throughput of the batched TPU engine.
+
+Runs an N-scenario sweep of the reference's 1-LB/2-server example
+(`/root/reference/examples/yaml_input/data/two_servers_lb.yml` topology and
+workload) on the JAX engine and prints ONE JSON line:
+
+    {"metric": "scenarios/sec (1k-sweep, lb-2srv-60s)", "value": ..., ...}
+
+The reference executes one scenario at a time as SimPy coroutines; its
+measured single-scenario wall time on this machine is the baseline
+(scenarios/sec = 1 / wall).  ``vs_baseline`` is our sweep rate over that.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_SCENARIOS = int(os.environ.get("BENCH_SCENARIOS", "128"))
+HORIZON = int(os.environ.get("BENCH_HORIZON", "60"))
+SEED = 1234
+
+
+def _payload():
+    from asyncflow_tpu.schemas.payload import SimulationPayload
+    import yaml
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "examples",
+        "yaml_input",
+        "data",
+        "two_servers_lb.yml",
+    )
+    data = yaml.safe_load(open(path).read())
+    data["sim_settings"]["total_simulation_time"] = HORIZON
+    return SimulationPayload.model_validate(data)
+
+
+def main() -> None:
+    payload = _payload()
+
+    # --- baseline: sequential oracle engine (reference architecture) ------
+    from asyncflow_tpu.engines.oracle.engine import OracleEngine
+
+    t0 = time.time()
+    OracleEngine(payload, seed=SEED).run()
+    oracle_wall = time.time() - t0
+    baseline_rate = 1.0 / oracle_wall  # scenarios/sec, one at a time
+
+    # --- batched JAX sweep -------------------------------------------------
+    from asyncflow_tpu.parallel.sweep import SweepRunner
+
+    runner = SweepRunner(payload)
+    # warm-up compile at the exact chunk shape the measured run will use
+    chunk = min(SweepRunner.DEFAULT_CHUNK, N_SCENARIOS)
+    runner.run(chunk, seed=SEED, chunk_size=chunk)
+    report = runner.run(N_SCENARIOS, seed=SEED, chunk_size=chunk)
+    summary = report.summary()
+
+    if summary["overflow_total"] > 0:
+        print(
+            f"WARNING: {summary['overflow_total']} pool overflows",
+            file=sys.stderr,
+        )
+
+    value = report.scenarios_per_second
+    print(
+        json.dumps(
+            {
+                "metric": f"scenarios/sec ({N_SCENARIOS}-sweep, lb-2srv-{HORIZON}s)",
+                "value": round(value, 3),
+                "unit": "scenarios/sec",
+                "vs_baseline": round(value / baseline_rate, 2),
+                "detail": {
+                    "oracle_wall_s_per_scenario": round(oracle_wall, 3),
+                    "sweep_wall_s": round(report.wall_seconds, 3),
+                    "latency_p95_ms": round(summary["latency_p95_s"] * 1e3, 3),
+                    "completed_total": summary["completed_total"],
+                    "overflow_total": summary["overflow_total"],
+                },
+            },
+        ),
+    )
+
+
+if __name__ == "__main__":
+    main()

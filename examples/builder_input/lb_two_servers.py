@@ -1,0 +1,96 @@
+"""Build the LB + 2-server scenario in Python and run it on either backend.
+
+Usage:  python examples/builder_input/lb_two_servers.py [oracle|jax]
+"""
+
+import sys
+
+from asyncflow_tpu import AsyncFlow, SimulationRunner
+from asyncflow_tpu.components import (
+    Client,
+    Edge,
+    Endpoint,
+    LoadBalancer,
+    Server,
+    ServerResources,
+    Step,
+)
+from asyncflow_tpu.settings import SimulationSettings
+from asyncflow_tpu.workload import RVConfig, RqsGenerator
+
+
+def endpoint() -> Endpoint:
+    return Endpoint(
+        endpoint_name="/api",
+        steps=[
+            Step(kind="initial_parsing", step_operation={"cpu_time": 0.002}),
+            Step(kind="ram", step_operation={"necessary_ram": 128}),
+            Step(kind="io_wait", step_operation={"io_waiting_time": 0.012}),
+        ],
+    )
+
+
+def exp(mean: float) -> RVConfig:
+    return RVConfig(mean=mean, distribution="exponential")
+
+
+flow = (
+    AsyncFlow()
+    .add_generator(
+        RqsGenerator(
+            id="rqs-1",
+            avg_active_users=RVConfig(mean=400),
+            avg_request_per_minute_per_user=RVConfig(mean=20),
+            user_sampling_window=60,
+        ),
+    )
+    .add_client(Client(id="client-1"))
+    .add_load_balancer(
+        LoadBalancer(
+            id="lb-1",
+            algorithms="round_robin",
+            server_covered={"srv-1", "srv-2"},
+        ),
+    )
+    .add_servers(
+        Server(
+            id="srv-1",
+            server_resources=ServerResources(cpu_cores=1, ram_mb=2048),
+            endpoints=[endpoint()],
+        ),
+        Server(
+            id="srv-2",
+            server_resources=ServerResources(cpu_cores=1, ram_mb=2048),
+            endpoints=[endpoint()],
+        ),
+    )
+    .add_edges(
+        Edge(id="gen-client", source="rqs-1", target="client-1", latency=exp(0.003)),
+        Edge(id="client-lb", source="client-1", target="lb-1", latency=exp(0.002)),
+        Edge(id="lb-srv1", source="lb-1", target="srv-1", latency=exp(0.002)),
+        Edge(id="lb-srv2", source="lb-1", target="srv-2", latency=exp(0.002)),
+        Edge(id="srv1-client", source="srv-1", target="client-1", latency=exp(0.003)),
+        Edge(id="srv2-client", source="srv-2", target="client-1", latency=exp(0.003)),
+    )
+    .add_simulation_settings(
+        SimulationSettings(total_simulation_time=120, sample_period_s=0.05),
+    )
+)
+
+# what-if events: a latency spike on one LB link, an outage on the other server
+flow.add_network_spike(
+    event_id="spike-1",
+    edge_id="lb-srv1",
+    t_start=20.0,
+    t_end=50.0,
+    spike_s=0.05,
+)
+flow.add_server_outage(event_id="outage-1", server_id="srv-2", t_start=60.0, t_end=90.0)
+
+backend = sys.argv[1] if len(sys.argv) > 1 else "oracle"
+runner = SimulationRunner(simulation_input=flow.build_payload(), backend=backend, seed=7)
+analyzer = runner.run()
+print(analyzer.format_latency_stats())
+for server_id in analyzer.list_server_ids():
+    times, ram = analyzer.get_series("ram_in_use", server_id)
+    print(f"{server_id}: mean RAM in use {sum(ram) / max(len(ram), 1):.1f} MB")

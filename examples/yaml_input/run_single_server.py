@@ -1,0 +1,20 @@
+"""Run the single-server scenario from YAML and render the dashboard.
+
+Usage:  python examples/yaml_input/run_single_server.py [oracle|jax]
+"""
+
+import sys
+from pathlib import Path
+
+from asyncflow_tpu import SimulationRunner
+
+backend = sys.argv[1] if len(sys.argv) > 1 else "oracle"
+scenario = Path(__file__).parent / "data" / "single_server.yml"
+
+analyzer = SimulationRunner.from_yaml(scenario, backend=backend, seed=42).run()
+print(analyzer.format_latency_stats())
+
+fig = analyzer.plot_base_dashboard()
+out = Path(__file__).parent / f"single_server_{backend}.png"
+fig.savefig(out)
+print(f"dashboard saved to {out}")

@@ -1,0 +1,168 @@
+"""Backend-parity tests: JAX batched engine vs the CPU oracle.
+
+The parity contract is distributional (SURVEY.md §7 "RNG parity discipline"):
+aggregate latency percentiles over a seed ensemble must agree within a few
+percent.  Regimes are kept at moderate utilisation — near-critical queues
+(rho -> 1) have heavy-tailed Monte-Carlo noise that no per-seed tolerance can
+bound (verified against an independent Lindley recursion during bring-up).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.engines.jaxsim.engine import Engine, scenario_keys
+from asyncflow_tpu.engines.oracle.engine import OracleEngine
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+pytestmark = pytest.mark.integration
+
+SEEDS = 12
+
+
+def _jax_latencies(payload: SimulationPayload, n: int, **engine_kw) -> np.ndarray:
+    plan = compile_payload(payload)
+    engine = Engine(plan, collect_clocks=True, **engine_kw)
+    final = engine.run_batch(scenario_keys(11, n))
+    assert int(np.asarray(final.n_overflow).sum()) == 0, "pool overflow in parity run"
+    clock = np.asarray(final.clock)
+    counts = np.asarray(final.clock_n)
+    return np.concatenate(
+        [clock[i, : counts[i], 1] - clock[i, : counts[i], 0] for i in range(n)],
+    )
+
+
+def _oracle_latencies(payload: SimulationPayload, n: int) -> np.ndarray:
+    return np.concatenate(
+        [OracleEngine(payload, seed=s).run().latencies for s in range(n)],
+    )
+
+
+def _assert_percentile_parity(
+    lat_jax: np.ndarray,
+    lat_oracle: np.ndarray,
+    tol: float,
+) -> None:
+    assert lat_jax.size > 1000
+    assert lat_oracle.size > 1000
+    for q in (50, 90, 95):
+        a = np.percentile(lat_jax, q)
+        b = np.percentile(lat_oracle, q)
+        assert abs(a - b) / b < tol, f"p{q}: jax={a:.6f} oracle={b:.6f}"
+    mean_a, mean_b = lat_jax.mean(), lat_oracle.mean()
+    assert abs(mean_a - mean_b) / mean_b < tol
+
+
+def _payload(path: str, mutate=None) -> SimulationPayload:
+    import yaml
+
+    data = yaml.safe_load(open(path).read())
+    if mutate:
+        mutate(data)
+    return SimulationPayload.model_validate(data)
+
+
+BASE = "tests/integration/data/single_server.yml"
+LB = "tests/integration/data/two_servers_lb.yml"
+
+
+def test_parity_single_server_light_load() -> None:
+    payload = _payload(BASE)
+    _assert_percentile_parity(
+        _jax_latencies(payload, SEEDS),
+        _oracle_latencies(payload, SEEDS),
+        tol=0.03,
+    )
+
+
+def test_parity_lb_round_robin() -> None:
+    payload = _payload(LB)
+    _assert_percentile_parity(
+        _jax_latencies(payload, SEEDS),
+        _oracle_latencies(payload, SEEDS),
+        tol=0.03,
+    )
+
+
+def test_parity_event_injection() -> None:
+    def add_events(data: dict) -> None:
+        data["events"] = [
+            {
+                "event_id": "spike-1",
+                "target_id": "lb-srv1",
+                "start": {
+                    "kind": "network_spike_start",
+                    "t_start": 5.0,
+                    "spike_s": 0.05,
+                },
+                "end": {"kind": "network_spike_end", "t_end": 25.0},
+            },
+            {
+                "event_id": "out-1",
+                "target_id": "srv-2",
+                "start": {"kind": "server_down", "t_start": 10.0},
+                "end": {"kind": "server_up", "t_end": 30.0},
+            },
+        ]
+
+    payload = _payload(LB, add_events)
+    _assert_percentile_parity(
+        _jax_latencies(payload, SEEDS),
+        _oracle_latencies(payload, SEEDS),
+        tol=0.04,
+    )
+
+
+def test_parity_multi_burst_moderate_contention() -> None:
+    """Alternating CPU/IO bursts on 2 cores at rho ~ 0.65."""
+
+    def mutate(data: dict) -> None:
+        server = data["topology_graph"]["nodes"]["servers"][0]
+        server["server_resources"]["cpu_cores"] = 2
+        server["endpoints"][0]["steps"] = [
+            {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.004}},
+            {"kind": "io_db", "step_operation": {"io_waiting_time": 0.02}},
+            {"kind": "cpu_bound_operation", "step_operation": {"cpu_time": 0.006}},
+            {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.01}},
+            {"kind": "cpu_bound_operation", "step_operation": {"cpu_time": 0.003}},
+        ]
+        data["rqs_input"]["avg_active_users"]["mean"] = 300
+
+    payload = _payload(BASE, mutate)
+    _assert_percentile_parity(
+        _jax_latencies(payload, SEEDS),
+        _oracle_latencies(payload, SEEDS),
+        tol=0.05,
+    )
+
+
+def test_parity_ram_moderate_contention() -> None:
+    """RAM-gated concurrency at rho ~ 0.7 on the RAM resource."""
+
+    def mutate(data: dict) -> None:
+        server = data["topology_graph"]["nodes"]["servers"][0]
+        server["server_resources"]["ram_mb"] = 512
+        server["endpoints"][0]["steps"] = [
+            {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.0005}},
+            {"kind": "ram", "step_operation": {"necessary_ram": 100}},
+            {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.05}},
+        ]
+        data["rqs_input"]["avg_active_users"]["mean"] = 200
+
+    payload = _payload(BASE, mutate)
+    _assert_percentile_parity(
+        _jax_latencies(payload, SEEDS),
+        _oracle_latencies(payload, SEEDS),
+        tol=0.05,
+    )
+
+
+def test_overflow_is_surfaced_not_silent() -> None:
+    """A deliberately tiny pool must report overflow, never hide it."""
+    payload = _payload(BASE)
+    plan = compile_payload(payload)
+    engine = Engine(plan, pool_size=2)
+    final = engine.run_batch(scenario_keys(3, 2))
+    assert int(np.asarray(final.n_overflow).sum()) > 0
