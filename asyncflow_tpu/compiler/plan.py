@@ -139,6 +139,12 @@ class StaticPlan:
     server_ids: list[str] = field(default_factory=list)
     edge_ids: list[str] = field(default_factory=list)
 
+    # ---- fast-path eligibility (scan engine; see engines/jaxsim/fastpath) ----
+    fastpath_ok: bool = False
+    fastpath_reason: str = ""
+    #: servers in topological order of the exit-chain DAG
+    server_topo_order: list[int] = field(default_factory=list)
+
     @property
     def n_gauges(self) -> int:
         """Gauge layout: [edge conns | ready | io | ram] per component."""
@@ -163,7 +169,16 @@ def _estimate_capacity(payload: SimulationPayload) -> tuple[int, int]:
     horizon = float(settings.total_simulation_time)
     window = float(workload.user_sampling_window)
     expected = rate * horizon
-    max_requests = int(expected + 6.0 * math.sqrt(max(expected, 1.0)) + 64)
+    # total-count variance = Poisson part + windowed user-draw part
+    users_var = (
+        float(workload.avg_active_users.variance) ** 2
+        if workload.avg_active_users.variance is not None
+        else users  # Poisson users
+    )
+    rate_per_user = float(workload.avg_request_per_minute_per_user.mean) / 60.0
+    n_windows = max(1.0, horizon / window)
+    count_var = expected + n_windows * users_var * (rate_per_user * window) ** 2
+    max_requests = int(expected + 6.0 * math.sqrt(max(count_var, 1.0)) + 64)
 
     # ~3-sigma burst of the windowed user draw
     burst_rate = rate * (1.0 + 3.0 / math.sqrt(max(users, 1.0)))
@@ -380,6 +395,15 @@ def compile_payload(
     sample_period = float(settings.sample_period_s)
     n_samples = max(0, math.ceil(round(horizon / sample_period, 9)) - 1)
 
+    fastpath_ok, fastpath_reason, topo = _fastpath_analysis(
+        payload,
+        compiled,
+        exit_kind,
+        exit_target,
+        lb_algo,
+        len(outages),
+    )
+
     return StaticPlan(
         n_servers=n_servers,
         n_edges=n_edges,
@@ -429,4 +453,88 @@ def compile_payload(
         max_iterations=max_iterations,
         server_ids=[server.id for server in servers],
         edge_ids=[edge.id for edge in edges],
+        fastpath_ok=fastpath_ok,
+        fastpath_reason=fastpath_reason,
+        server_topo_order=topo,
     )
+
+
+def _fastpath_analysis(
+    payload: SimulationPayload,
+    compiled: list[list[tuple[list[tuple[int, float]], float]]],
+    exit_kind: np.ndarray,
+    exit_target: np.ndarray,
+    lb_algo: int,
+    n_outage_marks: int,
+) -> tuple[bool, str, list[int]]:
+    """Decide whether the scan engine can execute this plan exactly.
+
+    Conditions (each mirrors an assumption of the Lindley-recursion model):
+    single core per server (G/G/1 FIFO on the merged CPU burst), endpoints
+    that are at most one CPU burst followed by at most one IO sleep, RAM
+    provably non-binding (admission never queues), round-robin routing (the
+    rotation is a deterministic function of LB-arrival rank), no outages (the
+    rotation membership never changes), and an acyclic server exit DAG.
+    """
+    servers = payload.topology_graph.nodes.servers
+    n_servers = len(servers)
+
+    if n_outage_marks > 0:
+        return False, "server outage events change LB membership", []
+    lb = payload.topology_graph.nodes.load_balancer
+    if lb is not None and lb_algo != 0:
+        return False, "least-connections routing needs live edge state", []
+    for edge in payload.topology_graph.edges:
+        if edge.latency.distribution == Distribution.POISSON:
+            return False, f"edge {edge.id}: poisson latency unsupported", []
+
+    workload = payload.rqs_input
+    users = float(workload.avg_active_users.mean)
+    rate = users * float(workload.avg_request_per_minute_per_user.mean) / 60.0
+    burst_rate = rate * (1.0 + 3.0 / math.sqrt(max(users, 1.0)))
+
+    for s, server in enumerate(servers):
+        if server.server_resources.cpu_cores != 1:
+            return False, f"server {server.id}: multi-core needs Kiefer-Wolfowitz", []
+        if exit_kind[s] == TARGET_LB:
+            return False, f"server {server.id}: exit to LB creates a cycle", []
+        max_ram = 0.0
+        residence = 0.0
+        cpu_dur = 0.0
+        for segs, ram in compiled[s]:
+            kinds = [k for k, _ in segs]
+            if kinds not in ([], [SEG_CPU], [SEG_IO], [SEG_CPU, SEG_IO]):
+                return False, f"server {server.id}: multi-burst endpoint", []
+            max_ram = max(max_ram, ram)
+            residence = max(residence, sum(d for _, d in segs))
+            cpu_dur = max(cpu_dur, sum(d for k, d in segs if k == SEG_CPU))
+        if max_ram > 0:
+            # RAM is held from admission to endpoint end, INCLUDING the CPU
+            # queue wait — bound the wait with an M/M/1-style estimate and
+            # refuse when the CPU can saturate (unbounded residency).
+            rho = burst_rate * cpu_dur
+            if rho >= 0.95:
+                return False, f"server {server.id}: RAM residency unbounded", []
+            wait_est = rho / (1.0 - rho) * cpu_dur
+            concurrent = server.server_resources.ram_mb / max_ram
+            if concurrent < 4.0 * burst_rate * (residence + wait_est) + 4.0:
+                return False, f"server {server.id}: RAM can bind", []
+
+    # topological order of the server exit DAG
+    indeg = [0] * n_servers
+    for s in range(n_servers):
+        if exit_kind[s] == TARGET_SERVER:
+            indeg[int(exit_target[s])] += 1
+    frontier = [s for s in range(n_servers) if indeg[s] == 0]
+    topo: list[int] = []
+    while frontier:
+        s = frontier.pop()
+        topo.append(s)
+        if exit_kind[s] == TARGET_SERVER:
+            t = int(exit_target[s])
+            indeg[t] -= 1
+            if indeg[t] == 0:
+                frontier.append(t)
+    if len(topo) != n_servers:
+        return False, "server exit chain has a cycle", []
+    return True, "", topo

@@ -47,6 +47,20 @@ from asyncflow_tpu.compiler.plan import (
     compile_payload,
 )
 from asyncflow_tpu.config.constants import SampledMetricName
+from asyncflow_tpu.engines.jaxsim.sampling import (
+    D_EXPONENTIAL as _D_EXPONENTIAL,
+    D_LOGNORMAL as _D_LOGNORMAL,
+    D_NORMAL as _D_NORMAL,
+    D_POISSON as _D_POISSON,
+    D_UNIFORM as _D_UNIFORM,
+    TINY as _TINY,
+    exponential_from_u,
+    hist_constants,
+    latency_bin,
+    lognormal,
+    sample_bucket,
+    truncated_normal,
+)
 from asyncflow_tpu.engines.results import SimulationResults, SweepResults
 from asyncflow_tpu.schemas.payload import SimulationPayload
 from asyncflow_tpu.engines.jaxsim.params import (
@@ -65,10 +79,7 @@ from asyncflow_tpu.engines.jaxsim.params import (
     params_from_plan,
 )
 
-# distribution ids (compiler order)
-_D_UNIFORM, _D_POISSON, _D_EXPONENTIAL, _D_NORMAL, _D_LOGNORMAL = range(5)
 
-_TINY = 1e-15
 
 
 class Engine:
@@ -96,8 +107,7 @@ class Engine:
         self.pool = pool_size or plan.pool_size
         self.max_requests = max_requests or plan.max_requests
         self.params = params_from_plan(plan)
-        self.hist_lo = float(np.log(1e-4))
-        self.hist_scale = float(n_hist_bins / (np.log(1e3) - np.log(1e-4)))
+        self.hist_lo, self.hist_scale = hist_constants(n_hist_bins)
         self.n_thr = int(np.ceil(plan.horizon)) or 1
         self._dists_present = sorted(set(plan.edge_dist.tolist()))
         self._compiled: dict = {}
@@ -108,8 +118,7 @@ class Engine:
 
     def _bucket(self, t):
         """Sample-tick bucket: a delta at ``t`` affects samples at ticks >= t."""
-        b = jnp.ceil(t / self.plan.sample_period).astype(jnp.int32)
-        return jnp.clip(b, 0, self.plan.n_samples + 1)
+        return sample_bucket(t, self.plan.sample_period, self.plan.n_samples)
 
     def _g_edge(self, e):
         return e
@@ -143,16 +152,13 @@ class Engine:
         if _D_UNIFORM in self._dists_present:
             delay = jnp.where(dist == _D_UNIFORM, u, delay)
         if _D_EXPONENTIAL in self._dists_present:
-            exp = -mean * jnp.log(jnp.maximum(1.0 - u, _TINY))
-            delay = jnp.where(dist == _D_EXPONENTIAL, exp, delay)
+            delay = jnp.where(dist == _D_EXPONENTIAL, exponential_from_u(mean, u), delay)
         if {_D_NORMAL, _D_LOGNORMAL} & set(self._dists_present):
             z = jax.random.normal(jax.random.fold_in(key, 2))
             if _D_NORMAL in self._dists_present:
-                # reference contract: the variance field is numpy's scale arg
-                norm = jnp.maximum(0.0, mean + var * z)
-                delay = jnp.where(dist == _D_NORMAL, norm, delay)
+                delay = jnp.where(dist == _D_NORMAL, truncated_normal(mean, var, z), delay)
             if _D_LOGNORMAL in self._dists_present:
-                delay = jnp.where(dist == _D_LOGNORMAL, jnp.exp(mean + var * z), delay)
+                delay = jnp.where(dist == _D_LOGNORMAL, lognormal(mean, var, z), delay)
         if _D_POISSON in self._dists_present:
             pois = jax.random.poisson(
                 jax.random.fold_in(key, 3),
@@ -184,12 +190,7 @@ class Engine:
     def _complete(self, st: EngineState, start, finish, pred) -> EngineState:
         """Record one completed request: histogram, moments, throughput, clock."""
         latency = finish - start
-        lbin = jnp.clip(
-            ((jnp.log(jnp.maximum(latency, 1e-6)) - self.hist_lo) * self.hist_scale)
-            .astype(jnp.int32),
-            0,
-            self.n_hist_bins - 1,
-        )
+        lbin = latency_bin(latency, self.hist_lo, self.hist_scale, self.n_hist_bins)
         tbin = jnp.clip(jnp.ceil(finish).astype(jnp.int32) - 1, 0, self.n_thr - 1)
         one = jnp.where(pred, 1, 0)
         lat = jnp.where(pred, latency, 0.0)

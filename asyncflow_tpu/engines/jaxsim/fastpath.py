@@ -1,0 +1,419 @@
+"""Scan fast path: closed-form vectorized simulation for eligible plans.
+
+For the common scenario shape (single-core servers, endpoints that are one
+merged CPU burst + one IO sleep, provably non-binding RAM, round-robin LB, no
+outages — see ``_fastpath_analysis`` in the compiler), the per-scenario
+discrete-event loop collapses into pure array code:
+
+1. **Arrivals.**  Within each user-sampling window the reference's gap chain
+   is exactly a Poisson process restarted at the boundary
+   (`/root/reference/src/asyncflow/samplers/poisson_poisson.py:56-82`): draw
+   per-window counts ``K_w ~ Poisson(lam_w * len_w)``, place arrivals as
+   sorted uniforms, and subtract each window's dropped residual
+   (boundary - last arrival) to recover *simulation* timestamps, which only
+   advance by emitted gaps.
+2. **Edges.**  Dropout/latency/spike draws are embarrassingly parallel.
+3. **Round robin** is a deterministic function of LB-arrival *rank*:
+   sort by arrival time at the LB, assign ``rank % n_edges``.
+4. **Each server is a G/G/1 FIFO queue on the CPU burst** (the IO sleep holds
+   no core), so waiting times follow the Lindley recursion
+   ``W_k = max(0, W_{k-1} + S_{k-1} - (A_k - A_{k-1}))`` — evaluated in
+   log-depth with ``lax.associative_scan`` in max-plus form.  IO-only
+   requests bypass the core (their own wait is zero) but do not disturb the
+   recursion (their service term is zero).
+5. Chained servers (app -> DB) are processed in exit-DAG topological order.
+
+Everything is (N,) array work per scenario, vmapped over the batch: the
+whole Monte-Carlo sweep becomes sorts + scans + elementwise math — exactly
+what the TPU's vector units and XLA's fusion want.  Gauge time series are
+reconstructed from [enter, leave) interval endpoints exactly like the event
+engine, so metric output is identical in shape and semantics.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from asyncflow_tpu.compiler.plan import (
+    SEG_CPU,
+    SEG_IO,
+    TARGET_SERVER,
+    StaticPlan,
+)
+from asyncflow_tpu.engines.jaxsim.params import INF, ScenarioOverrides, base_overrides
+from asyncflow_tpu.engines.jaxsim.sampling import (
+    D_EXPONENTIAL as _D_EXPONENTIAL,
+    D_LOGNORMAL as _D_LOGNORMAL,
+    D_NORMAL as _D_NORMAL,
+    D_UNIFORM as _D_UNIFORM,
+    TINY as _TINY,
+    exponential_from_u,
+    hist_constants,
+    latency_bin,
+    lognormal,
+    sample_bucket,
+    truncated_normal,
+)
+
+
+class FastState(NamedTuple):
+    """Metric outputs of one scenario (duck-compatible with EngineState)."""
+
+    hist: jnp.ndarray
+    lat_count: jnp.ndarray
+    lat_sum: jnp.ndarray
+    lat_sumsq: jnp.ndarray
+    lat_min: jnp.ndarray
+    lat_max: jnp.ndarray
+    thr: jnp.ndarray
+    gauge: jnp.ndarray
+    clock: jnp.ndarray
+    clock_n: jnp.ndarray
+    n_generated: jnp.ndarray
+    n_dropped: jnp.ndarray
+    n_overflow: jnp.ndarray
+
+
+def _lindley_waits(arrivals: jnp.ndarray, service: jnp.ndarray, valid) -> jnp.ndarray:
+    """FIFO G/G/1 waiting times for time-sorted ``arrivals`` via max-plus scan.
+
+    Invalid (padding) entries must carry ``arrivals=+inf, service=0``; they
+    compose as the identity and produce waits that are never used.
+    """
+    inter = jnp.diff(arrivals, prepend=arrivals[:1])
+    d = jnp.concatenate([jnp.array([-INF]), service[:-1] - inter[1:]])
+    # element k is f_k(x) = max(b_k, x + a_k); W_k = F_k(0).
+    # Padding sorts to the end (arrivals=inf), so d is only consumed where
+    # valid; invalid entries compose as the identity.
+    a = jnp.where(valid, d, 0.0)
+    b = jnp.where(valid, 0.0, -INF)
+
+    def compose(left, right):
+        a1, b1 = left
+        a2, b2 = right
+        return a1 + a2, jnp.maximum(b2, b1 + a2)
+
+    ca, cb = jax.lax.associative_scan(compose, (a, b))
+    return jnp.maximum(0.0, jnp.maximum(cb, ca))
+
+
+class FastEngine:
+    """Batched scan engine for one eligible :class:`StaticPlan`."""
+
+    def __init__(
+        self,
+        plan: StaticPlan,
+        *,
+        collect_gauges: bool = False,
+        collect_clocks: bool = False,
+        n_hist_bins: int = 1024,
+        max_requests: int | None = None,
+    ) -> None:
+        if not plan.fastpath_ok:
+            msg = f"plan not eligible for the fast path: {plan.fastpath_reason}"
+            raise ValueError(msg)
+        self.plan = plan
+        self.collect_gauges = collect_gauges
+        self.collect_clocks = collect_clocks
+        self.n_hist_bins = n_hist_bins
+        self.n = max_requests or plan.max_requests
+        self.n_windows = int(np.ceil(plan.horizon / plan.user_window))
+        self.n_thr = int(np.ceil(plan.horizon)) or 1
+        self.hist_lo, self.hist_scale = hist_constants(n_hist_bins)
+        self._dists_present = sorted(set(plan.edge_dist.tolist()))
+        self._spike_times = jnp.asarray(plan.spike_times)
+        self._spike_values = jnp.asarray(plan.spike_values)
+        self._compiled: dict = {}
+
+    # ------------------------------------------------------------------
+    # draw helpers
+    # ------------------------------------------------------------------
+
+    def _delay(self, dist_id: int, mean, var, u, z):
+        if dist_id == _D_UNIFORM:
+            return u
+        if dist_id == _D_EXPONENTIAL:
+            return exponential_from_u(mean, u)
+        if dist_id == _D_NORMAL:
+            return truncated_normal(mean, var, z)
+        if dist_id == _D_LOGNORMAL:
+            return lognormal(mean, var, z)
+        # unreachable: _fastpath_analysis rejects poisson-latency edges
+        msg = "poisson edge latency is not supported on the fast path"
+        raise NotImplementedError(msg)
+
+    def _edge_hop(self, key, edge: int, t_send, ov: ScenarioOverrides):
+        """(dropped, delay+spike) vectors for one static edge index."""
+        dist_id = int(self.plan.edge_dist[edge])
+        u_drop = jax.random.uniform(jax.random.fold_in(key, 0), t_send.shape)
+        u = jax.random.uniform(jax.random.fold_in(key, 1), t_send.shape)
+        z = (
+            jax.random.normal(jax.random.fold_in(key, 2), t_send.shape)
+            if dist_id in (_D_NORMAL, _D_LOGNORMAL)
+            else 0.0
+        )
+        delay = self._delay(dist_id, ov.edge_mean[edge], ov.edge_var[edge], u, z)
+        if len(self.plan.spike_times) > 1:
+            idx = (
+                jnp.searchsorted(self._spike_times, t_send, side="right").astype(
+                    jnp.int32,
+                )
+                - 1
+            )
+            delay = delay + self._spike_values[idx, edge]
+        return u_drop < ov.edge_dropout[edge], delay
+
+    # ------------------------------------------------------------------
+    # arrivals
+    # ------------------------------------------------------------------
+
+    def _arrivals(self, key, ov: ScenarioOverrides):
+        """(sim_times, valid) — simulation-clock arrival timestamps, sorted."""
+        plan = self.plan
+        nw, n = self.n_windows, self.n
+        window = jnp.float32(plan.user_window)
+        starts = jnp.arange(nw, dtype=jnp.float32) * window
+        ends = jnp.minimum(starts + window, plan.horizon)
+        lens = ends - starts
+
+        if plan.user_var < 0:
+            users = jax.random.poisson(
+                jax.random.fold_in(key, 1),
+                jnp.maximum(ov.user_mean, _TINY),
+                (nw,),
+            ).astype(jnp.float32)
+        else:
+            z = jax.random.normal(jax.random.fold_in(key, 1), (nw,))
+            users = jnp.maximum(0.0, ov.user_mean + plan.user_var * z)
+        lam = users * ov.req_rate
+
+        counts = jax.random.poisson(
+            jax.random.fold_in(key, 2),
+            jnp.maximum(lam * lens, _TINY),
+        ).astype(jnp.int32)
+        counts = jnp.where(lam > 0, counts, 0)
+        offsets = jnp.cumsum(counts)
+        total = jnp.minimum(offsets[-1], n)
+
+        slot = jnp.arange(n, dtype=jnp.int32)
+        valid = slot < total
+        win = jnp.searchsorted(offsets, slot, side="right").astype(jnp.int32)
+        win = jnp.clip(win, 0, nw - 1)
+        u = jax.random.uniform(jax.random.fold_in(key, 3), (n,))
+        sampler_t = jnp.where(valid, starts[win] + u * lens[win], INF)
+        # windows occupy disjoint time ranges and slots are blocked by window,
+        # so the global sort preserves each sorted position's window index
+        sampler_t = jnp.sort(sampler_t)
+
+        # residual dropped from the sim clock per window: boundary - last
+        # arrival (full window length when empty)
+        last = jnp.full(nw, -jnp.inf, jnp.float32)
+        last = last.at[win].max(jnp.where(valid, sampler_t, -jnp.inf))
+        last = jnp.maximum(last, starts)
+        residual = jnp.where(lens > 0, ends - last, 0.0)
+        cum_res = jnp.concatenate([jnp.zeros(1), jnp.cumsum(residual)])[:-1]
+        sim_t = jnp.where(valid, sampler_t - cum_res[win], INF)
+        overflow = offsets[-1] - total
+        return sim_t, valid, overflow
+
+    # ------------------------------------------------------------------
+    # metric recording
+    # ------------------------------------------------------------------
+
+    def _bucket(self, t):
+        return sample_bucket(t, self.plan.sample_period, self.plan.n_samples)
+
+    def _gauge_intervals(self, gauge, gidx, t0, t1, amount, on):
+        """Scatter +amount at enter and -amount at leave times (masked)."""
+        if not self.collect_gauges:
+            return gauge
+        val = jnp.where(on, amount, 0.0)
+        gauge = gauge.at[self._bucket(t0), gidx].add(val)
+        return gauge.at[self._bucket(t1), gidx].add(-val)
+
+    # ------------------------------------------------------------------
+    # main
+    # ------------------------------------------------------------------
+
+    def _run_one(self, key, ov: ScenarioOverrides) -> FastState:
+        plan = self.plan
+        n = self.n
+        n_gauge_rows = plan.n_samples + 2 if self.collect_gauges else 1
+        n_gauges = plan.n_gauges if self.collect_gauges else 1
+        gauge = jnp.zeros((n_gauge_rows, n_gauges), jnp.float32)
+
+        t, alive, overflow = self._arrivals(jax.random.fold_in(key, 0), ov)
+        start = t
+        n_generated = jnp.sum(alive)
+        n_dropped = jnp.int32(0)
+
+        # ---- entry chain ------------------------------------------------
+        for j, eidx in enumerate(plan.entry_edges.tolist()):
+            # a send at t >= horizon never happens in the event engines
+            # (events past the horizon don't fire): freeze silently
+            alive = alive & (t < plan.horizon)
+            dropped, delay = self._edge_hop(
+                jax.random.fold_in(key, 16 + j), eidx, t, ov,
+            )
+            ok = alive & ~dropped
+            gauge = self._gauge_intervals(gauge, eidx, t, t + delay, 1.0, ok)
+            n_dropped = n_dropped + jnp.sum(alive & dropped)
+            t = jnp.where(ok, t + delay, t)
+            alive = ok
+
+        # ---- routing ----------------------------------------------------
+        alive = alive & (t < plan.horizon)
+        srv = jnp.full(n, jnp.int32(max(plan.entry_target, 0)))
+        if plan.n_lb_edges > 0:
+            order = jnp.argsort(jnp.where(alive, t, INF))
+            rank_sorted = jnp.cumsum(alive[order].astype(jnp.int32)) - 1
+            rank = jnp.zeros(n, jnp.int32).at[order].set(rank_sorted)
+            slot = jnp.where(alive, rank % plan.n_lb_edges, 0)
+            srv = jnp.asarray(plan.lb_target)[slot]
+            # per-request edge draws: one pass per LB slot (static, small)
+            new_t = t
+            new_alive = alive
+            for s_idx, eidx in enumerate(plan.lb_edge_index.tolist()):
+                mine = alive & (slot == s_idx)
+                dropped, delay = self._edge_hop(
+                    jax.random.fold_in(key, 32 + s_idx), eidx, t, ov,
+                )
+                ok = mine & ~dropped
+                gauge = self._gauge_intervals(gauge, eidx, t, t + delay, 1.0, ok)
+                n_dropped = n_dropped + jnp.sum(mine & dropped)
+                new_t = jnp.where(ok, t + delay, new_t)
+                new_alive = jnp.where(mine, ok, new_alive)
+            t, alive = new_t, new_alive
+
+        # ---- servers in topological order -------------------------------
+        finish = jnp.full(n, INF, jnp.float32)
+        completed = jnp.zeros(n, bool)
+        seg_kind = jnp.asarray(plan.seg_kind)
+        seg_dur = jnp.asarray(plan.seg_dur)
+        for s in plan.server_topo_order:
+            mine = alive & (srv == s) & (t < plan.horizon)
+            nep = int(plan.n_endpoints[s])
+            u = jax.random.uniform(jax.random.fold_in(key, 64 + s), (n,))
+            ep = jnp.minimum((u * nep).astype(jnp.int32), nep - 1)
+            # per-endpoint cpu/io durations of the compiled segments
+            k0 = seg_kind[s, ep, 0]
+            d0 = seg_dur[s, ep, 0]
+            k1 = seg_kind[s, ep, 1] if plan.max_segments > 1 else jnp.zeros(n, jnp.int32)
+            d1 = seg_dur[s, ep, 1] if plan.max_segments > 1 else jnp.zeros(n)
+            cpu = jnp.where(k0 == SEG_CPU, d0, 0.0)
+            io = jnp.where(k0 == SEG_IO, d0, 0.0) + jnp.where(k1 == SEG_IO, d1, 0.0)
+            ram = jnp.asarray(plan.endpoint_ram)[s, ep]
+
+            arr = jnp.where(mine, t, INF)
+            order = jnp.argsort(arr)
+            arr_s = arr[order]
+            valid_s = mine[order]
+            cpu_s = jnp.where(valid_s, cpu[order], 0.0)
+            waits_s = _lindley_waits(arr_s, cpu_s, valid_s)
+            # IO-only requests bypass the core: their own wait is zero
+            waits_s = jnp.where(cpu_s > 0, waits_s, 0.0)
+            wait = jnp.zeros(n).at[order].set(waits_s)
+
+            dep = t + wait + cpu + io
+            # gauges: ready queue during the wait, io sleep, ram residency
+            gauge = self._gauge_intervals(
+                gauge, plan.n_edges + s, t, t + wait, 1.0, mine & (wait > 0),
+            )
+            gauge = self._gauge_intervals(
+                gauge,
+                plan.n_edges + plan.n_servers + s,
+                t + wait + cpu,
+                dep,
+                1.0,
+                mine & (io > 0),
+            )
+            gauge = self._gauge_intervals(
+                gauge,
+                plan.n_edges + 2 * plan.n_servers + s,
+                t,
+                dep,
+                ram,
+                mine & (ram > 0),
+            )
+
+            # exit edge: the send only happens while the clock is running
+            sendable = mine & (dep < plan.horizon)
+            eidx = int(plan.exit_edge[s])
+            dropped, delay = self._edge_hop(
+                jax.random.fold_in(key, 128 + s), eidx, dep, ov,
+            )
+            ok = sendable & ~dropped
+            gauge = self._gauge_intervals(gauge, eidx, dep, dep + delay, 1.0, ok)
+            n_dropped = n_dropped + jnp.sum(sendable & dropped)
+            if plan.exit_kind[s] == TARGET_SERVER:
+                nxt = int(plan.exit_target[s])
+                t = jnp.where(ok, dep + delay, t)
+                srv = jnp.where(ok, nxt, srv)
+                alive = jnp.where(mine, ok, alive)
+            else:  # client: completion
+                fin = dep + delay
+                done = ok & (fin < plan.horizon)
+                finish = jnp.where(done, fin, finish)
+                completed = completed | done
+                alive = jnp.where(mine, False, alive)
+
+        # ---- reductions --------------------------------------------------
+        latency = jnp.where(completed, finish - start, 0.0)
+        lbin = latency_bin(latency, self.hist_lo, self.hist_scale, self.n_hist_bins)
+        one = completed.astype(jnp.int32)
+        hist = jnp.zeros(self.n_hist_bins, jnp.int32).at[
+            jnp.where(completed, lbin, self.n_hist_bins)
+        ].add(1, mode="drop")
+        tbin = jnp.clip(jnp.ceil(finish).astype(jnp.int32) - 1, 0, self.n_thr - 1)
+        thr = jnp.zeros(self.n_thr, jnp.int32).at[
+            jnp.where(completed, tbin, self.n_thr)
+        ].add(1, mode="drop")
+
+        if self.collect_clocks:
+            # clocks in arrival order, compacted to the front
+            idx = jnp.where(completed, jnp.cumsum(one) - 1, self.n)
+            clock = jnp.zeros((self.n, 2), jnp.float32)
+            clock = clock.at[idx, 0].set(start, mode="drop")
+            clock = clock.at[idx, 1].set(finish, mode="drop")
+            clock_n = jnp.sum(one)
+        else:
+            clock = jnp.zeros((1, 2), jnp.float32)
+            clock_n = jnp.sum(one)
+
+        return FastState(
+            hist=hist,
+            lat_count=jnp.sum(one),
+            lat_sum=jnp.sum(latency),
+            lat_sumsq=jnp.sum(latency * latency),
+            lat_min=jnp.min(jnp.where(completed, latency, INF)),
+            lat_max=jnp.max(jnp.where(completed, latency, 0.0)),
+            thr=thr,
+            gauge=gauge,
+            clock=clock,
+            clock_n=clock_n,
+            n_generated=n_generated,
+            n_dropped=n_dropped,
+            n_overflow=overflow,
+        )
+
+    def run_batch(
+        self,
+        keys: jnp.ndarray,
+        overrides: ScenarioOverrides | None = None,
+    ) -> FastState:
+        """Run |keys| scenarios as one vmapped kernel."""
+        ov = overrides if overrides is not None else base_overrides(self.plan)
+        axes = ScenarioOverrides(
+            *[
+                0 if jnp.asarray(o).ndim > jnp.asarray(b).ndim else None
+                for o, b in zip(ov, base_overrides(self.plan))
+            ],
+        )
+        sig = tuple(axes)
+        if sig not in self._compiled:
+            self._compiled[sig] = jax.jit(jax.vmap(self._run_one, in_axes=(0, axes)))
+        return self._compiled[sig](keys, ov)

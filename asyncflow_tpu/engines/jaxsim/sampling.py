@@ -1,0 +1,57 @@
+"""Distribution formulas and metric-bucket helpers shared by both JAX engines.
+
+One home for the per-distribution math keeps the event engine and the scan
+fast path from drifting (the reference contract lives here once: uniform
+ignores the mean, normal/lognormal use the ``variance`` field as numpy's
+scale argument, see ``/root/reference/src/asyncflow/samplers/common_helpers.py``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+TINY = 1e-15
+
+# distribution ids (compiler order)
+D_UNIFORM, D_POISSON, D_EXPONENTIAL, D_NORMAL, D_LOGNORMAL = range(5)
+
+HIST_LO_S = 1e-4
+HIST_HI_S = 1e3
+
+
+def exponential_from_u(mean, u):
+    """Inverse-CDF exponential draw from a uniform."""
+    return -mean * jnp.log(jnp.maximum(1.0 - u, TINY))
+
+
+def truncated_normal(mean, scale, z):
+    """Zero-truncated normal; ``scale`` is the reference's variance field."""
+    return jnp.maximum(0.0, mean + scale * z)
+
+
+def lognormal(mean, scale, z):
+    """Lognormal with underlying (mean, scale); scale is the variance field."""
+    return jnp.exp(mean + scale * z)
+
+
+def hist_constants(n_bins: int) -> tuple[float, float]:
+    """(log-lo, bins-per-log) of the shared latency histogram."""
+    lo = float(np.log(HIST_LO_S))
+    scale = float(n_bins / (np.log(HIST_HI_S) - np.log(HIST_LO_S)))
+    return lo, scale
+
+
+def latency_bin(latency, lo: float, scale: float, n_bins: int):
+    """Log-histogram bin index of a latency value."""
+    return jnp.clip(
+        ((jnp.log(jnp.maximum(latency, 1e-6)) - lo) * scale).astype(jnp.int32),
+        0,
+        n_bins - 1,
+    )
+
+
+def sample_bucket(t, period: float, n_samples: int):
+    """Sample-tick bucket: a delta at ``t`` affects samples at ticks >= t."""
+    b = jnp.ceil(t / period).astype(jnp.int32)
+    return jnp.clip(b, 0, n_samples + 1)

@@ -115,20 +115,39 @@ class SweepRunner:
         pool_size: int | None = None,
         n_hist_bins: int = 1024,
         use_mesh: bool = True,
+        engine: str = "auto",
     ) -> None:
+        """``engine``: "auto" picks the scan fast path when the plan is
+        eligible (orders of magnitude faster), falling back to the general
+        event engine; "event"/"fast" force one."""
+        if engine not in ("auto", "fast", "event"):
+            msg = f"engine must be 'auto', 'fast' or 'event', got {engine!r}"
+            raise ValueError(msg)
         self.payload = payload
         self.plan = compile_payload(payload, pool_size=pool_size)
-        self.engine = Engine(
-            self.plan,
-            collect_gauges=False,
-            collect_clocks=False,
-            n_hist_bins=n_hist_bins,
-        )
+        if engine == "fast" or (engine == "auto" and self.plan.fastpath_ok):
+            from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+
+            self.engine = FastEngine(self.plan, n_hist_bins=n_hist_bins)
+            self.engine_kind = "fast"
+        else:
+            self.engine = Engine(
+                self.plan,
+                collect_gauges=False,
+                collect_clocks=False,
+                n_hist_bins=n_hist_bins,
+            )
+            self.engine_kind = "event"
         self.mesh = scenario_mesh() if use_mesh and len(jax.devices()) > 1 else None
 
-    # Default chunk: bounds both device memory and single-kernel runtime
+    def _guard_fastpath_overrides(self, overrides: ScenarioOverrides | None) -> None:
+        if self.engine_kind == "fast":
+            _guard_overrides_against_plan(self.plan, overrides)
+
+    # Default chunks bound both device memory and single-kernel runtime
     # (tunneled TPU workers kill executions running longer than ~1 minute).
-    DEFAULT_CHUNK = 64
+    DEFAULT_CHUNK = 64  # event engine: while-loop iterations dominate
+    DEFAULT_CHUNK_FAST = 512  # scan engine: (S, N) array memory dominates
 
     def run(
         self,
@@ -141,8 +160,12 @@ class SweepRunner:
         """Execute the sweep, chunking to bound memory and kernel runtime."""
         import time
 
+        self._guard_fastpath_overrides(overrides)
         n_dev = len(self.mesh.devices.flat) if self.mesh is not None else 1
-        chunk = chunk_size or min(self.DEFAULT_CHUNK * n_dev, n_scenarios)
+        default = (
+            self.DEFAULT_CHUNK_FAST if self.engine_kind == "fast" else self.DEFAULT_CHUNK
+        )
+        chunk = chunk_size or min(default * n_dev, n_scenarios)
         chunk = max(n_dev, (chunk // n_dev) * n_dev)
 
         t0 = time.time()
@@ -166,6 +189,35 @@ class SweepRunner:
 
         merged = _concat_sweeps(partials)[:n_scenarios]
         return SweepReport(results=merged, n_scenarios=n_scenarios, wall_seconds=wall)
+
+
+def _sweep_max(value) -> float:
+    return float(np.max(np.asarray(value)))
+
+
+class _FastpathOverrideError(ValueError):
+    pass
+
+
+def _guard_overrides_against_plan(
+    plan,
+    overrides: ScenarioOverrides | None,
+) -> None:
+    """The fast path's eligibility proof (RAM non-binding, rho < 1) was made
+    at the base workload rate; refuse overrides that raise it."""
+    if overrides is None:
+        return
+    base = base_overrides(plan)
+    base_rate = float(base.user_mean) * float(base.req_rate)
+    max_rate = _sweep_max(overrides.user_mean) * _sweep_max(overrides.req_rate)
+    if max_rate > base_rate * 1.001:
+        msg = (
+            "overrides raise the workload rate above the base plan "
+            f"({max_rate:.2f} vs {base_rate:.2f} rps), which invalidates the "
+            "fast path's RAM/CPU eligibility proof; use "
+            "SweepRunner(..., engine='event') or raise the base workload"
+        )
+        raise _FastpathOverrideError(msg)
 
 
 def _slice_overrides(

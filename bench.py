@@ -20,8 +20,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-N_SCENARIOS = int(os.environ.get("BENCH_SCENARIOS", "128"))
-HORIZON = int(os.environ.get("BENCH_HORIZON", "60"))
+N_SCENARIOS = int(os.environ.get("BENCH_SCENARIOS", "2048"))
+HORIZON = int(os.environ.get("BENCH_HORIZON", "600"))
 SEED = 1234
 
 
@@ -57,7 +57,12 @@ def main() -> None:
 
     runner = SweepRunner(payload)
     # warm-up compile at the exact chunk shape the measured run will use
-    chunk = min(SweepRunner.DEFAULT_CHUNK, N_SCENARIOS)
+    default = (
+        SweepRunner.DEFAULT_CHUNK_FAST
+        if runner.engine_kind == "fast"
+        else SweepRunner.DEFAULT_CHUNK
+    )
+    chunk = min(int(os.environ.get("BENCH_CHUNK", str(default))), N_SCENARIOS)
     runner.run(chunk, seed=SEED, chunk_size=chunk)
     report = runner.run(N_SCENARIOS, seed=SEED, chunk_size=chunk)
     summary = report.summary()
@@ -77,6 +82,7 @@ def main() -> None:
                 "unit": "scenarios/sec",
                 "vs_baseline": round(value / baseline_rate, 2),
                 "detail": {
+                    "engine": runner.engine_kind,
                     "oracle_wall_s_per_scenario": round(oracle_wall, 3),
                     "sweep_wall_s": round(report.wall_seconds, 3),
                     "latency_p95_ms": round(summary["latency_p95_s"] * 1e3, 3),

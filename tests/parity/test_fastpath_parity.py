@@ -1,0 +1,245 @@
+"""Parity tests: scan fast path vs the CPU oracle (and eligibility logic)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import yaml
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.engines.jaxsim.engine import scenario_keys
+from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+from asyncflow_tpu.engines.oracle.engine import OracleEngine
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+pytestmark = pytest.mark.integration
+
+SEEDS = 12
+BASE = "tests/integration/data/single_server.yml"
+LB = "tests/integration/data/two_servers_lb.yml"
+
+
+def _payload(path: str, mutate=None) -> SimulationPayload:
+    data = yaml.safe_load(open(path).read())
+    if mutate:
+        mutate(data)
+    return SimulationPayload.model_validate(data)
+
+
+def _fast_latencies(payload: SimulationPayload, n: int) -> np.ndarray:
+    plan = compile_payload(payload)
+    assert plan.fastpath_ok, plan.fastpath_reason
+    engine = FastEngine(plan, collect_clocks=True)
+    final = engine.run_batch(scenario_keys(11, n))
+    clock = np.asarray(final.clock)
+    counts = np.asarray(final.clock_n)
+    return np.concatenate(
+        [clock[i, : counts[i], 1] - clock[i, : counts[i], 0] for i in range(n)],
+    )
+
+
+def _oracle_latencies(payload: SimulationPayload, n: int) -> np.ndarray:
+    return np.concatenate(
+        [OracleEngine(payload, seed=s).run().latencies for s in range(n)],
+    )
+
+
+def _assert_parity(a: np.ndarray, b: np.ndarray, tol: float) -> None:
+    assert a.size > 1000 and b.size > 1000
+    for q in (50, 90, 95):
+        pa, pb = np.percentile(a, q), np.percentile(b, q)
+        assert abs(pa - pb) / pb < tol, f"p{q}: fast={pa:.6f} oracle={pb:.6f}"
+    assert abs(a.mean() - b.mean()) / b.mean() < tol
+
+
+def test_fastpath_single_server() -> None:
+    payload = _payload(BASE)
+    _assert_parity(_fast_latencies(payload, SEEDS), _oracle_latencies(payload, SEEDS), 0.03)
+
+
+def test_fastpath_lb_round_robin() -> None:
+    payload = _payload(LB)
+    _assert_parity(_fast_latencies(payload, SEEDS), _oracle_latencies(payload, SEEDS), 0.03)
+
+
+def test_fastpath_network_spike() -> None:
+    def add_spike(data: dict) -> None:
+        data["events"] = [
+            {
+                "event_id": "spike-1",
+                "target_id": "client-srv",
+                "start": {
+                    "kind": "network_spike_start",
+                    "t_start": 10.0,
+                    "spike_s": 0.04,
+                },
+                "end": {"kind": "network_spike_end", "t_end": 40.0},
+            },
+        ]
+
+    payload = _payload(BASE, add_spike)
+    lat_fast = _fast_latencies(payload, SEEDS)
+    lat_oracle = _oracle_latencies(payload, SEEDS)
+    # the latency distribution is bimodal (spiked vs unspiked sends) and the
+    # median sits exactly at the mode boundary, so percentiles are ill-posed;
+    # compare the mean and the mixture weight instead
+    assert abs(lat_fast.mean() - lat_oracle.mean()) / lat_oracle.mean() < 0.04
+    frac_fast = float(np.mean(lat_fast > 0.045))
+    frac_oracle = float(np.mean(lat_oracle > 0.045))
+    assert abs(frac_fast - frac_oracle) < 0.03
+
+
+def test_fastpath_cpu_queueing() -> None:
+    """Moderate CPU contention: Lindley waits must match the oracle's FIFO."""
+
+    def mutate(data: dict) -> None:
+        server = data["topology_graph"]["nodes"]["servers"][0]
+        server["endpoints"][0]["steps"] = [
+            {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.03}},
+            {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.02}},
+        ]
+        data["rqs_input"]["avg_active_users"]["mean"] = 60  # rho ~ 0.6
+
+    payload = _payload(BASE, mutate)
+    _assert_parity(_fast_latencies(payload, SEEDS), _oracle_latencies(payload, SEEDS), 0.05)
+
+
+def test_fastpath_mixed_endpoints_with_io_only() -> None:
+    """IO-only endpoints bypass the core but keep the FIFO recursion intact."""
+
+    def mutate(data: dict) -> None:
+        server = data["topology_graph"]["nodes"]["servers"][0]
+        server["endpoints"] = [
+            {
+                "endpoint_name": "compute",
+                "steps": [
+                    {"kind": "cpu_bound_operation", "step_operation": {"cpu_time": 0.02}},
+                    {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.01}},
+                ],
+            },
+            {
+                "endpoint_name": "passthrough",
+                "steps": [
+                    {"kind": "io_cache", "step_operation": {"io_waiting_time": 0.005}},
+                ],
+            },
+        ]
+        data["rqs_input"]["avg_active_users"]["mean"] = 80
+
+    payload = _payload(BASE, mutate)
+    _assert_parity(_fast_latencies(payload, SEEDS), _oracle_latencies(payload, SEEDS), 0.05)
+
+
+def test_fastpath_server_chain() -> None:
+    """client -> app -> db -> client chain processed in topological order."""
+
+    def mutate(data: dict) -> None:
+        nodes = data["topology_graph"]["nodes"]
+        nodes["servers"].append(
+            {
+                "id": "srv-db",
+                "server_resources": {"cpu_cores": 1, "ram_mb": 1024},
+                "endpoints": [
+                    {
+                        "endpoint_name": "query",
+                        "steps": [
+                            {
+                                "kind": "initial_parsing",
+                                "step_operation": {"cpu_time": 0.002},
+                            },
+                            {
+                                "kind": "io_db",
+                                "step_operation": {"io_waiting_time": 0.015},
+                            },
+                        ],
+                    },
+                ],
+            },
+        )
+        # rewire: srv-1 -> srv-db -> client
+        for edge in data["topology_graph"]["edges"]:
+            if edge["id"] == "srv-client":
+                edge["target"] = "srv-db"
+        data["topology_graph"]["edges"].append(
+            {
+                "id": "db-client",
+                "source": "srv-db",
+                "target": "client-1",
+                "latency": {"mean": 0.003, "distribution": "exponential"},
+                "dropout_rate": 0.0,
+            },
+        )
+
+    payload = _payload(BASE, mutate)
+    plan = compile_payload(payload)
+    assert plan.fastpath_ok
+    assert len(plan.server_topo_order) == 2
+    _assert_parity(_fast_latencies(payload, SEEDS), _oracle_latencies(payload, SEEDS), 0.04)
+
+
+class TestEligibility:
+    def test_outages_ineligible(self) -> None:
+        def add_outage(data: dict) -> None:
+            data["events"] = [
+                {
+                    "event_id": "o1",
+                    "target_id": "srv-1",
+                    "start": {"kind": "server_down", "t_start": 5.0},
+                    "end": {"kind": "server_up", "t_end": 10.0},
+                },
+            ]
+
+        plan = compile_payload(_payload(LB, add_outage))
+        assert not plan.fastpath_ok
+        assert "outage" in plan.fastpath_reason
+
+    def test_multicore_ineligible(self) -> None:
+        def mutate(data: dict) -> None:
+            data["topology_graph"]["nodes"]["servers"][0]["server_resources"][
+                "cpu_cores"
+            ] = 4
+
+        plan = compile_payload(_payload(BASE, mutate))
+        assert not plan.fastpath_ok
+        assert "multi-core" in plan.fastpath_reason
+
+    def test_multi_burst_ineligible(self) -> None:
+        def mutate(data: dict) -> None:
+            data["topology_graph"]["nodes"]["servers"][0]["endpoints"][0]["steps"] = [
+                {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.001}},
+                {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.01}},
+                {"kind": "cpu_bound_operation", "step_operation": {"cpu_time": 0.001}},
+            ]
+
+        plan = compile_payload(_payload(BASE, mutate))
+        assert not plan.fastpath_ok
+        assert "multi-burst" in plan.fastpath_reason
+
+    def test_ram_binding_ineligible(self) -> None:
+        def mutate(data: dict) -> None:
+            server = data["topology_graph"]["nodes"]["servers"][0]
+            server["server_resources"]["ram_mb"] = 256
+            server["endpoints"][0]["steps"][1]["step_operation"]["necessary_ram"] = 200
+
+        plan = compile_payload(_payload(BASE, mutate))
+        assert not plan.fastpath_ok
+        assert "RAM" in plan.fastpath_reason
+
+    def test_least_connections_ineligible(self) -> None:
+        def mutate(data: dict) -> None:
+            data["topology_graph"]["nodes"]["load_balancer"]["algorithms"] = (
+                "least_connection"
+            )
+
+        plan = compile_payload(_payload(LB, mutate))
+        assert not plan.fastpath_ok
+
+    def test_fast_engine_rejects_ineligible_plan(self) -> None:
+        def mutate(data: dict) -> None:
+            data["topology_graph"]["nodes"]["servers"][0]["server_resources"][
+                "cpu_cores"
+            ] = 4
+
+        plan = compile_payload(_payload(BASE, mutate))
+        with pytest.raises(ValueError, match="not eligible"):
+            FastEngine(plan)
