@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Lint (ruff, if installed) + compile check of every module.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+if command -v ruff >/dev/null 2>&1; then
+  ruff check asyncflow_tpu tests
+else
+  echo "ruff not installed; running a bytecode compile check instead"
+  python -m compileall -q asyncflow_tpu tests bench.py __graft_entry__.py
+fi

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Headline benchmark (defaults: 2048-scenario sweep of the 600 s LB example).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python bench.py

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Full test suite (unit + integration + parity + system) on forced-CPU JAX.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -q "$@"

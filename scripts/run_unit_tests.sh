@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Fast tier only: schema/builder/kernel/oracle unit tests.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m pytest tests/unit -q "$@"
