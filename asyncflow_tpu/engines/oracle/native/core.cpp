@@ -1,0 +1,512 @@
+// Native discrete-event core for the oracle engine.
+//
+// A sequential C++ implementation of the same actor semantics the Python
+// oracle executes (RAM-first FIFO admission, lazy core lock via merged
+// CPU/IO segments, FIFO ready queue, dropout-then-spike edges, rotation
+// order load balancing, outage timelines) driven by the compiler's
+// StaticPlan arrays.  Exposed through a plain C ABI and loaded with ctypes
+// (no pybind11 in this environment).  Parity with the Python engines is
+// distributional — the RNG stream differs by design.
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 core.cpp -o _afnative.so
+
+#include <cstdint>
+#include <cmath>
+#include <queue>
+#include <deque>
+#include <random>
+#include <vector>
+#include <algorithm>
+
+namespace {
+
+// segment kinds (compiler order)
+constexpr int SEG_CPU = 1;
+constexpr int SEG_IO = 2;
+
+// hop targets (compiler order)
+constexpr int TARGET_SERVER = 1;
+constexpr int TARGET_LB = 2;
+
+// distributions (compiler order)
+constexpr int D_UNIFORM = 0;
+constexpr int D_POISSON = 1;
+constexpr int D_EXPONENTIAL = 2;
+constexpr int D_NORMAL = 3;
+constexpr int D_LOGNORMAL = 4;
+
+struct PlanC {
+    // edges
+    int32_t n_edges;
+    const int32_t* edge_dist;
+    const float* edge_mean;
+    const float* edge_var;
+    const float* edge_dropout;
+    // entry chain
+    int32_t n_entry;
+    const int32_t* entry_edges;
+    int32_t entry_target_kind;
+    int32_t entry_target;
+    // servers
+    int32_t n_servers;
+    int32_t max_endpoints;
+    int32_t max_segments;  // seg arrays have max_segments + 1 columns
+    const int32_t* server_cores;
+    const float* server_ram;
+    const int32_t* n_endpoints;
+    const int32_t* seg_kind;  // [NS][NEP][NSEG+1]
+    const float* seg_dur;
+    const float* endpoint_ram;  // [NS][NEP]
+    const int32_t* exit_edge;
+    const int32_t* exit_kind;
+    const int32_t* exit_target;
+    // load balancer
+    int32_t lb_algo;  // 0 = round robin, 1 = least connections
+    int32_t n_lb_edges;
+    const int32_t* lb_edge_index;
+    const int32_t* lb_target;
+    // spikes (piecewise-constant cumulative spike per edge)
+    int32_t n_spike_times;
+    const float* spike_times;
+    const float* spike_values;  // [NB][NE]
+    // outage timeline
+    int32_t n_timeline;
+    const float* timeline_times;
+    const int32_t* timeline_down;
+    const int32_t* timeline_slot;
+    // workload
+    double user_mean;
+    double user_var;  // < 0: Poisson users
+    double user_window;
+    double req_rate;  // requests / user / second
+    // geometry
+    double horizon;
+    double sample_period;
+    int64_t n_samples;
+    int64_t max_requests;
+};
+
+struct Request {
+    double start = 0.0;
+    double ram = 0.0;
+    int32_t srv = -1;
+    int32_t ep = 0;
+    int32_t seg = 0;   // segment index; hop index during the entry chain
+    int32_t lbslot = -1;
+};
+
+struct Server {
+    int32_t cores_free = 1;
+    double ram_free = 0.0;
+    double ram_in_use = 0.0;
+    int32_t ready_len = 0;
+    int32_t io_len = 0;
+    std::deque<int32_t> cpu_wait;                      // request idx, FIFO
+    std::deque<std::pair<double, int32_t>> ram_wait;   // (amount, request)
+};
+
+enum EvType : int32_t {
+    EV_ARRIVAL = 0,     // generator emits a request
+    EV_ENTRY_HOP = 1,   // delivery of entry-chain hop `req.seg`
+    EV_ARRIVE_LB = 2,
+    EV_ARRIVE_SRV = 3,
+    EV_SEG_END = 4,
+    EV_RESUME = 5,      // RAM granted
+    EV_COMPLETE = 6,    // delivery at the client (second visit)
+    EV_TIMELINE = 7,
+    EV_SAMPLE = 8,
+};
+
+struct Ev {
+    double t;
+    uint64_t seq;
+    int32_t type;
+    int32_t req;
+    int32_t edge;  // in-flight edge to decrement on delivery, -1 none
+    bool operator>(const Ev& o) const {
+        return t != o.t ? t > o.t : seq > o.seq;
+    }
+};
+
+struct Sim {
+    const PlanC& p;
+    std::mt19937_64 rng;
+    std::priority_queue<Ev, std::vector<Ev>, std::greater<Ev>> heap;
+    uint64_t seq = 0;
+    double now = 0.0;
+
+    std::vector<Request> reqs;
+    std::vector<int32_t> free_slots;
+    std::vector<Server> servers;
+    std::vector<int32_t> lb_rotation;  // slot ids in rotation order
+    std::vector<int32_t> lb_conn;
+    std::vector<int32_t> edge_conn;    // in-flight messages per edge
+
+    // arrival sampler state (sampler clock drifts from sim clock by design)
+    double smp_now = 0.0, smp_window_end = 0.0, smp_lam = 0.0;
+
+    int32_t tl_ptr = 0;
+    int64_t sample_idx = 0;
+
+    // outputs
+    double* out_clock = nullptr;  // [max_requests][2]
+    int64_t clock_n = 0;
+    float* out_gauges = nullptr;  // [n_samples][NG] or nullptr
+    int64_t generated = 0, dropped = 0;
+
+    explicit Sim(const PlanC& plan, uint64_t seed) : p(plan), rng(seed) {
+        servers.resize(p.n_servers);
+        for (int s = 0; s < p.n_servers; ++s) {
+            servers[s].cores_free = p.server_cores[s];
+            servers[s].ram_free = p.server_ram[s];
+        }
+        lb_rotation.resize(p.n_lb_edges);
+        for (int i = 0; i < p.n_lb_edges; ++i) lb_rotation[i] = i;
+        lb_conn.assign(p.n_lb_edges, 0);
+        edge_conn.assign(p.n_edges, 0);
+    }
+
+    void push(double t, int32_t type, int32_t req, int32_t edge = -1) {
+        heap.push(Ev{t, seq++, type, req, edge});
+    }
+
+    // ---- randomness ---------------------------------------------------
+    double uniform() {
+        return std::uniform_real_distribution<double>(0.0, 1.0)(rng);
+    }
+    double sample_edge_delay(int e) {
+        double mean = p.edge_mean[e], var = p.edge_var[e];
+        switch (p.edge_dist[e]) {
+            case D_UNIFORM: return uniform();
+            case D_POISSON:
+                return (double)std::poisson_distribution<long>(mean)(rng);
+            case D_EXPONENTIAL:
+                return std::exponential_distribution<double>(1.0 / mean)(rng);
+            case D_NORMAL: {
+                // reference contract: the variance field is numpy's scale
+                double v = std::normal_distribution<double>(mean, var)(rng);
+                return v < 0.0 ? 0.0 : v;
+            }
+            case D_LOGNORMAL:
+                return std::lognormal_distribution<double>(mean, var)(rng);
+        }
+        return 0.0;
+    }
+    double spike_at(int e, double t) const {
+        if (p.n_spike_times <= 1) return 0.0;
+        const float* times = p.spike_times;
+        int idx = int(std::upper_bound(times, times + p.n_spike_times, (float)t)
+                      - times) - 1;
+        if (idx < 0) idx = 0;
+        return p.spike_values[(int64_t)idx * p.n_edges + e];
+    }
+
+    // ---- arrival process (window-jump semantics) ----------------------
+    // Next emitted gap, or negative when the stream is exhausted.  Window
+    // boundary jumps advance the sampler clock only; simulated time advances
+    // by emitted gaps, reproducing the reference generator's drift.
+    double next_gap() {
+        while (true) {
+            if (smp_now >= p.horizon) return -1.0;
+            if (smp_now >= smp_window_end) {
+                smp_window_end = smp_now + p.user_window;
+                double users;
+                if (p.user_var < 0) {
+                    users = (double)std::poisson_distribution<long>(
+                        p.user_mean)(rng);
+                } else {
+                    users = std::normal_distribution<double>(
+                        p.user_mean, p.user_var)(rng);
+                    if (users < 0.0) users = 0.0;
+                }
+                smp_lam = users * p.req_rate;
+            }
+            if (smp_lam <= 0.0) { smp_now = smp_window_end; continue; }
+            double u = uniform();
+            if (u < 1e-15) u = 1e-15;
+            double gap = -std::log(1.0 - u) / smp_lam;
+            if (smp_now + gap > p.horizon) return -1.0;
+            if (smp_now + gap >= smp_window_end) { smp_now = smp_window_end; continue; }
+            smp_now += gap;
+            return gap;
+        }
+    }
+
+    void schedule_next_arrival() {
+        double gap = next_gap();
+        if (gap >= 0.0) push(now + gap, EV_ARRIVAL, -1);
+    }
+
+    // ---- request slots ------------------------------------------------
+    int32_t alloc() {
+        if (!free_slots.empty()) {
+            int32_t i = free_slots.back();
+            free_slots.pop_back();
+            reqs[i] = Request{};
+            return i;
+        }
+        reqs.emplace_back();
+        return (int32_t)reqs.size() - 1;
+    }
+    void release(int32_t i) { free_slots.push_back(i); }
+
+    // ---- edge traversal ------------------------------------------------
+    // Rolls dropout + delay at `now`; on success increments the in-flight
+    // counter and schedules `type` at the delivery time.  Returns false when
+    // the message was dropped (the request slot is released).
+    bool send(int e, int32_t type, int32_t req_idx) {
+        if (uniform() < p.edge_dropout[e]) {
+            ++dropped;
+            if (req_idx >= 0) release(req_idx);
+            return false;
+        }
+        double delay = sample_edge_delay(e) + spike_at(e, now);
+        ++edge_conn[e];
+        push(now + delay, type, req_idx, e);
+        return true;
+    }
+
+    const int32_t* segs(int s, int ep) const {
+        return p.seg_kind + ((int64_t)s * p.max_endpoints + ep)
+                                * (p.max_segments + 1);
+    }
+    const float* durs(int s, int ep) const {
+        return p.seg_dur + ((int64_t)s * p.max_endpoints + ep)
+                               * (p.max_segments + 1);
+    }
+
+    // ---- server machinery ---------------------------------------------
+    void start_segment(int32_t i) {
+        Request& r = reqs[i];
+        Server& sv = servers[r.srv];
+        int kind = segs(r.srv, r.ep)[r.seg];
+        double dur = durs(r.srv, r.ep)[r.seg];
+        if (kind == SEG_CPU) {
+            if (sv.cores_free > 0 && sv.cpu_wait.empty()) {
+                --sv.cores_free;
+                push(now + dur, EV_SEG_END, i);
+            } else {
+                sv.cpu_wait.push_back(i);
+                ++sv.ready_len;
+            }
+        } else if (kind == SEG_IO) {
+            ++sv.io_len;
+            push(now + dur, EV_SEG_END, i);
+        } else {
+            exit_server(i);
+        }
+    }
+
+    void grant_cores(int s) {
+        Server& sv = servers[s];
+        while (sv.cores_free > 0 && !sv.cpu_wait.empty()) {
+            int32_t j = sv.cpu_wait.front();
+            sv.cpu_wait.pop_front();
+            --sv.ready_len;
+            --sv.cores_free;
+            double dur = durs(reqs[j].srv, reqs[j].ep)[reqs[j].seg];
+            push(now + dur, EV_SEG_END, j);
+        }
+    }
+
+    void grant_ram(int s) {
+        Server& sv = servers[s];
+        // strict FIFO with head-of-line blocking
+        while (!sv.ram_wait.empty() && sv.ram_wait.front().first <= sv.ram_free) {
+            auto [amount, j] = sv.ram_wait.front();
+            sv.ram_wait.pop_front();
+            sv.ram_free -= amount;
+            sv.ram_in_use += amount;
+            push(now, EV_RESUME, j);
+        }
+    }
+
+    void exit_server(int32_t i) {
+        Request& r = reqs[i];
+        int s = r.srv;
+        Server& sv = servers[s];
+        if (r.ram > 0.0) {
+            sv.ram_free += r.ram;
+            sv.ram_in_use -= r.ram;
+            r.ram = 0.0;
+            grant_ram(s);
+        }
+        int kind = p.exit_kind[s];
+        if (kind == TARGET_SERVER) {
+            r.srv = p.exit_target[s];
+            r.lbslot = -1;
+            send(p.exit_edge[s], EV_ARRIVE_SRV, i);
+        } else if (kind == TARGET_LB) {
+            send(p.exit_edge[s], EV_ARRIVE_LB, i);
+        } else {
+            send(p.exit_edge[s], EV_COMPLETE, i);
+        }
+    }
+
+    // ---- event handlers ------------------------------------------------
+    void on_arrival() {
+        ++generated;
+        schedule_next_arrival();
+        int32_t i = alloc();
+        reqs[i].start = now;
+        reqs[i].seg = 0;  // entry-hop index
+        send(p.entry_edges[0], EV_ENTRY_HOP, i);
+    }
+
+    void on_entry_hop(int32_t i) {
+        Request& r = reqs[i];
+        int hop = ++r.seg;  // this delivery completed hop (r.seg - 1)
+        if (hop < p.n_entry) {
+            send(p.entry_edges[hop], EV_ENTRY_HOP, i);
+            return;
+        }
+        r.seg = 0;
+        if (p.entry_target_kind == TARGET_LB) {
+            on_arrive_lb(i);
+        } else {
+            r.srv = p.entry_target;
+            on_arrive_srv(i);
+        }
+    }
+
+    void on_arrive_lb(int32_t i) {
+        if (lb_rotation.empty()) { ++dropped; release(i); return; }
+        int slot;
+        if (p.lb_algo == 0) {  // round robin: head out, rotate to tail
+            slot = lb_rotation.front();
+            lb_rotation.erase(lb_rotation.begin());
+            lb_rotation.push_back(slot);
+        } else {  // least connections: first minimum in rotation order
+            slot = lb_rotation[0];
+            for (size_t pos = 1; pos < lb_rotation.size(); ++pos)
+                if (lb_conn[lb_rotation[pos]] < lb_conn[slot])
+                    slot = lb_rotation[pos];
+        }
+        reqs[i].srv = p.lb_target[slot];
+        reqs[i].lbslot = slot;
+        // dropout is rolled before the connection count, like the Python
+        // oracle's transport(): dropped messages never count
+        if (send(p.lb_edge_index[slot], EV_ARRIVE_SRV, i)) ++lb_conn[slot];
+    }
+
+    void on_arrive_srv(int32_t i) {
+        Request& r = reqs[i];
+        if (r.lbslot >= 0) { --lb_conn[r.lbslot]; r.lbslot = -1; }
+        Server& sv = servers[r.srv];
+        int nep = p.n_endpoints[r.srv];
+        r.ep = (int32_t)std::min<long>((long)(uniform() * nep), nep - 1);
+        r.seg = 0;
+        double need = p.endpoint_ram[(int64_t)r.srv * p.max_endpoints + r.ep];
+        r.ram = need;
+        if (need <= 0.0) { start_segment(i); return; }
+        if (sv.ram_wait.empty() && sv.ram_free >= need) {
+            sv.ram_free -= need;
+            sv.ram_in_use += need;
+            start_segment(i);
+        } else {
+            sv.ram_wait.emplace_back(need, i);
+        }
+    }
+
+    void on_seg_end(int32_t i) {
+        Request& r = reqs[i];
+        Server& sv = servers[r.srv];
+        int kind = segs(r.srv, r.ep)[r.seg];
+        if (kind == SEG_CPU) {
+            ++sv.cores_free;
+            grant_cores(r.srv);
+        } else {
+            --sv.io_len;
+        }
+        ++r.seg;
+        start_segment(i);
+    }
+
+    void on_complete(int32_t i) {
+        Request& r = reqs[i];
+        if (clock_n < p.max_requests) {
+            out_clock[2 * clock_n] = r.start;
+            out_clock[2 * clock_n + 1] = now;
+            ++clock_n;
+        }
+        release(i);
+    }
+
+    void on_timeline() {
+        int slot = p.timeline_slot[tl_ptr];
+        bool down = p.timeline_down[tl_ptr] == 1;
+        ++tl_ptr;
+        if (slot < 0) return;
+        auto it = std::find(lb_rotation.begin(), lb_rotation.end(), slot);
+        if (down) {
+            if (it != lb_rotation.end()) lb_rotation.erase(it);
+        } else if (it == lb_rotation.end()) {
+            lb_rotation.push_back(slot);  // revive at the rotation tail
+        }
+    }
+
+    void on_sample() {
+        if (out_gauges && sample_idx < p.n_samples) {
+            float* row = out_gauges
+                + sample_idx * (p.n_edges + 3 * (int64_t)p.n_servers);
+            for (int e = 0; e < p.n_edges; ++e) row[e] = (float)edge_conn[e];
+            for (int s = 0; s < p.n_servers; ++s) {
+                row[p.n_edges + s] = (float)servers[s].ready_len;
+                row[p.n_edges + p.n_servers + s] = (float)servers[s].io_len;
+                row[p.n_edges + 2 * p.n_servers + s] =
+                    (float)servers[s].ram_in_use;
+            }
+        }
+        ++sample_idx;
+        double next = (sample_idx + 1) * p.sample_period;
+        if (next < p.horizon) push(next, EV_SAMPLE, -1);
+    }
+
+    void run() {
+        for (int i = 0; i < p.n_timeline; ++i)
+            push(p.timeline_times[i], EV_TIMELINE, -1);
+        if (p.sample_period > 0.0 && p.n_samples > 0)
+            push(p.sample_period, EV_SAMPLE, -1);
+        schedule_next_arrival();
+
+        while (!heap.empty() && heap.top().t < p.horizon) {
+            Ev ev = heap.top();
+            heap.pop();
+            now = ev.t;
+            if (ev.edge >= 0) --edge_conn[ev.edge];
+            switch (ev.type) {
+                case EV_ARRIVAL: on_arrival(); break;
+                case EV_ENTRY_HOP: on_entry_hop(ev.req); break;
+                case EV_ARRIVE_LB: on_arrive_lb(ev.req); break;
+                case EV_ARRIVE_SRV: on_arrive_srv(ev.req); break;
+                case EV_SEG_END: on_seg_end(ev.req); break;
+                case EV_RESUME: start_segment(ev.req); break;
+                case EV_COMPLETE: on_complete(ev.req); break;
+                case EV_TIMELINE: on_timeline(); break;
+                case EV_SAMPLE: on_sample(); break;
+            }
+        }
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+int64_t afnative_run(
+    const PlanC* plan,
+    uint64_t seed,
+    double* out_clock,
+    float* out_gauges,  // may be null
+    int64_t* out_counters /* [generated, dropped, clock_n] */) {
+    Sim sim(*plan, seed);
+    sim.out_clock = out_clock;
+    sim.out_gauges = out_gauges;
+    sim.run();
+    out_counters[0] = sim.generated;
+    out_counters[1] = sim.dropped;
+    out_counters[2] = sim.clock_n;
+    return 0;
+}
+
+}  // extern "C"

@@ -38,7 +38,37 @@ class SimulationRunner:
 
     def run(self) -> ResultsAnalyzer:
         """Execute the scenario on the selected engine."""
-        if self.backend == Backend.ORACLE:
+        backend = self.backend
+        if backend == Backend.NATIVE:
+            from asyncflow_tpu.engines.oracle.native import native_available
+
+            if native_available():
+                from asyncflow_tpu.compiler import compile_payload
+                from asyncflow_tpu.engines.oracle.native import run_native
+
+                # same determinism rule as the other backends: seeded iff the
+                # caller provided a seed
+                seed = self.seed
+                if seed is None:
+                    import secrets
+
+                    seed = secrets.randbits(63)
+                results = run_native(
+                    compile_payload(self.simulation_input),
+                    seed=seed,
+                    settings=self.simulation_input.sim_settings,
+                )
+                return ResultsAnalyzer(results)
+            import warnings
+
+            warnings.warn(
+                "native oracle core unavailable (no C++ toolchain); "
+                "falling back to the Python oracle engine",
+                stacklevel=2,
+            )
+            backend = Backend.ORACLE
+
+        if backend == Backend.ORACLE:
             from asyncflow_tpu.engines.oracle.engine import OracleEngine
 
             results = OracleEngine(self.simulation_input, seed=self.seed).run()

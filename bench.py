@@ -52,6 +52,20 @@ def main() -> None:
     oracle_wall = time.time() - t0
     baseline_rate = 1.0 / oracle_wall  # scenarios/sec, one at a time
 
+    # secondary reference point: the native C++ oracle core
+    native_wall = None
+    try:
+        from asyncflow_tpu.compiler import compile_payload
+        from asyncflow_tpu.engines.oracle.native import native_available, run_native
+
+        if native_available():
+            plan = compile_payload(payload)
+            t0 = time.time()
+            run_native(plan, seed=SEED, collect_gauges=False)
+            native_wall = time.time() - t0
+    except Exception:  # noqa: BLE001 - benchmark detail only
+        pass
+
     # --- batched JAX sweep -------------------------------------------------
     from asyncflow_tpu.parallel.sweep import SweepRunner
 
@@ -84,6 +98,9 @@ def main() -> None:
                 "detail": {
                     "engine": runner.engine_kind,
                     "oracle_wall_s_per_scenario": round(oracle_wall, 3),
+                    "native_oracle_wall_s_per_scenario": (
+                        round(native_wall, 4) if native_wall is not None else None
+                    ),
                     "sweep_wall_s": round(report.wall_seconds, 3),
                     "latency_p95_ms": round(summary["latency_p95_s"] * 1e3, 3),
                     "completed_total": summary["completed_total"],

@@ -1,0 +1,127 @@
+"""Parity tests: native C++ oracle core vs the Python oracle."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import yaml
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.engines.oracle.engine import OracleEngine
+from asyncflow_tpu.engines.oracle.native import native_available, run_native
+from asyncflow_tpu.runtime.runner import SimulationRunner
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+pytestmark = [
+    pytest.mark.integration,
+    pytest.mark.skipif(not native_available(), reason="no C++ toolchain"),
+]
+
+SEEDS = 10
+BASE = "tests/integration/data/single_server.yml"
+LB = "tests/integration/data/two_servers_lb.yml"
+
+
+def _payload(path: str, mutate=None) -> SimulationPayload:
+    data = yaml.safe_load(open(path).read())
+    if mutate:
+        mutate(data)
+    return SimulationPayload.model_validate(data)
+
+
+def _native_latencies(payload: SimulationPayload, n: int) -> np.ndarray:
+    plan = compile_payload(payload)
+    return np.concatenate(
+        [
+            run_native(plan, seed=s, collect_gauges=False).latencies
+            for s in range(n)
+        ],
+    )
+
+
+def _oracle_latencies(payload: SimulationPayload, n: int) -> np.ndarray:
+    return np.concatenate(
+        [OracleEngine(payload, seed=s).run().latencies for s in range(n)],
+    )
+
+
+def _assert_parity(a: np.ndarray, b: np.ndarray, tol: float) -> None:
+    assert a.size > 1000 and b.size > 1000
+    for q in (50, 90, 95):
+        pa, pb = np.percentile(a, q), np.percentile(b, q)
+        assert abs(pa - pb) / pb < tol, f"p{q}: native={pa:.6f} python={pb:.6f}"
+    assert abs(a.mean() - b.mean()) / b.mean() < tol
+
+
+def test_native_single_server_parity() -> None:
+    payload = _payload(BASE)
+    _assert_parity(
+        _native_latencies(payload, SEEDS),
+        _oracle_latencies(payload, SEEDS),
+        0.03,
+    )
+
+
+def test_native_lb_parity() -> None:
+    payload = _payload(LB)
+    _assert_parity(
+        _native_latencies(payload, SEEDS),
+        _oracle_latencies(payload, SEEDS),
+        0.03,
+    )
+
+
+def test_native_events_parity() -> None:
+    def add_events(data: dict) -> None:
+        data["events"] = [
+            {
+                "event_id": "spike-1",
+                "target_id": "lb-srv1",
+                "start": {
+                    "kind": "network_spike_start",
+                    "t_start": 5.0,
+                    "spike_s": 0.05,
+                },
+                "end": {"kind": "network_spike_end", "t_end": 25.0},
+            },
+            {
+                "event_id": "out-1",
+                "target_id": "srv-2",
+                "start": {"kind": "server_down", "t_start": 10.0},
+                "end": {"kind": "server_up", "t_end": 30.0},
+            },
+        ]
+
+    payload = _payload(LB, add_events)
+    _assert_parity(
+        _native_latencies(payload, SEEDS),
+        _oracle_latencies(payload, SEEDS),
+        0.05,
+    )
+
+
+def test_native_gauges_match_python() -> None:
+    payload = _payload(LB)
+    plan = compile_payload(payload)
+    ram_native = []
+    io_native = []
+    for s in range(6):
+        res = run_native(plan, seed=s, settings=payload.sim_settings)
+        ram_native.append(res.sampled["ram_in_use"]["srv-1"].mean())
+        io_native.append(res.sampled["event_loop_io_sleep"]["srv-1"].mean())
+    ram_py = []
+    io_py = []
+    for s in range(6):
+        res = OracleEngine(payload, seed=s).run()
+        ram_py.append(res.sampled["ram_in_use"]["srv-1"].mean())
+        io_py.append(res.sampled["event_loop_io_sleep"]["srv-1"].mean())
+    assert abs(np.mean(ram_native) - np.mean(ram_py)) / np.mean(ram_py) < 0.1
+    assert abs(np.mean(io_native) - np.mean(io_py)) / np.mean(io_py) < 0.1
+
+
+def test_native_backend_through_runner() -> None:
+    analyzer = SimulationRunner.from_yaml(BASE, backend="native", seed=3).run()
+    stats = analyzer.get_latency_stats()
+    assert stats
+    assert 0.0 < stats["mean"] < 1.0
+    assert len(analyzer.get_sampled_metrics()) == 4

@@ -1,0 +1,89 @@
+"""Lock the curated public import surface (mirrors the reference's
+__all__-equality tests, tests/unit/public_api/test_import.py)."""
+
+import asyncflow_tpu
+import asyncflow_tpu.analysis as analysis
+import asyncflow_tpu.components as components
+import asyncflow_tpu.enums as enums
+import asyncflow_tpu.parallel as parallel
+import asyncflow_tpu.settings as settings
+import asyncflow_tpu.workload as workload
+
+
+def test_top_level_surface() -> None:
+    assert set(asyncflow_tpu.__all__) == {"AsyncFlow", "SimulationRunner", "__version__"}
+    assert asyncflow_tpu.AsyncFlow is not None
+    assert asyncflow_tpu.SimulationRunner is not None
+    assert isinstance(asyncflow_tpu.__version__, str)
+
+
+def test_components_surface() -> None:
+    assert set(components.__all__) == {
+        "Client",
+        "Edge",
+        "Endpoint",
+        "EventInjection",
+        "LoadBalancer",
+        "Server",
+        "ServerResources",
+        "Step",
+    }
+
+
+def test_settings_surface() -> None:
+    assert set(settings.__all__) == {"SimulationSettings"}
+
+
+def test_workload_surface() -> None:
+    assert set(workload.__all__) == {"RVConfig", "RqsGenerator"}
+
+
+def test_analysis_surface() -> None:
+    assert set(analysis.__all__) == {"ResultsAnalyzer"}
+
+
+def test_parallel_surface() -> None:
+    assert set(parallel.__all__) == {
+        "SweepReport",
+        "SweepRunner",
+        "make_overrides",
+        "scenario_mesh",
+        "scenario_sharding",
+    }
+
+
+def test_enums_cover_the_contract() -> None:
+    expected = {
+        "AggregatedMetricName",
+        "Backend",
+        "Distribution",
+        "EndpointStepCPU",
+        "EndpointStepIO",
+        "EndpointStepRAM",
+        "EventDescription",
+        "EventMetricName",
+        "LatencyKey",
+        "LbAlgorithmsName",
+        "SampledMetricName",
+        "SamplePeriods",
+        "ServerResourceName",
+        "StepOperation",
+        "SystemEdges",
+        "SystemNodes",
+        "TimeDefaults",
+    }
+    assert set(enums.__all__) == expected
+
+
+def test_yaml_string_contract_is_stable() -> None:
+    """Enum values are the on-disk format; renaming any is a breaking change."""
+    assert enums.Distribution.LOG_NORMAL.value == "log_normal"
+    assert enums.EndpointStepCPU.INITIAL_PARSING.value == "initial_parsing"
+    assert enums.EndpointStepIO.WAIT.value == "io_wait"
+    assert enums.EndpointStepRAM.RAM.value == "ram"
+    assert enums.StepOperation.NECESSARY_RAM.value == "necessary_ram"
+    assert enums.LbAlgorithmsName.LEAST_CONNECTIONS.value == "least_connection"
+    assert enums.EventDescription.NETWORK_SPIKE_START.value == "network_spike_start"
+    assert enums.SampledMetricName.EVENT_LOOP_IO_SLEEP.value == "event_loop_io_sleep"
+    assert enums.EventMetricName.RQS_CLOCK.value == "rqs_clock"
+    assert enums.LatencyKey.STD_DEV.value == "std_dev"
