@@ -2,19 +2,25 @@
 
 Runs an N-scenario sweep of the reference's 1-LB/2-server example
 (`/root/reference/examples/yaml_input/data/two_servers_lb.yml` topology and
-workload) on the JAX engine and prints ONE JSON line:
+workload, 600 s horizon) and prints ONE JSON line:
 
-    {"metric": "scenarios/sec (1k-sweep, lb-2srv-60s)", "value": ..., ...}
+    {"metric": "scenarios/sec (...)", "value": ..., "unit": ..., "vs_baseline": ...}
 
-The reference executes one scenario at a time as SimPy coroutines; its
-measured single-scenario wall time on this machine is the baseline
-(scenarios/sec = 1 / wall).  ``vs_baseline`` is our sweep rate over that.
+`vs_baseline` is the sweep rate over the sequential baseline (the reference
+architecture runs one scenario at a time; our Python oracle engine stands in
+for its SimPy loop — same algorithmic class, same machine).
+
+Robustness: the tunneled TPU worker in this environment sometimes wedges on
+long-running kernels, so the measured sweep runs in a child process with a
+watchdog; if the accelerator hangs, the benchmark reruns on CPU and reports
+the platform honestly in `detail.platform`.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -23,11 +29,13 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 N_SCENARIOS = int(os.environ.get("BENCH_SCENARIOS", "2048"))
 HORIZON = int(os.environ.get("BENCH_HORIZON", "600"))
 SEED = 1234
+WATCHDOG_S = int(os.environ.get("BENCH_WATCHDOG_S", "900"))
 
 
 def _payload():
-    from asyncflow_tpu.schemas.payload import SimulationPayload
     import yaml
+
+    from asyncflow_tpu.schemas.payload import SimulationPayload
 
     path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
@@ -41,7 +49,13 @@ def _payload():
     return SimulationPayload.model_validate(data)
 
 
-def main() -> None:
+def run_measurement() -> None:
+    """Child-process entry: run the sweep and print the JSON line."""
+    if os.environ.get("BENCH_PLATFORM") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
     payload = _payload()
 
     # --- baseline: sequential oracle engine (reference architecture) ------
@@ -50,7 +64,7 @@ def main() -> None:
     t0 = time.time()
     OracleEngine(payload, seed=SEED).run()
     oracle_wall = time.time() - t0
-    baseline_rate = 1.0 / oracle_wall  # scenarios/sec, one at a time
+    baseline_rate = 1.0 / oracle_wall
 
     # secondary reference point: the native C++ oracle core
     native_wall = None
@@ -64,26 +78,28 @@ def main() -> None:
             run_native(plan, seed=SEED, collect_gauges=False)
             native_wall = time.time() - t0
     except Exception:  # noqa: BLE001 - benchmark detail only
-        pass
+        native_wall = None
 
     # --- batched JAX sweep -------------------------------------------------
+    import jax
+
     from asyncflow_tpu.parallel.sweep import SweepRunner
 
     runner = SweepRunner(payload)
-    # warm-up compile at the exact chunk shape the measured run will use
     default = (
         SweepRunner.DEFAULT_CHUNK_FAST
         if runner.engine_kind == "fast"
         else SweepRunner.DEFAULT_CHUNK
     )
     chunk = min(int(os.environ.get("BENCH_CHUNK", str(default))), N_SCENARIOS)
+    # warm-up compile at the exact chunk shape the measured run uses
     runner.run(chunk, seed=SEED, chunk_size=chunk)
     report = runner.run(N_SCENARIOS, seed=SEED, chunk_size=chunk)
     summary = report.summary()
 
     if summary["overflow_total"] > 0:
         print(
-            f"WARNING: {summary['overflow_total']} pool overflows",
+            f"WARNING: {summary['overflow_total']} overflow drops",
             file=sys.stderr,
         )
 
@@ -91,12 +107,15 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": f"scenarios/sec ({N_SCENARIOS}-sweep, lb-2srv-{HORIZON}s)",
+                "metric": (
+                    f"scenarios/sec ({N_SCENARIOS}-sweep, lb-2srv-{HORIZON}s)"
+                ),
                 "value": round(value, 3),
                 "unit": "scenarios/sec",
                 "vs_baseline": round(value / baseline_rate, 2),
                 "detail": {
                     "engine": runner.engine_kind,
+                    "platform": jax.default_backend(),
                     "oracle_wall_s_per_scenario": round(oracle_wall, 3),
                     "native_oracle_wall_s_per_scenario": (
                         round(native_wall, 4) if native_wall is not None else None
@@ -108,7 +127,40 @@ def main() -> None:
                 },
             },
         ),
+        flush=True,
     )
+
+
+def main() -> None:
+    if os.environ.get("BENCH_CHILD") == "1":
+        run_measurement()
+        return
+
+    env = dict(os.environ, BENCH_CHILD="1")
+    for platform in ("default", "cpu"):
+        if platform == "cpu":
+            env["BENCH_PLATFORM"] = "cpu"
+            print(
+                "WARNING: accelerator run failed or hung; retrying on CPU",
+                file=sys.stderr,
+            )
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env,
+                timeout=WATCHDOG_S,
+                capture_output=True,
+                text=True,
+            )
+        except subprocess.TimeoutExpired:
+            continue
+        if proc.returncode == 0 and proc.stdout.strip():
+            sys.stderr.write(proc.stderr)
+            print(proc.stdout.strip().splitlines()[-1])
+            return
+        sys.stderr.write(proc.stderr)
+    msg = "benchmark failed on both accelerator and CPU"
+    raise SystemExit(msg)
 
 
 if __name__ == "__main__":
