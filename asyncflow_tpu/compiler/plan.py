@@ -494,8 +494,6 @@ def _fastpath_analysis(
     burst_rate = rate * (1.0 + 3.0 / math.sqrt(max(users, 1.0)))
 
     for s, server in enumerate(servers):
-        if server.server_resources.cpu_cores != 1:
-            return False, f"server {server.id}: multi-core needs Kiefer-Wolfowitz", []
         if exit_kind[s] == TARGET_LB:
             return False, f"server {server.id}: exit to LB creates a cycle", []
         max_ram = 0.0
@@ -510,12 +508,13 @@ def _fastpath_analysis(
             cpu_dur = max(cpu_dur, sum(d for k, d in segs if k == SEG_CPU))
         if max_ram > 0:
             # RAM is held from admission to endpoint end, INCLUDING the CPU
-            # queue wait — bound the wait with an M/M/1-style estimate and
+            # queue wait — bound the wait with an M/M/c-style estimate and
             # refuse when the CPU can saturate (unbounded residency).
-            rho = burst_rate * cpu_dur
+            cores = server.server_resources.cpu_cores
+            rho = burst_rate * cpu_dur / cores
             if rho >= 0.95:
                 return False, f"server {server.id}: RAM residency unbounded", []
-            wait_est = rho / (1.0 - rho) * cpu_dur
+            wait_est = rho / (1.0 - rho) * cpu_dur / cores
             concurrent = server.server_resources.ram_mb / max_ram
             if concurrent < 4.0 * burst_rate * (residence + wait_est) + 4.0:
                 return False, f"server {server.id}: RAM can bind", []

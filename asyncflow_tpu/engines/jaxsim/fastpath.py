@@ -78,6 +78,38 @@ class FastState(NamedTuple):
     n_overflow: jnp.ndarray
 
 
+def _kw_waits(
+    arrivals: jnp.ndarray,
+    service: jnp.ndarray,
+    valid,
+    cores: int,
+) -> jnp.ndarray:
+    """FIFO G/G/c waiting times via the Kiefer-Wolfowitz workload vector.
+
+    Carry the sorted per-core residual-work vector ``w``; for each customer:
+    age it by the inter-arrival gap, wait on the least-loaded core, add the
+    service there, re-sort.  Sequential in the number of requests (a
+    ``lax.scan``) but the carried state is just ``cores`` floats per lane.
+    """
+    inter = jnp.diff(arrivals, prepend=arrivals[:1])
+    inter = jnp.where(jnp.isfinite(inter), inter, 0.0)
+
+    def step(w, x):
+        gap, svc, ok = x
+        w = jnp.maximum(w - gap, 0.0)
+        wait = w[0]
+        busy = jnp.sort(w.at[0].add(svc))
+        w = jnp.where(ok, busy, w)
+        return w, jnp.where(ok, wait, 0.0)
+
+    _, waits = jax.lax.scan(
+        step,
+        jnp.zeros(cores, jnp.float32),
+        (inter, jnp.where(valid, service, 0.0), valid),
+    )
+    return waits
+
+
 def _lindley_waits(arrivals: jnp.ndarray, service: jnp.ndarray, valid) -> jnp.ndarray:
     """FIFO G/G/1 waiting times for time-sorted ``arrivals`` via max-plus scan.
 
@@ -313,7 +345,11 @@ class FastEngine:
             arr_s = arr[order]
             valid_s = mine[order]
             cpu_s = jnp.where(valid_s, cpu[order], 0.0)
-            waits_s = _lindley_waits(arr_s, cpu_s, valid_s)
+            n_cores = int(plan.server_cores[s])
+            if n_cores == 1:
+                waits_s = _lindley_waits(arr_s, cpu_s, valid_s)
+            else:
+                waits_s = _kw_waits(arr_s, cpu_s, valid_s, n_cores)
             # IO-only requests bypass the core: their own wait is zero
             waits_s = jnp.where(cpu_s > 0, waits_s, 0.0)
             wait = jnp.zeros(n).at[order].set(waits_s)

@@ -9,8 +9,10 @@ import numpy as np
 
 from asyncflow_tpu.compiler.plan import StaticPlan
 
-INF = jnp.float32(1e30)
-NO_TICKET = jnp.int32(2**30)
+# plain Python scalars: creating jnp values at import time would initialise
+# the accelerator backend before users can select a platform
+INF = 1e30
+NO_TICKET = 2**30
 
 # request-slot event codes
 EV_IDLE = 0

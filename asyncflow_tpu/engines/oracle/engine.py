@@ -192,11 +192,19 @@ class _ServerRuntime:
 class OracleEngine:
     """Builds and runs one scenario sequentially on the CPU."""
 
-    def __init__(self, payload: SimulationPayload, *, seed: int | None = None) -> None:
+    def __init__(
+        self,
+        payload: SimulationPayload,
+        *,
+        seed: int | None = None,
+        collect_traces: bool = False,
+    ) -> None:
         self.payload = payload
         self.settings = payload.sim_settings
         self.sim = Sim()
         self.rng = np.random.default_rng(seed)
+        self.collect_traces = collect_traces
+        self.traces: dict[int, list[tuple[str, str, float]]] = {}
 
         self.total_generated = 0
         self.total_dropped = 0
@@ -275,6 +283,11 @@ class OracleEngine:
         if len(req.history) > 3:
             req.finish_time = self.sim.now
             self.rqs_clock.append((req.initial_time, req.finish_time))
+            if self.collect_traces:
+                self.traces[req.id] = [
+                    (hop.component_type, hop.component_id, hop.timestamp)
+                    for hop in req.history
+                ]
         else:
             assert self.client_out is not None
             self.client_out.transport(req)
@@ -435,4 +448,5 @@ class OracleEngine:
             total_dropped=self.total_dropped,
             server_ids=list(self.servers),
             edge_ids=list(self.edges),
+            traces=self.traces if self.collect_traces else None,
         )

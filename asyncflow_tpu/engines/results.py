@@ -33,6 +33,11 @@ class SimulationResults:
     server_ids: list[str] = field(default_factory=list)
     #: edge ids in topology order.
     edge_ids: list[str] = field(default_factory=list)
+    #: optional per-request traces (oracle engine with collect_traces=True):
+    #: request id -> list of (component_kind, component_id, timestamp) hops,
+    #: the OpenTelemetry-style span record of the reference's RequestState
+    #: history (`/root/reference/src/asyncflow/runtime/rqs_state.py:12-41`).
+    traces: dict[int, list[tuple[str, str, float]]] | None = None
 
     @property
     def latencies(self) -> np.ndarray:

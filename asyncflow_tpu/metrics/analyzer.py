@@ -154,6 +154,11 @@ class ResultsAnalyzer:
         """All sampled time series: metric -> component id -> values."""
         return self._results.sampled
 
+    def get_traces(self) -> dict[int, list[tuple[str, str, float]]]:
+        """Per-request hop traces (requires an engine run with tracing on,
+        e.g. ``engine_options={"collect_traces": True}`` on the oracle)."""
+        return self._results.traces or {}
+
     def get_metric_map(
         self,
         key: SampledMetricName | str,

@@ -144,6 +144,20 @@ class SweepRunner:
         if self.engine_kind == "fast":
             _guard_overrides_against_plan(self.plan, overrides)
 
+    def _checkpoint_identity(self, overrides: ScenarioOverrides | None) -> str:
+        """Hash of everything that shapes per-chunk results: reusing a chunk
+        computed under a different payload/override/engine must be impossible."""
+        import hashlib
+
+        digest = hashlib.sha256()
+        digest.update(self.payload.model_dump_json().encode())
+        digest.update(self.engine_kind.encode())
+        digest.update(str(self.engine.n_hist_bins).encode())
+        if overrides is not None:
+            for field in overrides:
+                digest.update(np.asarray(field).tobytes())
+        return digest.hexdigest()[:16]
+
     # Default chunks bound both device memory and single-kernel runtime
     # (tunneled TPU workers kill executions running longer than ~1 minute).
     DEFAULT_CHUNK = 64  # event engine: while-loop iterations dominate
@@ -156,8 +170,15 @@ class SweepRunner:
         seed: int = 0,
         overrides: ScenarioOverrides | None = None,
         chunk_size: int | None = None,
+        checkpoint_dir: str | None = None,
     ) -> SweepReport:
-        """Execute the sweep, chunking to bound memory and kernel runtime."""
+        """Execute the sweep, chunking to bound memory and kernel runtime.
+
+        With ``checkpoint_dir``, every completed chunk is persisted and an
+        interrupted sweep resumes from the last finished chunk (the chunk
+        grid and per-scenario keys are deterministic functions of the
+        arguments, so resumed results are identical to uninterrupted ones).
+        """
         import time
 
         self._guard_fastpath_overrides(overrides)
@@ -168,12 +189,30 @@ class SweepRunner:
         chunk = chunk_size or min(default * n_dev, n_scenarios)
         chunk = max(n_dev, (chunk // n_dev) * n_dev)
 
+        ckpt = (
+            _SweepCheckpoint(
+                checkpoint_dir,
+                seed,
+                n_scenarios,
+                chunk,
+                identity=self._checkpoint_identity(overrides),
+                settings=self.payload.sim_settings,
+            )
+            if checkpoint_dir
+            else None
+        )
+
         t0 = time.time()
-        partials = []
+        partials: list[SweepResults] = []
         done = 0
         while done < n_scenarios:
             take = min(chunk, n_scenarios - done)
             take = max(n_dev, (take // n_dev) * n_dev)  # pad to device multiple
+            cached = ckpt.load(done) if ckpt else None
+            if cached is not None:
+                partials.append(cached)
+                done += take
+                continue
             keys = scenario_keys(seed, done + take)[done : done + take]
             ov = (
                 _slice_overrides(overrides, base_overrides(self.plan), done, take)
@@ -183,12 +222,72 @@ class SweepRunner:
             if self.mesh is not None:
                 keys = jax.device_put(keys, scenario_sharding(self.mesh))
             final = self.engine.run_batch(keys, ov)
-            partials.append(sweep_results(self.engine, final, self.payload.sim_settings))
+            part = sweep_results(self.engine, final, self.payload.sim_settings)
+            if ckpt:
+                ckpt.save(done, part)
+            partials.append(part)
             done += take
         wall = time.time() - t0
 
         merged = _concat_sweeps(partials)[:n_scenarios]
         return SweepReport(results=merged, n_scenarios=n_scenarios, wall_seconds=wall)
+
+
+class _SweepCheckpoint:
+    """Per-chunk npz persistence keyed by the sweep's deterministic grid."""
+
+    _ARRAY_FIELDS = (
+        "completed",
+        "latency_hist",
+        "latency_sum",
+        "latency_sumsq",
+        "latency_min",
+        "latency_max",
+        "throughput",
+        "total_generated",
+        "total_dropped",
+        "overflow_dropped",
+    )
+
+    def __init__(
+        self,
+        root: str,
+        seed: int,
+        n_scenarios: int,
+        chunk: int,
+        *,
+        identity: str,
+        settings,
+    ) -> None:
+        from pathlib import Path
+
+        self.dir = Path(root) / f"sweep_s{seed}_n{n_scenarios}_c{chunk}_{identity}"
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self._settings = settings
+
+    def _path(self, start: int):
+        return self.dir / f"chunk_{start:08d}.npz"
+
+    def save(self, start: int, part: SweepResults) -> None:
+        import os
+
+        payload = {name: getattr(part, name) for name in self._ARRAY_FIELDS}
+        payload["hist_edges"] = part.hist_edges
+        # atomic write so an interrupt never leaves a half-written chunk
+        tmp = self.dir / f".chunk_{start:08d}.{os.getpid()}.tmp.npz"
+        np.savez(tmp, **payload)
+        os.replace(tmp, self._path(start))
+
+    def load(self, start: int) -> SweepResults | None:
+        path = self._path(start)
+        if not path.exists():
+            return None
+        with np.load(path) as data:
+            return SweepResults(
+                settings=self._settings,
+                hist_edges=data["hist_edges"],
+                **{name: data[name] for name in self._ARRAY_FIELDS},
+            )
 
 
 def _sweep_max(value) -> float:

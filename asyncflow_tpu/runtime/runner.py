@@ -42,6 +42,14 @@ class SimulationRunner:
         if backend == Backend.NATIVE:
             from asyncflow_tpu.engines.oracle.native import native_available
 
+            unsupported = set(self.engine_options) - {"collect_gauges"}
+            if unsupported:
+                msg = (
+                    f"engine_options {sorted(unsupported)} are not supported "
+                    "by the native backend (use backend='oracle' for tracing)"
+                )
+                raise ValueError(msg)
+
             if native_available():
                 from asyncflow_tpu.compiler import compile_payload
                 from asyncflow_tpu.engines.oracle.native import run_native
@@ -57,6 +65,7 @@ class SimulationRunner:
                     compile_payload(self.simulation_input),
                     seed=seed,
                     settings=self.simulation_input.sim_settings,
+                    **self.engine_options,
                 )
                 return ResultsAnalyzer(results)
             import warnings
@@ -71,7 +80,11 @@ class SimulationRunner:
         if backend == Backend.ORACLE:
             from asyncflow_tpu.engines.oracle.engine import OracleEngine
 
-            results = OracleEngine(self.simulation_input, seed=self.seed).run()
+            results = OracleEngine(
+                self.simulation_input,
+                seed=self.seed,
+                **self.engine_options,
+            ).run()
         else:
             from asyncflow_tpu.engines.jaxsim.engine import run_single
 

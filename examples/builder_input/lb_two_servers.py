@@ -4,6 +4,9 @@ Usage:  python examples/builder_input/lb_two_servers.py [oracle|jax]
 """
 
 import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
 
 from asyncflow_tpu import AsyncFlow, SimulationRunner
 from asyncflow_tpu.components import (

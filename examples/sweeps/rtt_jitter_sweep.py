@@ -1,0 +1,67 @@
+"""Monte-Carlo RTT/jitter sweep of the LB example (BASELINE config #2).
+
+Scales every edge's latency mean from 0.5x to 4x across 1000 scenarios and
+plots how the pooled latency percentiles respond.
+
+Usage:  python examples/sweeps/rtt_jitter_sweep.py [n_scenarios] [--cpu]
+
+Pass ``--cpu`` to force the CPU backend (e.g. when no accelerator is
+reachable); it must be handled before JAX initialises.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import numpy as np
+
+from asyncflow_tpu import SimulationRunner
+from asyncflow_tpu.parallel import SweepRunner, make_overrides
+
+if "--cpu" in sys.argv:
+    sys.argv.remove("--cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+n_scenarios = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+
+payload = SimulationRunner.from_yaml(
+    Path(__file__).parents[1] / "yaml_input" / "data" / "two_servers_lb.yml",
+).simulation_input
+runner = SweepRunner(payload)
+print(f"engine: {runner.engine_kind} "
+      f"(fast path eligible: {runner.plan.fastpath_ok})")
+
+scales = np.linspace(0.5, 4.0, n_scenarios)
+overrides = make_overrides(runner.plan, n_scenarios, edge_mean_scale=scales)
+report = runner.run(n_scenarios, seed=0, overrides=overrides)
+
+summary = report.summary()
+print(f"{n_scenarios} scenarios in {report.wall_seconds:.1f}s "
+      f"({summary['scenarios_per_second']:.1f} scen/s), "
+      f"{summary['completed_total']:,} requests simulated")
+
+p95 = report.results.percentile(95)
+for lo, hi in [(0.5, 1.0), (1.0, 2.0), (2.0, 3.0), (3.0, 4.0)]:
+    band = (scales >= lo) & (scales < hi)
+    print(f"RTT x[{lo:.1f}, {hi:.1f}): p95 = {p95[band].mean() * 1e3:6.2f} ms")
+
+try:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(8, 5))
+    ax.scatter(scales, p95 * 1e3, s=4, alpha=0.5)
+    ax.set_xlabel("edge latency scale")
+    ax.set_ylabel("p95 latency (ms)")
+    ax.set_title(f"RTT sweep: {n_scenarios} scenarios")
+    ax.grid(visible=True)
+    out = Path(__file__).parent / "rtt_sweep.png"
+    fig.savefig(out)
+    print(f"plot saved to {out}")
+except ImportError:
+    pass

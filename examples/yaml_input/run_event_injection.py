@@ -1,0 +1,43 @@
+"""Run the event-injection scenario and compare against a no-event baseline.
+
+Usage:  python examples/yaml_input/run_event_injection.py [oracle|native|jax]
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import numpy as np
+
+from asyncflow_tpu import SimulationRunner
+
+backend = sys.argv[1] if len(sys.argv) > 1 else "native"
+data_dir = Path(__file__).parent / "data"
+
+with_events = SimulationRunner.from_yaml(
+    data_dir / "event_inj_lb.yml",
+    backend=backend,
+    seed=7,
+).run()
+baseline = SimulationRunner.from_yaml(
+    data_dir / "two_servers_lb.yml",
+    backend=backend,
+    seed=7,
+).run()
+
+base_stats = baseline.get_latency_stats()
+event_stats = with_events.get_latency_stats()
+print(f"baseline : mean {base_stats['mean'] * 1e3:6.2f} ms  "
+      f"p95 {base_stats['p95'] * 1e3:6.2f} ms")
+print(f"w/ events: mean {event_stats['mean'] * 1e3:6.2f} ms  "
+      f"p95 {event_stats['p95'] * 1e3:6.2f} ms")
+
+cc = with_events.get_metric_map("edge_concurrent_connection")
+for edge_id in ("lb-srv1", "lb-srv2"):
+    print(f"{edge_id}: mean concurrency {float(np.mean(cc[edge_id])):.4f}")
+
+fig = with_events.plot_base_dashboard()
+out = Path(__file__).parent / f"event_injection_{backend}.png"
+fig.savefig(out)
+print(f"dashboard saved to {out}")

@@ -6,6 +6,8 @@ Usage:  python examples/yaml_input/run_single_server.py [oracle|jax]
 import sys
 from pathlib import Path
 
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
 from asyncflow_tpu import SimulationRunner
 
 backend = sys.argv[1] if len(sys.argv) > 1 else "oracle"

@@ -193,15 +193,14 @@ class TestEligibility:
         assert not plan.fastpath_ok
         assert "outage" in plan.fastpath_reason
 
-    def test_multicore_ineligible(self) -> None:
+    def test_multicore_now_eligible(self) -> None:
         def mutate(data: dict) -> None:
             data["topology_graph"]["nodes"]["servers"][0]["server_resources"][
                 "cpu_cores"
             ] = 4
 
         plan = compile_payload(_payload(BASE, mutate))
-        assert not plan.fastpath_ok
-        assert "multi-core" in plan.fastpath_reason
+        assert plan.fastpath_ok  # Kiefer-Wolfowitz handles G/G/c
 
     def test_multi_burst_ineligible(self) -> None:
         def mutate(data: dict) -> None:
@@ -235,11 +234,29 @@ class TestEligibility:
         assert not plan.fastpath_ok
 
     def test_fast_engine_rejects_ineligible_plan(self) -> None:
-        def mutate(data: dict) -> None:
-            data["topology_graph"]["nodes"]["servers"][0]["server_resources"][
-                "cpu_cores"
-            ] = 4
+        def use_least_connections(data: dict) -> None:
+            data["topology_graph"]["nodes"]["load_balancer"]["algorithms"] = (
+                "least_connection"
+            )
 
-        plan = compile_payload(_payload(BASE, mutate))
+        plan = compile_payload(_payload(LB, use_least_connections))
         with pytest.raises(ValueError, match="not eligible"):
             FastEngine(plan)
+
+
+def test_fastpath_multicore_kw() -> None:
+    """G/G/c waits via Kiefer-Wolfowitz: 3-core server at rho ~ 0.6."""
+
+    def mutate(data: dict) -> None:
+        server = data["topology_graph"]["nodes"]["servers"][0]
+        server["server_resources"]["cpu_cores"] = 3
+        server["endpoints"][0]["steps"] = [
+            {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.05}},
+            {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.02}},
+        ]
+        data["rqs_input"]["avg_active_users"]["mean"] = 110  # ~36.7 rps vs 60 cap
+
+    payload = _payload(BASE, mutate)
+    plan = compile_payload(payload)
+    assert plan.fastpath_ok, plan.fastpath_reason
+    _assert_parity(_fast_latencies(payload, SEEDS), _oracle_latencies(payload, SEEDS), 0.05)

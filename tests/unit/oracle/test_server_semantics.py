@@ -120,3 +120,31 @@ def test_full_dropout_completes_nothing(minimal_payload) -> None:
     results = OracleEngine(payload, seed=17).run()
     assert results.rqs_clock.shape[0] == 0
     assert results.total_dropped == results.total_generated
+
+
+def test_traces_record_the_full_request_path(minimal_payload) -> None:
+    """Tracing mirrors the reference's hop history: generator, each edge,
+    client forward, server, return edge, client completion."""
+    results = OracleEngine(minimal_payload, seed=19, collect_traces=True).run()
+    traces = results.traces
+    assert traces
+    trace = next(iter(traces.values()))
+    kinds = [kind for kind, _, _ in trace]
+    assert kinds == [
+        "generator",
+        "network_connection",
+        "client",
+        "network_connection",
+        "server",
+        "network_connection",
+        "client",
+    ]
+    times = [t for _, _, t in trace]
+    assert times == sorted(times)
+    # every completed request has a trace; dropped ones do not
+    assert len(traces) == results.rqs_clock.shape[0]
+
+
+def test_traces_off_by_default(minimal_payload) -> None:
+    results = OracleEngine(minimal_payload, seed=19).run()
+    assert results.traces is None
