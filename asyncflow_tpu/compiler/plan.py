@@ -469,19 +469,22 @@ def _fastpath_analysis(
 ) -> tuple[bool, str, list[int]]:
     """Decide whether the scan engine can execute this plan exactly.
 
-    Conditions (each mirrors an assumption of the Lindley-recursion model):
-    single core per server (G/G/1 FIFO on the merged CPU burst), endpoints
-    that are at most one CPU burst followed by at most one IO sleep, RAM
-    provably non-binding (admission never queues), round-robin routing (the
-    rotation is a deterministic function of LB-arrival rank), no outages (the
-    rotation membership never changes), and an acyclic server exit DAG.
+    Conditions (each mirrors an assumption of the queueing-recursion model):
+    endpoints that are at most one CPU burst followed by at most one IO sleep
+    (G/G/1 Lindley or G/G/c Kiefer-Wolfowitz FIFO on the burst), RAM provably
+    non-binding (admission never queues), round-robin routing (the rotation
+    is deterministic given the pick/outage interleaving, which the fast path
+    replays with a scan), no Poisson-latency edges, and an acyclic server
+    exit DAG.  Outage windows are supported when an LB exists to act on.
     """
     servers = payload.topology_graph.nodes.servers
     n_servers = len(servers)
 
-    if n_outage_marks > 0:
-        return False, "server outage events change LB membership", []
     lb = payload.topology_graph.nodes.load_balancer
+    if n_outage_marks > 0 and lb is None:
+        # outages only act through the LB rotation; without one they are
+        # no-ops in the event engines, but keep the exact engine for safety
+        return False, "outage events without a load balancer", []
     if lb is not None and lb_algo != 0:
         return False, "least-connections routing needs live edge state", []
     for edge in payload.topology_graph.edges:

@@ -63,6 +63,11 @@ from asyncflow_tpu.engines.jaxsim.sampling import (
 )
 from asyncflow_tpu.engines.results import SimulationResults, SweepResults
 from asyncflow_tpu.schemas.payload import SimulationPayload
+from asyncflow_tpu.engines.jaxsim.rotation import (
+    rotation_advance,
+    rotation_insert,
+    rotation_remove,
+)
 from asyncflow_tpu.engines.jaxsim.params import (
     EV_ARRIVE_LB,
     EV_ARRIVE_SRV,
@@ -289,32 +294,21 @@ class Engine:
     def _lb_pick(self, st: EngineState):
         """(slot, rotated order) per algorithm; caller guards empty rotation."""
         el = max(self.plan.n_lb_edges, 1)
+        if self.plan.lb_algo == 0:  # round robin: head out, rotate to tail
+            slot = st.lb_order[0]
+            return slot, rotation_advance(st.lb_order, st.lb_len, True, el)
         pos = jnp.arange(el, dtype=jnp.int32)
         valid = pos < st.lb_len
-        if self.plan.lb_algo == 0:  # round robin: head out, rotate left
-            slot = st.lb_order[0]
-            shifted = st.lb_order[(pos + 1) % jnp.maximum(st.lb_len, 1)]
-            return slot, jnp.where(valid, shifted, st.lb_order)
         conn = st.lb_conn[st.lb_order]
         order_key = jnp.where(valid, conn * el + pos, jnp.int32(2**30))
         best = jnp.argmin(order_key).astype(jnp.int32)
         return st.lb_order[best], st.lb_order
 
     def _lb_remove(self, order, length, slot, pred):
-        el = max(self.plan.n_lb_edges, 1)
-        pos = jnp.arange(el, dtype=jnp.int32)
-        hit = jnp.where((order == slot) & (pos < length), pos, el)
-        at = jnp.min(hit).astype(jnp.int32)
-        act = pred & (at < el)
-        shifted = order[jnp.minimum(pos + 1, el - 1)]
-        new_order = jnp.where((pos >= at) & act, shifted, order)
-        return new_order, jnp.where(act, length - 1, length)
+        return rotation_remove(order, length, slot, pred, max(self.plan.n_lb_edges, 1))
 
     def _lb_insert(self, order, length, slot, pred):
-        el = max(self.plan.n_lb_edges, 1)
-        idx = jnp.where(pred, jnp.clip(length, 0, el - 1), jnp.int32(el))
-        new_order = order.at[idx].set(slot, mode="drop")
-        return new_order, jnp.where(pred, jnp.minimum(length + 1, el), length)
+        return rotation_insert(order, length, slot, pred, max(self.plan.n_lb_edges, 1))
 
     # ==================================================================
     # branches (all updates masked by disjoint predicates)

@@ -1,9 +1,9 @@
 """Scan fast path: closed-form vectorized simulation for eligible plans.
 
-For the common scenario shape (single-core servers, endpoints that are one
-merged CPU burst + one IO sleep, provably non-binding RAM, round-robin LB, no
-outages — see ``_fastpath_analysis`` in the compiler), the per-scenario
-discrete-event loop collapses into pure array code:
+For the common scenario shape (endpoints that are one merged CPU burst + one
+IO sleep, provably non-binding RAM, round-robin LB — see
+``_fastpath_analysis`` in the compiler), the per-scenario discrete-event loop
+collapses into pure array code:
 
 1. **Arrivals.**  Within each user-sampling window the reference's gap chain
    is exactly a Poisson process restarted at the boundary
@@ -13,14 +13,18 @@ discrete-event loop collapses into pure array code:
    (boundary - last arrival) to recover *simulation* timestamps, which only
    advance by emitted gaps.
 2. **Edges.**  Dropout/latency/spike draws are embarrassingly parallel.
-3. **Round robin** is a deterministic function of LB-arrival *rank*:
-   sort by arrival time at the LB, assign ``rank % n_edges``.
-4. **Each server is a G/G/1 FIFO queue on the CPU burst** (the IO sleep holds
-   no core), so waiting times follow the Lindley recursion
+3. **Round robin** with fixed membership is a deterministic function of
+   LB-arrival *rank* (sort by arrival time, assign ``rank % n_edges``); with
+   outage windows, a ``lax.scan`` over time-ordered arrivals carries the
+   rotation and applies down/up marks with the event engines' pop /
+   reinsert-at-tail discipline.
+4. **Each server is a G/G/c FIFO queue on the CPU burst** (the IO sleep holds
+   no core): single-core waits follow the Lindley recursion
    ``W_k = max(0, W_{k-1} + S_{k-1} - (A_k - A_{k-1}))`` — evaluated in
-   log-depth with ``lax.associative_scan`` in max-plus form.  IO-only
-   requests bypass the core (their own wait is zero) but do not disturb the
-   recursion (their service term is zero).
+   log-depth with ``lax.associative_scan`` in max-plus form — and multi-core
+   waits use the Kiefer-Wolfowitz workload-vector scan.  IO-only requests
+   bypass the core (their own wait is zero) but do not disturb the recursion
+   (their service term is zero).
 5. Chained servers (app -> DB) are processed in exit-DAG topological order.
 
 Everything is (N,) array work per scenario, vmapped over the batch: the
@@ -45,6 +49,11 @@ from asyncflow_tpu.compiler.plan import (
     StaticPlan,
 )
 from asyncflow_tpu.engines.jaxsim.params import INF, ScenarioOverrides, base_overrides
+from asyncflow_tpu.engines.jaxsim.rotation import (
+    rotation_advance,
+    rotation_insert,
+    rotation_remove,
+)
 from asyncflow_tpu.engines.jaxsim.sampling import (
     D_EXPONENTIAL as _D_EXPONENTIAL,
     D_LOGNORMAL as _D_LOGNORMAL,
@@ -253,6 +262,59 @@ class FastEngine:
         return sim_t, valid, overflow
 
     # ------------------------------------------------------------------
+    # round robin with a mutating rotation (outage timelines)
+    # ------------------------------------------------------------------
+
+    def _routed_slots(self, t, alive):
+        """(slot, routed) per request: scan arrivals in time order carrying
+        the LB rotation, applying down/up timeline marks as time passes —
+        the same pop / reinsert-at-tail discipline as the event engines."""
+        plan = self.plan
+        el = plan.n_lb_edges
+        ntl = len(plan.timeline_times)
+        tl_times = jnp.asarray(plan.timeline_times)
+        tl_down = jnp.asarray(plan.timeline_down)
+        tl_slot = jnp.asarray(plan.timeline_slot)
+
+        def step(carry, x):
+            rot, length, ptr = carry
+            t_arr, ok = x
+
+            def tl_cond(c):
+                _rot, _length, p = c
+                return (p < ntl) & (tl_times[jnp.minimum(p, ntl - 1)] <= t_arr)
+
+            def tl_body(c):
+                rot_c, length_c, p = c
+                idx = jnp.minimum(p, ntl - 1)
+                s = tl_slot[idx]
+                down = tl_down[idx] == 1
+                act = s >= 0
+                rot_c, length_c = rotation_remove(rot_c, length_c, s, act & down, el)
+                rot_c, length_c = rotation_insert(rot_c, length_c, s, act & ~down, el)
+                return rot_c, length_c, p + 1
+
+            rot, length, ptr = jax.lax.while_loop(
+                tl_cond,
+                tl_body,
+                (rot, length, ptr),
+            )
+            empty = length <= 0
+            picked = jnp.where(ok & ~empty, rot[0], jnp.int32(-1))
+            rot = rotation_advance(rot, length, ok & ~empty, el)
+            return (rot, length, ptr), picked
+
+        order = jnp.argsort(jnp.where(alive, t, INF))
+        init = (jnp.arange(el, dtype=jnp.int32), jnp.int32(el), jnp.int32(0))
+        _, picked_sorted = jax.lax.scan(
+            step,
+            init,
+            (jnp.where(alive, t, INF)[order], alive[order]),
+        )
+        picked = jnp.zeros(t.shape[0], jnp.int32).at[order].set(picked_sorted)
+        return picked, picked >= 0
+
+    # ------------------------------------------------------------------
     # metric recording
     # ------------------------------------------------------------------
 
@@ -301,10 +363,20 @@ class FastEngine:
         alive = alive & (t < plan.horizon)
         srv = jnp.full(n, jnp.int32(max(plan.entry_target, 0)))
         if plan.n_lb_edges > 0:
-            order = jnp.argsort(jnp.where(alive, t, INF))
-            rank_sorted = jnp.cumsum(alive[order].astype(jnp.int32)) - 1
-            rank = jnp.zeros(n, jnp.int32).at[order].set(rank_sorted)
-            slot = jnp.where(alive, rank % plan.n_lb_edges, 0)
+            if len(plan.timeline_times) == 0:
+                # fixed membership: round robin is a pure function of rank
+                order = jnp.argsort(jnp.where(alive, t, INF))
+                rank_sorted = jnp.cumsum(alive[order].astype(jnp.int32)) - 1
+                rank = jnp.zeros(n, jnp.int32).at[order].set(rank_sorted)
+                slot = jnp.where(alive, rank % plan.n_lb_edges, 0)
+            else:
+                # outages mutate the rotation: scan LB arrivals in time
+                # order, interleaving the outage timeline (slot -1 = no
+                # healthy target, request dropped like the event engines)
+                slot, routed = self._routed_slots(t, alive)
+                n_dropped = n_dropped + jnp.sum(alive & ~routed)
+                alive = alive & routed
+                slot = jnp.where(alive, slot, 0)
             srv = jnp.asarray(plan.lb_target)[slot]
             # per-request edge draws: one pass per LB slot (static, small)
             new_t = t

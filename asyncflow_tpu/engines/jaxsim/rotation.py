@@ -1,0 +1,48 @@
+"""LB rotation primitives shared by the event engine and the scan fast path.
+
+The rotation is a dense prefix of slot ids with an explicit length, mirroring
+the reference's ordered mapping: round robin takes the head and moves it to
+the tail; an outage removes a slot (shift left); revival reinserts at the
+tail (`/root/reference/src/asyncflow/runtime/events/injection.py:201-226`).
+All updates are predicated so they compose inside vmapped/scanned code.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rotation_remove(rot, length, slot, pred, el: int):
+    """Remove ``slot`` from the rotation prefix (no-op when absent/masked)."""
+    pos = jnp.arange(el, dtype=jnp.int32)
+    hit = jnp.where((rot == slot) & (pos < length), pos, el)
+    at = jnp.min(hit).astype(jnp.int32)
+    act = pred & (at < el)
+    shifted = rot[jnp.minimum(pos + 1, el - 1)]
+    return (
+        jnp.where((pos >= at) & act, shifted, rot),
+        jnp.where(act, length - 1, length),
+    )
+
+
+def rotation_insert(rot, length, slot, pred, el: int):
+    """Append ``slot`` at the rotation tail (no-op when present/masked)."""
+    pos = jnp.arange(el, dtype=jnp.int32)
+    present = jnp.any((rot == slot) & (pos < length))
+    act = pred & ~present
+    idx = jnp.where(act, jnp.clip(length, 0, el - 1), jnp.int32(el))
+    return (
+        rot.at[idx].set(slot, mode="drop"),
+        jnp.where(act, jnp.minimum(length + 1, el), length),
+    )
+
+
+def rotation_advance(rot, length, pred, el: int):
+    """Move the head to the tail (round-robin pick); masked by ``pred``."""
+    pos = jnp.arange(el, dtype=jnp.int32)
+    rotated = jnp.where(
+        pos < length,
+        rot[(pos + 1) % jnp.maximum(length, 1)],
+        rot,
+    )
+    return jnp.where(pred, rotated, rot)

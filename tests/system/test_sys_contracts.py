@@ -29,6 +29,25 @@ from asyncflow_tpu.workload import RVConfig, RqsGenerator
 pytestmark = pytest.mark.system
 
 
+def _backend_param(name: str):
+    """Skip the native case when no C++ toolchain exists (the runner would
+    silently fall back to the oracle and the test would not test native)."""
+    if name != "native":
+        return name
+    from asyncflow_tpu.engines.oracle.native import native_available
+
+    return pytest.param(
+        "native",
+        marks=pytest.mark.skipif(
+            not native_available(),
+            reason="no C++ toolchain",
+        ),
+    )
+
+
+BACKENDS = [_backend_param("oracle"), _backend_param("native")]
+
+
 def _rel_diff(a: float, b: float) -> float:
     return abs(a - b) / max(1e-9, (abs(a) + abs(b)) / 2.0)
 
@@ -125,9 +144,14 @@ def _lb_payload(horizon: int = 400) -> AsyncFlow:
     return flow
 
 
-def test_system_single_server_contract() -> None:
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_system_single_server_contract(backend: str) -> None:
     """Mean latency in [0.015, 0.060] s; throughput within 35% of 26.7 rps."""
-    runner = SimulationRunner(simulation_input=_single_server_payload(), seed=1337)
+    runner = SimulationRunner(
+        simulation_input=_single_server_payload(),
+        backend=backend,
+        seed=1337,
+    )
     analyzer = runner.run()
 
     stats = analyzer.get_latency_stats()
@@ -143,11 +167,16 @@ def test_system_single_server_contract() -> None:
     assert np.max(sampled["ram_in_use"]["srv-1"]) > 0
 
 
-def test_system_lb_two_servers_contract() -> None:
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_system_lb_two_servers_contract(backend: str) -> None:
     """Mean latency in [0.020, 0.060] s; throughput within 30% of 40 rps;
     round-robin balance within 25% on edge concurrency and RAM means."""
     payload = _lb_payload().build_payload()
-    analyzer = SimulationRunner(simulation_input=payload, seed=4242).run()
+    analyzer = SimulationRunner(
+        simulation_input=payload,
+        backend=backend,
+        seed=4242,
+    ).run()
 
     stats = analyzer.get_latency_stats()
     mean_latency = stats[LatencyKey.MEAN]
