@@ -789,7 +789,13 @@ def run_single(
 ) -> SimulationResults:
     """Run one scenario on the JAX engine, reduced to SimulationResults."""
     plan = compile_payload(payload)
-    engine_kw.setdefault("collect_gauges", True)
+    # gate gauge recording on the settings like the oracle's collector does;
+    # partial metric sets still record everything and filter below (the
+    # gauges share one scatter array on device)
+    engine_kw.setdefault(
+        "collect_gauges",
+        bool(payload.sim_settings.enabled_sample_metrics),
+    )
     engine_kw.setdefault("collect_clocks", True)
     engine = Engine(plan, **engine_kw)
     final = engine.run_batch(scenario_keys(seed, 1))
@@ -828,6 +834,8 @@ def run_single(
                 for s, sid in enumerate(plan.server_ids)
             },
         }
+        enabled = {m.value for m in payload.sim_settings.enabled_sample_metrics}
+        sampled = {k: v for k, v in sampled.items() if k in enabled}
     return SimulationResults(
         settings=payload.sim_settings,
         rqs_clock=clock,
@@ -861,4 +869,9 @@ def sweep_results(
         total_generated=np.asarray(final.n_generated),
         total_dropped=np.asarray(final.n_dropped),
         overflow_dropped=np.asarray(final.n_overflow),
+        gauge_means=(
+            np.asarray(final.gauge_means)
+            if hasattr(final, "gauge_means")
+            else None
+        ),
     )

@@ -85,6 +85,9 @@ class FastState(NamedTuple):
     n_generated: jnp.ndarray
     n_dropped: jnp.ndarray
     n_overflow: jnp.ndarray
+    #: (n_gauges,) exact time-average of every gauge over the horizon —
+    #: cheap per-scenario what-if statistics even in histogram-only sweeps
+    gauge_means: jnp.ndarray
 
 
 def _kw_waits(
@@ -345,6 +348,16 @@ class FastEngine:
         n_generated = jnp.sum(alive)
         n_dropped = jnp.int32(0)
 
+        # exact time-integrals of every gauge (divided by the horizon at the
+        # end); an interval [a, b) contributes its horizon-clipped length
+        gauge_means = jnp.zeros(plan.n_gauges, jnp.float32)
+        horizon = jnp.float32(plan.horizon)
+
+        def span(a, b, on, amount=1.0):
+            lo = jnp.minimum(a, horizon)
+            hi = jnp.minimum(b, horizon)
+            return jnp.sum(jnp.where(on, amount * jnp.maximum(hi - lo, 0.0), 0.0))
+
         # ---- entry chain ------------------------------------------------
         for j, eidx in enumerate(plan.entry_edges.tolist()):
             # a send at t >= horizon never happens in the event engines
@@ -355,6 +368,7 @@ class FastEngine:
             )
             ok = alive & ~dropped
             gauge = self._gauge_intervals(gauge, eidx, t, t + delay, 1.0, ok)
+            gauge_means = gauge_means.at[eidx].add(span(t, t + delay, ok))
             n_dropped = n_dropped + jnp.sum(alive & dropped)
             t = jnp.where(ok, t + delay, t)
             alive = ok
@@ -388,6 +402,7 @@ class FastEngine:
                 )
                 ok = mine & ~dropped
                 gauge = self._gauge_intervals(gauge, eidx, t, t + delay, 1.0, ok)
+                gauge_means = gauge_means.at[eidx].add(span(t, t + delay, ok))
                 n_dropped = n_dropped + jnp.sum(mine & dropped)
                 new_t = jnp.where(ok, t + delay, new_t)
                 new_alive = jnp.where(mine, ok, new_alive)
@@ -447,6 +462,15 @@ class FastEngine:
                 ram,
                 mine & (ram > 0),
             )
+            gauge_means = gauge_means.at[plan.n_edges + s].add(
+                span(t, t + wait, mine),
+            )
+            gauge_means = gauge_means.at[plan.n_edges + plan.n_servers + s].add(
+                span(t + wait + cpu, dep, mine),
+            )
+            gauge_means = gauge_means.at[plan.n_edges + 2 * plan.n_servers + s].add(
+                span(t, dep, mine, amount=ram),
+            )
 
             # exit edge: the send only happens while the clock is running
             sendable = mine & (dep < plan.horizon)
@@ -456,6 +480,7 @@ class FastEngine:
             )
             ok = sendable & ~dropped
             gauge = self._gauge_intervals(gauge, eidx, dep, dep + delay, 1.0, ok)
+            gauge_means = gauge_means.at[eidx].add(span(dep, dep + delay, ok))
             n_dropped = n_dropped + jnp.sum(sendable & dropped)
             if plan.exit_kind[s] == TARGET_SERVER:
                 nxt = int(plan.exit_target[s])
@@ -506,6 +531,7 @@ class FastEngine:
             n_generated=n_generated,
             n_dropped=n_dropped,
             n_overflow=overflow,
+            gauge_means=gauge_means / horizon,
         )
 
     def run_batch(

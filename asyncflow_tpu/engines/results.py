@@ -70,6 +70,9 @@ class SweepResults:
     total_generated: np.ndarray = field(default_factory=lambda: np.empty(0))
     total_dropped: np.ndarray = field(default_factory=lambda: np.empty(0))
     overflow_dropped: np.ndarray = field(default_factory=lambda: np.empty(0))
+    #: (S, n_gauges) exact per-scenario time-averages of every gauge (fast
+    #: path only; None otherwise). Layout: [edges | ready | io | ram].
+    gauge_means: np.ndarray | None = None
 
     def __getitem__(self, idx) -> SweepResults:
         """Slice along the scenario axis."""
@@ -86,6 +89,9 @@ class SweepResults:
             total_generated=self.total_generated[idx],
             total_dropped=self.total_dropped[idx],
             overflow_dropped=self.overflow_dropped[idx],
+            gauge_means=(
+                self.gauge_means[idx] if self.gauge_means is not None else None
+            ),
         )
 
     def percentile(self, q: float) -> np.ndarray:

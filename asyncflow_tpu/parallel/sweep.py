@@ -73,6 +73,42 @@ class SweepReport:
     results: SweepResults
     n_scenarios: int
     wall_seconds: float
+    plan: StaticPlan | None = None
+
+    def mean_gauge(self, metric: str, component_id: str) -> np.ndarray:
+        """(S,) per-scenario time-average of one gauge (fast path sweeps).
+
+        ``metric`` is a :class:`SampledMetricName` value; ``component_id`` an
+        edge id (edge concurrency) or server id (ready/io/ram).
+        """
+        from asyncflow_tpu.config.constants import SampledMetricName as Metric
+
+        if self.results.gauge_means is None or self.plan is None:
+            msg = "per-scenario gauge means are only recorded by the fast path"
+            raise ValueError(msg)
+        plan = self.plan
+
+        def server_idx() -> int:
+            if component_id not in plan.server_ids:
+                msg = f"unknown server {component_id!r}; valid: {plan.server_ids}"
+                raise ValueError(msg)
+            return plan.server_ids.index(component_id)
+
+        if metric == Metric.EDGE_CONCURRENT_CONNECTION:
+            if component_id not in plan.edge_ids:
+                msg = f"unknown edge {component_id!r}; valid: {plan.edge_ids}"
+                raise ValueError(msg)
+            idx = plan.edge_ids.index(component_id)
+        elif metric == Metric.READY_QUEUE_LEN:
+            idx = plan.n_edges + server_idx()
+        elif metric == Metric.EVENT_LOOP_IO_SLEEP:
+            idx = plan.n_edges + plan.n_servers + server_idx()
+        elif metric == Metric.RAM_IN_USE:
+            idx = plan.n_edges + 2 * plan.n_servers + server_idx()
+        else:
+            msg = f"unknown sampled metric {metric!r}"
+            raise ValueError(msg)
+        return self.results.gauge_means[:, idx]
 
     @property
     def scenarios_per_second(self) -> float:
@@ -150,6 +186,9 @@ class SweepRunner:
         import hashlib
 
         digest = hashlib.sha256()
+        # bump when the per-chunk npz schema changes so stale chunks are
+        # never silently merged (e.g. pre-gauge_means chunks)
+        digest.update(b"chunk-schema-v2")
         digest.update(self.payload.model_dump_json().encode())
         digest.update(self.engine_kind.encode())
         digest.update(str(self.engine.n_hist_bins).encode())
@@ -230,7 +269,12 @@ class SweepRunner:
         wall = time.time() - t0
 
         merged = _concat_sweeps(partials)[:n_scenarios]
-        return SweepReport(results=merged, n_scenarios=n_scenarios, wall_seconds=wall)
+        return SweepReport(
+            results=merged,
+            n_scenarios=n_scenarios,
+            wall_seconds=wall,
+            plan=self.plan,
+        )
 
 
 class _SweepCheckpoint:
@@ -273,6 +317,8 @@ class _SweepCheckpoint:
 
         payload = {name: getattr(part, name) for name in self._ARRAY_FIELDS}
         payload["hist_edges"] = part.hist_edges
+        if part.gauge_means is not None:
+            payload["gauge_means"] = part.gauge_means
         # atomic write so an interrupt never leaves a half-written chunk
         tmp = self.dir / f".chunk_{start:08d}.{os.getpid()}.tmp.npz"
         np.savez(tmp, **payload)
@@ -286,6 +332,7 @@ class _SweepCheckpoint:
             return SweepResults(
                 settings=self._settings,
                 hist_edges=data["hist_edges"],
+                gauge_means=data["gauge_means"] if "gauge_means" in data else None,
                 **{name: data[name] for name in self._ARRAY_FIELDS},
             )
 
@@ -357,6 +404,11 @@ def _concat_sweeps(parts: list[SweepResults]) -> SweepResults:
             total_generated=np.concatenate([p.total_generated for p in parts]),
             total_dropped=np.concatenate([p.total_dropped for p in parts]),
             overflow_dropped=np.concatenate([p.overflow_dropped for p in parts]),
+            gauge_means=(
+                np.concatenate([p.gauge_means for p in parts])
+                if all(p.gauge_means is not None for p in parts)
+                else None
+            ),
         )
     return merged
 
