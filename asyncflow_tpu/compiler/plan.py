@@ -150,6 +150,20 @@ class StaticPlan:
         """Gauge layout: [edge conns | ready | io | ram] per component."""
         return self.n_edges + 3 * self.n_servers
 
+    # single source of truth for the gauge array layout ------------------
+
+    def gauge_edge(self, edge_idx: int) -> int:
+        return edge_idx
+
+    def gauge_ready(self, server_idx: int) -> int:
+        return self.n_edges + server_idx
+
+    def gauge_io(self, server_idx: int) -> int:
+        return self.n_edges + self.n_servers + server_idx
+
+    def gauge_ram(self, server_idx: int) -> int:
+        return self.n_edges + 2 * self.n_servers + server_idx
+
 
 def _estimate_capacity(payload: SimulationPayload) -> tuple[int, int]:
     """(max_requests, pool_size) estimates.

@@ -126,16 +126,16 @@ class Engine:
         return sample_bucket(t, self.plan.sample_period, self.plan.n_samples)
 
     def _g_edge(self, e):
-        return e
+        return self.plan.gauge_edge(e)
 
     def _g_ready(self, s):
-        return self.plan.n_edges + s
+        return self.plan.gauge_ready(s)
 
     def _g_io(self, s):
-        return self.plan.n_edges + self.plan.n_servers + s
+        return self.plan.gauge_io(s)
 
     def _g_ram(self, s):
-        return self.plan.n_edges + 2 * self.plan.n_servers + s
+        return self.plan.gauge_ram(s)
 
     def _spike(self, edge, t):
         if len(self.plan.spike_times) == 1:
@@ -789,9 +789,10 @@ def run_single(
 ) -> SimulationResults:
     """Run one scenario on the JAX engine, reduced to SimulationResults."""
     plan = compile_payload(payload)
-    # gate gauge recording on the settings like the oracle's collector does;
-    # partial metric sets still record everything and filter below (the
-    # gauges share one scatter array on device)
+    # Gauge recording is gated on the settings like the oracle's collector —
+    # unless the caller explicitly forced it, in which case everything
+    # recorded is also returned.
+    gauges_forced = "collect_gauges" in engine_kw
     engine_kw.setdefault(
         "collect_gauges",
         bool(payload.sim_settings.enabled_sample_metrics),
@@ -819,23 +820,38 @@ def run_single(
         series = np.cumsum(state.gauge, axis=0)[1 : plan.n_samples + 1]
         sampled = {
             SampledMetricName.EDGE_CONCURRENT_CONNECTION.value: {
-                eid: series[:, e] for e, eid in enumerate(plan.edge_ids)
+                eid: series[:, plan.gauge_edge(e)]
+                for e, eid in enumerate(plan.edge_ids)
             },
             SampledMetricName.READY_QUEUE_LEN.value: {
-                sid: series[:, plan.n_edges + s]
+                sid: series[:, plan.gauge_ready(s)]
                 for s, sid in enumerate(plan.server_ids)
             },
             SampledMetricName.EVENT_LOOP_IO_SLEEP.value: {
-                sid: series[:, plan.n_edges + plan.n_servers + s]
+                sid: series[:, plan.gauge_io(s)]
                 for s, sid in enumerate(plan.server_ids)
             },
             SampledMetricName.RAM_IN_USE.value: {
-                sid: series[:, plan.n_edges + 2 * plan.n_servers + s]
+                sid: series[:, plan.gauge_ram(s)]
                 for s, sid in enumerate(plan.server_ids)
             },
         }
-        enabled = {m.value for m in payload.sim_settings.enabled_sample_metrics}
-        sampled = {k: v for k, v in sampled.items() if k in enabled}
+        if not gauges_forced:
+            # reference collector semantics: the edge metric toggles on its
+            # own, the three server metrics are all-or-nothing
+            # (`/root/reference/src/asyncflow/metrics/collector.py:55-67`)
+            enabled = set(payload.sim_settings.enabled_sample_metrics)
+            server_metrics = {
+                SampledMetricName.READY_QUEUE_LEN,
+                SampledMetricName.EVENT_LOOP_IO_SLEEP,
+                SampledMetricName.RAM_IN_USE,
+            }
+            keep: set[str] = set()
+            if SampledMetricName.EDGE_CONCURRENT_CONNECTION in enabled:
+                keep.add(SampledMetricName.EDGE_CONCURRENT_CONNECTION.value)
+            if server_metrics <= enabled:
+                keep |= {m.value for m in server_metrics}
+            sampled = {k: v for k, v in sampled.items() if k in keep}
     return SimulationResults(
         settings=payload.sim_settings,
         rqs_clock=clock,

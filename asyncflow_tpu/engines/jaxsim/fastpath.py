@@ -444,11 +444,11 @@ class FastEngine:
             dep = t + wait + cpu + io
             # gauges: ready queue during the wait, io sleep, ram residency
             gauge = self._gauge_intervals(
-                gauge, plan.n_edges + s, t, t + wait, 1.0, mine & (wait > 0),
+                gauge, plan.gauge_ready(s), t, t + wait, 1.0, mine & (wait > 0),
             )
             gauge = self._gauge_intervals(
                 gauge,
-                plan.n_edges + plan.n_servers + s,
+                plan.gauge_io(s),
                 t + wait + cpu,
                 dep,
                 1.0,
@@ -456,19 +456,19 @@ class FastEngine:
             )
             gauge = self._gauge_intervals(
                 gauge,
-                plan.n_edges + 2 * plan.n_servers + s,
+                plan.gauge_ram(s),
                 t,
                 dep,
                 ram,
                 mine & (ram > 0),
             )
-            gauge_means = gauge_means.at[plan.n_edges + s].add(
+            gauge_means = gauge_means.at[plan.gauge_ready(s)].add(
                 span(t, t + wait, mine),
             )
-            gauge_means = gauge_means.at[plan.n_edges + plan.n_servers + s].add(
+            gauge_means = gauge_means.at[plan.gauge_io(s)].add(
                 span(t + wait + cpu, dep, mine),
             )
-            gauge_means = gauge_means.at[plan.n_edges + 2 * plan.n_servers + s].add(
+            gauge_means = gauge_means.at[plan.gauge_ram(s)].add(
                 span(t, dep, mine, amount=ram),
             )
 

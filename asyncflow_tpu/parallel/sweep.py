@@ -98,13 +98,13 @@ class SweepReport:
             if component_id not in plan.edge_ids:
                 msg = f"unknown edge {component_id!r}; valid: {plan.edge_ids}"
                 raise ValueError(msg)
-            idx = plan.edge_ids.index(component_id)
+            idx = plan.gauge_edge(plan.edge_ids.index(component_id))
         elif metric == Metric.READY_QUEUE_LEN:
-            idx = plan.n_edges + server_idx()
+            idx = plan.gauge_ready(server_idx())
         elif metric == Metric.EVENT_LOOP_IO_SLEEP:
-            idx = plan.n_edges + plan.n_servers + server_idx()
+            idx = plan.gauge_io(server_idx())
         elif metric == Metric.RAM_IN_USE:
-            idx = plan.n_edges + 2 * plan.n_servers + server_idx()
+            idx = plan.gauge_ram(server_idx())
         else:
             msg = f"unknown sampled metric {metric!r}"
             raise ValueError(msg)

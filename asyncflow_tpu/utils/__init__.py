@@ -1,0 +1,5 @@
+"""Cross-cutting utilities."""
+
+from asyncflow_tpu.utils.profiling import Stopwatch, profile_trace
+
+__all__ = ["Stopwatch", "profile_trace"]

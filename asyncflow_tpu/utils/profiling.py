@@ -1,0 +1,59 @@
+"""Kernel-level profiling helpers.
+
+The reference traces per-request hops (request-level observability, covered
+by ``collect_traces``); the engine-level equivalent on TPU is XLA's profiler.
+These helpers wrap ``jax.profiler`` so a sweep can be captured for
+TensorBoard / Perfetto without touching engine code:
+
+    from asyncflow_tpu.utils.profiling import profile_trace
+
+    with profile_trace("/tmp/af_profile"):
+        runner.run(1024, seed=0)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections.abc import Iterator
+from dataclasses import dataclass, field
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str) -> Iterator[None]:
+    """Capture an XLA profiler trace of the enclosed block into ``log_dir``."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@dataclass
+class Stopwatch:
+    """Tiny section timer for host-side phase breakdowns."""
+
+    sections: dict[str, float] = field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def section(self, name: str) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.sections[name] = (
+                self.sections.get(name, 0.0) + time.perf_counter() - start
+            )
+
+    def report(self) -> str:
+        total = sum(self.sections.values()) or 1.0
+        lines = [
+            f"{name:<24s} {seconds:8.3f}s {seconds / total * 100:5.1f}%"
+            for name, seconds in sorted(
+                self.sections.items(),
+                key=lambda item: -item[1],
+            )
+        ]
+        return "\n".join(lines)

@@ -1,0 +1,178 @@
+"""Unit tests for payload -> StaticPlan lowering."""
+
+import numpy as np
+import pytest
+import yaml
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.compiler.plan import (
+    SEG_CPU,
+    SEG_IO,
+    TARGET_CLIENT,
+    TARGET_LB,
+    TARGET_SERVER,
+)
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+BASE = "tests/integration/data/single_server.yml"
+LB = "tests/integration/data/two_servers_lb.yml"
+
+
+def _payload(path: str, mutate=None) -> SimulationPayload:
+    data = yaml.safe_load(open(path).read())
+    if mutate:
+        mutate(data)
+    return SimulationPayload.model_validate(data)
+
+
+def test_entry_chain_and_exit(minimal_payload) -> None:
+    plan = compile_payload(minimal_payload)
+    # generator -> client -> server: two entry edges, target = server 0
+    assert plan.entry_edges.tolist() == [0, 1]
+    assert plan.entry_target_kind == TARGET_SERVER
+    assert plan.entry_target == 0
+    assert plan.exit_kind.tolist() == [TARGET_CLIENT]
+    assert plan.edge_ids == ["gen-client", "client-srv", "srv-client"]
+
+
+def test_lb_plan() -> None:
+    plan = compile_payload(_payload(LB))
+    assert plan.entry_target_kind == TARGET_LB
+    assert plan.n_lb_edges == 2
+    assert [plan.edge_ids[e] for e in plan.lb_edge_index] == ["lb-srv1", "lb-srv2"]
+    assert plan.lb_target.tolist() == [0, 1]
+
+
+def test_consecutive_steps_merge_into_segments() -> None:
+    def mutate(data: dict) -> None:
+        data["topology_graph"]["nodes"]["servers"][0]["endpoints"][0]["steps"] = [
+            {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.001}},
+            {"kind": "cpu_bound_operation", "step_operation": {"cpu_time": 0.002}},
+            {"kind": "ram", "step_operation": {"necessary_ram": 64}},
+            {"kind": "io_db", "step_operation": {"io_waiting_time": 0.003}},
+            {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.004}},
+            {"kind": "cpu_bound_operation", "step_operation": {"cpu_time": 0.005}},
+        ]
+
+    plan = compile_payload(_payload(BASE, mutate))
+    kinds = plan.seg_kind[0, 0].tolist()
+    durs = plan.seg_dur[0, 0].tolist()
+    # CPU(1+2ms), IO(3+4ms), CPU(5ms), END
+    assert kinds == [SEG_CPU, SEG_IO, SEG_CPU, 0]
+    assert durs == pytest.approx([0.003, 0.007, 0.005, 0.0])
+    assert plan.endpoint_ram[0, 0] == 64.0
+
+
+def test_spike_breakpoints_superpose() -> None:
+    def mutate(data: dict) -> None:
+        data["events"] = [
+            {
+                "event_id": "a",
+                "target_id": "client-srv",
+                "start": {
+                    "kind": "network_spike_start",
+                    "t_start": 10.0,
+                    "spike_s": 0.1,
+                },
+                "end": {"kind": "network_spike_end", "t_end": 30.0},
+            },
+            {
+                "event_id": "b",
+                "target_id": "client-srv",
+                "start": {
+                    "kind": "network_spike_start",
+                    "t_start": 20.0,
+                    "spike_s": 0.2,
+                },
+                "end": {"kind": "network_spike_end", "t_end": 40.0},
+            },
+        ]
+
+    plan = compile_payload(_payload(BASE, mutate))
+    assert plan.spike_times.tolist() == [0.0, 10.0, 20.0, 30.0, 40.0]
+    edge = plan.edge_ids.index("client-srv")
+    values = plan.spike_values[:, edge]
+    assert values == pytest.approx([0.0, 0.1, 0.3, 0.2, 0.0], abs=1e-6)
+
+
+def test_outage_timeline_order() -> None:
+    def mutate(data: dict) -> None:
+        data["events"] = [
+            {
+                "event_id": "o1",
+                "target_id": "srv-1",
+                "start": {"kind": "server_down", "t_start": 5.0},
+                "end": {"kind": "server_up", "t_end": 20.0},
+            },
+            {
+                "event_id": "o2",
+                "target_id": "srv-2",
+                "start": {"kind": "server_down", "t_start": 20.0},
+                "end": {"kind": "server_up", "t_end": 30.0},
+            },
+        ]
+
+    plan = compile_payload(_payload(LB, mutate))
+    assert plan.timeline_times.tolist() == [5.0, 20.0, 20.0, 30.0]
+    # at the t=20 tie the UP (srv-1) sorts before the DOWN (srv-2)
+    assert plan.timeline_down.tolist() == [1, 0, 1, 0]
+    assert plan.timeline_slot.tolist() == [0, 0, 1, 1]
+
+
+def test_pool_scales_with_overload() -> None:
+    def overload(data: dict) -> None:
+        server = data["topology_graph"]["nodes"]["servers"][0]
+        server["endpoints"][0]["steps"] = [
+            {"kind": "cpu_bound_operation", "step_operation": {"cpu_time": 0.08}},
+        ]
+        data["rqs_input"]["avg_active_users"]["mean"] = 100  # ~33 rps vs 12.5 cap
+
+    light = compile_payload(_payload(BASE))
+    heavy = compile_payload(_payload(BASE, overload))
+    assert heavy.pool_size >= 16 * light.pool_size
+
+
+def test_server_chain_topology() -> None:
+    def chain(data: dict) -> None:
+        data["topology_graph"]["nodes"]["servers"].append(
+            {
+                "id": "srv-db",
+                "server_resources": {"cpu_cores": 1, "ram_mb": 1024},
+                "endpoints": [
+                    {
+                        "endpoint_name": "q",
+                        "steps": [
+                            {
+                                "kind": "io_db",
+                                "step_operation": {"io_waiting_time": 0.01},
+                            },
+                        ],
+                    },
+                ],
+            },
+        )
+        for edge in data["topology_graph"]["edges"]:
+            if edge["id"] == "srv-client":
+                edge["target"] = "srv-db"
+        data["topology_graph"]["edges"].append(
+            {
+                "id": "db-client",
+                "source": "srv-db",
+                "target": "client-1",
+                "latency": {"mean": 0.002, "distribution": "exponential"},
+            },
+        )
+
+    plan = compile_payload(_payload(BASE, chain))
+    assert plan.exit_kind.tolist() == [TARGET_SERVER, TARGET_CLIENT]
+    assert plan.exit_target[0] == 1
+    assert plan.server_topo_order == [0, 1]
+
+
+def test_sample_count_matches_oracle_convention(minimal_payload) -> None:
+    plan = compile_payload(minimal_payload)
+    settings = minimal_payload.sim_settings
+    # samples at k*period for k=1.. strictly below the horizon
+    assert plan.n_samples == round(
+        settings.total_simulation_time / settings.sample_period_s,
+    ) - 1

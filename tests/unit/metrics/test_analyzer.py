@@ -1,0 +1,74 @@
+"""Analyzer unit tests with synthetic results (no simulation run needed),
+mirroring the reference's duck-typed-dummy technique
+(`/root/reference/tests/unit/metrics/test_analyzer.py:34-60`)."""
+
+import numpy as np
+import pytest
+
+from asyncflow_tpu.config.constants import LatencyKey
+from asyncflow_tpu.engines.results import SimulationResults
+from asyncflow_tpu.metrics.analyzer import ResultsAnalyzer
+from asyncflow_tpu.schemas.settings import SimulationSettings
+
+
+def _results(clock: np.ndarray, horizon: int = 10) -> SimulationResults:
+    return SimulationResults(
+        settings=SimulationSettings(total_simulation_time=horizon),
+        rqs_clock=clock,
+        sampled={"ram_in_use": {"srv-1": np.array([1.0, 2.0, 3.0])}},
+        server_ids=["srv-1"],
+        edge_ids=["e-1"],
+    )
+
+
+def test_latency_stats_exact_values() -> None:
+    clock = np.array([[0.0, 1.0], [1.0, 3.0], [2.0, 5.0], [3.0, 7.0]])
+    analyzer = ResultsAnalyzer(_results(clock))
+    stats = analyzer.get_latency_stats()
+    # latencies: 1, 2, 3, 4
+    assert stats[LatencyKey.TOTAL_REQUESTS] == 4
+    assert stats[LatencyKey.MEAN] == pytest.approx(2.5)
+    assert stats[LatencyKey.MEDIAN] == pytest.approx(2.5)
+    assert stats[LatencyKey.MIN] == 1.0
+    assert stats[LatencyKey.MAX] == 4.0
+    assert stats[LatencyKey.P95] == pytest.approx(np.percentile([1, 2, 3, 4], 95))
+
+
+def test_empty_clock_gives_empty_stats() -> None:
+    analyzer = ResultsAnalyzer(_results(np.empty((0, 2))))
+    assert analyzer.get_latency_stats() == {}
+    assert analyzer.format_latency_stats() == "Latency stats: (empty)"
+
+
+def test_throughput_bucket_edges() -> None:
+    """Completions exactly on a bucket boundary count in that bucket
+    (reference scan: finish <= current_end)."""
+    clock = np.array([[0.0, 0.5], [0.0, 1.0], [0.0, 1.5], [0.0, 9.99]])
+    analyzer = ResultsAnalyzer(_results(clock, horizon=10))
+    times, rps = analyzer.get_throughput_series()
+    assert times == [float(k) for k in range(1, 11)]
+    assert rps[0] == 2.0  # 0.5 and exactly 1.0
+    assert rps[1] == 1.0  # 1.5
+    assert rps[9] == 1.0  # 9.99
+    assert sum(rps) == 4.0
+
+
+def test_custom_window_preserves_total() -> None:
+    rng = np.random.default_rng(3)
+    finishes = np.sort(rng.uniform(0, 10, 100))
+    clock = np.stack([np.zeros(100), finishes], axis=1)
+    analyzer = ResultsAnalyzer(_results(clock, horizon=10))
+    _, r1 = analyzer.get_throughput_series()
+    _, r2 = analyzer.get_throughput_series(window_s=2.5)
+    assert np.isclose(sum(r1), sum(np.asarray(r2) * 2.5))
+
+
+def test_series_accessors() -> None:
+    analyzer = ResultsAnalyzer(_results(np.empty((0, 2))))
+    assert analyzer.list_server_ids() == ["srv-1"]
+    times, values = analyzer.get_series("ram_in_use", "srv-1")
+    assert values.tolist() == [1.0, 2.0, 3.0]
+    assert times[0] == 0.0
+    assert analyzer.get_metric_map("nonexistent") == {}
+    _, missing = analyzer.get_series("ram_in_use", "ghost")
+    assert missing.size == 0

@@ -1,0 +1,15 @@
+"""Utility smoke tests."""
+
+import time
+
+from asyncflow_tpu.utils import Stopwatch
+
+
+def test_stopwatch_sections() -> None:
+    watch = Stopwatch()
+    with watch.section("a"):
+        time.sleep(0.01)
+    with watch.section("b"):
+        pass
+    assert watch.sections["a"] >= 0.01
+    assert "a" in watch.report()
