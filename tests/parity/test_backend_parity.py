@@ -166,3 +166,36 @@ def test_overflow_is_surfaced_not_silent() -> None:
     engine = Engine(plan, pool_size=2)
     final = engine.run_batch(scenario_keys(3, 2))
     assert int(np.asarray(final.n_overflow).sum()) > 0
+
+
+def test_parity_gaussian_users_workload() -> None:
+    """Normal-distributed active users (the gaussian-poisson sampler)."""
+
+    def mutate(data: dict) -> None:
+        data["rqs_input"]["avg_active_users"] = {
+            "mean": 60,
+            "distribution": "normal",
+            "variance": 12,
+        }
+
+    payload = _payload(BASE, mutate)
+    _assert_percentile_parity(
+        _jax_latencies(payload, SEEDS),
+        _oracle_latencies(payload, SEEDS),
+        tol=0.03,
+    )
+
+
+def test_parity_least_connections_routing() -> None:
+    """Least-connections on the event engine vs the oracle (fast path is
+    ineligible for LC by design)."""
+
+    def mutate(data: dict) -> None:
+        data["topology_graph"]["nodes"]["load_balancer"]["algorithms"] = (
+            "least_connection"
+        )
+
+    payload = _payload(LB, mutate)
+    lat_jax = _jax_latencies(payload, SEEDS)
+    lat_oracle = _oracle_latencies(payload, SEEDS)
+    _assert_percentile_parity(lat_jax, lat_oracle, tol=0.04)

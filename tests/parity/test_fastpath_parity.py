@@ -324,3 +324,17 @@ def test_fastpath_outage_gauge_blackout() -> None:
         after = cc2[int(32 / period) :]
         assert float(np.max(during)) == 0.0
         assert float(np.max(after)) > 0.0
+
+
+def test_fastpath_gaussian_users() -> None:
+    """Window-Poisson synthesis with truncated-Gaussian user draws."""
+
+    def mutate(data: dict) -> None:
+        data["rqs_input"]["avg_active_users"] = {
+            "mean": 60,
+            "distribution": "normal",
+            "variance": 12,
+        }
+
+    payload = _payload(BASE, mutate)
+    _assert_parity(_fast_latencies(payload, SEEDS), _oracle_latencies(payload, SEEDS), 0.03)
