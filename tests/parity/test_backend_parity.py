@@ -199,3 +199,48 @@ def test_parity_least_connections_routing() -> None:
     lat_jax = _jax_latencies(payload, SEEDS)
     lat_oracle = _oracle_latencies(payload, SEEDS)
     _assert_percentile_parity(lat_jax, lat_oracle, tol=0.04)
+
+
+def test_parity_gateway_before_lb() -> None:
+    """A server whose exit edge feeds the LB (client->gw->LB->workers->client):
+    exercises the event engines' ARRIVE_LB-after-server path."""
+
+    def mutate(data: dict) -> None:
+        nodes = data["topology_graph"]["nodes"]
+        nodes["servers"].append(
+            {
+                "id": "srv-gw",
+                "server_resources": {"cpu_cores": 1, "ram_mb": 1024},
+                "endpoints": [
+                    {
+                        "endpoint_name": "route",
+                        "steps": [
+                            {
+                                "kind": "initial_parsing",
+                                "step_operation": {"cpu_time": 0.001},
+                            },
+                        ],
+                    },
+                ],
+            },
+        )
+        for edge in data["topology_graph"]["edges"]:
+            if edge["id"] == "client-lb":
+                edge["target"] = "srv-gw"
+        data["topology_graph"]["edges"].append(
+            {
+                "id": "gw-lb",
+                "source": "srv-gw",
+                "target": "lb-1",
+                "latency": {"mean": 0.002, "distribution": "exponential"},
+            },
+        )
+
+    payload = _payload(LB, mutate)
+    plan = compile_payload(payload)
+    assert not plan.fastpath_ok  # exit-to-LB is a cycle for the scan engine
+    _assert_percentile_parity(
+        _jax_latencies(payload, SEEDS),
+        _oracle_latencies(payload, SEEDS),
+        tol=0.04,
+    )
