@@ -785,9 +785,18 @@ def run_single(
     payload: SimulationPayload,
     *,
     seed: int = 0,
+    engine: str = "auto",
     **engine_kw,
 ) -> SimulationResults:
-    """Run one scenario on the JAX engine, reduced to SimulationResults."""
+    """Run one scenario on the JAX backend, reduced to SimulationResults.
+
+    ``engine="auto"`` uses the scan fast path when the compiler proves it
+    exact for this plan (it records the same clocks and gauges), otherwise
+    the general event engine; ``"event"``/``"fast"`` force one.
+    """
+    if engine not in ("auto", "fast", "event"):
+        msg = f"engine must be 'auto', 'fast' or 'event', got {engine!r}"
+        raise ValueError(msg)
     plan = compile_payload(payload)
     # Gauge recording is gated on the settings like the oracle's collector —
     # unless the caller explicitly forced it, in which case everything
@@ -798,17 +807,31 @@ def run_single(
         bool(payload.sim_settings.enabled_sample_metrics),
     )
     engine_kw.setdefault("collect_clocks", True)
-    engine = Engine(plan, **engine_kw)
-    final = engine.run_batch(scenario_keys(seed, 1))
+    # an explicit pool_size is an event-engine knob: honor it by using that
+    # engine rather than silently discarding the tuning on the fast path
+    pool_tuned = "pool_size" in engine_kw
+    use_fast = engine == "fast" or (
+        engine == "auto" and plan.fastpath_ok and not pool_tuned
+    )
+    if use_fast:
+        from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+
+        if pool_tuned:
+            msg = "pool_size applies to the event engine; use max_requests here"
+            raise ValueError(msg)
+        sim_engine: Engine | FastEngine = FastEngine(plan, **engine_kw)
+    else:
+        sim_engine = Engine(plan, **engine_kw)
+    final = sim_engine.run_batch(scenario_keys(seed, 1))
     state = jax.tree.map(lambda x: np.asarray(x[0]), final)
 
     if int(state.n_overflow) > 0:
         import warnings
 
+        knob = "max_requests" if use_fast else "pool_size"
         warnings.warn(
-            f"request pool overflowed {int(state.n_overflow)} times; "
-            "latency percentiles are truncated — rerun with a larger "
-            "pool_size",
+            f"request capacity overflowed {int(state.n_overflow)} times; "
+            f"latency percentiles are truncated — rerun with a larger {knob}",
             stacklevel=2,
         )
 
@@ -816,7 +839,7 @@ def run_single(
     clock = state.clock[:clock_n].astype(np.float64)
 
     sampled: dict[str, dict[str, np.ndarray]] = {}
-    if engine.collect_gauges:
+    if sim_engine.collect_gauges:
         series = np.cumsum(state.gauge, axis=0)[1 : plan.n_samples + 1]
         sampled = {
             SampledMetricName.EDGE_CONCURRENT_CONNECTION.value: {
