@@ -781,6 +781,31 @@ def scenario_keys(seed: int, n: int) -> jnp.ndarray:
     return jax.random.split(jax.random.PRNGKey(seed), n)
 
 
+def engine_truncated(engine: Engine, state) -> np.ndarray:
+    """Did the iteration safety cap fire with work still pending?
+
+    Works on a single scenario's final state or a batched one (leading
+    scenario axis); fast-path states have no iteration counter and are never
+    truncated.  Reuses the engine's own ``_next_times`` so the detection can
+    never drift from the loop's continue condition, and reduces on device so
+    only an (S,) bool crosses to the host.
+    """
+    if not hasattr(state, "it"):
+        return np.zeros(
+            np.asarray(getattr(state, "lat_count", 0)).shape,
+            dtype=bool,
+        )
+    plan = engine.plan
+
+    def one(st):
+        t_pool, t_arr, t_tl = engine._next_times(st)
+        t_min = jnp.minimum(jnp.minimum(t_pool, t_arr), t_tl)
+        return (st.it >= plan.max_iterations) & (t_min < plan.horizon)
+
+    batched = np.ndim(state.it) > 0
+    return np.asarray(jax.vmap(one)(state) if batched else one(state))
+
+
 def run_single(
     payload: SimulationPayload,
     *,
@@ -834,9 +859,32 @@ def run_single(
             f"latency percentiles are truncated — rerun with a larger {knob}",
             stacklevel=2,
         )
+    if not use_fast and engine_truncated(sim_engine, state):
+        import warnings
 
-    clock_n = int(state.clock_n)
-    clock = state.clock[:clock_n].astype(np.float64)
+        warnings.warn(
+            "the event engine's iteration safety cap fired before the "
+            "horizon; results cover only part of the run — rerun with a "
+            "shorter horizon or a larger pool/budget",
+            stacklevel=2,
+        )
+
+    if sim_engine.collect_clocks:
+        clock_n = int(state.clock_n)
+        capacity = state.clock.shape[0]
+        if clock_n > capacity:
+            import warnings
+
+            warnings.warn(
+                f"clock table overflow: {clock_n - capacity} completions past "
+                f"max_requests={capacity} were not recorded; analyzer latency "
+                "stats exclude them — rerun with a larger max_requests",
+                stacklevel=2,
+            )
+            clock_n = capacity
+        clock = state.clock[:clock_n].astype(np.float64)
+    else:
+        clock = np.empty((0, 2), dtype=np.float64)
 
     sampled: dict[str, dict[str, np.ndarray]] = {}
     if sim_engine.collect_gauges:
@@ -913,4 +961,5 @@ def sweep_results(
             if hasattr(final, "gauge_means")
             else None
         ),
+        truncated=engine_truncated(engine, final),
     )

@@ -217,7 +217,7 @@ def run_native(
         if collect_gauges
         else None
     )
-    counters = np.zeros(3, dtype=np.int64)
+    counters = np.zeros(4, dtype=np.int64)
 
     lib.afnative_run(
         ctypes.byref(c),
@@ -226,27 +226,35 @@ def run_native(
         gauges.ctypes.data_as(_f32p) if gauges is not None else _f32p(),
         counters.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
-    generated, dropped, clock_n = (int(x) for x in counters)
+    generated, dropped, clock_n, clock_overflow = (int(x) for x in counters)
+    if clock_overflow > 0:
+        import warnings
+
+        warnings.warn(
+            f"clock table overflow: {clock_overflow} completions past "
+            f"max_requests={plan.max_requests} were not recorded; analyzer "
+            "latency stats exclude them — recompile the plan with a larger "
+            "max_requests",
+            stacklevel=2,
+        )
 
     sampled: dict[str, dict[str, np.ndarray]] = {}
     if gauges is not None:
         sampled = {
             SampledMetricName.EDGE_CONCURRENT_CONNECTION.value: {
-                eid: gauges[:, e].astype(np.float64)
+                eid: gauges[:, plan.gauge_edge(e)].astype(np.float64)
                 for e, eid in enumerate(plan.edge_ids)
             },
             SampledMetricName.READY_QUEUE_LEN.value: {
-                sid: gauges[:, plan.n_edges + s].astype(np.float64)
+                sid: gauges[:, plan.gauge_ready(s)].astype(np.float64)
                 for s, sid in enumerate(plan.server_ids)
             },
             SampledMetricName.EVENT_LOOP_IO_SLEEP.value: {
-                sid: gauges[:, plan.n_edges + plan.n_servers + s].astype(np.float64)
+                sid: gauges[:, plan.gauge_io(s)].astype(np.float64)
                 for s, sid in enumerate(plan.server_ids)
             },
             SampledMetricName.RAM_IN_USE.value: {
-                sid: gauges[:, plan.n_edges + 2 * plan.n_servers + s].astype(
-                    np.float64,
-                )
+                sid: gauges[:, plan.gauge_ram(s)].astype(np.float64)
                 for s, sid in enumerate(plan.server_ids)
             },
         }

@@ -151,6 +151,7 @@ struct Sim {
     // outputs
     double* out_clock = nullptr;  // [max_requests][2]
     int64_t clock_n = 0;
+    int64_t clock_overflow = 0;  // completions past the clock capacity
     float* out_gauges = nullptr;  // [n_samples][NG] or nullptr
     int64_t generated = 0, dropped = 0;
 
@@ -428,6 +429,8 @@ struct Sim {
             out_clock[2 * clock_n] = r.start;
             out_clock[2 * clock_n + 1] = now;
             ++clock_n;
+        } else {
+            ++clock_overflow;  // saturated run: surface, don't silently drop
         }
         release(i);
     }
@@ -498,7 +501,7 @@ int64_t afnative_run(
     uint64_t seed,
     double* out_clock,
     float* out_gauges,  // may be null
-    int64_t* out_counters /* [generated, dropped, clock_n] */) {
+    int64_t* out_counters /* [generated, dropped, clock_n, clock_overflow] */) {
     Sim sim(*plan, seed);
     sim.out_clock = out_clock;
     sim.out_gauges = out_gauges;
@@ -506,6 +509,7 @@ int64_t afnative_run(
     out_counters[0] = sim.generated;
     out_counters[1] = sim.dropped;
     out_counters[2] = sim.clock_n;
+    out_counters[3] = sim.clock_overflow;
     return 0;
 }
 

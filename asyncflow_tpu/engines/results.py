@@ -73,6 +73,10 @@ class SweepResults:
     #: (S, n_gauges) exact per-scenario time-averages of every gauge (fast
     #: path only; None otherwise). Layout: [edges | ready | io | ram].
     gauge_means: np.ndarray | None = None
+    #: (S,) bool: the event engine's iteration safety cap fired before the
+    #: horizon, so this scenario's results cover only part of the run (event
+    #: engine only; always False on the fast path).
+    truncated: np.ndarray | None = None
 
     def __getitem__(self, idx) -> SweepResults:
         """Slice along the scenario axis."""
@@ -92,6 +96,7 @@ class SweepResults:
             gauge_means=(
                 self.gauge_means[idx] if self.gauge_means is not None else None
             ),
+            truncated=self.truncated[idx] if self.truncated is not None else None,
         )
 
     def percentile(self, q: float) -> np.ndarray:

@@ -134,6 +134,9 @@ class SweepReport:
             "completed_total": int(completed),
             "dropped_total": int(res.total_dropped.sum()),
             "overflow_total": int(res.overflow_dropped.sum()),
+            "truncated_total": (
+                int(res.truncated.sum()) if res.truncated is not None else 0
+            ),
             "latency_mean_s": float(mean),
             "latency_p50_s": self.aggregate_percentile(50),
             "latency_p95_s": self.aggregate_percentile(95),
@@ -188,10 +191,14 @@ class SweepRunner:
         digest = hashlib.sha256()
         # bump when the per-chunk npz schema changes so stale chunks are
         # never silently merged (e.g. pre-gauge_means chunks)
-        digest.update(b"chunk-schema-v2")
+        digest.update(b"chunk-schema-v3")
         digest.update(self.payload.model_dump_json().encode())
         digest.update(self.engine_kind.encode())
         digest.update(str(self.engine.n_hist_bins).encode())
+        # capacity knobs change overflow truncation in saturated runs, so
+        # chunks computed under different capacities must never be merged
+        digest.update(str(self.plan.pool_size).encode())
+        digest.update(str(self.plan.max_requests).encode())
         if overrides is not None:
             for field in overrides:
                 digest.update(np.asarray(field).tobytes())
@@ -319,6 +326,8 @@ class _SweepCheckpoint:
         payload["hist_edges"] = part.hist_edges
         if part.gauge_means is not None:
             payload["gauge_means"] = part.gauge_means
+        if part.truncated is not None:
+            payload["truncated"] = part.truncated
         # atomic write so an interrupt never leaves a half-written chunk
         tmp = self.dir / f".chunk_{start:08d}.{os.getpid()}.tmp.npz"
         np.savez(tmp, **payload)
@@ -333,6 +342,7 @@ class _SweepCheckpoint:
                 settings=self._settings,
                 hist_edges=data["hist_edges"],
                 gauge_means=data["gauge_means"] if "gauge_means" in data else None,
+                truncated=data["truncated"] if "truncated" in data else None,
                 **{name: data[name] for name in self._ARRAY_FIELDS},
             )
 
@@ -407,6 +417,11 @@ def _concat_sweeps(parts: list[SweepResults]) -> SweepResults:
             gauge_means=(
                 np.concatenate([p.gauge_means for p in parts])
                 if all(p.gauge_means is not None for p in parts)
+                else None
+            ),
+            truncated=(
+                np.concatenate([p.truncated for p in parts])
+                if all(p.truncated is not None for p in parts)
                 else None
             ),
         )

@@ -36,6 +36,15 @@ class SimulationRunner:
         self.seed = seed
         self.engine_options = engine_options or {}
 
+    def _effective_seed(self) -> int:
+        """Same determinism rule on every backend: seeded iff the caller
+        provided a seed (0 is a valid explicit seed)."""
+        if self.seed is not None:
+            return self.seed
+        import secrets
+
+        return secrets.randbits(63)
+
     def run(self) -> ResultsAnalyzer:
         """Execute the scenario on the selected engine."""
         backend = self.backend
@@ -54,16 +63,9 @@ class SimulationRunner:
                 from asyncflow_tpu.compiler import compile_payload
                 from asyncflow_tpu.engines.oracle.native import run_native
 
-                # same determinism rule as the other backends: seeded iff the
-                # caller provided a seed
-                seed = self.seed
-                if seed is None:
-                    import secrets
-
-                    seed = secrets.randbits(63)
                 results = run_native(
                     compile_payload(self.simulation_input),
-                    seed=seed,
+                    seed=self._effective_seed(),
                     settings=self.simulation_input.sim_settings,
                     **self.engine_options,
                 )
@@ -90,7 +92,7 @@ class SimulationRunner:
 
             results = run_single(
                 self.simulation_input,
-                seed=self.seed or 0,
+                seed=self._effective_seed(),
                 **self.engine_options,
             )
         return ResultsAnalyzer(results)

@@ -1,0 +1,147 @@
+"""Loud-failure accounting: iteration-cap truncation and clock overflow.
+
+The reference's unbounded Python lists can't overflow silently; our
+fixed-shape buffers can, so every capacity cliff must be surfaced
+(`/root/reference/src/asyncflow/runtime/actors/server.py:186-193` states the
+invariants; SURVEY.md §7 "Variable-length everything" demands explicit
+overflow handling).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.engines.jaxsim.engine import (
+    Engine,
+    engine_truncated,
+    run_single,
+    scenario_keys,
+    sweep_results,
+)
+
+
+@pytest.fixture
+def plan(minimal_payload):
+    return compile_payload(minimal_payload)
+
+
+class TestIterationCapTruncation:
+    def test_capped_run_is_flagged(self, plan) -> None:
+        tiny = dataclasses.replace(plan, max_iterations=40)
+        eng = Engine(tiny)
+        final = eng.run_batch(scenario_keys(0, 2))
+        flags = engine_truncated(eng, final)
+        assert flags.shape == (2,)
+        assert flags.all()
+
+    def test_completed_run_is_not_flagged(self, plan) -> None:
+        eng = Engine(plan)
+        final = eng.run_batch(scenario_keys(0, 2))
+        assert not engine_truncated(eng, final).any()
+
+    def test_sweep_results_carry_the_flag(self, plan, minimal_payload) -> None:
+        tiny = dataclasses.replace(plan, max_iterations=40)
+        eng = Engine(tiny)
+        final = eng.run_batch(scenario_keys(0, 3))
+        res = sweep_results(eng, final, minimal_payload.sim_settings)
+        assert res.truncated is not None
+        assert res.truncated.all()
+        # scenario-axis slicing keeps the flag aligned
+        assert res[:2].truncated.shape == (2,)
+
+    def test_fastpath_states_never_flag(self, plan) -> None:
+        from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+
+        assert plan.fastpath_ok, plan.fastpath_reason
+        eng = FastEngine(plan)
+        final = eng.run_batch(scenario_keys(0, 2))
+        flags = engine_truncated(eng, final)
+        assert flags.shape == (2,)
+        assert not flags.any()
+
+    def test_run_single_warns_on_truncation(self, minimal_payload) -> None:
+        import warnings
+
+        import asyncflow_tpu.engines.jaxsim.engine as engine_mod
+
+        plan = compile_payload(minimal_payload)
+        tiny = dataclasses.replace(plan, max_iterations=40)
+        orig = engine_mod.compile_payload
+        engine_mod.compile_payload = lambda _p: tiny
+        try:
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                run_single(minimal_payload, seed=1, engine="event")
+        finally:
+            engine_mod.compile_payload = orig
+        assert any("iteration safety cap" in str(w.message) for w in caught)
+
+
+class TestClockOverflow:
+    def test_jax_event_engine_warns_and_clamps(self, minimal_payload) -> None:
+        with pytest.warns(UserWarning, match="clock table overflow"):
+            res = run_single(
+                minimal_payload,
+                seed=3,
+                engine="event",
+                max_requests=8,
+            )
+        assert len(res.rqs_clock) == 8
+
+    def test_no_spurious_warning_without_clocks(self, minimal_payload) -> None:
+        import warnings
+
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            res = run_single(
+                minimal_payload,
+                seed=3,
+                collect_clocks=False,
+            )
+        assert not any("clock table overflow" in str(w.message) for w in caught)
+        assert res.rqs_clock.shape == (0, 2)
+
+    def test_native_core_warns_and_clamps(self, plan, minimal_payload) -> None:
+        from asyncflow_tpu.engines.oracle.native import native_available, run_native
+
+        if not native_available():
+            pytest.skip("no C++ toolchain")
+        small = dataclasses.replace(plan, max_requests=8)
+        with pytest.warns(UserWarning, match="clock table overflow"):
+            res = run_native(small, seed=3, settings=minimal_payload.sim_settings)
+        assert len(res.rqs_clock) == 8
+        # counters still report the full run, not the clamped clock
+        assert res.total_generated > 8
+
+
+class TestCheckpointIdentity:
+    def test_identity_depends_on_capacity_knobs(self, minimal_payload) -> None:
+        from asyncflow_tpu.parallel.sweep import SweepRunner
+
+        base = SweepRunner(minimal_payload, use_mesh=False)
+        bigger = SweepRunner(minimal_payload, use_mesh=False, pool_size=2048)
+        assert bigger.plan.pool_size != base.plan.pool_size
+        assert base._checkpoint_identity(None) != bigger._checkpoint_identity(None)
+
+
+class TestUnseededRunsDiffer:
+    def test_jax_backend_draws_a_seed_when_none(self, minimal_payload) -> None:
+        from asyncflow_tpu.runtime.runner import SimulationRunner
+
+        runs = [
+            SimulationRunner(simulation_input=minimal_payload, backend="jax")
+            .run()
+            .get_latency_stats()["total_requests"]
+            for _ in range(2)
+        ]
+        seeded = [
+            SimulationRunner(simulation_input=minimal_payload, backend="jax", seed=0)
+            .run()
+            .get_latency_stats()["total_requests"]
+            for _ in range(2)
+        ]
+        assert seeded[0] == seeded[1]
+        # two unseeded 30 s runs colliding in completion count is ~impossible
+        assert runs[0] != runs[1] or runs[0] != seeded[0]
