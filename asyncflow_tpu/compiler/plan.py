@@ -74,6 +74,31 @@ def _compile_endpoint(endpoint: Endpoint) -> tuple[list[tuple[int, float]], floa
     return segments, total_ram
 
 
+def _burst_decomposition(
+    segs: list[tuple[int, float]],
+) -> tuple[list[float], list[float], float]:
+    """Rewrite an alternating segment program as core-queue visits.
+
+    Returns ``(burst_dur, burst_pre_io, post_io)``: the k-th CPU burst holds a
+    core for ``burst_dur[k]`` seconds and is *enqueued* ``burst_pre_io[k]``
+    seconds after the previous burst completed (IO sleeps hold no core —
+    `/root/reference/src/asyncflow/runtime/actors/server.py:235-255`);
+    ``post_io`` runs after the last burst.  A pure-IO endpoint has no bursts
+    and only ``post_io``.
+    """
+    burst_dur: list[float] = []
+    burst_pre: list[float] = []
+    io_acc = 0.0
+    for kind, dur in segs:
+        if kind == SEG_IO:
+            io_acc += dur
+        else:
+            burst_pre.append(io_acc)
+            burst_dur.append(dur)
+            io_acc = 0.0
+    return burst_dur, burst_pre, io_acc
+
+
 @dataclass
 class StaticPlan:
     """Dense arrays describing one scenario family for the batched engine."""
@@ -103,6 +128,13 @@ class StaticPlan:
     seg_kind: np.ndarray  # (NS, NEP, NSEG+1) i32 (END-terminated)
     seg_dur: np.ndarray  # (NS, NEP, NSEG+1) f32
     endpoint_ram: np.ndarray  # (NS, NEP) f32
+    # core-queue visit view of the same programs (scan fast path):
+    # burst k is enqueued burst_pre_io[...,k] seconds after burst k-1 ends
+    max_bursts: int  # KB: max CPU bursts over all endpoints
+    n_bursts: np.ndarray  # (NS, NEP) i32
+    burst_dur: np.ndarray  # (NS, NEP, max(KB,1)) f32
+    burst_pre_io: np.ndarray  # (NS, NEP, max(KB,1)) f32
+    endpoint_post_io: np.ndarray  # (NS, NEP) f32
     exit_edge: np.ndarray  # (NS,) i32
     exit_kind: np.ndarray  # (NS,) i32 (TARGET_*)
     exit_target: np.ndarray  # (NS,) i32 (server idx when TARGET_SERVER)
@@ -144,6 +176,10 @@ class StaticPlan:
     fastpath_reason: str = ""
     #: servers in topological order of the exit-chain DAG
     server_topo_order: list[int] = field(default_factory=list)
+    #: per-server RAM admission treatment on the fast path: -1 = proven
+    #: non-binding (not modeled), 0 = no RAM steps, k > 0 = FIFO admission
+    #: queue with k concurrency slots (homogeneous needs, cap // need)
+    ram_slots: np.ndarray = field(default_factory=lambda: np.empty(0, np.int32))
 
     @property
     def n_gauges(self) -> int:
@@ -317,6 +353,19 @@ def compile_payload(
     seg_dur = np.zeros((n_servers, max_endpoints, max_segments + 1), dtype=np.float32)
     endpoint_ram = np.zeros((n_servers, max_endpoints), dtype=np.float32)
     n_endpoints = np.zeros(n_servers, dtype=np.int32)
+    bursts = [
+        [_burst_decomposition(segs) for segs, _ in per_server]
+        for per_server in compiled
+    ]
+    max_bursts = max(
+        (len(dur) for per_server in bursts for dur, _, _ in per_server),
+        default=0,
+    )
+    kb = max(max_bursts, 1)
+    n_bursts = np.zeros((n_servers, max_endpoints), dtype=np.int32)
+    burst_dur = np.zeros((n_servers, max_endpoints, kb), dtype=np.float32)
+    burst_pre_io = np.zeros((n_servers, max_endpoints, kb), dtype=np.float32)
+    endpoint_post_io = np.zeros((n_servers, max_endpoints), dtype=np.float32)
     for s, per_server in enumerate(compiled):
         n_endpoints[s] = len(per_server)
         for e, (segs, ram) in enumerate(per_server):
@@ -324,6 +373,11 @@ def compile_payload(
             for k, (seg_k, dur) in enumerate(segs):
                 seg_kind[s, e, k] = seg_k
                 seg_dur[s, e, k] = dur
+            dur_list, pre_list, post = bursts[s][e]
+            n_bursts[s, e] = len(dur_list)
+            burst_dur[s, e, : len(dur_list)] = dur_list
+            burst_pre_io[s, e, : len(pre_list)] = pre_list
+            endpoint_post_io[s, e] = post
 
     server_cores = np.array(
         [server.server_resources.cpu_cores for server in servers],
@@ -409,7 +463,7 @@ def compile_payload(
     sample_period = float(settings.sample_period_s)
     n_samples = max(0, math.ceil(round(horizon / sample_period, 9)) - 1)
 
-    fastpath_ok, fastpath_reason, topo = _fastpath_analysis(
+    fastpath_ok, fastpath_reason, topo, ram_slots = _fastpath_analysis(
         payload,
         compiled,
         exit_kind,
@@ -437,6 +491,11 @@ def compile_payload(
         seg_kind=seg_kind,
         seg_dur=seg_dur,
         endpoint_ram=endpoint_ram,
+        max_bursts=max_bursts,
+        n_bursts=n_bursts,
+        burst_dur=burst_dur,
+        burst_pre_io=burst_pre_io,
+        endpoint_post_io=endpoint_post_io,
         exit_edge=exit_edge,
         exit_kind=exit_kind,
         exit_target=exit_target,
@@ -470,6 +529,7 @@ def compile_payload(
         fastpath_ok=fastpath_ok,
         fastpath_reason=fastpath_reason,
         server_topo_order=topo,
+        ram_slots=ram_slots,
     )
 
 
@@ -480,61 +540,137 @@ def _fastpath_analysis(
     exit_target: np.ndarray,
     lb_algo: int,
     n_outage_marks: int,
-) -> tuple[bool, str, list[int]]:
+) -> tuple[bool, str, list[int], np.ndarray]:
     """Decide whether the scan engine can execute this plan exactly.
 
     Conditions (each mirrors an assumption of the queueing-recursion model):
-    endpoints that are at most one CPU burst followed by at most one IO sleep
-    (G/G/1 Lindley or G/G/c Kiefer-Wolfowitz FIFO on the burst), RAM provably
-    non-binding (admission never queues), round-robin routing (the rotation
-    is deterministic given the pick/outage interleaving, which the fast path
-    replays with a scan), no Poisson-latency edges, and an acyclic server
-    exit DAG.  Outage windows are supported when an LB exists to act on.
+    round-robin routing (the rotation is deterministic given the pick/outage
+    interleaving, which the fast path replays with a scan), no Poisson-latency
+    edges, and an acyclic server exit DAG.  Outage windows are supported when
+    an LB exists to act on.  Any alternating CPU/IO endpoint shape is
+    accepted: each CPU burst is one FIFO core-queue visit, solved by the fast
+    path's iterated Lindley / Kiefer-Wolfowitz recursion over the merged
+    visit stream.
+
+    RAM admission (`/root/reference/src/asyncflow/runtime/actors/
+    server.py:147-149`) is handled in tiers per server: proven non-binding
+    (admission can never queue -> not modeled), or homogeneous per-endpoint
+    needs (admission is exactly a FIFO queue with ``ram_mb // need``
+    concurrency slots -> modeled by the same KW recursion).  Only
+    heterogeneous needs that can actually bind force the event engines.
     """
     servers = payload.topology_graph.nodes.servers
     n_servers = len(servers)
+    no_slots = np.empty(0, np.int32)
 
     lb = payload.topology_graph.nodes.load_balancer
     if n_outage_marks > 0 and lb is None:
         # outages only act through the LB rotation; without one they are
         # no-ops in the event engines, but keep the exact engine for safety
-        return False, "outage events without a load balancer", []
+        return False, "outage events without a load balancer", [], no_slots
     if lb is not None and lb_algo != 0:
-        return False, "least-connections routing needs live edge state", []
+        return False, "least-connections routing needs live edge state", [], no_slots
     for edge in payload.topology_graph.edges:
         if edge.latency.distribution == Distribution.POISSON:
-            return False, f"edge {edge.id}: poisson latency unsupported", []
+            return False, f"edge {edge.id}: poisson latency unsupported", [], no_slots
 
     workload = payload.rqs_input
     users = float(workload.avg_active_users.mean)
     rate = users * float(workload.avg_request_per_minute_per_user.mean) / 60.0
     burst_rate = rate * (1.0 + 3.0 / math.sqrt(max(users, 1.0)))
 
+    max_visits = max(
+        (
+            sum(1 for k, _ in segs if k == SEG_CPU)
+            for per_server in compiled
+            for segs, _ in per_server
+        ),
+        default=0,
+    )
+    if max_visits > 8:
+        # each extra burst adds relaxation sweeps over an n*kb merged stream;
+        # beyond this the general event engine is the better engine
+        return False, f"endpoint with {max_visits} CPU bursts", [], no_slots
+
+    ram_slots = np.zeros(n_servers, dtype=np.int32)
     for s, server in enumerate(servers):
         if exit_kind[s] == TARGET_LB:
-            return False, f"server {server.id}: exit to LB creates a cycle", []
+            return False, f"server {server.id}: exit to LB creates a cycle", [], no_slots
         max_ram = 0.0
         residence = 0.0
         cpu_dur = 0.0
+        visits = 1
+        needs: set[float] = set()
         for segs, ram in compiled[s]:
-            kinds = [k for k, _ in segs]
-            if kinds not in ([], [SEG_CPU], [SEG_IO], [SEG_CPU, SEG_IO]):
-                return False, f"server {server.id}: multi-burst endpoint", []
             max_ram = max(max_ram, ram)
+            if ram > 0:
+                needs.add(ram)
             residence = max(residence, sum(d for _, d in segs))
             cpu_dur = max(cpu_dur, sum(d for k, d in segs if k == SEG_CPU))
-        if max_ram > 0:
-            # RAM is held from admission to endpoint end, INCLUDING the CPU
-            # queue wait — bound the wait with an M/M/c-style estimate and
-            # refuse when the CPU can saturate (unbounded residency).
-            cores = server.server_resources.cpu_cores
-            rho = burst_rate * cpu_dur / cores
-            if rho >= 0.95:
-                return False, f"server {server.id}: RAM residency unbounded", []
-            wait_est = rho / (1.0 - rho) * cpu_dur / cores
-            concurrent = server.server_resources.ram_mb / max_ram
-            if concurrent < 4.0 * burst_rate * (residence + wait_est) + 4.0:
-                return False, f"server {server.id}: RAM can bind", []
+            visits = max(visits, sum(1 for k, _ in segs if k == SEG_CPU))
+        if max_ram <= 0:
+            continue  # ram_slots[s] stays 0: nothing to admit
+        # Tier 1: RAM provably non-binding.  RAM is held from admission to
+        # endpoint end, INCLUDING every CPU queue wait — bound the waits with
+        # an M/M/c-style estimate per core-queue visit.
+        cores = server.server_resources.cpu_cores
+        rho = burst_rate * cpu_dur / cores
+        capacity_mb = float(server.server_resources.ram_mb)
+        if rho < 0.95:
+            wait_est = visits * rho / (1.0 - rho) * cpu_dur / cores
+            if capacity_mb / max_ram >= 4.0 * burst_rate * (residence + wait_est) + 4.0:
+                ram_slots[s] = -1
+                continue
+        # Tier 2: admission can queue, but with one uniform need per server it
+        # is exactly a FIFO queue with ``cap // need`` slots, settled jointly
+        # with the core queue in one arrival-order pass — which requires both
+        # FIFO orders to coincide with arrival order: at most one CPU burst
+        # per endpoint, no zero-RAM endpoints that would bypass admission and
+        # overtake in the core queue, and a uniform pre-burst IO (a longer
+        # pre-IO on one endpoint would let later grants enqueue earlier).
+        if len(needs) == 1 and min(ram for _, ram in compiled[s]) > 0:
+            if visits > 1:
+                return (
+                    False,
+                    f"server {server.id}: multi-burst endpoints with binding RAM",
+                    [],
+                    no_slots,
+                )
+            pre_ios = {
+                _burst_decomposition(segs)[1][0]
+                for segs, _ in compiled[s]
+                if any(k == SEG_CPU for k, _ in segs)
+            }
+            if len(pre_ios) > 1:
+                return (
+                    False,
+                    f"server {server.id}: varying pre-burst IO with binding RAM",
+                    [],
+                    no_slots,
+                )
+            slots = int(capacity_mb // next(iter(needs)))
+            if 1 <= slots <= 1024:  # scan carry is `slots` floats per lane
+                ram_slots[s] = slots
+                continue
+            if slots < 1:
+                return (
+                    False,
+                    f"server {server.id}: endpoint RAM exceeds server RAM",
+                    [],
+                    no_slots,
+                )
+            return (
+                False,
+                f"server {server.id}: RAM admission needs {slots} slots",
+                [],
+                no_slots,
+            )
+        return (
+            False,
+            f"server {server.id}: heterogeneous RAM needs can bind",
+            [],
+            no_slots,
+        )
 
     # topological order of the server exit DAG
     indeg = [0] * n_servers
@@ -552,5 +688,5 @@ def _fastpath_analysis(
             if indeg[t] == 0:
                 frontier.append(t)
     if len(topo) != n_servers:
-        return False, "server exit chain has a cycle", []
-    return True, "", topo
+        return False, "server exit chain has a cycle", [], no_slots
+    return True, "", topo, ram_slots

@@ -359,9 +359,14 @@ def _guard_overrides_against_plan(
     plan,
     overrides: ScenarioOverrides | None,
 ) -> None:
-    """The fast path's eligibility proof (RAM non-binding, rho < 1) was made
-    at the base workload rate; refuse overrides that raise it."""
+    """The fast path's tier-1 RAM proof ("admission can never queue") was
+    made at the base workload rate; refuse rate-raising overrides when any
+    server relies on it.  Servers whose admission queue is modeled
+    (``ram_slots > 0``) or that hold no RAM are rate-safe: saturation is
+    simulated, not assumed away."""
     if overrides is None:
+        return
+    if not (len(plan.ram_slots) and bool(np.any(plan.ram_slots == -1))):
         return
     base = base_overrides(plan)
     base_rate = float(base.user_mean) * float(base.req_rate)
@@ -370,7 +375,7 @@ def _guard_overrides_against_plan(
         msg = (
             "overrides raise the workload rate above the base plan "
             f"({max_rate:.2f} vs {base_rate:.2f} rps), which invalidates the "
-            "fast path's RAM/CPU eligibility proof; use "
+            "fast path's RAM non-binding proof; use "
             "SweepRunner(..., engine='event') or raise the base workload"
         )
         raise _FastpathOverrideError(msg)

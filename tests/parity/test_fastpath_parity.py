@@ -201,7 +201,7 @@ class TestEligibility:
         plan = compile_payload(_payload(BASE, mutate))
         assert plan.fastpath_ok  # Kiefer-Wolfowitz handles G/G/c
 
-    def test_multi_burst_ineligible(self) -> None:
+    def test_multi_burst_now_eligible(self) -> None:
         def mutate(data: dict) -> None:
             data["topology_graph"]["nodes"]["servers"][0]["endpoints"][0]["steps"] = [
                 {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.001}},
@@ -210,18 +210,107 @@ class TestEligibility:
             ]
 
         plan = compile_payload(_payload(BASE, mutate))
-        assert not plan.fastpath_ok
-        assert "multi-burst" in plan.fastpath_reason
+        assert plan.fastpath_ok, plan.fastpath_reason
+        assert plan.max_bursts == 2
 
-    def test_ram_binding_ineligible(self) -> None:
+    def test_binding_homogeneous_ram_is_modeled(self) -> None:
         def mutate(data: dict) -> None:
             server = data["topology_graph"]["nodes"]["servers"][0]
             server["server_resources"]["ram_mb"] = 256
             server["endpoints"][0]["steps"][1]["step_operation"]["necessary_ram"] = 200
 
         plan = compile_payload(_payload(BASE, mutate))
+        assert plan.fastpath_ok, plan.fastpath_reason
+        assert plan.ram_slots[0] == 1  # 256 // 200: FIFO admission, 1 slot
+
+    def test_heterogeneous_binding_ram_ineligible(self) -> None:
+        def mutate(data: dict) -> None:
+            server = data["topology_graph"]["nodes"]["servers"][0]
+            server["server_resources"]["ram_mb"] = 300
+            server["endpoints"] = [
+                {
+                    "endpoint_name": "big",
+                    "steps": [
+                        {"kind": "ram", "step_operation": {"necessary_ram": 200}},
+                        {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.05}},
+                    ],
+                },
+                {
+                    "endpoint_name": "small",
+                    "steps": [
+                        {"kind": "ram", "step_operation": {"necessary_ram": 120}},
+                        {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.05}},
+                    ],
+                },
+            ]
+
+        plan = compile_payload(_payload(BASE, mutate))
         assert not plan.fastpath_ok
-        assert "RAM" in plan.fastpath_reason
+        assert "heterogeneous RAM" in plan.fastpath_reason
+
+    def test_varying_pre_io_with_binding_ram_ineligible(self) -> None:
+        """Different pre-burst IO across endpoints breaks the arrival-order
+        core-FIFO assumption of the joint scan: a long pre-IO would let a
+        later grant enqueue earlier than an already-granted request."""
+
+        def mutate(data: dict) -> None:
+            server = data["topology_graph"]["nodes"]["servers"][0]
+            server["server_resources"]["ram_mb"] = 256
+            server["endpoints"] = [
+                {
+                    "endpoint_name": "slowpre",
+                    "steps": [
+                        {"kind": "ram", "step_operation": {"necessary_ram": 200}},
+                        {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.5}},
+                        {"kind": "cpu_bound_operation", "step_operation": {"cpu_time": 0.01}},
+                    ],
+                },
+                {
+                    "endpoint_name": "fast",
+                    "steps": [
+                        {"kind": "ram", "step_operation": {"necessary_ram": 200}},
+                        {"kind": "cpu_bound_operation", "step_operation": {"cpu_time": 0.01}},
+                    ],
+                },
+            ]
+
+        plan = compile_payload(_payload(BASE, mutate))
+        assert not plan.fastpath_ok
+        assert "pre-burst IO" in plan.fastpath_reason
+
+    def test_many_bursts_ineligible(self) -> None:
+        def mutate(data: dict) -> None:
+            steps = []
+            for _ in range(9):
+                steps.append(
+                    {"kind": "cpu_bound_operation", "step_operation": {"cpu_time": 0.001}},
+                )
+                steps.append(
+                    {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.001}},
+                )
+            data["topology_graph"]["nodes"]["servers"][0]["endpoints"][0][
+                "steps"
+            ] = steps
+
+        plan = compile_payload(_payload(BASE, mutate))
+        assert not plan.fastpath_ok
+        assert "CPU bursts" in plan.fastpath_reason
+
+    def test_oversized_ram_need_ineligible(self) -> None:
+        def mutate(data: dict) -> None:
+            server = data["topology_graph"]["nodes"]["servers"][0]
+            server["server_resources"]["ram_mb"] = 256
+            server["endpoints"][0]["steps"][1]["step_operation"][
+                "necessary_ram"
+            ] = 300
+            # make the endpoint slow enough that tier 1 can't prove anything
+            server["endpoints"][0]["steps"][2]["step_operation"][
+                "io_waiting_time"
+            ] = 5.0
+
+        plan = compile_payload(_payload(BASE, mutate))
+        assert not plan.fastpath_ok
+        assert "exceeds server RAM" in plan.fastpath_reason
 
     def test_least_connections_ineligible(self) -> None:
         def mutate(data: dict) -> None:
@@ -326,6 +415,37 @@ def test_fastpath_outage_gauge_blackout() -> None:
         assert float(np.max(after)) > 0.0
 
 
+def test_fastpath_ram_server_records_ready_gauge() -> None:
+    """A RAM-modeled server still records core-wait (ready queue) gauges."""
+
+    def mutate(data: dict) -> None:
+        server = data["topology_graph"]["nodes"]["servers"][0]
+        server["server_resources"]["ram_mb"] = 2048
+        server["endpoints"][0]["steps"] = [
+            {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.02}},
+            {"kind": "ram", "step_operation": {"necessary_ram": 200}},
+            {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.05}},
+        ]
+        data["rqs_input"]["avg_active_users"]["mean"] = 100  # cpu rho ~ 0.67
+
+    payload = _payload(BASE, mutate)
+    plan = compile_payload(payload)
+    assert plan.fastpath_ok, plan.fastpath_reason
+    assert plan.ram_slots[0] == 10
+    engine = FastEngine(plan, collect_gauges=True)
+    final = engine.run_batch(scenario_keys(5, 2))
+    series = np.cumsum(np.asarray(final.gauge[0]), axis=0)[1 : plan.n_samples + 1]
+    ready = series[:, plan.gauge_ready(0)]
+    assert float(np.max(ready)) >= 1.0  # real core queueing must be visible
+    assert float(np.min(ready)) >= 0.0
+
+
+def test_fastpath_rejects_bad_relax_sweeps() -> None:
+    plan = compile_payload(_payload(BASE))
+    with pytest.raises(ValueError, match="relax_sweeps"):
+        FastEngine(plan, relax_sweeps=0)
+
+
 def test_fastpath_gaussian_users() -> None:
     """Window-Poisson synthesis with truncated-Gaussian user draws."""
 
@@ -338,3 +458,106 @@ def test_fastpath_gaussian_users() -> None:
 
     payload = _payload(BASE, mutate)
     _assert_parity(_fast_latencies(payload, SEEDS), _oracle_latencies(payload, SEEDS), 0.03)
+
+
+def test_fastpath_multi_burst_contended() -> None:
+    """CPU -> IO -> CPU -> IO endpoints under real core contention: the
+    iterated merged-visit recursion must match the oracle's single FIFO core
+    queue that both bursts of every request pass through.
+
+    The 300 s horizon averages over many busy periods — at rho ~ 0.6 a 60 s
+    run's p95 is dominated by each seed's single worst busy period (per-seed
+    p95 spread measured at +/-40%).  Converged relaxation bias measured at
+    +1.0% mean / +2.3% p95; the tolerance covers bias + residual seed noise.
+    """
+
+    def mutate(data: dict) -> None:
+        server = data["topology_graph"]["nodes"]["servers"][0]
+        server["endpoints"][0]["steps"] = [
+            {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.018}},
+            {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.015}},
+            {"kind": "cpu_bound_operation", "step_operation": {"cpu_time": 0.012}},
+            {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.005}},
+        ]
+        data["rqs_input"]["avg_active_users"]["mean"] = 60  # rho ~ 0.6
+        data["sim_settings"]["total_simulation_time"] = 300
+
+    payload = _payload(BASE, mutate)
+    plan = compile_payload(payload)
+    assert plan.fastpath_ok, plan.fastpath_reason
+    assert plan.max_bursts == 2
+    _assert_parity(_fast_latencies(payload, SEEDS), _oracle_latencies(payload, SEEDS), 0.05)
+
+
+def test_fastpath_io_first_endpoint() -> None:
+    """IO -> CPU endpoints (previously rejected shape): the burst is enqueued
+    one IO sleep after server arrival."""
+
+    def mutate(data: dict) -> None:
+        server = data["topology_graph"]["nodes"]["servers"][0]
+        server["endpoints"][0]["steps"] = [
+            {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.012}},
+            {"kind": "cpu_bound_operation", "step_operation": {"cpu_time": 0.015}},
+        ]
+        data["rqs_input"]["avg_active_users"]["mean"] = 70
+
+    payload = _payload(BASE, mutate)
+    plan = compile_payload(payload)
+    assert plan.fastpath_ok, plan.fastpath_reason
+    _assert_parity(_fast_latencies(payload, SEEDS), _oracle_latencies(payload, SEEDS), 0.05)
+
+
+def test_fastpath_ram_admission_queue() -> None:
+    """Binding homogeneous RAM: admission + core are settled jointly by the
+    arrival-order scan (`.../actors/server.py:147-149` RAM-first FIFO
+    semantics).  k = 1024 // 200 = 5 slots; at ~72 rps against a ~96/s drain
+    (rho ~ 0.75) admission queueing contributes ~19% of mean latency while
+    the ensemble stays statistically stable.  (Closer to criticality the
+    oracle's own seed-to-seed spread explodes: at rho ~ 0.89 an
+    oracle-vs-oracle comparison across disjoint 12-seed ensembles showed
+    -18% mean / -13% p95 — no cross-engine tolerance is meaningful there.)
+    Measured noise floor at these settings: mean +/-3%, p95 +/-6.4%."""
+
+    def mutate(data: dict) -> None:
+        server = data["topology_graph"]["nodes"]["servers"][0]
+        server["server_resources"]["ram_mb"] = 1024
+        server["endpoints"][0]["steps"] = [
+            {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.002}},
+            {"kind": "ram", "step_operation": {"necessary_ram": 200}},
+            {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.05}},
+        ]
+        data["rqs_input"]["avg_active_users"]["mean"] = 216  # ~72 rps
+        data["sim_settings"]["total_simulation_time"] = 300
+
+    payload = _payload(BASE, mutate)
+    plan = compile_payload(payload)
+    assert plan.fastpath_ok, plan.fastpath_reason
+    assert plan.ram_slots[0] == 5
+    lat_fast = _fast_latencies(payload, SEEDS)
+    lat_oracle = _oracle_latencies(payload, SEEDS)
+    assert abs(lat_fast.mean() - lat_oracle.mean()) / lat_oracle.mean() < 0.04
+    p50f, p50o = np.percentile(lat_fast, 50), np.percentile(lat_oracle, 50)
+    assert abs(p50f - p50o) / p50o < 0.04
+    p95f, p95o = np.percentile(lat_fast, 95), np.percentile(lat_oracle, 95)
+    assert abs(p95f - p95o) / p95o < 0.08
+
+
+def test_fastpath_heavy_spike_flood() -> None:
+    """The heavy-injection scenario family (a multi-second spike parks
+    hundreds of requests, whose release floods the server): RAM admission and
+    CPU queueing both saturate transiently; the relaxation must track the
+    flood's drain."""
+    payload = _payload("examples/yaml_input/data/heavy_inj_single_server.yml")
+    plan = compile_payload(payload)
+    assert plan.fastpath_ok, plan.fastpath_reason
+    n = 6  # the 300-user flood scenario is slow on the oracle
+    lat_fast = _fast_latencies(payload, n)
+    lat_oracle = _oracle_latencies(payload, n)
+    # flood scenarios are heavy-tailed and multi-modal: compare mean, p95 and
+    # the tail mixture weight
+    assert abs(lat_fast.mean() - lat_oracle.mean()) / lat_oracle.mean() < 0.05
+    p95f, p95o = np.percentile(lat_fast, 95), np.percentile(lat_oracle, 95)
+    assert abs(p95f - p95o) / p95o < 0.05
+    frac_fast = float(np.mean(lat_fast > 1.0))
+    frac_oracle = float(np.mean(lat_oracle > 1.0))
+    assert abs(frac_fast - frac_oracle) < 0.02
