@@ -1,0 +1,143 @@
+"""Build the LB scenario with what-if events in Python and compare outcomes.
+
+The builder twin of ``examples/yaml_input/data/event_inj_lb.yml``: a
+latency spike on the client->LB link, one outage per server (never both at
+once), and a spike on an LB->server link — then a baseline-vs-events
+comparison, the capacity question event injection exists to answer
+(mirrors `/root/reference/examples/builder_input/event_injection/`).
+
+Usage:  python examples/builder_input/event_injection.py [oracle|native|jax]
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from asyncflow_tpu import AsyncFlow, SimulationRunner
+from asyncflow_tpu.components import (
+    Client,
+    Edge,
+    Endpoint,
+    LoadBalancer,
+    Server,
+    ServerResources,
+    Step,
+)
+from asyncflow_tpu.settings import SimulationSettings
+from asyncflow_tpu.workload import RVConfig, RqsGenerator
+
+
+def exp(mean: float) -> RVConfig:
+    return RVConfig(mean=mean, distribution="exponential")
+
+
+def endpoint() -> Endpoint:
+    return Endpoint(
+        endpoint_name="/api",
+        steps=[
+            Step(kind="initial_parsing", step_operation={"cpu_time": 0.002}),
+            Step(kind="ram", step_operation={"necessary_ram": 128}),
+            Step(kind="io_wait", step_operation={"io_waiting_time": 0.012}),
+        ],
+    )
+
+
+def build_flow() -> AsyncFlow:
+    return (
+        AsyncFlow()
+        .add_generator(
+            RqsGenerator(
+                id="rqs-1",
+                avg_active_users=RVConfig(mean=120),
+                avg_request_per_minute_per_user=RVConfig(mean=20),
+                user_sampling_window=60,
+            ),
+        )
+        .add_client(Client(id="client-1"))
+        .add_load_balancer(
+            LoadBalancer(
+                id="lb-1",
+                algorithms="round_robin",
+                server_covered={"srv-1", "srv-2"},
+            ),
+        )
+        .add_servers(
+            Server(
+                id="srv-1",
+                server_resources=ServerResources(cpu_cores=1, ram_mb=2048),
+                endpoints=[endpoint()],
+            ),
+            Server(
+                id="srv-2",
+                server_resources=ServerResources(cpu_cores=1, ram_mb=2048),
+                endpoints=[endpoint()],
+            ),
+        )
+        .add_edges(
+            Edge(id="gen-client", source="rqs-1", target="client-1", latency=exp(0.003)),
+            Edge(id="client-lb", source="client-1", target="lb-1", latency=exp(0.002)),
+            Edge(id="lb-srv1", source="lb-1", target="srv-1", latency=exp(0.002)),
+            Edge(id="lb-srv2", source="lb-1", target="srv-2", latency=exp(0.002)),
+            Edge(id="srv1-client", source="srv-1", target="client-1", latency=exp(0.003)),
+            Edge(id="srv2-client", source="srv-2", target="client-1", latency=exp(0.003)),
+        )
+        .add_simulation_settings(
+            SimulationSettings(total_simulation_time=600, sample_period_s=0.05),
+        )
+    )
+
+
+backend = sys.argv[1] if len(sys.argv) > 1 else "oracle"
+
+baseline = SimulationRunner(
+    simulation_input=build_flow().build_payload(),
+    backend=backend,
+    seed=7,
+).run()
+
+flow = build_flow()
+flow.add_network_spike(
+    event_id="spike-client-lb",
+    edge_id="client-lb",
+    t_start=100.0,
+    t_end=160.0,
+    spike_s=0.015,
+)
+flow.add_server_outage(
+    event_id="outage-srv1",
+    server_id="srv-1",
+    t_start=180.0,
+    t_end=240.0,
+)
+flow.add_network_spike(
+    event_id="spike-lb-srv2",
+    edge_id="lb-srv2",
+    t_start=300.0,
+    t_end=360.0,
+    spike_s=0.020,
+)
+flow.add_server_outage(
+    event_id="outage-srv2",
+    server_id="srv-2",
+    t_start=360.0,
+    t_end=420.0,
+)
+with_events = SimulationRunner(
+    simulation_input=flow.build_payload(),
+    backend=backend,
+    seed=7,
+).run()
+
+b = baseline.get_latency_stats()
+e = with_events.get_latency_stats()
+print(f"baseline:    mean={b['mean']*1e3:6.2f} ms  p95={b['p95']*1e3:6.2f} ms "
+      f"({int(b['total_requests'])} requests)")
+print(f"with events: mean={e['mean']*1e3:6.2f} ms  p95={e['p95']*1e3:6.2f} ms "
+      f"({int(e['total_requests'])} requests)")
+print(f"event impact: +{(e['mean']-b['mean'])*1e3:.2f} ms mean latency")
+
+fig = with_events.plot_base_dashboard()
+out = Path(__file__).parent / f"event_injection_{backend}.png"
+fig.savefig(out)
+print(f"dashboard saved to {out}")

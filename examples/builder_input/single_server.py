@@ -1,0 +1,80 @@
+"""Build the single-server scenario in Python and run it on either backend.
+
+The builder twin of ``examples/yaml_input/data/single_server.yml`` — the two
+front doors produce the same validated payload (mirroring the reference's
+paired examples, `/root/reference/examples/builder_input/single_server/`).
+
+Usage:  python examples/builder_input/single_server.py [oracle|native|jax]
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from asyncflow_tpu import AsyncFlow, SimulationRunner
+from asyncflow_tpu.components import Client, Edge, Endpoint, Server, ServerResources, Step
+from asyncflow_tpu.settings import SimulationSettings
+from asyncflow_tpu.workload import RVConfig, RqsGenerator
+
+
+def exp(mean: float) -> RVConfig:
+    return RVConfig(mean=mean, distribution="exponential")
+
+
+flow = (
+    AsyncFlow()
+    .add_generator(
+        RqsGenerator(
+            id="rqs-1",
+            avg_active_users=RVConfig(mean=100),
+            avg_request_per_minute_per_user=RVConfig(mean=20),
+            user_sampling_window=60,
+        ),
+    )
+    .add_client(Client(id="client-1"))
+    .add_servers(
+        Server(
+            id="srv-1",
+            server_resources=ServerResources(cpu_cores=1, ram_mb=1024),
+            endpoints=[
+                Endpoint(
+                    endpoint_name="/api",
+                    steps=[
+                        Step(
+                            kind="initial_parsing",
+                            step_operation={"cpu_time": 0.001},
+                        ),
+                        Step(kind="ram", step_operation={"necessary_ram": 64}),
+                        Step(
+                            kind="io_wait",
+                            step_operation={"io_waiting_time": 0.01},
+                        ),
+                    ],
+                ),
+            ],
+        ),
+    )
+    .add_edges(
+        Edge(id="gen-client", source="rqs-1", target="client-1", latency=exp(0.003)),
+        Edge(id="client-srv", source="client-1", target="srv-1", latency=exp(0.002)),
+        Edge(id="srv-client", source="srv-1", target="client-1", latency=exp(0.003)),
+    )
+    .add_simulation_settings(
+        SimulationSettings(total_simulation_time=300, sample_period_s=0.05),
+    )
+)
+
+backend = sys.argv[1] if len(sys.argv) > 1 else "oracle"
+runner = SimulationRunner(
+    simulation_input=flow.build_payload(),
+    backend=backend,
+    seed=42,
+)
+analyzer = runner.run()
+print(analyzer.format_latency_stats())
+
+fig = analyzer.plot_base_dashboard()
+out = Path(__file__).parent / f"single_server_{backend}.png"
+fig.savefig(out)
+print(f"dashboard saved to {out}")

@@ -1,0 +1,201 @@
+"""Capacity sweep of a client -> LB -> app tier -> DB chain (BASELINE row 4).
+
+The classic capacity-planning question the reference can only answer one
+scenario at a time (`/root/reference/ROADMAP.md:23-29` roadmaps Monte-Carlo
+support): how do tail latencies respond as load approaches the tier's
+capacity?  Here the whole load-response curve is one mesh-sharded sweep:
+every scenario runs the same validated topology at a different workload
+intensity, batched through the scan engine and sharded over all visible
+devices (8 virtual CPU devices in tests, TPU chips in production).
+
+The base payload pins the workload at the TOP of the swept range so the
+compiler's capacity estimates hold for every scenario (overrides only lower
+the rate — raising it above the compiled plan is refused when any RAM
+non-binding proof depends on it).
+
+Usage:  python examples/sweeps/capacity_sweep.py [n_scenarios] [--cpu]
+        [--checkpoint DIR]
+"""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import numpy as np
+
+if "--cpu" in sys.argv:
+    sys.argv.remove("--cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+from asyncflow_tpu.builder import AsyncFlow
+from asyncflow_tpu.components import (
+    Client,
+    Edge,
+    Endpoint,
+    LoadBalancer,
+    Server,
+    ServerResources,
+    Step,
+)
+from asyncflow_tpu.parallel import SweepRunner, make_overrides
+from asyncflow_tpu.settings import SimulationSettings
+from asyncflow_tpu.workload import RVConfig, RqsGenerator
+
+MAX_USERS = 400.0  # top of the swept range (~133 rps)
+
+
+def build_chain_payload(horizon: int = 60):
+    """gen -> client -> LB -> {app-1, app-2} -> db -> client."""
+
+    def endpoint(cpu_s: float, io_s: float) -> Endpoint:
+        return Endpoint(
+            endpoint_name="/work",
+            steps=[
+                Step(kind="initial_parsing", step_operation={"cpu_time": cpu_s}),
+                Step(kind="io_wait", step_operation={"io_waiting_time": io_s}),
+            ],
+        )
+
+    def exp(mean: float) -> RVConfig:
+        return RVConfig(mean=mean, distribution="exponential")
+
+    app_resources = ServerResources(cpu_cores=2, ram_mb=2048)
+    return (
+        AsyncFlow()
+        .add_generator(
+            RqsGenerator(
+                id="rqs-1",
+                avg_active_users=RVConfig(mean=MAX_USERS),
+                avg_request_per_minute_per_user=RVConfig(mean=20),
+                user_sampling_window=60,
+            ),
+        )
+        .add_client(Client(id="client-1"))
+        .add_load_balancer(
+            LoadBalancer(
+                id="lb-1",
+                algorithms="round_robin",
+                server_covered={"app-1", "app-2"},
+            ),
+        )
+        .add_servers(
+            # app tier reaches rho ~ 0.83 per server at 100% load
+            # (400 users * 20 rpm / 60 / 2 servers * 0.025 s / 2 cores)
+            Server(
+                id="app-1",
+                server_resources=app_resources,
+                endpoints=[endpoint(0.025, 0.010)],
+            ),
+            Server(
+                id="app-2",
+                server_resources=app_resources,
+                endpoints=[endpoint(0.025, 0.010)],
+            ),
+            # shared DB stays comfortable (rho ~ 0.27 at 100%)
+            Server(
+                id="db-1",
+                server_resources=ServerResources(cpu_cores=4, ram_mb=4096),
+                endpoints=[endpoint(0.008, 0.012)],
+            ),
+        )
+        .add_edges(
+            Edge(id="gen-client", source="rqs-1", target="client-1", latency=exp(0.003)),
+            Edge(id="client-lb", source="client-1", target="lb-1", latency=exp(0.002)),
+            Edge(id="lb-app1", source="lb-1", target="app-1", latency=exp(0.002)),
+            Edge(id="lb-app2", source="lb-1", target="app-2", latency=exp(0.002)),
+            Edge(id="app1-db", source="app-1", target="db-1", latency=exp(0.002)),
+            Edge(id="app2-db", source="app-2", target="db-1", latency=exp(0.002)),
+            Edge(id="db-client", source="db-1", target="client-1", latency=exp(0.003)),
+        )
+        .add_simulation_settings(
+            SimulationSettings(total_simulation_time=horizon, sample_period_s=0.05),
+        )
+        .build_payload()
+    )
+
+
+def run_capacity_sweep(
+    n_scenarios: int,
+    *,
+    seed: int = 0,
+    checkpoint_dir: str | None = None,
+    chunk_size: int | None = None,
+):
+    """(scales, report): per-scenario load fraction and the sweep report."""
+    payload = build_chain_payload()
+    runner = SweepRunner(payload)
+    # load fraction 10% .. 100% of MAX_USERS, one scenario per grid point
+    scales = np.linspace(0.1, 1.0, n_scenarios)
+    overrides = make_overrides(
+        runner.plan,
+        n_scenarios,
+        user_mean=(MAX_USERS * scales).astype(np.float32),
+    )
+    report = runner.run(
+        n_scenarios,
+        seed=seed,
+        overrides=overrides,
+        checkpoint_dir=checkpoint_dir,
+        chunk_size=chunk_size,
+    )
+    return scales, runner, report
+
+
+def main() -> None:
+    checkpoint_dir = None
+    if "--checkpoint" in sys.argv:
+        i = sys.argv.index("--checkpoint")
+        checkpoint_dir = sys.argv[i + 1]
+        del sys.argv[i : i + 2]
+    n_scenarios = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+
+    import jax
+
+    print(f"devices: {jax.device_count()} ({jax.default_backend()})")
+    t0 = time.time()
+    scales, runner, report = run_capacity_sweep(
+        n_scenarios,
+        checkpoint_dir=checkpoint_dir,
+    )
+    summary = report.summary()
+    print(
+        f"engine={runner.engine_kind}  {n_scenarios:,} scenarios in "
+        f"{report.wall_seconds:.1f}s ({summary['scenarios_per_second']:.1f} "
+        f"scen/s), {summary['completed_total']:,} requests, "
+        f"overflow={summary['overflow_total']}, wall total {time.time()-t0:.1f}s",
+    )
+
+    p95 = report.results.percentile(95)
+    print("\nload -> pooled p95 (the capacity curve):")
+    for lo, hi in [(0.1, 0.4), (0.4, 0.6), (0.6, 0.8), (0.8, 0.9), (0.9, 1.0)]:
+        band = (scales >= lo) & (scales < hi)
+        print(
+            f"  {int(lo*100):3d}-{int(hi*100):3d}% of {MAX_USERS:.0f} users: "
+            f"p95 = {p95[band].mean() * 1e3:6.2f} ms",
+        )
+
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(figsize=(8, 5))
+        ax.scatter(scales * 100, p95 * 1e3, s=2, alpha=0.4)
+        ax.set_xlabel("load (% of max users)")
+        ax.set_ylabel("p95 latency (ms)")
+        ax.set_title(f"capacity curve: {n_scenarios:,} scenarios")
+        ax.grid(visible=True)
+        out = Path(__file__).parent / "capacity_sweep.png"
+        fig.savefig(out)
+        print(f"plot saved to {out}")
+    except ImportError:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""Run the LB + 2-server scenario from YAML and render the dashboard.
+
+YAML twin of ``examples/builder_input/lb_two_servers.py``.
+
+Usage:  python examples/yaml_input/run_lb_two_servers.py [oracle|native|jax]
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from asyncflow_tpu import SimulationRunner
+
+backend = sys.argv[1] if len(sys.argv) > 1 else "oracle"
+scenario = Path(__file__).parent / "data" / "two_servers_lb.yml"
+
+analyzer = SimulationRunner.from_yaml(scenario, backend=backend, seed=42).run()
+print(analyzer.format_latency_stats())
+
+fig = analyzer.plot_base_dashboard()
+out = Path(__file__).parent / f"lb_two_servers_{backend}.png"
+fig.savefig(out)
+print(f"dashboard saved to {out}")

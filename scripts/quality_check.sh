@@ -1,10 +1,19 @@
 #!/usr/bin/env bash
-# Lint (ruff, if installed) + compile check of every module.
+# Static gates: ruff (broad rule set) + mypy (strict) when installed, with a
+# bytecode compile check as the everywhere-available floor.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+python -m compileall -q asyncflow_tpu tests examples bench.py __graft_entry__.py
+
 if command -v ruff >/dev/null 2>&1; then
-  ruff check asyncflow_tpu tests
+  ruff check asyncflow_tpu tests examples
 else
-  echo "ruff not installed; running a bytecode compile check instead"
-  python -m compileall -q asyncflow_tpu tests bench.py __graft_entry__.py
+  echo "ruff not installed; skipped (compile check ran)"
+fi
+
+if command -v mypy >/dev/null 2>&1; then
+  mypy
+else
+  echo "mypy not installed; skipped (compile check ran)"
 fi

@@ -19,7 +19,7 @@ from asyncflow_tpu.schemas.payload import SimulationPayload
 
 pytestmark = pytest.mark.integration
 
-SEEDS = 12
+SEEDS = 24
 
 
 def _jax_latencies(payload: SimulationPayload, n: int, **engine_kw) -> np.ndarray:
@@ -73,7 +73,7 @@ def test_parity_single_server_light_load() -> None:
     _assert_percentile_parity(
         _jax_latencies(payload, SEEDS),
         _oracle_latencies(payload, SEEDS),
-        tol=0.03,
+        tol=0.02,
     )
 
 

@@ -14,7 +14,7 @@ from asyncflow_tpu.schemas.payload import SimulationPayload
 
 pytestmark = pytest.mark.integration
 
-SEEDS = 12
+SEEDS = 24
 BASE = "tests/integration/data/single_server.yml"
 LB = "tests/integration/data/two_servers_lb.yml"
 
@@ -54,12 +54,12 @@ def _assert_parity(a: np.ndarray, b: np.ndarray, tol: float) -> None:
 
 def test_fastpath_single_server() -> None:
     payload = _payload(BASE)
-    _assert_parity(_fast_latencies(payload, SEEDS), _oracle_latencies(payload, SEEDS), 0.03)
+    _assert_parity(_fast_latencies(payload, SEEDS), _oracle_latencies(payload, SEEDS), 0.02)
 
 
 def test_fastpath_lb_round_robin() -> None:
     payload = _payload(LB)
-    _assert_parity(_fast_latencies(payload, SEEDS), _oracle_latencies(payload, SEEDS), 0.03)
+    _assert_parity(_fast_latencies(payload, SEEDS), _oracle_latencies(payload, SEEDS), 0.02)
 
 
 def test_fastpath_network_spike() -> None:
@@ -90,7 +90,11 @@ def test_fastpath_network_spike() -> None:
 
 
 def test_fastpath_cpu_queueing() -> None:
-    """Moderate CPU contention: Lindley waits must match the oracle's FIFO."""
+    """Moderate CPU contention: Lindley waits must match the oracle's FIFO.
+
+    300 s horizon: at rho ~ 0.6 a 60 s run's upper percentiles are dominated
+    by each seed's single worst busy period and ensemble noise exceeds any
+    honest cross-engine tolerance."""
 
     def mutate(data: dict) -> None:
         server = data["topology_graph"]["nodes"]["servers"][0]
@@ -99,9 +103,10 @@ def test_fastpath_cpu_queueing() -> None:
             {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.02}},
         ]
         data["rqs_input"]["avg_active_users"]["mean"] = 60  # rho ~ 0.6
+        data["sim_settings"]["total_simulation_time"] = 300
 
     payload = _payload(BASE, mutate)
-    _assert_parity(_fast_latencies(payload, SEEDS), _oracle_latencies(payload, SEEDS), 0.05)
+    _assert_parity(_fast_latencies(payload, SEEDS), _oracle_latencies(payload, SEEDS), 0.04)
 
 
 def test_fastpath_mixed_endpoints_with_io_only() -> None:

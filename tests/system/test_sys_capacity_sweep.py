@@ -1,0 +1,72 @@
+"""System test: the BASELINE row-4 capacity sweep (client -> LB -> app -> DB).
+
+The Monte-Carlo capability the reference only roadmapped
+(`/root/reference/ROADMAP.md:23-29`), demonstrated end-to-end: a workload-
+intensity sweep of a three-server chain, mesh-sharded over every visible
+device (the 8-device virtual CPU mesh in CI), with per-chunk checkpointing.
+
+The default tier runs 2,048 scenarios (~1 min on one CPU core); the full
+100k-scenario run is gated separately because it needs ~1 h of CPU (it is
+executed and its wall time recorded in STATUS.md).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from examples.sweeps.capacity_sweep import run_capacity_sweep
+
+pytestmark = pytest.mark.system
+
+FULL = os.environ.get("ASYNCFLOW_RUN_CAPACITY_SWEEP") == "1"
+
+
+def _assert_capacity_curve(scales, report, n: int) -> None:
+    summary = report.summary()
+    assert summary["overflow_total"] == 0
+    assert summary["truncated_total"] == 0
+    assert summary["completed_total"] > 100 * n  # every scenario really ran
+
+    # the whole point of the sweep: tail latency must rise with load
+    p95 = report.results.percentile(95)
+    low = p95[(scales >= 0.1) & (scales < 0.4)].mean()
+    high = p95[scales >= 0.9].mean()
+    assert high > low * 1.2, (low, high)
+
+    # per-scenario completion counts scale with the load fraction
+    completed = report.results.completed
+    lo_band = completed[(scales >= 0.1) & (scales < 0.2)].mean()
+    hi_band = completed[scales >= 0.9].mean()
+    assert hi_band > 4.0 * lo_band
+
+
+def test_capacity_sweep_sharded(tmp_path) -> None:
+    n = 2048
+    scales, runner, report = run_capacity_sweep(
+        n,
+        seed=7,
+        checkpoint_dir=str(tmp_path / "ck"),
+    )
+    assert runner.engine_kind == "fast"
+    _assert_capacity_curve(scales, report, n)
+
+    # interrupted-and-resumed sweeps reproduce the identical result
+    resumed = run_capacity_sweep(n, seed=7, checkpoint_dir=str(tmp_path / "ck"))[2]
+    np.testing.assert_array_equal(
+        resumed.results.latency_hist,
+        report.results.latency_hist,
+    )
+
+
+@pytest.mark.skipif(not FULL, reason="set ASYNCFLOW_RUN_CAPACITY_SWEEP=1")
+def test_capacity_sweep_100k(tmp_path) -> None:
+    n = 100_000
+    scales, runner, report = run_capacity_sweep(
+        n,
+        seed=7,
+        checkpoint_dir=str(tmp_path / "ck"),
+    )
+    _assert_capacity_curve(scales, report, n)

@@ -29,15 +29,18 @@ from asyncflow_tpu.workload import RVConfig, RqsGenerator
 pytestmark = pytest.mark.system
 
 
-def _backend_param(name: str):
-    """Skip the native case when no C++ toolchain exists (the runner would
-    silently fall back to the oracle and the test would not test native)."""
+def _backend_param(name: str, engine: str | None = None):
+    """(backend, engine_options) pairs; skip native when no C++ toolchain
+    exists (the runner would silently fall back to the oracle and the test
+    would not test native)."""
+    options = {"engine": engine} if engine else {}
     if name != "native":
-        return name
+        return pytest.param((name, options), id=name + (f"-{engine}" if engine else ""))
     from asyncflow_tpu.engines.oracle.native import native_available
 
     return pytest.param(
-        "native",
+        (name, options),
+        id="native",
         marks=pytest.mark.skipif(
             not native_available(),
             reason="no C++ toolchain",
@@ -45,7 +48,16 @@ def _backend_param(name: str):
     )
 
 
-BACKENDS = [_backend_param("oracle"), _backend_param("native")]
+# Every engine is held to the absolute contracts: the reference-shaped CPU
+# oracle, the native C++ core, the JAX scan fast path, and the JAX batched
+# event engine (`/root/reference/tests/system/test_sys_lb_two_servers.py:47-49`
+# defines the windows; BASELINE.md reproduces them).
+BACKENDS = [
+    _backend_param("oracle"),
+    _backend_param("native"),
+    _backend_param("jax", "fast"),
+    _backend_param("jax", "event"),
+]
 
 
 def _rel_diff(a: float, b: float) -> float:
@@ -145,12 +157,14 @@ def _lb_payload(horizon: int = 400) -> AsyncFlow:
 
 
 @pytest.mark.parametrize("backend", BACKENDS)
-def test_system_single_server_contract(backend: str) -> None:
+def test_system_single_server_contract(backend) -> None:
     """Mean latency in [0.015, 0.060] s; throughput within 35% of 26.7 rps."""
+    name, options = backend
     runner = SimulationRunner(
         simulation_input=_single_server_payload(),
-        backend=backend,
+        backend=name,
         seed=1337,
+        engine_options=options,
     )
     analyzer = runner.run()
 
@@ -168,14 +182,16 @@ def test_system_single_server_contract(backend: str) -> None:
 
 
 @pytest.mark.parametrize("backend", BACKENDS)
-def test_system_lb_two_servers_contract(backend: str) -> None:
+def test_system_lb_two_servers_contract(backend) -> None:
     """Mean latency in [0.020, 0.060] s; throughput within 30% of 40 rps;
     round-robin balance within 25% on edge concurrency and RAM means."""
+    name, options = backend
     payload = _lb_payload().build_payload()
     analyzer = SimulationRunner(
         simulation_input=payload,
-        backend=backend,
+        backend=name,
         seed=4242,
+        engine_options=options,
     ).run()
 
     stats = analyzer.get_latency_stats()
@@ -194,13 +210,15 @@ def test_system_lb_two_servers_contract(backend: str) -> None:
     assert set(analyzer.list_server_ids()) == {"srv-1", "srv-2"}
 
 
-def test_system_event_impact_contract() -> None:
+@pytest.mark.parametrize("backend", ["oracle", "jax"])
+def test_system_event_impact_contract(backend: str) -> None:
     """+50ms spike on lb->srv-1 (t in [2,12]) plus srv-2 outage (t in [5,20]):
     mean latency rises by >= 3ms and throughput stays in [30%, 125%] of the
     no-event baseline."""
     horizon = 60
     baseline = SimulationRunner(
         simulation_input=_lb_payload(horizon).build_payload(),
+        backend=backend,
         seed=7778,
     ).run()
 
@@ -220,6 +238,7 @@ def test_system_event_impact_contract() -> None:
     )
     with_events = SimulationRunner(
         simulation_input=flow.build_payload(),
+        backend=backend,
         seed=7778,
     ).run()
 
@@ -233,11 +252,16 @@ def test_system_event_impact_contract() -> None:
     assert 0.30 <= ratio <= 1.25
 
 
-def test_system_single_server_spike_contract() -> None:
+@pytest.mark.parametrize("backend", ["oracle", "jax"])
+def test_system_single_server_spike_contract(backend: str) -> None:
     """Single-server spike: mean latency >= 1.02x the no-event baseline."""
     horizon = 60
     base_payload = _single_server_payload(horizon)
-    baseline = SimulationRunner(simulation_input=base_payload, seed=555).run()
+    baseline = SimulationRunner(
+        simulation_input=base_payload,
+        backend=backend,
+        seed=555,
+    ).run()
 
     data = base_payload.model_dump()
     data["events"] = [
@@ -253,7 +277,11 @@ def test_system_single_server_spike_contract() -> None:
         },
     ]
     spiked_payload = SimulationPayload.model_validate(data)
-    spiked = SimulationRunner(simulation_input=spiked_payload, seed=555).run()
+    spiked = SimulationRunner(
+        simulation_input=spiked_payload,
+        backend=backend,
+        seed=555,
+    ).run()
 
     base_mean = baseline.get_latency_stats()[LatencyKey.MEAN]
     spike_mean = spiked.get_latency_stats()[LatencyKey.MEAN]

@@ -1,0 +1,353 @@
+"""Deterministic branch tests for the JAX event engine.
+
+The reference forces actor branches with RNG doubles and manual clock
+stepping (`/root/reference/tests/unit/runtime/actors/test_edge.py:31-49`,
+`tests/unit/runtime/events/test_injection_edges.py:48-52`).  A jitted kernel
+has no RNG to stub, so the same branches are forced through *parameters*
+that make them deterministic: dropout_rate=1 (every request drops),
+dropout_rate=0 (none does), outage windows covering known intervals, spike
+windows with known amplitudes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.engines.jaxsim.engine import Engine, run_single, scenario_keys
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+
+def _payload(mutate=None, **settings) -> SimulationPayload:
+    data = {
+        "rqs_input": {
+            "id": "rqs-1",
+            "avg_active_users": {"mean": 40},
+            "avg_request_per_minute_per_user": {"mean": 30},
+            "user_sampling_window": 30,
+        },
+        "topology_graph": {
+            "nodes": {
+                "client": {"id": "client-1"},
+                "servers": [
+                    {
+                        "id": "srv-1",
+                        "server_resources": {"cpu_cores": 1, "ram_mb": 1024},
+                        "endpoints": [
+                            {
+                                "endpoint_name": "ep",
+                                "steps": [
+                                    {
+                                        "kind": "initial_parsing",
+                                        "step_operation": {"cpu_time": 0.002},
+                                    },
+                                    {
+                                        "kind": "io_wait",
+                                        "step_operation": {"io_waiting_time": 0.01},
+                                    },
+                                ],
+                            },
+                        ],
+                    },
+                ],
+            },
+            "edges": [
+                {
+                    "id": "gen-client",
+                    "source": "rqs-1",
+                    "target": "client-1",
+                    "latency": {"mean": 0.003, "distribution": "exponential"},
+                    "dropout_rate": 0.0,
+                },
+                {
+                    "id": "client-srv",
+                    "source": "client-1",
+                    "target": "srv-1",
+                    "latency": {"mean": 0.002, "distribution": "exponential"},
+                    "dropout_rate": 0.0,
+                },
+                {
+                    "id": "srv-client",
+                    "source": "srv-1",
+                    "target": "client-1",
+                    "latency": {"mean": 0.003, "distribution": "exponential"},
+                    "dropout_rate": 0.0,
+                },
+            ],
+        },
+        "sim_settings": {"total_simulation_time": 20, "sample_period_s": 0.05},
+    }
+    if mutate:
+        mutate(data)
+    data["sim_settings"].update(settings)
+    return SimulationPayload.model_validate(data)
+
+
+class TestDropoutBranch:
+    def test_certain_dropout_completes_nothing(self) -> None:
+        """dropout=1 on the outbound edge: every request is dropped exactly
+        once, none completes, none leaks from the pool."""
+
+        def all_drop(data: dict) -> None:
+            data["topology_graph"]["edges"][1]["dropout_rate"] = 1.0
+
+        res = run_single(_payload(all_drop), seed=5, engine="event")
+        assert len(res.rqs_clock) == 0
+        assert res.total_dropped == res.total_generated > 50
+        assert res.overflow_dropped == 0
+
+    def test_zero_dropout_drops_nothing(self) -> None:
+        res = run_single(_payload(), seed=5, engine="event")
+        assert res.total_dropped == 0
+        assert len(res.rqs_clock) > 50
+
+    def test_return_edge_dropout_drops_after_serving(self) -> None:
+        """dropout on the server->client edge: requests are served (RAM/CPU
+        cycles happen) but never complete — the drop is at the last hop."""
+
+        def return_drop(data: dict) -> None:
+            data["topology_graph"]["edges"][2]["dropout_rate"] = 1.0
+
+        res = run_single(_payload(return_drop), seed=5, engine="event")
+        assert len(res.rqs_clock) == 0
+        assert res.total_dropped > 50
+        # everything generated either dropped or was still in flight when
+        # the horizon cut the run (conservation, no completions)
+        assert res.total_generated - res.total_dropped <= 3
+        # the server really ran: the IO gauge saw residency
+        assert np.max(res.sampled["ram_in_use"]["srv-1"]) == 0  # no RAM step
+        assert np.max(res.sampled["event_loop_io_sleep"]["srv-1"]) > 0
+
+
+class TestOutageTimelineBranch:
+    def _lb_payload(self, events) -> SimulationPayload:
+        def mutate(data: dict) -> None:
+            nodes = data["topology_graph"]["nodes"]
+            nodes["servers"].append(
+                {
+                    "id": "srv-2",
+                    "server_resources": {"cpu_cores": 1, "ram_mb": 1024},
+                    "endpoints": nodes["servers"][0]["endpoints"],
+                },
+            )
+            nodes["load_balancer"] = {
+                "id": "lb-1",
+                "algorithms": "round_robin",
+                "server_covered": ["srv-1", "srv-2"],
+            }
+            data["topology_graph"]["edges"] = [
+                data["topology_graph"]["edges"][0],
+                {
+                    "id": "client-lb",
+                    "source": "client-1",
+                    "target": "lb-1",
+                    "latency": {"mean": 0.002, "distribution": "exponential"},
+                    "dropout_rate": 0.0,
+                },
+                {
+                    "id": "lb-srv1",
+                    "source": "lb-1",
+                    "target": "srv-1",
+                    "latency": {"mean": 0.002, "distribution": "exponential"},
+                    "dropout_rate": 0.0,
+                },
+                {
+                    "id": "lb-srv2",
+                    "source": "lb-1",
+                    "target": "srv-2",
+                    "latency": {"mean": 0.002, "distribution": "exponential"},
+                    "dropout_rate": 0.0,
+                },
+                {
+                    "id": "srv1-client",
+                    "source": "srv-1",
+                    "target": "client-1",
+                    "latency": {"mean": 0.003, "distribution": "exponential"},
+                    "dropout_rate": 0.0,
+                },
+                {
+                    "id": "srv2-client",
+                    "source": "srv-2",
+                    "target": "client-1",
+                    "latency": {"mean": 0.003, "distribution": "exponential"},
+                    "dropout_rate": 0.0,
+                },
+            ]
+            data["events"] = events
+        return _payload(mutate)
+
+    def test_outage_window_blacks_out_the_lb_edge(self) -> None:
+        """During [6, 14) the down server's LB edge carries zero traffic on
+        the event engine; before and after it carries traffic."""
+        payload = self._lb_payload(
+            [
+                {
+                    "event_id": "o1",
+                    "target_id": "srv-2",
+                    "start": {"kind": "server_down", "t_start": 6.0},
+                    "end": {"kind": "server_up", "t_end": 14.0},
+                },
+            ],
+        )
+        res = run_single(payload, seed=9, engine="event")
+        cc2 = res.sampled["edge_concurrent_connection"]["lb-srv2"]
+        period = 0.05
+        during = cc2[int(7 / period) : int(13.5 / period)]
+        before = cc2[: int(5.5 / period)]
+        after = cc2[int(15 / period) :]
+        assert float(np.max(during)) == 0.0
+        assert float(np.max(before)) > 0.0
+        assert float(np.max(after)) > 0.0
+
+    def test_back_to_back_windows_are_legal_and_ordered(self) -> None:
+        """END at t then START at t (the reference's END-before-START
+        tie-break): the server flaps but the system stays live."""
+        payload = self._lb_payload(
+            [
+                {
+                    "event_id": "o1",
+                    "target_id": "srv-2",
+                    "start": {"kind": "server_down", "t_start": 4.0},
+                    "end": {"kind": "server_up", "t_end": 8.0},
+                },
+                {
+                    "event_id": "o2",
+                    "target_id": "srv-2",
+                    "start": {"kind": "server_down", "t_start": 8.0},
+                    "end": {"kind": "server_up", "t_end": 12.0},
+                },
+            ],
+        )
+        res = run_single(payload, seed=9, engine="event")
+        cc2 = res.sampled["edge_concurrent_connection"]["lb-srv2"]
+        period = 0.05
+        assert float(np.max(cc2[int(5 / period) : int(11.5 / period)])) == 0.0
+        assert len(res.rqs_clock) > 100  # srv-1 kept serving throughout
+
+
+class TestSpikeBranch:
+    def test_spike_window_adds_exact_floor(self) -> None:
+        """A deterministic +200ms spike window: every completion whose
+        outbound send fell inside the window is at least 200ms slower."""
+
+        def add_spike(data: dict) -> None:
+            data["events"] = [
+                {
+                    "event_id": "s1",
+                    "target_id": "client-srv",
+                    "start": {
+                        "kind": "network_spike_start",
+                        "t_start": 5.0,
+                        "spike_s": 0.2,
+                    },
+                    "end": {"kind": "network_spike_end", "t_end": 15.0},
+                },
+            ]
+
+        res = run_single(_payload(add_spike), seed=3, engine="event")
+        clock = res.rqs_clock
+        lat = clock[:, 1] - clock[:, 0]
+        # requests generated well inside the window (sends happen ~ms later)
+        inside = (clock[:, 0] > 5.5) & (clock[:, 0] < 14.0)
+        outside = clock[:, 0] < 4.5
+        assert inside.sum() > 20 and outside.sum() > 20
+        assert lat[inside].min() >= 0.2
+        assert np.median(lat[outside]) < 0.1
+
+
+class TestLeastConnections:
+    def test_least_connections_avoids_the_congested_edge(self) -> None:
+        """Least-connections counts *edge-transit* connections
+        (`/root/reference/src/asyncflow/runtime/actors/edge.py:88-116`), not
+        server occupancy.  A slow LB->srv-1 link (50 ms) holds connections
+        ~25x longer than the fast LB->srv-2 link (2 ms): least-connections
+        must shift routed traffic to srv-2, while round robin splits evenly
+        regardless."""
+
+        def build(algorithms: str):
+            def mutate(data: dict) -> None:
+                nodes = data["topology_graph"]["nodes"]
+                ep = [
+                    {
+                        "endpoint_name": "io",
+                        "steps": [
+                            {
+                                "kind": "io_wait",
+                                "step_operation": {"io_waiting_time": 0.005},
+                            },
+                        ],
+                    },
+                ]
+                nodes["servers"] = [
+                    {
+                        "id": "srv-1",
+                        "server_resources": {"cpu_cores": 1, "ram_mb": 1024},
+                        "endpoints": ep,
+                    },
+                    {
+                        "id": "srv-2",
+                        "server_resources": {"cpu_cores": 1, "ram_mb": 1024},
+                        "endpoints": ep,
+                    },
+                ]
+                nodes["load_balancer"] = {
+                    "id": "lb-1",
+                    "algorithms": algorithms,
+                    "server_covered": ["srv-1", "srv-2"],
+                }
+                data["topology_graph"]["edges"] = [
+                    data["topology_graph"]["edges"][0],
+                    {
+                        "id": "client-lb",
+                        "source": "client-1",
+                        "target": "lb-1",
+                        "latency": {"mean": 0.002, "distribution": "exponential"},
+                        "dropout_rate": 0.0,
+                    },
+                    {
+                        "id": "lb-srv1",
+                        "source": "lb-1",
+                        "target": "srv-1",
+                        "latency": {"mean": 0.05, "distribution": "exponential"},
+                        "dropout_rate": 0.0,
+                    },
+                    {
+                        "id": "lb-srv2",
+                        "source": "lb-1",
+                        "target": "srv-2",
+                        "latency": {"mean": 0.002, "distribution": "exponential"},
+                        "dropout_rate": 0.0,
+                    },
+                    {
+                        "id": "srv1-client",
+                        "source": "srv-1",
+                        "target": "client-1",
+                        "latency": {"mean": 0.003, "distribution": "exponential"},
+                        "dropout_rate": 0.0,
+                    },
+                    {
+                        "id": "srv2-client",
+                        "source": "srv-2",
+                        "target": "client-1",
+                        "latency": {"mean": 0.003, "distribution": "exponential"},
+                        "dropout_rate": 0.0,
+                    },
+                ]
+                # enough load that edge in-flight counts exceed 1 — at low
+                # rates both edges are usually empty and least-connections
+                # degenerates to its tie-break
+                data["rqs_input"]["avg_active_users"]["mean"] = 200
+            return mutate
+
+        def srv1_share(algorithms: str) -> float:
+            res = run_single(_payload(build(algorithms)), seed=17, engine="event")
+            io1 = float(np.mean(res.sampled["event_loop_io_sleep"]["srv-1"]))
+            io2 = float(np.mean(res.sampled["event_loop_io_sleep"]["srv-2"]))
+            return io1 / max(io1 + io2, 1e-9)
+
+        rr = srv1_share("round_robin")
+        lc = srv1_share("least_connection")
+        # identical endpoints: IO occupancy is proportional to routed count
+        assert 0.4 < rr < 0.65
+        assert lc < 0.35

@@ -1,0 +1,254 @@
+"""Property tests: batched-engine invariants on randomized payloads.
+
+The invariants the reference states as comments
+(`/root/reference/src/asyncflow/runtime/actors/server.py:186-193`: queue
+lengths never negative, RAM within [0, capacity]) plus conservation
+(generated = completed + dropped + overflow + in-flight at the horizon),
+checked on the JAX engines across randomized topologies/workloads rather
+than hand-picked examples.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.engines.jaxsim.engine import Engine, scenario_keys
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+
+def _random_payload(rng: np.random.Generator) -> SimulationPayload:
+    """A random small scenario: 1-3 servers, optional LB, random endpoints."""
+    n_servers = int(rng.integers(1, 4))
+    use_lb = bool(rng.integers(0, 2)) and n_servers >= 2
+
+    def endpoint(i: int) -> dict:
+        steps = []
+        for _ in range(int(rng.integers(1, 4))):
+            kind = rng.choice(["cpu", "io", "ram"])
+            if kind == "cpu":
+                steps.append(
+                    {
+                        "kind": "cpu_bound_operation",
+                        "step_operation": {"cpu_time": float(rng.uniform(0.001, 0.01))},
+                    },
+                )
+            elif kind == "io":
+                steps.append(
+                    {
+                        "kind": "io_wait",
+                        "step_operation": {
+                            "io_waiting_time": float(rng.uniform(0.002, 0.03)),
+                        },
+                    },
+                )
+            else:
+                steps.append(
+                    {
+                        "kind": "ram",
+                        "step_operation": {"necessary_ram": int(rng.integers(32, 256))},
+                    },
+                )
+        if not any("cpu_time" in s["step_operation"] or "io_waiting_time" in s["step_operation"] for s in steps):
+            steps.append(
+                {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.005}},
+            )
+        return {"endpoint_name": f"ep-{i}", "steps": steps}
+
+    servers = [
+        {
+            "id": f"srv-{i}",
+            "server_resources": {
+                "cpu_cores": int(rng.integers(1, 3)),
+                "ram_mb": int(rng.integers(512, 4096)),
+            },
+            "endpoints": [endpoint(j) for j in range(int(rng.integers(1, 3)))],
+        }
+        for i in range(n_servers)
+    ]
+
+    edges = [
+        {
+            "id": "gen-client",
+            "source": "rqs-1",
+            "target": "client-1",
+            "latency": {"mean": 0.003, "distribution": "exponential"},
+            "dropout_rate": float(rng.uniform(0, 0.05)),
+        },
+    ]
+    if use_lb:
+        covered = [s["id"] for s in servers[:2]]
+        edges.append(
+            {
+                "id": "client-lb",
+                "source": "client-1",
+                "target": "lb-1",
+                "latency": {"mean": 0.002, "distribution": "exponential"},
+            },
+        )
+        edges += [
+            {
+                "id": f"lb-{sid}",
+                "source": "lb-1",
+                "target": sid,
+                "latency": {"mean": 0.002, "distribution": "exponential"},
+            }
+            for sid in covered
+        ]
+        chain = covered
+    else:
+        edges.append(
+            {
+                "id": "client-srv",
+                "source": "client-1",
+                "target": "srv-0",
+                "latency": {"mean": 0.002, "distribution": "exponential"},
+            },
+        )
+        chain = ["srv-0"]
+    # remaining servers become a chain behind the first one
+    rest = [s["id"] for s in servers if s["id"] not in chain]
+    hops = [chain[0], *rest] if not use_lb else rest
+    if use_lb:
+        for sid in chain:
+            edges.append(
+                {
+                    "id": f"{sid}-out",
+                    "source": sid,
+                    "target": rest[0] if rest else "client-1",
+                    "latency": {"mean": 0.003, "distribution": "exponential"},
+                },
+            )
+        hops = rest
+    for i, sid in enumerate(hops):
+        target = hops[i + 1] if i + 1 < len(hops) else "client-1"
+        edges.append(
+            {
+                "id": f"{sid}-out",
+                "source": sid,
+                "target": target,
+                "latency": {"mean": 0.003, "distribution": "exponential"},
+            },
+        )
+
+    data = {
+        "rqs_input": {
+            "id": "rqs-1",
+            "avg_active_users": {"mean": int(rng.integers(10, 80))},
+            "avg_request_per_minute_per_user": {"mean": 20},
+            "user_sampling_window": 30,
+        },
+        "topology_graph": {
+            "nodes": {
+                "client": {"id": "client-1"},
+                **(
+                    {
+                        "load_balancer": {
+                            "id": "lb-1",
+                            "algorithms": str(
+                                rng.choice(["round_robin", "least_connection"]),
+                            ),
+                            "server_covered": [s["id"] for s in servers[:2]],
+                        },
+                    }
+                    if use_lb
+                    else {}
+                ),
+                "servers": servers,
+            },
+            "edges": edges,
+        },
+        "sim_settings": {"total_simulation_time": 20, "sample_period_s": 0.05},
+    }
+    return SimulationPayload.model_validate(data)
+
+
+@pytest.mark.parametrize("case", range(8))
+def test_engine_invariants_random_payloads(case: int) -> None:
+    rng = np.random.default_rng(1000 + case)
+    payload = _random_payload(rng)
+    plan = compile_payload(payload)
+    engine = Engine(plan, collect_gauges=True, collect_clocks=True)
+    final = engine.run_batch(scenario_keys(case, 2))
+
+    for i in range(2):
+        # resource conservation at the horizon
+        cores_free = np.asarray(final.cores_free[i])
+        ram_free = np.asarray(final.ram_free[i])
+        assert (cores_free >= 0).all()
+        assert (cores_free <= plan.server_cores).all()
+        assert (ram_free >= -1e-3).all()
+        assert (ram_free <= plan.server_ram + 1e-3).all()
+
+        # gauge series: queue lengths and RAM never negative, RAM <= capacity
+        series = np.cumsum(np.asarray(final.gauge[i]), axis=0)[
+            1 : plan.n_samples + 1
+        ]
+        for s in range(plan.n_servers):
+            ready = series[:, plan.gauge_ready(s)]
+            io = series[:, plan.gauge_io(s)]
+            ram = series[:, plan.gauge_ram(s)]
+            assert ready.min() >= -1e-3, f"server {s} ready queue negative"
+            assert io.min() >= -1e-3
+            assert ram.min() >= -1e-3
+            assert ram.max() <= float(plan.server_ram[s]) + 1e-3
+        for e in range(plan.n_edges):
+            assert series[:, plan.gauge_edge(e)].min() >= -1e-3
+
+        # request conservation: everything generated is accounted for
+        generated = int(final.n_generated[i])
+        completed = int(final.lat_count[i])
+        dropped = int(final.n_dropped[i])
+        overflow = int(final.n_overflow[i])
+        in_flight = int(np.sum(np.asarray(final.req_ev[i]) != 0))
+        assert generated == completed + dropped + overflow + in_flight, (
+            generated,
+            completed,
+            dropped,
+            overflow,
+            in_flight,
+        )
+
+        # clocks are consistent: 0 <= start < finish <= horizon
+        clock_n = min(int(final.clock_n[i]), final.clock.shape[1])
+        clock = np.asarray(final.clock[i][:clock_n])
+        if clock_n:
+            assert (clock[:, 0] >= 0).all()
+            assert (clock[:, 1] > clock[:, 0]).all()
+            assert (clock[:, 1] <= plan.horizon + 1e-5).all()
+
+
+def test_fastpath_invariants_random_payloads() -> None:
+    """Fast-path variant on the eligible subset of random payloads."""
+    from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+
+    checked = 0
+    for case in range(16):
+        rng = np.random.default_rng(2000 + case)
+        payload = _random_payload(rng)
+        plan = compile_payload(payload)
+        if not plan.fastpath_ok:
+            continue
+        engine = FastEngine(plan, collect_gauges=True, collect_clocks=True)
+        final = engine.run_batch(scenario_keys(case, 2))
+        for i in range(2):
+            series = np.cumsum(np.asarray(final.gauge[i]), axis=0)[
+                1 : plan.n_samples + 1
+            ]
+            for s in range(plan.n_servers):
+                assert series[:, plan.gauge_ready(s)].min() >= -1e-3
+                assert series[:, plan.gauge_ram(s)].max() <= (
+                    float(plan.server_ram[s]) + 1e-3
+                )
+            generated = int(final.n_generated[i])
+            completed = int(final.lat_count[i])
+            dropped = int(final.n_dropped[i])
+            overflow = int(final.n_overflow[i])
+            # the fast path freezes requests that would act past the horizon
+            # instead of tracking them individually: conservation is an
+            # inequality (completed + dropped never exceed generated)
+            assert completed + dropped <= generated
+            assert overflow >= 0
+        checked += 1
+    assert checked >= 4, f"only {checked} random payloads were eligible"

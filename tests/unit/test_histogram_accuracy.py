@@ -1,0 +1,71 @@
+"""Histogram quantization: sweep percentiles must not eat the parity budget.
+
+The sweep path estimates percentiles from 1024 log-spaced bins over
+[1e-4, 1e3] s (~1.6% relative bin width) with linear interpolation inside
+the crossing bin.  VERDICT r1 flagged that quantization alone could consume
+most of a +/-2% p95 budget; this pins the actual error against exact clocks
+computed on the same runs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.engines.jaxsim.engine import scenario_keys, sweep_results
+from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+from asyncflow_tpu.runtime.runner import SimulationRunner
+
+pytestmark = pytest.mark.integration
+
+
+def test_sweep_percentiles_match_exact_clocks() -> None:
+    payload = SimulationRunner.from_yaml(
+        "tests/integration/data/two_servers_lb.yml",
+    ).simulation_input
+    plan = compile_payload(payload)
+    engine = FastEngine(plan, collect_clocks=True)
+    n = 16
+    final = engine.run_batch(scenario_keys(3, n))
+
+    # exact pooled percentiles from the clock tables
+    clock = np.asarray(final.clock)
+    counts = np.asarray(final.clock_n)
+    exact = np.concatenate(
+        [clock[i, : counts[i], 1] - clock[i, : counts[i], 0] for i in range(n)],
+    )
+
+    # histogram-estimated pooled percentiles via the sweep reduction
+    res = sweep_results(engine, final, payload.sim_settings)
+    import dataclasses
+
+    pooled = dataclasses.replace(
+        res,
+        latency_hist=res.latency_hist.sum(axis=0, keepdims=True),
+    )
+    for q in (50, 90, 95, 99):
+        est = float(pooled.percentile(q)[0])
+        ref = float(np.percentile(exact, q))
+        rel = abs(est - ref) / ref
+        assert rel < 0.01, f"p{q}: histogram={est:.6f} exact={ref:.6f} rel={rel:.4f}"
+
+
+def test_per_scenario_percentiles_match_exact_clocks() -> None:
+    payload = SimulationRunner.from_yaml(
+        "tests/integration/data/single_server.yml",
+    ).simulation_input
+    plan = compile_payload(payload)
+    engine = FastEngine(plan, collect_clocks=True)
+    n = 8
+    final = engine.run_batch(scenario_keys(4, n))
+    res = sweep_results(engine, final, payload.sim_settings)
+    est = res.percentile(95)
+
+    clock = np.asarray(final.clock)
+    counts = np.asarray(final.clock_n)
+    for i in range(n):
+        lat = clock[i, : counts[i], 1] - clock[i, : counts[i], 0]
+        ref = float(np.percentile(lat, 95))
+        rel = abs(float(est[i]) - ref) / ref
+        assert rel < 0.02, f"scenario {i}: histogram={est[i]:.6f} exact={ref:.6f}"
