@@ -115,6 +115,9 @@ class Engine:
         self.hist_lo, self.hist_scale = hist_constants(n_hist_bins)
         self.n_thr = int(np.ceil(plan.horizon)) or 1
         self._dists_present = sorted(set(plan.edge_dist.tolist()))
+        # statically prune the RAM admission/grant machinery (several pool
+        # scans per iteration) for the many plans with no RAM steps at all
+        self._has_ram = bool(np.max(plan.endpoint_ram) > 0)
         self._compiled: dict = {}
 
     # ==================================================================
@@ -386,9 +389,7 @@ class Engine:
         is_io = pred & (kind == SEG_IO)
         is_end = pred & (kind == SEG_END)
 
-        has_waiters = jnp.any(
-            (st.req_ev == EV_WAIT_CPU) & (st.req_srv == s) & (st.req_ticket < NO_TICKET),
-        )
+        has_waiters = st.cpu_wait_n[s] > 0
         can_take = (st.cores_free[s] > 0) & ~has_waiters
         cpu_run = is_cpu & can_take
         cpu_wait = is_cpu & ~can_take
@@ -397,6 +398,7 @@ class Engine:
         st = st._replace(
             cores_free=st.cores_free.at[s].add(jnp.where(cpu_run, -1, 0)),
             cpu_ticket=st.cpu_ticket.at[s].add(jnp.where(cpu_wait, 1, 0)),
+            cpu_wait_n=st.cpu_wait_n.at[s].add(jnp.where(cpu_wait, 1, 0)),
             req_ev=st.req_ev.at[i].set(
                 jnp.where(
                     run_now,
@@ -421,51 +423,61 @@ class Engine:
         complete / forward / drop."""
         p = self.params
         plan = self.plan
-        ram_amt = st.req_ram[i]
 
-        st = st._replace(
-            ram_free=st.ram_free.at[s].add(jnp.where(pred, ram_amt, 0.0)),
-        )
-        st = self._gauge_add(
-            st,
-            now,
-            self._g_ram(s),
-            -ram_amt,
-            pred & (ram_amt > 0),
-        )
-
-        # strict-FIFO RAM grant loop: grant heads while they fit
-        def gcond(carry):
-            req_ev, _t, req_tk, ram_free_s, go = carry
-            waiting = (req_ev == EV_WAIT_RAM) & (st.req_srv == s)
-            tick = jnp.where(waiting, req_tk, NO_TICKET)
-            head = jnp.argmin(tick).astype(jnp.int32)
-            return go & (tick[head] < NO_TICKET) & (st.req_ram[head] <= ram_free_s)
-
-        def gbody(carry):
-            req_ev, req_t, req_tk, ram_free_s, go = carry
-            waiting = (req_ev == EV_WAIT_RAM) & (st.req_srv == s)
-            tick = jnp.where(waiting, req_tk, NO_TICKET)
-            head = jnp.argmin(tick).astype(jnp.int32)
-            return (
-                req_ev.at[head].set(EV_RESUME),
-                req_t.at[head].set(now),
-                req_tk.at[head].set(NO_TICKET),
-                ram_free_s - st.req_ram[head],
-                go,
+        if self._has_ram:
+            ram_amt = st.req_ram[i]
+            st = st._replace(
+                ram_free=st.ram_free.at[s].add(jnp.where(pred, ram_amt, 0.0)),
+            )
+            st = self._gauge_add(
+                st,
+                now,
+                self._g_ram(s),
+                -ram_amt,
+                pred & (ram_amt > 0),
             )
 
-        req_ev, req_t, req_tk, ram_free_s, _ = jax.lax.while_loop(
-            gcond,
-            gbody,
-            (st.req_ev, st.req_t, st.req_ticket, st.ram_free[s], pred),
-        )
-        st = st._replace(
-            req_ev=req_ev,
-            req_t=req_t,
-            req_ticket=req_tk,
-            ram_free=st.ram_free.at[s].set(ram_free_s),
-        )
+            # strict-FIFO RAM grant loop: grant heads while they fit
+            def gcond(carry):
+                req_ev, _t, req_tk, ram_free_s, wait_n, go = carry
+                waiting = (req_ev == EV_WAIT_RAM) & (st.req_srv == s)
+                tick = jnp.where(waiting, req_tk, NO_TICKET)
+                head = jnp.argmin(tick).astype(jnp.int32)
+                return go & (tick[head] < NO_TICKET) & (st.req_ram[head] <= ram_free_s)
+
+            def gbody(carry):
+                req_ev, req_t, req_tk, ram_free_s, wait_n, go = carry
+                waiting = (req_ev == EV_WAIT_RAM) & (st.req_srv == s)
+                tick = jnp.where(waiting, req_tk, NO_TICKET)
+                head = jnp.argmin(tick).astype(jnp.int32)
+                return (
+                    req_ev.at[head].set(EV_RESUME),
+                    req_t.at[head].set(now),
+                    req_tk.at[head].set(NO_TICKET),
+                    ram_free_s - st.req_ram[head],
+                    wait_n - 1,
+                    go,
+                )
+
+            req_ev, req_t, req_tk, ram_free_s, wait_n, _ = jax.lax.while_loop(
+                gcond,
+                gbody,
+                (
+                    st.req_ev,
+                    st.req_t,
+                    st.req_ticket,
+                    st.ram_free[s],
+                    st.ram_wait_n[s],
+                    pred,
+                ),
+            )
+            st = st._replace(
+                req_ev=req_ev,
+                req_t=req_t,
+                req_ticket=req_tk,
+                ram_free=st.ram_free.at[s].set(ram_free_s),
+                ram_wait_n=st.ram_wait_n.at[s].set(wait_n),
+            )
 
         # route the single exit edge of this server
         e = p.exit_edge[s]
@@ -577,21 +589,26 @@ class Engine:
             (u * p.n_endpoints[s]).astype(jnp.int32),
             p.n_endpoints[s] - 1,
         )
-        need = p.endpoint_ram[s, ep]
         st = st._replace(
             req_ep=st.req_ep.at[i].set(jnp.where(pred, ep, st.req_ep[i])),
+        )
+        if not self._has_ram:
+            # no RAM steps anywhere in the plan: admission always succeeds
+            return self._seg_start(st, i, s, ep, jnp.int32(0), now, key, ov, pred)
+
+        need = p.endpoint_ram[s, ep]
+        st = st._replace(
             req_ram=st.req_ram.at[i].set(jnp.where(pred, need, st.req_ram[i])),
         )
 
-        ram_waiters = jnp.any(
-            (st.req_ev == EV_WAIT_RAM) & (st.req_srv == s) & (st.req_ticket < NO_TICKET),
-        )
+        ram_waiters = st.ram_wait_n[s] > 0
         granted = pred & ((need <= 0) | (~ram_waiters & (st.ram_free[s] >= need)))
         blocked = pred & ~granted
 
         st = st._replace(
             ram_free=st.ram_free.at[s].add(jnp.where(granted, -need, 0.0)),
             ram_ticket=st.ram_ticket.at[s].add(jnp.where(blocked, 1, 0)),
+            ram_wait_n=st.ram_wait_n.at[s].add(jnp.where(blocked, 1, 0)),
             req_ev=st.req_ev.at[i].set(
                 jnp.where(blocked, EV_WAIT_RAM, st.req_ev[i]),
             ),
@@ -605,6 +622,8 @@ class Engine:
 
     def _resume_branch(self, st, i, now, key, ov, pred) -> EngineState:
         """RAM was granted by a releasing request: start the endpoint."""
+        if not self._has_ram:
+            return st  # EV_RESUME can never occur without RAM admission
         s = st.req_srv[i]
         ep = st.req_ep[i]
         st = self._gauge_add(
@@ -637,6 +656,7 @@ class Engine:
         jidx = jnp.where(grant, j, jnp.int32(self.pool))
         st = st._replace(
             cores_free=st.cores_free.at[s].add(jnp.where(release, 1, 0)),
+            cpu_wait_n=st.cpu_wait_n.at[s].add(jnp.where(grant, -1, 0)),
             req_ev=st.req_ev.at[jidx].set(EV_SEG_END, mode="drop"),
             req_t=st.req_t.at[jidx].set(now + jdur, mode="drop"),
             req_ticket=st.req_ticket.at[jidx].set(NO_TICKET, mode="drop"),
@@ -673,6 +693,8 @@ class Engine:
             ram_free=jnp.asarray(plan.server_ram),
             cpu_ticket=jnp.zeros(plan.n_servers, jnp.int32),
             ram_ticket=jnp.zeros(plan.n_servers, jnp.int32),
+            cpu_wait_n=jnp.zeros(plan.n_servers, jnp.int32),
+            ram_wait_n=jnp.zeros(plan.n_servers, jnp.int32),
             lb_order=jnp.arange(elp, dtype=jnp.int32),
             lb_len=jnp.int32(plan.n_lb_edges),
             lb_conn=jnp.zeros(elp, jnp.int32),
@@ -681,6 +703,8 @@ class Engine:
             smp_lam=jnp.float32(0.0),
             next_arrival=jnp.float32(0.0),
             tl_ptr=jnp.int32(0),
+            nxt_i=jnp.int32(0),
+            nxt_t=jnp.float32(INF),  # empty pool
             key=key,
             it=jnp.int32(1),
             hist=jnp.zeros(self.n_hist_bins, jnp.int32),
@@ -706,7 +730,8 @@ class Engine:
         )
 
     def _next_times(self, st: EngineState):
-        t_pool = jnp.min(st.req_t)
+        """Next event times from the cached pool argmin (see ``nxt_t``)."""
+        t_pool = st.nxt_t
         if len(self.plan.timeline_times) > 0:
             ptr = jnp.clip(st.tl_ptr, 0, len(self.plan.timeline_times) - 1)
             t_tl = jnp.where(
@@ -717,6 +742,12 @@ class Engine:
         else:
             t_tl = INF
         return t_pool, st.next_arrival, t_tl
+
+    def _refresh_pool_min(self, st: EngineState) -> EngineState:
+        """The single pool scan per iteration: cache argmin index + value so
+        ``_cond`` and the next body read scalars."""
+        i = jnp.argmin(st.req_t).astype(jnp.int32)
+        return st._replace(nxt_i=i, nxt_t=st.req_t[i])
 
     def _cond(self, st: EngineState):
         t_pool, t_arr, t_tl = self._next_times(st)
@@ -737,13 +768,16 @@ class Engine:
         st = self._timeline_branch(st, is_tl)
         st = self._spawn_branch(st, now, kit, ov, is_arr)
 
-        i = jnp.argmin(st.req_t).astype(jnp.int32)
+        # the pool's next event was cached by the previous iteration's
+        # argmin; the spawn/timeline branches above never reduce req_t below
+        # `now`, so the cached index stays the pool minimum when is_pool
+        i = st.nxt_i
         ev = st.req_ev[i]
         st = self._arrive_lb_branch(st, i, now, kit, ov, is_pool & (ev == EV_ARRIVE_LB))
         st = self._arrive_srv_branch(st, i, now, kit, ov, is_pool & (ev == EV_ARRIVE_SRV))
         st = self._resume_branch(st, i, now, kit, ov, is_pool & (ev == EV_RESUME))
         st = self._seg_end_branch(st, i, now, kit, ov, is_pool & (ev == EV_SEG_END))
-        return st
+        return self._refresh_pool_min(st)
 
     def _run_one(self, key, ov: ScenarioOverrides) -> EngineState:
         st = self._init_state(key, ov)

@@ -99,6 +99,8 @@ class EngineState(NamedTuple):
     ram_free: jnp.ndarray  # (NS,) f32
     cpu_ticket: jnp.ndarray  # (NS,) i32
     ram_ticket: jnp.ndarray  # (NS,) i32
+    cpu_wait_n: jnp.ndarray  # (NS,) i32: live CPU waiter counts
+    ram_wait_n: jnp.ndarray  # (NS,) i32: live RAM waiter counts
     # load balancer
     lb_order: jnp.ndarray  # (EL,) i32
     lb_len: jnp.ndarray  # scalar i32
@@ -110,6 +112,10 @@ class EngineState(NamedTuple):
     next_arrival: jnp.ndarray  # scalar f32 (simulation clock)
     # outage timeline cursor
     tl_ptr: jnp.ndarray  # scalar i32
+    # cached pool argmin (computed once at the end of each loop body so the
+    # loop condition reads a scalar instead of re-scanning the pool)
+    nxt_i: jnp.ndarray  # scalar i32: index of the pool's next event
+    nxt_t: jnp.ndarray  # scalar f32: its time (== min(req_t))
     # rng
     key: jnp.ndarray
     it: jnp.ndarray  # scalar i32 iteration counter (rng stream + safety)
