@@ -180,6 +180,9 @@ class StaticPlan:
     #: non-binding (not modeled), 0 = no RAM steps, k > 0 = FIFO admission
     #: queue with k concurrency slots (homogeneous needs, cap // need)
     ram_slots: np.ndarray = field(default_factory=lambda: np.empty(0, np.int32))
+    #: least-connections support on the fast path: ring capacity per LB slot
+    #: for outstanding delivery times (0 = round robin / no LB)
+    lc_ring: int = 0
 
     @property
     def n_gauges(self) -> int:
@@ -463,13 +466,15 @@ def compile_payload(
     sample_period = float(settings.sample_period_s)
     n_samples = max(0, math.ceil(round(horizon / sample_period, 9)) - 1)
 
-    fastpath_ok, fastpath_reason, topo, ram_slots = _fastpath_analysis(
+    fastpath_ok, fastpath_reason, topo, ram_slots, lc_ring = _fastpath_analysis(
         payload,
         compiled,
         exit_kind,
         exit_target,
         lb_algo,
         len(outages),
+        lb_edge_means=[float(edge_mean[e]) for e in lb_slots],
+        max_spike=float(spike_values.max()) if spike_values.size else 0.0,
     )
 
     return StaticPlan(
@@ -530,6 +535,7 @@ def compile_payload(
         fastpath_reason=fastpath_reason,
         server_topo_order=topo,
         ram_slots=ram_slots,
+        lc_ring=lc_ring,
     )
 
 
@@ -540,7 +546,10 @@ def _fastpath_analysis(
     exit_target: np.ndarray,
     lb_algo: int,
     n_outage_marks: int,
-) -> tuple[bool, str, list[int], np.ndarray]:
+    *,
+    lb_edge_means: list[float] | None = None,
+    max_spike: float = 0.0,
+) -> tuple[bool, str, list[int], np.ndarray, int]:
     """Decide whether the scan engine can execute this plan faithfully.
 
     "Faithfully" means exact per scenario for single-burst endpoints
@@ -571,17 +580,43 @@ def _fastpath_analysis(
     if n_outage_marks > 0 and lb is None:
         # outages only act through the LB rotation; without one they are
         # no-ops in the event engines, but keep the exact engine for safety
-        return False, "outage events without a load balancer", [], no_slots
-    if lb is not None and lb_algo != 0:
-        return False, "least-connections routing needs live edge state", [], no_slots
+        return False, "outage events without a load balancer", [], no_slots, 0
     for edge in payload.topology_graph.edges:
         if edge.latency.distribution == Distribution.POISSON:
-            return False, f"edge {edge.id}: poisson latency unsupported", [], no_slots
+            return (
+                False,
+                f"edge {edge.id}: poisson latency unsupported",
+                [],
+                no_slots,
+                0,
+            )
 
     workload = payload.rqs_input
     users = float(workload.avg_active_users.mean)
     rate = users * float(workload.avg_request_per_minute_per_user.mean) / 60.0
     burst_rate = rate * (1.0 + 3.0 / math.sqrt(max(users, 1.0)))
+
+    lc_ring = 0
+    if lb is not None and lb_algo != 0:
+        # Least-connections reads live per-edge in-flight counts.  The scan
+        # engine replays them with a bounded ring of outstanding delivery
+        # times per slot: exact while the ring never overflows.  In-flight on
+        # one edge is ~Poisson(rate x delay) even if every request lands on
+        # it (an outage can concentrate all traffic), so a 6-sigma bound
+        # with slack makes overflow astronomically unlikely; refuse when the
+        # bound itself is impractically large.
+        worst_delay = max(lb_edge_means or [0.0]) + max_spike
+        m = burst_rate * worst_delay
+        ring = int(math.ceil(m + 6.0 * math.sqrt(max(m, 1.0)) + 16.0))
+        if ring > 128:
+            return (
+                False,
+                f"least-connections in-flight bound too large ({ring} slots)",
+                [],
+                no_slots,
+                0,
+            )
+        lc_ring = ring
 
     max_visits = max(
         (
@@ -594,12 +629,18 @@ def _fastpath_analysis(
     if max_visits > 8:
         # each extra burst adds relaxation sweeps over an n*kb merged stream;
         # beyond this the general event engine is the better engine
-        return False, f"endpoint with {max_visits} CPU bursts", [], no_slots
+        return False, f"endpoint with {max_visits} CPU bursts", [], no_slots, 0
 
     ram_slots = np.zeros(n_servers, dtype=np.int32)
     for s, server in enumerate(servers):
         if exit_kind[s] == TARGET_LB:
-            return False, f"server {server.id}: exit to LB creates a cycle", [], no_slots
+            return (
+                False,
+                f"server {server.id}: exit to LB creates a cycle",
+                [],
+                no_slots,
+                0,
+            )
         max_ram = 0.0
         residence = 0.0
         cpu_dur = 0.0
@@ -639,6 +680,7 @@ def _fastpath_analysis(
                     f"server {server.id}: multi-burst endpoints with binding RAM",
                     [],
                     no_slots,
+                    0,
                 )
             pre_ios = {
                 _burst_decomposition(segs)[1][0]
@@ -651,6 +693,7 @@ def _fastpath_analysis(
                     f"server {server.id}: varying pre-burst IO with binding RAM",
                     [],
                     no_slots,
+                    0,
                 )
             slots = int(capacity_mb // next(iter(needs)))
             if 1 <= slots <= 1024:  # scan carry is `slots` floats per lane
@@ -662,18 +705,21 @@ def _fastpath_analysis(
                     f"server {server.id}: endpoint RAM exceeds server RAM",
                     [],
                     no_slots,
+                    0,
                 )
             return (
                 False,
                 f"server {server.id}: RAM admission needs {slots} slots",
                 [],
                 no_slots,
+                0,
             )
         return (
             False,
             f"server {server.id}: heterogeneous RAM needs can bind",
             [],
             no_slots,
+            0,
         )
 
     # topological order of the server exit DAG
@@ -692,5 +738,5 @@ def _fastpath_analysis(
             if indeg[t] == 0:
                 frontier.append(t)
     if len(topo) != n_servers:
-        return False, "server exit chain has a cycle", [], no_slots
-    return True, "", topo, ram_slots
+        return False, "server exit chain has a cycle", [], no_slots, 0
+    return True, "", topo, ram_slots, lc_ring

@@ -323,40 +323,46 @@ class FastEngine:
     # round robin with a mutating rotation (outage timelines)
     # ------------------------------------------------------------------
 
+    def _advance_timeline(self, rot, length, ptr, t_arr):
+        """Apply every outage mark with time <= ``t_arr`` to the rotation
+        (pop on down, reinsert-at-tail on up — the event engines'
+        discipline). Shared by the round-robin and least-connections scans."""
+        plan = self.plan
+        el = plan.n_lb_edges
+        ntl = len(plan.timeline_times)
+        if ntl == 0:
+            return rot, length, ptr
+        tl_times = jnp.asarray(plan.timeline_times)
+        tl_down = jnp.asarray(plan.timeline_down)
+        tl_slot = jnp.asarray(plan.timeline_slot)
+
+        def tl_cond(c):
+            _rot, _length, p = c
+            return (p < ntl) & (tl_times[jnp.minimum(p, ntl - 1)] <= t_arr)
+
+        def tl_body(c):
+            rot_c, length_c, p = c
+            idx = jnp.minimum(p, ntl - 1)
+            s = tl_slot[idx]
+            down = tl_down[idx] == 1
+            act = s >= 0
+            rot_c, length_c = rotation_remove(rot_c, length_c, s, act & down, el)
+            rot_c, length_c = rotation_insert(rot_c, length_c, s, act & ~down, el)
+            return rot_c, length_c, p + 1
+
+        return jax.lax.while_loop(tl_cond, tl_body, (rot, length, ptr))
+
     def _routed_slots(self, t, alive):
         """(slot, routed) per request: scan arrivals in time order carrying
         the LB rotation, applying down/up timeline marks as time passes —
         the same pop / reinsert-at-tail discipline as the event engines."""
         plan = self.plan
         el = plan.n_lb_edges
-        ntl = len(plan.timeline_times)
-        tl_times = jnp.asarray(plan.timeline_times)
-        tl_down = jnp.asarray(plan.timeline_down)
-        tl_slot = jnp.asarray(plan.timeline_slot)
 
         def step(carry, x):
             rot, length, ptr = carry
             t_arr, ok = x
-
-            def tl_cond(c):
-                _rot, _length, p = c
-                return (p < ntl) & (tl_times[jnp.minimum(p, ntl - 1)] <= t_arr)
-
-            def tl_body(c):
-                rot_c, length_c, p = c
-                idx = jnp.minimum(p, ntl - 1)
-                s = tl_slot[idx]
-                down = tl_down[idx] == 1
-                act = s >= 0
-                rot_c, length_c = rotation_remove(rot_c, length_c, s, act & down, el)
-                rot_c, length_c = rotation_insert(rot_c, length_c, s, act & ~down, el)
-                return rot_c, length_c, p + 1
-
-            rot, length, ptr = jax.lax.while_loop(
-                tl_cond,
-                tl_body,
-                (rot, length, ptr),
-            )
+            rot, length, ptr = self._advance_timeline(rot, length, ptr, t_arr)
             empty = length <= 0
             picked = jnp.where(ok & ~empty, rot[0], jnp.int32(-1))
             rot = rotation_advance(rot, length, ok & ~empty, el)
@@ -368,6 +374,69 @@ class FastEngine:
             step,
             init,
             (jnp.where(alive, t, INF)[order], alive[order]),
+        )
+        picked = jnp.zeros(t.shape[0], jnp.int32).at[order].set(picked_sorted)
+        return picked, picked >= 0
+
+    def _routed_slots_lc(self, t, alive, drop_s, delay_s):
+        """Least-connections routing as a time-ordered scan.
+
+        The event engines count *edge-transit* connections: +1 at a
+        non-dropped send, -1 at delivery
+        (`/root/reference/src/asyncflow/runtime/actors/edge.py:88-116`), and
+        pick the first minimum in rotation order
+        (`runtime/actors/routing/lb_algorithms.py:10-20`).  The scan carries,
+        per LB slot, a ring of outstanding delivery times: the live count at
+        an arrival is how many ring entries still lie in the future.  Ring
+        capacity comes from the compiler's 6-sigma in-flight bound
+        (``plan.lc_ring``); on the astronomically-rare overflow the earliest
+        delivery is evicted (graceful degradation, not a drop).  Outage
+        marks mutate the rotation exactly as in ``_routed_slots``.
+        """
+        plan = self.plan
+        el = plan.n_lb_edges
+        ring_b = max(plan.lc_ring, 1)
+        deliver = t[:, None] + delay_s  # (n, EL) candidate delivery times
+
+        def step(carry, x):
+            rot, length, ptr, rings = carry
+            t_arr, ok, drops_i, deliv_i = x
+            rot, length, ptr = self._advance_timeline(rot, length, ptr, t_arr)
+
+            # live in-flight count per slot, then first-min in rotation order
+            conn = jnp.sum(rings > t_arr, axis=1).astype(jnp.int32)  # (EL,)
+            pos = jnp.arange(el, dtype=jnp.int32)
+            valid = pos < length
+            order_key = jnp.where(valid, conn[rot] * el + pos, jnp.int32(2**30))
+            best = jnp.argmin(order_key).astype(jnp.int32)
+            empty = length <= 0
+            picked_slot = rot[best]
+            picked = jnp.where(ok & ~empty, picked_slot, jnp.int32(-1))
+
+            # record the outstanding delivery unless the edge drops the send
+            do_ins = ok & ~empty & ~drops_i[jnp.clip(picked_slot, 0, el - 1)]
+            row = jnp.clip(picked_slot, 0, el - 1)
+            j = jnp.argmin(rings[row]).astype(jnp.int32)
+            new_val = jnp.where(do_ins, deliv_i[row], rings[row, j])
+            rings = rings.at[row, j].set(new_val)
+            return (rot, length, ptr, rings), picked
+
+        order = jnp.argsort(jnp.where(alive, t, INF))
+        init = (
+            jnp.arange(el, dtype=jnp.int32),
+            jnp.int32(el),
+            jnp.int32(0),
+            jnp.full((el, ring_b), -INF, jnp.float32),
+        )
+        _, picked_sorted = jax.lax.scan(
+            step,
+            init,
+            (
+                jnp.where(alive, t, INF)[order],
+                alive[order],
+                drop_s[order],
+                deliver[order],
+            ),
         )
         picked = jnp.zeros(t.shape[0], jnp.int32).at[order].set(picked_sorted)
         return picked, picked >= 0
@@ -432,7 +501,28 @@ class FastEngine:
         alive = alive & (t < plan.horizon)
         srv = jnp.full(n, jnp.int32(max(plan.entry_target, 0)))
         if plan.n_lb_edges > 0:
-            if len(plan.timeline_times) == 0:
+            # pre-draw every (request, slot) edge outcome; the routing rule
+            # then just selects a column (distributionally identical to the
+            # event engines' draw-after-pick)
+            drops = []
+            delays = []
+            for s_idx, eidx in enumerate(plan.lb_edge_index.tolist()):
+                dropped_c, delay_c = self._edge_hop(
+                    jax.random.fold_in(key, 32 + s_idx), eidx, t, ov,
+                )
+                drops.append(dropped_c)
+                delays.append(delay_c)
+            drop_s = jnp.stack(drops, axis=1)  # (n, EL)
+            delay_s = jnp.stack(delays, axis=1)
+
+            if plan.lb_algo == 1:
+                # least connections: scan arrivals carrying per-slot rings of
+                # outstanding delivery times (live edge in-flight counts)
+                slot, routed = self._routed_slots_lc(t, alive, drop_s, delay_s)
+                n_dropped = n_dropped + jnp.sum(alive & ~routed)
+                alive = alive & routed
+                slot = jnp.where(alive, slot, 0)
+            elif len(plan.timeline_times) == 0:
                 # fixed membership: round robin is a pure function of rank
                 order = jnp.argsort(jnp.where(alive, t, INF))
                 rank_sorted = jnp.cumsum(alive[order].astype(jnp.int32)) - 1
@@ -447,21 +537,21 @@ class FastEngine:
                 alive = alive & routed
                 slot = jnp.where(alive, slot, 0)
             srv = jnp.asarray(plan.lb_target)[slot]
-            # per-request edge draws: one pass per LB slot (static, small)
-            new_t = t
-            new_alive = alive
-            for s_idx, eidx in enumerate(plan.lb_edge_index.tolist()):
-                mine = alive & (slot == s_idx)
-                dropped, delay = self._edge_hop(
-                    jax.random.fold_in(key, 32 + s_idx), eidx, t, ov,
-                )
-                ok = mine & ~dropped
-                gauge = self._gauge_intervals(gauge, eidx, t, t + delay, 1.0, ok)
-                gauge_means = gauge_means.at[eidx].add(span(t, t + delay, ok))
-                n_dropped = n_dropped + jnp.sum(mine & dropped)
-                new_t = jnp.where(ok, t + delay, new_t)
-                new_alive = jnp.where(mine, ok, new_alive)
-            t, alive = new_t, new_alive
+
+            lanes = jnp.arange(n)
+            dropped = drop_s[lanes, slot]
+            delay = delay_s[lanes, slot]
+            eidx_arr = jnp.asarray(plan.lb_edge_index)[slot]
+            ok = alive & ~dropped
+            gauge = self._gauge_intervals(gauge, eidx_arr, t, t + delay, 1.0, ok)
+            lo = jnp.minimum(t, horizon)
+            hi = jnp.minimum(t + delay, horizon)
+            gauge_means = gauge_means.at[eidx_arr].add(
+                jnp.where(ok, jnp.maximum(hi - lo, 0.0), 0.0),
+            )
+            n_dropped = n_dropped + jnp.sum(alive & dropped)
+            t = jnp.where(ok, t + delay, t)
+            alive = ok
 
         # ---- servers in topological order -------------------------------
         finish = jnp.full(n, INF, jnp.float32)

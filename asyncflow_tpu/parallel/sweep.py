@@ -359,23 +359,41 @@ def _guard_overrides_against_plan(
     plan,
     overrides: ScenarioOverrides | None,
 ) -> None:
-    """The fast path's tier-1 RAM proof ("admission can never queue") was
-    made at the base workload rate; refuse rate-raising overrides when any
-    server relies on it.  Servers whose admission queue is modeled
-    (``ram_slots > 0``) or that hold no RAM are rate-safe: saturation is
-    simulated, not assumed away."""
+    """The fast path's compile-time proofs were made at the base workload:
+    the tier-1 RAM bound ("admission can never queue") and the
+    least-connections in-flight ring bound both scale with the rate, so
+    refuse rate-raising overrides when either is in play.  Servers whose
+    admission queue is modeled (``ram_slots > 0``) or that hold no RAM are
+    rate-safe: saturation is simulated, not assumed away."""
     if overrides is None:
         return
-    if not (len(plan.ram_slots) and bool(np.any(plan.ram_slots == -1))):
+    tier1 = len(plan.ram_slots) and bool(np.any(plan.ram_slots == -1))
+    if not tier1 and plan.lc_ring == 0:
         return
     base = base_overrides(plan)
     base_rate = float(base.user_mean) * float(base.req_rate)
     max_rate = _sweep_max(overrides.user_mean) * _sweep_max(overrides.req_rate)
-    if max_rate > base_rate * 1.001:
+    rate_raised = max_rate > base_rate * 1.001
+    lb_mean_raised = False
+    if plan.lc_ring > 0:
+        # the ring bound was proven from the worst LB-edge delay: compare
+        # per LB edge, not against the global max (a large non-LB edge must
+        # not mask an LB-edge raise)
+        ov_mean = np.asarray(overrides.edge_mean)
+        base_mean = np.asarray(plan.edge_mean)
+        for e in plan.lb_edge_index.tolist():
+            col = ov_mean[..., e] if ov_mean.ndim else ov_mean
+            if float(np.max(col)) > float(base_mean[e]) * 1.001:
+                lb_mean_raised = True
+                break
+    if rate_raised or lb_mean_raised:
+        if rate_raised and tier1:
+            proof = "RAM non-binding proof"
+        else:
+            proof = "least-connections in-flight bound"
         msg = (
-            "overrides raise the workload rate above the base plan "
-            f"({max_rate:.2f} vs {base_rate:.2f} rps), which invalidates the "
-            "fast path's RAM non-binding proof; use "
+            "overrides raise the workload above the base plan, which "
+            f"invalidates the fast path's {proof}; use "
             "SweepRunner(..., engine='event') or raise the base workload"
         )
         raise _FastpathOverrideError(msg)

@@ -317,22 +317,55 @@ class TestEligibility:
         assert not plan.fastpath_ok
         assert "exceeds server RAM" in plan.fastpath_reason
 
-    def test_least_connections_ineligible(self) -> None:
+    def test_least_connections_now_eligible(self) -> None:
         def mutate(data: dict) -> None:
             data["topology_graph"]["nodes"]["load_balancer"]["algorithms"] = (
                 "least_connection"
             )
 
         plan = compile_payload(_payload(LB, mutate))
-        assert not plan.fastpath_ok
+        assert plan.fastpath_ok, plan.fastpath_reason
+        assert plan.lc_ring > 0  # the in-flight ring bound was proven
 
-    def test_fast_engine_rejects_ineligible_plan(self) -> None:
-        def use_least_connections(data: dict) -> None:
+    def test_least_connections_huge_inflight_ineligible(self) -> None:
+        """A slow LB edge at high rate pushes the in-flight bound past the
+        ring cap: fall back to the event engine."""
+
+        def mutate(data: dict) -> None:
             data["topology_graph"]["nodes"]["load_balancer"]["algorithms"] = (
                 "least_connection"
             )
+            for edge in data["topology_graph"]["edges"]:
+                if edge["id"].startswith("lb-"):
+                    edge["latency"]["mean"] = 3.0
+            data["rqs_input"]["avg_active_users"]["mean"] = 300
 
-        plan = compile_payload(_payload(LB, use_least_connections))
+        plan = compile_payload(_payload(LB, mutate))
+        assert not plan.fastpath_ok
+        assert "in-flight bound" in plan.fastpath_reason
+
+    def test_fast_engine_rejects_ineligible_plan(self) -> None:
+        def heterogeneous_ram(data: dict) -> None:
+            server = data["topology_graph"]["nodes"]["servers"][0]
+            server["server_resources"]["ram_mb"] = 300
+            server["endpoints"] = [
+                {
+                    "endpoint_name": "big",
+                    "steps": [
+                        {"kind": "ram", "step_operation": {"necessary_ram": 200}},
+                        {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.05}},
+                    ],
+                },
+                {
+                    "endpoint_name": "small",
+                    "steps": [
+                        {"kind": "ram", "step_operation": {"necessary_ram": 120}},
+                        {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.05}},
+                    ],
+                },
+            ]
+
+        plan = compile_payload(_payload(BASE, heterogeneous_ram))
         with pytest.raises(ValueError, match="not eligible"):
             FastEngine(plan)
 
@@ -545,6 +578,91 @@ def test_fastpath_ram_admission_queue() -> None:
     assert abs(p50f - p50o) / p50o < 0.04
     p95f, p95o = np.percentile(lat_fast, 95), np.percentile(lat_oracle, 95)
     assert abs(p95f - p95o) / p95o < 0.08
+
+
+def test_fastpath_least_connections() -> None:
+    """Least-connections via the delivery-time ring scan: distributional
+    parity with the oracle's live edge-connection counting."""
+
+    def mutate(data: dict) -> None:
+        data["topology_graph"]["nodes"]["load_balancer"]["algorithms"] = (
+            "least_connection"
+        )
+
+    payload = _payload(LB, mutate)
+    plan = compile_payload(payload)
+    assert plan.fastpath_ok, plan.fastpath_reason
+    assert plan.lc_ring > 0
+    _assert_parity(_fast_latencies(payload, SEEDS), _oracle_latencies(payload, SEEDS), 0.02)
+
+
+def test_fastpath_least_connections_discriminates() -> None:
+    """A congested LB edge (25x transit time) must shed traffic under
+    least-connections, matching the oracle's routed share."""
+
+    def mutate(data: dict) -> None:
+        data["topology_graph"]["nodes"]["load_balancer"]["algorithms"] = (
+            "least_connection"
+        )
+        for edge in data["topology_graph"]["edges"]:
+            if edge["id"] == "lb-srv1":
+                edge["latency"]["mean"] = 0.05
+        data["rqs_input"]["avg_active_users"]["mean"] = 300
+
+    payload = _payload(LB, mutate)
+    plan = compile_payload(payload)
+    assert plan.fastpath_ok, plan.fastpath_reason
+    engine = FastEngine(plan, collect_gauges=True)
+    final = engine.run_batch(scenario_keys(5, 6))
+    shares = []
+    for i in range(6):
+        gm = np.asarray(final.gauge_means[i])
+        io1, io2 = gm[plan.gauge_io(0)], gm[plan.gauge_io(1)]
+        shares.append(io1 / max(io1 + io2, 1e-9))
+    fast_share = float(np.mean(shares))
+
+    from asyncflow_tpu.engines.oracle.engine import OracleEngine as _OE
+
+    oracle_shares = []
+    for seed in range(4):
+        res = _OE(payload, seed=seed).run()
+        io1 = float(np.mean(res.sampled["event_loop_io_sleep"]["srv-1"]))
+        io2 = float(np.mean(res.sampled["event_loop_io_sleep"]["srv-2"]))
+        oracle_shares.append(io1 / (io1 + io2))
+    oracle_share = float(np.mean(oracle_shares))
+
+    assert fast_share < 0.35  # traffic really shifted off the slow edge
+    assert abs(fast_share - oracle_share) < 0.05
+
+
+def test_fastpath_least_connections_outage() -> None:
+    """LC + outage windows: the ring scan interleaves timeline marks and the
+    down server's edge carries zero traffic inside the window."""
+
+    def mutate(data: dict) -> None:
+        data["topology_graph"]["nodes"]["load_balancer"]["algorithms"] = (
+            "least_connection"
+        )
+        data["events"] = [
+            {
+                "event_id": "o1",
+                "target_id": "srv-2",
+                "start": {"kind": "server_down", "t_start": 10.0},
+                "end": {"kind": "server_up", "t_end": 30.0},
+            },
+        ]
+
+    payload = _payload(LB, mutate)
+    plan = compile_payload(payload)
+    assert plan.fastpath_ok, plan.fastpath_reason
+    engine = FastEngine(plan, collect_gauges=True)
+    final = engine.run_batch(scenario_keys(3, 4))
+    period = plan.sample_period
+    for i in range(4):
+        series = np.cumsum(np.asarray(final.gauge[i]), axis=0)[1 : plan.n_samples + 1]
+        cc2 = series[:, plan.edge_ids.index("lb-srv2")]
+        assert float(np.max(cc2[int(12 / period) : int(28 / period)])) == 0.0
+        assert float(np.max(cc2[int(32 / period) :])) > 0.0
 
 
 def test_fastpath_heavy_spike_flood() -> None:
