@@ -553,9 +553,10 @@ def _fastpath_analysis(
     """Decide whether the scan engine can execute this plan faithfully.
 
     "Faithfully" means exact per scenario for single-burst endpoints
-    (including modeled RAM admission), and bounded-residual for multi-burst
-    endpoints (iterated relaxation; measured ~+1% mean / +2.3% p95 vs the
-    oracle at rho 0.6 — see docs/internals/fastpath.md §5).  Conditions
+    (including modeled RAM admission), and fixed-point relaxation for
+    multi-burst endpoints (converged results sit inside the oracle's own
+    ensemble noise, +/-2-3% p95 at rho 0.6 — see
+    docs/internals/fastpath.md §5).  Conditions
     (each mirrors an assumption of the queueing-recursion model):
     round-robin routing (the rotation is deterministic given the pick/outage
     interleaving, which the fast path replays with a scan), no Poisson-latency

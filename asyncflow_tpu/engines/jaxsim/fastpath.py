@@ -1,7 +1,8 @@
 """Scan fast path: closed-form vectorized simulation for eligible plans.
 
-For plans with provably non-binding RAM and round-robin routing (see
-``_fastpath_analysis`` in the compiler), the per-scenario discrete-event loop
+For every plan the compiler proves faithful (``_fastpath_analysis`` —
+alternating CPU/IO endpoints, round-robin or least-connections routing,
+non-binding or uniform-need RAM), the per-scenario discrete-event loop
 collapses into pure array code:
 
 1. **Arrivals.**  Within each user-sampling window the reference's gap chain
@@ -16,7 +17,10 @@ collapses into pure array code:
    LB-arrival *rank* (sort by arrival time, assign ``rank % n_edges``); with
    outage windows, a ``lax.scan`` over time-ordered arrivals carries the
    rotation and applies down/up marks with the event engines' pop /
-   reinsert-at-tail discipline.
+   reinsert-at-tail discipline.  **Least connections** rides the same scan:
+   edge outcomes are pre-drawn per (request, slot), so a per-slot ring of
+   outstanding delivery times reproduces the live in-flight counts
+   (``_routed_slots_lc``; ring capacity = compile-time 6-sigma bound).
 4. **Each server is a FIFO G/G/c core queue visited once per CPU burst**
    (IO sleeps hold no core, `/root/reference/src/asyncflow/runtime/actors/
    server.py:235-255`): the compiler rewrites every alternating CPU/IO
@@ -27,9 +31,11 @@ collapses into pure array code:
    log-depth with ``lax.associative_scan`` in max-plus form — and multi-core
    waits use the Kiefer-Wolfowitz workload-vector scan.  Visit k's enqueue
    time depends on earlier visits' waits, so multi-burst plans relax to the
-   fixed point (2*kb + 2 sweeps; measured residual vs the oracle at rho=0.6:
-   mean +1.0%, p95 +2.3%); with one burst per endpoint a single sweep is
-   exact, reproducing the classic formulation.  Servers whose RAM admission
+   fixed point (2*kb + 2 sweeps; statistically indistinguishable from the
+   oracle — deviations across key ensembles span +/-2-3% at rho 0.6, the
+   same spread disjoint oracle ensembles show against each other); with one
+   burst per endpoint a single sweep is exact, reproducing the classic
+   formulation.  Servers whose RAM admission
    can bind are settled by ``_ram_core_scan`` instead: one exact
    arrival-order pass over (admission slots, cores) jointly.
 5. Chained servers (app -> DB) are processed in exit-DAG topological order.
@@ -638,8 +644,8 @@ class FastEngine:
                 # Visit k's enqueue time depends on earlier visits' waits, so
                 # relax to the fixed point; one sweep is exact when kb == 1
                 # (enqueue times don't depend on waits).  Multi-burst sweeps
-                # converge by ~2*kb+2 (measured: mean +0.3%, p95 +1.3% vs the
-                # oracle at rho=0.6 — visit-order effects, not sweep count).
+                # converge by ~2*kb+2; at convergence the result is within
+                # the oracle's own ensemble noise (+/-2-3% p95 at rho 0.6).
                 W = jnp.zeros((n, kb), jnp.float32)
                 n_sweeps = (
                     self.relax_sweeps
