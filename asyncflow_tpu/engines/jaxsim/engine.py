@@ -824,6 +824,9 @@ def engine_truncated(engine: Engine, state) -> np.ndarray:
     never drift from the loop's continue condition, and reduces on device so
     only an (S,) bool crosses to the host.
     """
+    if hasattr(state, "truncated"):
+        # the Pallas engine detects truncation inside its kernel
+        return np.asarray(state.truncated).astype(bool)
     if not hasattr(state, "it"):
         return np.zeros(
             np.asarray(getattr(state, "lat_count", 0)).shape,

@@ -157,10 +157,14 @@ class SweepRunner:
         engine: str = "auto",
     ) -> None:
         """``engine``: "auto" picks the scan fast path when the plan is
-        eligible (orders of magnitude faster), falling back to the general
-        event engine; "event"/"fast" force one."""
-        if engine not in ("auto", "fast", "event"):
-            msg = f"engine must be 'auto', 'fast' or 'event', got {engine!r}"
+        eligible (orders of magnitude faster), then the Pallas event kernel
+        on TPU (VMEM-resident loop; no per-iteration launch overhead), then
+        the general XLA event engine; "event"/"fast"/"pallas" force one."""
+        if engine not in ("auto", "fast", "event", "pallas"):
+            msg = (
+                f"engine must be 'auto', 'fast', 'event' or 'pallas', "
+                f"got {engine!r}"
+            )
             raise ValueError(msg)
         self.payload = payload
         self.plan = compile_payload(payload, pool_size=pool_size)
@@ -169,6 +173,13 @@ class SweepRunner:
 
             self.engine = FastEngine(self.plan, n_hist_bins=n_hist_bins)
             self.engine_kind = "fast"
+        elif engine == "pallas" or (
+            engine == "auto" and jax.default_backend() == "tpu"
+        ):
+            from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
+
+            self.engine = PallasEngine(self.plan, n_hist_bins=n_hist_bins)
+            self.engine_kind = "pallas"
         else:
             self.engine = Engine(
                 self.plan,
@@ -177,7 +188,15 @@ class SweepRunner:
                 n_hist_bins=n_hist_bins,
             )
             self.engine_kind = "event"
-        self.mesh = scenario_mesh() if use_mesh and len(jax.devices()) > 1 else None
+        # The Pallas kernel is a single-device program (no GSPMD partitioning
+        # rule): sharding its operands over a mesh would run the full chunk
+        # replicated on every device.  Until a shard_map wrapper exists, the
+        # pallas engine runs unsharded; event/fast vmapped jits partition.
+        self.mesh = (
+            scenario_mesh()
+            if use_mesh and len(jax.devices()) > 1 and self.engine_kind != "pallas"
+            else None
+        )
 
     def _guard_fastpath_overrides(self, overrides: ScenarioOverrides | None) -> None:
         if self.engine_kind == "fast":
@@ -208,6 +227,15 @@ class SweepRunner:
     # (tunneled TPU workers kill executions running longer than ~1 minute).
     DEFAULT_CHUNK = 64  # event engine: while-loop iterations dominate
     DEFAULT_CHUNK_FAST = 512  # scan engine: (S, N) array memory dominates
+    DEFAULT_CHUNK_PALLAS = 256  # VMEM kernel: two blocks of 128 per call
+
+    @classmethod
+    def default_chunk(cls, engine_kind: str) -> int:
+        """Single source of the per-engine chunk default (bench.py uses it)."""
+        return {
+            "fast": cls.DEFAULT_CHUNK_FAST,
+            "pallas": cls.DEFAULT_CHUNK_PALLAS,
+        }.get(engine_kind, cls.DEFAULT_CHUNK)
 
     def run(
         self,
@@ -229,9 +257,7 @@ class SweepRunner:
 
         self._guard_fastpath_overrides(overrides)
         n_dev = len(self.mesh.devices.flat) if self.mesh is not None else 1
-        default = (
-            self.DEFAULT_CHUNK_FAST if self.engine_kind == "fast" else self.DEFAULT_CHUNK
-        )
+        default = self.default_chunk(self.engine_kind)
         chunk = chunk_size or min(default * n_dev, n_scenarios)
         chunk = max(n_dev, (chunk // n_dev) * n_dev)
 

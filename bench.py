@@ -93,11 +93,7 @@ def run_measurement() -> None:
     from asyncflow_tpu.parallel.sweep import SweepRunner
 
     runner = SweepRunner(payload)
-    default = (
-        SweepRunner.DEFAULT_CHUNK_FAST
-        if runner.engine_kind == "fast"
-        else SweepRunner.DEFAULT_CHUNK
-    )
+    default = SweepRunner.default_chunk(runner.engine_kind)
     chunk = min(int(os.environ.get("BENCH_CHUNK", str(default))), n_scenarios)
     # warm-up compile at the exact chunk shape the measured run uses
     runner.run(chunk, seed=SEED, chunk_size=chunk)

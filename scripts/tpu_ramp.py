@@ -1,0 +1,118 @@
+"""Gentle TPU benchmark ramp for the tunneled worker.
+
+The axon-tunneled TPU worker wedges when a kernel overruns its ~60 s budget
+(and a wedged worker hangs backend init for every process on the machine).
+This script approaches the north-star sweep carefully:
+
+1. health probe (tiny matmul),
+2. compile + run the fast path at the 600 s-horizon benchmark shape with a
+   tiny chunk, timing compile and warm runs,
+3. grow the chunk geometrically, stopping the ramp before projected
+   per-kernel time crosses ``KERNEL_BUDGET_S``,
+4. run the full 10k sweep at the chosen chunk and report scenarios/sec.
+
+Each stage logs a timestamped line to stdout *before* it starts, so a wedge
+is attributable to an exact shape.  Run it in the background and never kill
+it mid-compile: killing the client while the worker executes is the
+suspected wedge trigger.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+KERNEL_BUDGET_S = float(os.environ.get("RAMP_KERNEL_BUDGET_S", "30"))
+N_FULL = int(os.environ.get("RAMP_SCENARIOS", "10240"))
+HORIZON = int(os.environ.get("RAMP_HORIZON", "600"))
+SEED = 1234
+RAMP = (8, 32, 128, 512, 2048)
+
+
+def log(msg: str) -> None:
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def main() -> None:
+    log("importing jax")
+    import jax
+
+    log(f"backend init: {jax.devices()}")
+    import jax.numpy as jnp
+
+    t0 = time.time()
+    x = jnp.ones((512, 512))
+    (x @ x).block_until_ready()
+    log(f"matmul probe ok ({time.time() - t0:.1f}s)")
+
+    import yaml
+
+    from asyncflow_tpu.parallel.sweep import SweepRunner
+    from asyncflow_tpu.schemas.payload import SimulationPayload
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    data = yaml.safe_load(
+        open(os.path.join(repo, "examples/yaml_input/data/two_servers_lb.yml")).read(),
+    )
+    data["sim_settings"]["total_simulation_time"] = HORIZON
+    payload = SimulationPayload.model_validate(data)
+    runner = SweepRunner(payload)
+    log(f"engine: {runner.engine_kind}, horizon {HORIZON}s")
+
+    best_chunk, best_warm = None, None
+    for chunk in RAMP:
+        if best_warm is not None:
+            # project this chunk's kernel time from the last one (work is
+            # linear in chunk size; overheads only shrink the ratio)
+            projected = best_warm * (chunk / best_chunk)
+            if projected > KERNEL_BUDGET_S:
+                log(
+                    f"stop ramp: chunk {chunk} projected {projected:.1f}s "
+                    f"> budget {KERNEL_BUDGET_S:.0f}s",
+                )
+                break
+        log(f"chunk {chunk}: compiling")
+        t0 = time.time()
+        runner.run(chunk, seed=SEED, chunk_size=chunk)
+        log(f"chunk {chunk}: compile+first run {time.time() - t0:.1f}s")
+        t0 = time.time()
+        rep = runner.run(chunk, seed=SEED + 1, chunk_size=chunk)
+        warm = time.time() - t0
+        log(f"chunk {chunk}: warm {warm:.2f}s -> {chunk / warm:.1f} scen/s")
+        best_chunk, best_warm = chunk, warm
+
+    if best_chunk is None:
+        log("ramp produced no usable chunk")
+        sys.exit(1)
+
+    n_kernels = -(-N_FULL // best_chunk)
+    log(
+        f"full sweep: {N_FULL} scenarios at chunk {best_chunk} "
+        f"({n_kernels} kernels, ~{n_kernels * best_warm:.0f}s projected)",
+    )
+    t0 = time.time()
+    rep = runner.run(N_FULL, seed=SEED, chunk_size=best_chunk)
+    wall = time.time() - t0
+    s = rep.summary()
+    log(f"full sweep done: {wall:.1f}s -> {N_FULL / wall:.1f} scen/s")
+    print(
+        json.dumps(
+            {
+                "platform": jax.default_backend(),
+                "n_scenarios": N_FULL,
+                "chunk": best_chunk,
+                "wall_s": round(wall, 2),
+                "scen_per_s": round(N_FULL / wall, 2),
+                "p95_ms": round(s["latency_p95_s"] * 1e3, 3),
+                "completed_total": int(s["completed_total"]),
+                "overflow_total": int(s["overflow_total"]),
+            },
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

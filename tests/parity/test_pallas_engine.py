@@ -1,0 +1,279 @@
+"""Pallas VMEM event kernel vs the XLA event engine.
+
+The Pallas engine re-expresses the event engine's state machine as one
+VMEM-resident kernel (``engines/jaxsim/pallas_engine.py``); parity is
+distributional (independent RNG streams), so assertions compare pooled
+ensemble statistics between the two engines on the same scenario families
+the event engine itself is held to, plus conservation and capacity-cliff
+invariants.  Runs in interpreter mode on CPU (the kernel auto-selects it
+off-TPU), so horizons are kept short.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+import pytest
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.engines.jaxsim.engine import Engine, scenario_keys
+from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+pytestmark = pytest.mark.integration
+
+S = 48
+TOL = 0.08  # pooled-ensemble tolerance at ~3-4k completions per side
+
+
+def _base(horizon: float = 10.0) -> dict:
+    return {
+        "rqs_input": {
+            "id": "g",
+            "avg_active_users": {"mean": 15},
+            "avg_request_per_minute_per_user": {"mean": 30},
+            "user_sampling_window": 4,
+        },
+        "topology_graph": {
+            "nodes": {
+                "client": {"id": "c"},
+                "servers": [
+                    {
+                        "id": "s1",
+                        "server_resources": {"cpu_cores": 1, "ram_mb": 1024},
+                        "endpoints": [
+                            {
+                                "endpoint_name": "ep",
+                                "steps": [
+                                    {
+                                        "kind": "initial_parsing",
+                                        "step_operation": {"cpu_time": 0.004},
+                                    },
+                                    {
+                                        "kind": "ram",
+                                        "step_operation": {"necessary_ram": 64},
+                                    },
+                                    {
+                                        "kind": "io_wait",
+                                        "step_operation": {
+                                            "io_waiting_time": 0.02,
+                                        },
+                                    },
+                                ],
+                            },
+                        ],
+                    },
+                ],
+            },
+            "edges": [
+                {
+                    "id": "g-c",
+                    "source": "g",
+                    "target": "c",
+                    "latency": {"mean": 0.003, "distribution": "exponential"},
+                    "dropout_rate": 0.01,
+                },
+                {
+                    "id": "c-s",
+                    "source": "c",
+                    "target": "s1",
+                    "latency": {"mean": 0.003, "distribution": "exponential"},
+                },
+                {
+                    "id": "s-c",
+                    "source": "s1",
+                    "target": "c",
+                    "latency": {"mean": 0.003, "distribution": "exponential"},
+                },
+            ],
+        },
+        "sim_settings": {"total_simulation_time": horizon, "sample_period_s": 0.01},
+    }
+
+
+def _lb_payload() -> dict:
+    data = _base(horizon=8.0)
+    nodes = data["topology_graph"]["nodes"]
+    srv2 = copy.deepcopy(nodes["servers"][0])
+    srv2["id"] = "s2"
+    nodes["servers"].append(srv2)
+    nodes["load_balancer"] = {
+        "id": "lb",
+        "algorithms": "round_robin",
+        "server_covered": ["s1", "s2"],
+    }
+    data["topology_graph"]["edges"] = [
+        {
+            "id": "g-c",
+            "source": "g",
+            "target": "c",
+            "latency": {"mean": 0.003, "distribution": "exponential"},
+        },
+        {
+            "id": "c-lb",
+            "source": "c",
+            "target": "lb",
+            "latency": {"mean": 0.002, "distribution": "exponential"},
+        },
+        {
+            "id": "lb-s1",
+            "source": "lb",
+            "target": "s1",
+            "latency": {"mean": 0.002, "distribution": "exponential"},
+        },
+        {
+            "id": "lb-s2",
+            "source": "lb",
+            "target": "s2",
+            "latency": {"mean": 0.002, "distribution": "normal", "variance": 0.001},
+        },
+        {
+            "id": "s1-c",
+            "source": "s1",
+            "target": "c",
+            "latency": {"mean": 0.003, "distribution": "exponential"},
+        },
+        {
+            "id": "s2-c",
+            "source": "s2",
+            "target": "c",
+            "latency": {"mean": 0.003, "distribution": "exponential"},
+        },
+    ]
+    return data
+
+
+def _run_both(data: dict, s: int = S):
+    payload = SimulationPayload.model_validate(data)
+    plan = compile_payload(payload)
+    keys = scenario_keys(17, s)
+    ev = Engine(plan).run_batch(keys)
+    ps = PallasEngine(plan, block=32).run_batch(keys)
+    return plan, ev, ps
+
+
+def _hist_percentile(hist: np.ndarray, edges: np.ndarray, q: float) -> float:
+    c = np.cumsum(hist)
+    idx = np.searchsorted(c, q / 100 * c[-1])
+    return float(edges[min(idx + 1, len(edges) - 1)])
+
+
+def _assert_parity(ev, ps) -> None:
+    from asyncflow_tpu.engines.jaxsim.params import hist_edges
+
+    ec = int(np.asarray(ev.lat_count).sum())
+    pc = int(ps.lat_count.sum())
+    assert ec > 1000 and pc > 1000
+    # completion-rate parity (counts are MC-noisy: sqrt-n tolerance x4)
+    assert abs(ec - pc) / ec < 4.5 / np.sqrt(ec) + 0.02
+    em = float(np.asarray(ev.lat_sum).sum()) / ec
+    pm = float(ps.lat_sum.sum()) / pc
+    assert abs(em - pm) / em < TOL
+    edges = hist_edges(1024)
+    he = np.asarray(ev.hist).sum(0)
+    hp = ps.hist.sum(0)
+    for q in (50, 90, 95):
+        a = _hist_percentile(he, edges, q)
+        b = _hist_percentile(hp, edges, q)
+        assert abs(a - b) / a < TOL, f"p{q}: event={a:.5f} pallas={b:.5f}"
+
+
+def test_single_server_parity() -> None:
+    _plan, ev, ps = _run_both(_base())
+    _assert_parity(ev, ps)
+    assert int(ps.truncated.sum()) == 0
+    assert int(ps.n_overflow.sum()) == 0
+
+
+def test_lb_round_robin_parity() -> None:
+    _plan, ev, ps = _run_both(_lb_payload())
+    _assert_parity(ev, ps)
+
+
+def test_lb_least_connection_parity() -> None:
+    data = _lb_payload()
+    data["topology_graph"]["nodes"]["load_balancer"]["algorithms"] = (
+        "least_connection"
+    )
+    _plan, ev, ps = _run_both(data)
+    _assert_parity(ev, ps)
+
+
+def test_event_injection_parity() -> None:
+    data = _lb_payload()
+    data["events"] = [
+        {
+            "event_id": "spike",
+            "target_id": "lb-s1",
+            "start": {
+                "kind": "network_spike_start",
+                "t_start": 2.0,
+                "spike_s": 0.05,
+            },
+            "end": {"kind": "network_spike_end", "t_end": 6.0},
+        },
+        {
+            "event_id": "outage",
+            "target_id": "s2",
+            "start": {"kind": "server_down", "t_start": 3.0},
+            "end": {"kind": "server_up", "t_end": 5.0},
+        },
+    ]
+    _plan, ev, ps = _run_both(data)
+    _assert_parity(ev, ps)
+
+
+def test_ram_binding_parity() -> None:
+    data = _base()
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    srv["server_resources"]["ram_mb"] = 256
+    srv["endpoints"][0]["steps"][1]["step_operation"]["necessary_ram"] = 100
+    _plan, ev, ps = _run_both(data)
+    _assert_parity(ev, ps)
+
+
+def test_conservation_invariant() -> None:
+    """generated = completed + dropped + overflow + in-flight-at-horizon."""
+    _plan, _ev, ps = _run_both(_base())
+    slack = ps.n_generated - ps.lat_count - ps.n_dropped - ps.n_overflow
+    assert (slack >= 0).all()
+    # in-flight at horizon is bounded by the pool
+    assert (slack <= _plan.pool_size).all()
+
+
+def test_padding_rows_are_inert() -> None:
+    """S not a multiple of the block: padded rows must not contribute."""
+    data = _base(horizon=6.0)
+    payload = SimulationPayload.model_validate(data)
+    plan = compile_payload(payload)
+    keys = scenario_keys(5, 11)
+    ps = PallasEngine(plan, block=8).run_batch(keys)
+    assert ps.hist.shape[0] == 11
+    assert int(ps.n_generated.min()) > 0
+
+
+def test_overflow_counted_loudly() -> None:
+    """A pool too small for the offered load must count overflow, not hang."""
+    data = _base(horizon=6.0)
+    data["rqs_input"]["avg_active_users"]["mean"] = 120
+    payload = SimulationPayload.model_validate(data)
+    plan = compile_payload(payload)
+    ps = PallasEngine(plan, block=8, pool_size=2).run_batch(scenario_keys(5, 8))
+    assert int(ps.n_overflow.sum()) > 0
+    # overflowed arrivals are dropped, not simulated
+    assert (ps.n_generated >= ps.lat_count + ps.n_dropped + ps.n_overflow).all()
+
+
+def test_sweep_runner_pallas_engine() -> None:
+    """SweepRunner(engine='pallas') produces a coherent report."""
+    from asyncflow_tpu.parallel.sweep import SweepRunner
+
+    payload = SimulationPayload.model_validate(_base(horizon=6.0))
+    runner = SweepRunner(payload, engine="pallas", use_mesh=False)
+    assert runner.engine_kind == "pallas"
+    report = runner.run(12, seed=3, chunk_size=8)
+    s = report.summary()
+    assert s["completed_total"] > 100
+    assert s["overflow_total"] == 0
+    assert np.isfinite(s["latency_p95_s"])
