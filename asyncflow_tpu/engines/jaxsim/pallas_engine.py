@@ -1110,6 +1110,9 @@ class PallasEngine:
             ed,
             *[jnp.asarray(arr) for _, arr in self._tables],
         )
+        # _kernel binds the traced table refs to self._tk for its helpers;
+        # drop them after the call so no tracer outlives its trace
+        self._tk = {}
         hist = np.asarray(hist[:s])
         thr = np.asarray(thr[:s])
         momf = np.asarray(momf[:s])

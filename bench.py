@@ -26,14 +26,19 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# On an accelerator the sweep directly targets the north star (10k-scenario
-# sweep, BASELINE.md); the CPU fallback uses a size that finishes inside the
-# watchdog on one core.
+# On an accelerator the sweep targets the north star (10k-scenario sweep,
+# BASELINE.md) but adapts the measured size to the wall budget from a
+# calibration run, so one healthy-worker shot always produces a number.
+# The CPU fallback uses a size that finishes inside the watchdog on one core.
 N_ACCEL = int(os.environ.get("BENCH_SCENARIOS", "10240"))
 N_CPU = int(os.environ.get("BENCH_SCENARIOS_CPU", "2048"))
 HORIZON = int(os.environ.get("BENCH_HORIZON", "600"))
 SEED = 1234
-WATCHDOG_S = int(os.environ.get("BENCH_WATCHDOG_S", "900"))
+WATCHDOG_S = int(os.environ.get("BENCH_WATCHDOG_S", "1200"))
+# wall budget for the measured sweep itself (excludes compile/calibration)
+MEASURE_BUDGET_S = float(os.environ.get("BENCH_MEASURE_BUDGET_S", "420"))
+# per-kernel ceiling: the tunneled worker kills kernels past ~60 s
+KERNEL_BUDGET_S = float(os.environ.get("BENCH_KERNEL_BUDGET_S", "25"))
 
 
 def _payload():
@@ -93,10 +98,43 @@ def run_measurement() -> None:
     from asyncflow_tpu.parallel.sweep import SweepRunner
 
     runner = SweepRunner(payload)
+    on_accel = jax.default_backend() != "cpu"
     default = SweepRunner.default_chunk(runner.engine_kind)
     chunk = min(int(os.environ.get("BENCH_CHUNK", str(default))), n_scenarios)
-    # warm-up compile at the exact chunk shape the measured run uses
-    runner.run(chunk, seed=SEED, chunk_size=chunk)
+    if on_accel:
+        # Gentle ramp: compile + calibrate at a small chunk first so a slow
+        # shape can never wedge the worker with a >60 s kernel, then step up
+        # while the projected per-kernel time stays under budget.  An
+        # explicit BENCH_CHUNK is honored exactly (no ramp past it).
+        chunk_cap = int(os.environ.get("BENCH_CHUNK", "2048"))
+        chunk = min(chunk, chunk_cap, 128)
+        runner.run(chunk, seed=SEED, chunk_size=chunk)  # compile
+        t0 = time.time()
+        runner.run(chunk, seed=SEED + 1, chunk_size=chunk)
+        warm = time.time() - t0
+        print(f"calibration: chunk {chunk} warm {warm:.2f}s", file=sys.stderr)
+        while (
+            chunk * 4 <= min(n_scenarios, chunk_cap)
+            and warm * 4 < KERNEL_BUDGET_S
+        ):
+            chunk *= 4
+            runner.run(chunk, seed=SEED, chunk_size=chunk)  # compile
+            t0 = time.time()
+            runner.run(chunk, seed=SEED + 1, chunk_size=chunk)
+            warm = time.time() - t0
+            print(f"calibration: chunk {chunk} warm {warm:.2f}s", file=sys.stderr)
+        rate = chunk / max(warm, 1e-9)
+        n_budget = max(chunk, int(rate * MEASURE_BUDGET_S) // chunk * chunk)
+        if n_budget < n_scenarios:
+            print(
+                f"measured sweep capped at {n_budget} scenarios to fit the "
+                f"{MEASURE_BUDGET_S:.0f}s budget (rate ~{rate:.1f} scen/s)",
+                file=sys.stderr,
+            )
+            n_scenarios = n_budget
+    else:
+        # warm-up compile at the exact chunk shape the measured run uses
+        runner.run(chunk, seed=SEED, chunk_size=chunk)
     report = runner.run(n_scenarios, seed=SEED, chunk_size=chunk)
     summary = report.summary()
 
@@ -119,6 +157,7 @@ def run_measurement() -> None:
                 "detail": {
                     "engine": runner.engine_kind,
                     "platform": jax.default_backend(),
+                    "chunk": chunk,
                     "oracle_wall_s_per_scenario": round(oracle_wall, 3),
                     "native_oracle_wall_s_per_scenario": (
                         round(native_wall, 4) if native_wall is not None else None
