@@ -914,12 +914,15 @@ class PallasEngine:
             "n_overflow": col(0, jnp.int32),
         }
         st = self._advance_arrival(st, rng, jnp.int32(0), lam_tab, col(True, jnp.bool_))
+        # cached pool argmin (the single pool scan per iteration, refreshed
+        # at the end of each body after every branch — same discipline as
+        # engine.py's _refresh_pool_min)
+        st["nxt_i"], st["nxt_t"] = _argmin_row(st["req_t"])
 
         keys = sorted(st.keys())
         ntl = len(plan.timeline_times)
 
         def next_times(sd):
-            _i, t_pool = _argmin_row(sd["req_t"])
             if ntl > 0:
                 ptr = jnp.clip(sd["tl_ptr"], 0, ntl - 1)
                 t_tl = jnp.where(
@@ -928,8 +931,8 @@ class PallasEngine:
                     np.float32(INF),
                 )
             else:
-                t_tl = jnp.full_like(t_pool, np.float32(INF))
-            return _i, t_pool, sd["next_arrival"], t_tl
+                t_tl = jnp.full_like(sd["nxt_t"], np.float32(INF))
+            return sd["nxt_i"], sd["nxt_t"], sd["next_arrival"], t_tl
 
         def cond(carry):
             it = carry[0]
@@ -965,6 +968,7 @@ class PallasEngine:
             sd = self._seg_end_branch(
                 sd, i, now, rng, it, ov_tabs, is_pool & (ev == EV_SEG_END),
             )
+            sd["nxt_i"], sd["nxt_t"] = _argmin_row(sd["req_t"])
             return (it + 1, *[sd[k] for k in keys])
 
         final = jax.lax.while_loop(cond, body, (jnp.int32(1), *[st[k] for k in keys]))
@@ -1101,18 +1105,21 @@ class PallasEngine:
             )
             self._compiled[sig] = jax.jit(call)
 
-        hist, thr, momf, momi, trunc = self._compiled[sig](
-            k0,
-            k1,
-            lam,
-            em,
-            evr,
-            ed,
-            *[jnp.asarray(arr) for _, arr in self._tables],
-        )
-        # _kernel binds the traced table refs to self._tk for its helpers;
-        # drop them after the call so no tracer outlives its trace
-        self._tk = {}
+        try:
+            hist, thr, momf, momi, trunc = self._compiled[sig](
+                k0,
+                k1,
+                lam,
+                em,
+                evr,
+                ed,
+                *[jnp.asarray(arr) for _, arr in self._tables],
+            )
+        finally:
+            # _kernel binds the traced table refs to self._tk for its
+            # helpers; drop them even when tracing/compilation fails so no
+            # tracer outlives its trace
+            self._tk = {}
         hist = np.asarray(hist[:s])
         thr = np.asarray(thr[:s])
         momf = np.asarray(momf[:s])

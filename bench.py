@@ -99,30 +99,32 @@ def run_measurement() -> None:
 
     runner = SweepRunner(payload)
     on_accel = jax.default_backend() != "cpu"
+    env_chunk = os.environ.get("BENCH_CHUNK")
     default = SweepRunner.default_chunk(runner.engine_kind)
-    chunk = min(int(os.environ.get("BENCH_CHUNK", str(default))), n_scenarios)
+    chunk = min(int(env_chunk) if env_chunk else default, n_scenarios)
     if on_accel:
         # Gentle ramp: compile + calibrate at a small chunk first so a slow
         # shape can never wedge the worker with a >60 s kernel, then step up
         # while the projected per-kernel time stays under budget.  An
-        # explicit BENCH_CHUNK is honored exactly (no ramp past it).
-        chunk_cap = int(os.environ.get("BENCH_CHUNK", "2048"))
-        chunk = min(chunk, chunk_cap, 128)
-        runner.run(chunk, seed=SEED, chunk_size=chunk)  # compile
-        t0 = time.time()
-        runner.run(chunk, seed=SEED + 1, chunk_size=chunk)
-        warm = time.time() - t0
-        print(f"calibration: chunk {chunk} warm {warm:.2f}s", file=sys.stderr)
-        while (
-            chunk * 4 <= min(n_scenarios, chunk_cap)
-            and warm * 4 < KERNEL_BUDGET_S
-        ):
-            chunk *= 4
-            runner.run(chunk, seed=SEED, chunk_size=chunk)  # compile
+        # explicit BENCH_CHUNK caps the ramp and is itself reachable.
+        cap = min(int(env_chunk) if env_chunk else 2048, n_scenarios)
+
+        def calibrate(c: int) -> float:
+            runner.run(c, seed=SEED, chunk_size=c)  # compile
             t0 = time.time()
-            runner.run(chunk, seed=SEED + 1, chunk_size=chunk)
+            runner.run(c, seed=SEED + 1, chunk_size=c)
             warm = time.time() - t0
-            print(f"calibration: chunk {chunk} warm {warm:.2f}s", file=sys.stderr)
+            print(f"calibration: chunk {c} warm {warm:.2f}s", file=sys.stderr)
+            return warm
+
+        chunk = min(cap, 128)
+        warm = calibrate(chunk)
+        while chunk < cap:
+            nxt = min(chunk * 4, cap)
+            if warm * (nxt / chunk) >= KERNEL_BUDGET_S:
+                break
+            chunk = nxt
+            warm = calibrate(chunk)
         rate = chunk / max(warm, 1e-9)
         n_budget = max(chunk, int(rate * MEASURE_BUDGET_S) // chunk * chunk)
         if n_budget < n_scenarios:

@@ -1,0 +1,148 @@
+"""Monte-Carlo sweep of a plan OUTSIDE the scan fast path's eligibility.
+
+A mixed workload on a memory-tight node: the same server exposes a light
+endpoint (16 MB/request) and a heavy one whose working set is swept from
+comfortable to thrashing.  *Heterogeneous* RAM needs within one server are
+exactly what the scan fast path refuses once the non-binding proof fails
+(`compiler/plan.py` fastpath analysis: tier-2 admission requires one
+uniform need), so the binding half of this sweep exercises the general
+event state machine — on TPU via the Pallas VMEM-resident kernel
+(`docs/internals/pallas-engine.md`), off TPU via the XLA event engine (or
+the Pallas interpreter with --pallas).
+
+The engine column shows the eligibility seam live: comfortable memory
+points carry a non-binding proof and ride the scan engine; binding points
+fall through to the event machine, whose strict-FIFO admission grants
+(reference semantics: RAM-first acquire,
+/root/reference/src/asyncflow/runtime/actors/server.py:147-149) produce
+the p95 cliff the proof would otherwise have had to assume away.
+
+Usage:  python examples/sweeps/mixed_fleet_sweep.py [n_scenarios] [--cpu]
+        [--pallas]
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import numpy as np
+
+if "--cpu" in sys.argv:
+    sys.argv.remove("--cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+FORCE_PALLAS = "--pallas" in sys.argv
+if FORCE_PALLAS:
+    sys.argv.remove("--pallas")
+
+from asyncflow_tpu.builder import AsyncFlow
+from asyncflow_tpu.components import (
+    Client,
+    Edge,
+    Endpoint,
+    LoadBalancer,
+    Server,
+    ServerResources,
+    Step,
+)
+from asyncflow_tpu.parallel import SweepRunner
+from asyncflow_tpu.settings import SimulationSettings
+from asyncflow_tpu.workload import RVConfig, RqsGenerator
+
+
+def build_payload(heavy_need_mb: float, horizon: int = 30):
+    """gen -> client -> LB(least_connection) -> {big, small} -> client.
+
+    The small node serves a light endpoint and a heavy one; its 1 GB of RAM
+    admits ``1024 // heavy_need_mb`` concurrent heavy requests.
+    """
+
+    def endpoint(name: str, need: float, io_s: float) -> Endpoint:
+        return Endpoint(
+            endpoint_name=name,
+            steps=[
+                Step(kind="initial_parsing", step_operation={"cpu_time": 0.002}),
+                Step(kind="ram", step_operation={"necessary_ram": need}),
+                Step(kind="io_wait", step_operation={"io_waiting_time": io_s}),
+            ],
+        )
+
+    def exp(mean: float) -> RVConfig:
+        return RVConfig(mean=mean, distribution="exponential")
+
+    return (
+        AsyncFlow()
+        .add_generator(
+            RqsGenerator(
+                id="gen",
+                avg_active_users=RVConfig(mean=60),
+                avg_request_per_minute_per_user=RVConfig(mean=30),
+                user_sampling_window=10,
+            ),
+        )
+        .add_client(Client(id="client"))
+        .add_load_balancer(
+            LoadBalancer(
+                id="lb",
+                algorithms="least_connection",
+                server_covered={"big", "small"},
+            ),
+        )
+        .add_servers(
+            Server(
+                id="big",
+                server_resources=ServerResources(cpu_cores=2, ram_mb=4096),
+                endpoints=[endpoint("/work", 64.0, 0.04)],
+            ),
+            Server(
+                id="small",
+                server_resources=ServerResources(cpu_cores=1, ram_mb=1024),
+                endpoints=[
+                    endpoint("/light", 16.0, 0.02),
+                    endpoint("/heavy", heavy_need_mb, 0.12),
+                ],
+            ),
+        )
+        .add_edges(
+            Edge(id="gen-client", source="gen", target="client", latency=exp(0.003)),
+            Edge(id="client-lb", source="client", target="lb", latency=exp(0.002)),
+            Edge(id="lb-big", source="lb", target="big", latency=exp(0.02)),
+            Edge(id="lb-small", source="lb", target="small", latency=exp(0.02)),
+            Edge(id="big-client", source="big", target="client", latency=exp(0.003)),
+            Edge(id="small-client", source="small", target="client", latency=exp(0.003)),
+        )
+        .add_simulation_settings(
+            SimulationSettings(total_simulation_time=horizon, sample_period_s=0.05),
+        )
+        .build_payload()
+    )
+
+
+def main() -> None:
+    n_scenarios = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    ram_points = (24.0, 320.0, 520.0, 640.0)
+    engine = "pallas" if FORCE_PALLAS else "auto"
+
+    print(f"{'heavy (MB)':>12} {'engine':>8} {'p50 (ms)':>10} "
+          f"{'p95 (ms)':>10} {'completed':>10} {'overflow':>9}")
+    for need in ram_points:
+        payload = build_payload(need)
+        runner = SweepRunner(payload, engine=engine)
+        # 'auto' shows the eligibility seam: comfortable memory points carry
+        # a non-binding proof and ride the scan fast path; binding points
+        # fall through to the event state machine (pallas kernel on TPU)
+        report = runner.run(n_scenarios, seed=7)
+        s = report.summary()
+        print(
+            f"{need:>12.0f} {runner.engine_kind:>8} "
+            f"{report.aggregate_percentile(50) * 1e3:>10.2f} "
+            f"{report.aggregate_percentile(95) * 1e3:>10.2f} "
+            f"{s['completed_total']:>10d} {s['overflow_total']:>9d}",
+        )
+
+
+if __name__ == "__main__":
+    main()
