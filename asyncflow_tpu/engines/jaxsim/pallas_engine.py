@@ -291,8 +291,15 @@ class PallasEngine:
         pool_size: int | None = None,
         block: int = 128,
         interpret: bool | None = None,
+        mesh=None,
     ) -> None:
+        """``mesh``: an optional 1-D scenario mesh; when given, ``run_batch``
+        wraps the kernel in :func:`jax.shard_map` so each device runs the
+        kernel on its scenario shard (the kernel itself is a single-device
+        program — GSPMD cannot partition a ``pallas_call``, so the sharding
+        seam has to be explicit)."""
         self.plan = plan
+        self.mesh = mesh
         self.n_hist_bins = n_hist_bins
         self.pool = pool_size or plan.pool_size
         self.block = block
@@ -1036,8 +1043,11 @@ class PallasEngine:
         ov = overrides if overrides is not None else base_overrides(self.plan)
         s = keys.shape[0]
         ne = self.plan.n_edges
+        n_dev = len(self.mesh.devices.flat) if self.mesh is not None else 1
         blk = min(self.block, max(s, 1))
-        pad = (-s) % blk
+        # pad so every device's shard is a whole number of blocks; padded
+        # rows carry lam=0 and are inert
+        pad = (-s) % (blk * n_dev)
         sp = s + pad
 
         key_data = jax.random.key_data(keys) if jnp.issubdtype(
@@ -1064,8 +1074,9 @@ class PallasEngine:
             if self.interpret is not None
             else jax.default_backend() != "tpu"
         )
-        nblk = sp // blk
-        sig = (blk, nblk, interpret)
+        rows = sp // n_dev  # per-device rows (== sp when unsharded)
+        nblk = rows // blk
+        sig = (blk, nblk, interpret, n_dev)
         if sig not in self._compiled:
             grid = (nblk,)
 
@@ -1095,14 +1106,29 @@ class PallasEngine:
                     row_spec(1),
                 ],
                 out_shape=[
-                    jax.ShapeDtypeStruct((sp, self.n_hist_bins), jnp.int32),
-                    jax.ShapeDtypeStruct((sp, self.n_thr), jnp.int32),
-                    jax.ShapeDtypeStruct((sp, 4), jnp.float32),
-                    jax.ShapeDtypeStruct((sp, 4), jnp.int32),
-                    jax.ShapeDtypeStruct((sp, 1), jnp.int32),
+                    jax.ShapeDtypeStruct((rows, self.n_hist_bins), jnp.int32),
+                    jax.ShapeDtypeStruct((rows, self.n_thr), jnp.int32),
+                    jax.ShapeDtypeStruct((rows, 4), jnp.float32),
+                    jax.ShapeDtypeStruct((rows, 4), jnp.int32),
+                    jax.ShapeDtypeStruct((rows, 1), jnp.int32),
                 ],
                 interpret=interpret,
             )
+            if self.mesh is not None:
+                from jax.sharding import PartitionSpec
+
+                from asyncflow_tpu.parallel.mesh import SCENARIO_AXIS
+
+                row_p = PartitionSpec(SCENARIO_AXIS, None)
+                tab_p = PartitionSpec(None, None)
+                ntab = len(self._tables)
+                call = jax.shard_map(
+                    call,
+                    mesh=self.mesh,
+                    in_specs=(row_p,) * 6 + (tab_p,) * ntab,
+                    out_specs=(row_p,) * 5,
+                    check_vma=False,
+                )
             self._compiled[sig] = jax.jit(call)
 
         try:

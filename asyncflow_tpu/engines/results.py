@@ -101,18 +101,35 @@ class SweepResults:
 
     def percentile(self, q: float) -> np.ndarray:
         """Per-scenario latency percentile estimated from the histograms."""
-        counts = self.latency_hist.astype(np.float64)
-        totals = counts.sum(axis=1, keepdims=True)
-        cdf = np.cumsum(counts, axis=1) / np.maximum(totals, 1.0)
-        # linear interpolation inside the first bin whose cdf crosses q
-        idx = np.argmax(cdf >= q / 100.0, axis=1)
-        lo = self.hist_edges[idx]
-        hi = self.hist_edges[idx + 1]
-        prev = np.take_along_axis(
-            np.pad(cdf, ((0, 0), (1, 0)))[:, :-1],
-            idx[:, None],
-            axis=1,
-        )[:, 0]
-        cur = np.take_along_axis(cdf, idx[:, None], axis=1)[:, 0]
-        frac = np.where(cur > prev, (q / 100.0 - prev) / (cur - prev), 0.0)
-        return lo + frac * (hi - lo)
+        return hist_percentile(self.latency_hist, self.hist_edges, q)
+
+
+def hist_percentile(
+    counts: np.ndarray,
+    edges: np.ndarray,
+    q: float,
+) -> np.ndarray:
+    """Latency percentile from log-binned histogram counts.
+
+    ``counts`` is ``(n_bins,)`` or ``(S, n_bins)``; ``edges`` has
+    ``n_bins + 1`` entries.  Linear interpolation inside the first bin whose
+    CDF crosses ``q`` — the single percentile definition shared by the sweep
+    reports, the bench and the TPU shot scripts.
+    """
+    counts = np.asarray(counts, np.float64)
+    single = counts.ndim == 1
+    counts = np.atleast_2d(counts)
+    totals = counts.sum(axis=1, keepdims=True)
+    cdf = np.cumsum(counts, axis=1) / np.maximum(totals, 1.0)
+    idx = np.argmax(cdf >= q / 100.0, axis=1)
+    lo = edges[idx]
+    hi = edges[idx + 1]
+    prev = np.take_along_axis(
+        np.pad(cdf, ((0, 0), (1, 0)))[:, :-1],
+        idx[:, None],
+        axis=1,
+    )[:, 0]
+    cur = np.take_along_axis(cdf, idx[:, None], axis=1)[:, 0]
+    frac = np.where(cur > prev, (q / 100.0 - prev) / (cur - prev), 0.0)
+    out = lo + frac * (hi - lo)
+    return out[0] if single else out

@@ -155,11 +155,22 @@ class SweepRunner:
         n_hist_bins: int = 1024,
         use_mesh: bool = True,
         engine: str = "auto",
+        scan_inner: int | None = None,
     ) -> None:
         """``engine``: "auto" picks the scan fast path when the plan is
         eligible (orders of magnitude faster), then the Pallas event kernel
         on TPU (VMEM-resident loop; no per-iteration launch overhead), then
-        the general XLA event engine; "event"/"fast"/"pallas" force one."""
+        the general XLA event engine; "event"/"fast"/"pallas" force one.
+
+        ``scan_inner``: fast-path block size for the in-program chunk loop
+        (``FastEngine.run_batch_scanned``).  ``None`` auto-enables blocks of
+        16 on TPU — XLA-TPU compile time explodes with the vmapped batch
+        size there, while CPU compiles are flat and prefer one big vmap.
+        ``0`` disables the scanned path explicitly.  With a live multi-device
+        mesh the scanned path is unavailable (its block reshape conflicts
+        with the scenario-axis sharding); an explicit ``scan_inner`` is then
+        ignored with a warning and per-device chunk sizes should stay at a
+        compile-safe scale."""
         if engine not in ("auto", "fast", "event", "pallas"):
             msg = (
                 f"engine must be 'auto', 'fast', 'event' or 'pallas', "
@@ -168,17 +179,35 @@ class SweepRunner:
             raise ValueError(msg)
         self.payload = payload
         self.plan = compile_payload(payload, pool_size=pool_size)
+        self.mesh = scenario_mesh() if use_mesh and len(jax.devices()) > 1 else None
         if engine == "fast" or (engine == "auto" and self.plan.fastpath_ok):
             from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
 
             self.engine = FastEngine(self.plan, n_hist_bins=n_hist_bins)
             self.engine_kind = "fast"
+            if scan_inner is None:
+                scan_inner = 16 if jax.default_backend() == "tpu" else 0
+            elif scan_inner and self.mesh is not None:
+                import warnings
+
+                warnings.warn(
+                    "scan_inner is ignored with a live multi-device mesh: "
+                    "the scanned fast path cannot shard its block loop; "
+                    "keep per-device chunks at a compile-safe size instead",
+                    stacklevel=2,
+                )
+            self._scan_inner = scan_inner if self.mesh is None else 0
         elif engine == "pallas" or (
             engine == "auto" and jax.default_backend() == "tpu"
         ):
             from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
 
-            self.engine = PallasEngine(self.plan, n_hist_bins=n_hist_bins)
+            # GSPMD cannot partition a pallas_call, so the engine carries the
+            # mesh itself and wraps the kernel in shard_map: each device runs
+            # the kernel on its scenario shard.
+            self.engine = PallasEngine(
+                self.plan, n_hist_bins=n_hist_bins, mesh=self.mesh,
+            )
             self.engine_kind = "pallas"
         else:
             self.engine = Engine(
@@ -188,15 +217,6 @@ class SweepRunner:
                 n_hist_bins=n_hist_bins,
             )
             self.engine_kind = "event"
-        # The Pallas kernel is a single-device program (no GSPMD partitioning
-        # rule): sharding its operands over a mesh would run the full chunk
-        # replicated on every device.  Until a shard_map wrapper exists, the
-        # pallas engine runs unsharded; event/fast vmapped jits partition.
-        self.mesh = (
-            scenario_mesh()
-            if use_mesh and len(jax.devices()) > 1 and self.engine_kind != "pallas"
-            else None
-        )
 
     def _guard_fastpath_overrides(self, overrides: ScenarioOverrides | None) -> None:
         if self.engine_kind == "fast":
@@ -276,6 +296,7 @@ class SweepRunner:
 
         t0 = time.time()
         partials: list[SweepResults] = []
+        inflight: list[tuple[int, object]] = []
         done = 0
         while done < n_scenarios:
             take = min(chunk, n_scenarios - done)
@@ -293,12 +314,29 @@ class SweepRunner:
             )
             if self.mesh is not None:
                 keys = jax.device_put(keys, scenario_sharding(self.mesh))
-            final = self.engine.run_batch(keys, ov)
-            part = sweep_results(self.engine, final, self.payload.sim_settings)
+            if self.engine_kind == "fast" and getattr(self, "_scan_inner", 0):
+                final = self.engine.run_batch_scanned(
+                    keys, ov, inner=self._scan_inner, total=chunk,
+                )
+            else:
+                final = self.engine.run_batch(keys, ov)
             if ckpt:
+                # checkpointing persists each chunk as numpy -> sync per chunk
+                part = sweep_results(self.engine, final, self.payload.sim_settings)
                 ckpt.save(done, part)
-            partials.append(part)
+                partials.append(part)
+            else:
+                # pipeline: jax dispatch is async, so queue the device work
+                # for every chunk and convert to host arrays afterwards —
+                # device compute overlaps the host merge and (on tunneled
+                # accelerators) the per-dispatch round trip
+                partials.append(None)  # ordered placeholder
+                inflight.append((len(partials) - 1, final))
             done += take
+        for slot, final in inflight:
+            partials[slot] = sweep_results(
+                self.engine, final, self.payload.sim_settings,
+            )
         wall = time.time() - t0
 
         merged = _concat_sweeps(partials)[:n_scenarios]

@@ -1,0 +1,36 @@
+"""Persistent XLA compilation cache setup.
+
+On the tunneled TPU worker a fast-path compile costs minutes and is the
+moment most likely to wedge the worker, so every entry point that compiles
+for the accelerator (bench.py, the TPU shot scripts) shares this helper: a
+successful compile is persisted once and reused by every later process.
+"""
+
+from __future__ import annotations
+
+import os
+
+#: honored by every caller so one env var moves the cache for all of them
+ENV_VAR = "ASYNCFLOW_COMPILE_CACHE"
+_DEFAULT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    ".jax_cache",
+)
+
+
+def enable_compile_cache(path: str | None = None) -> str | None:
+    """Point jax at a persistent on-disk compilation cache.
+
+    Returns the cache directory, or ``None`` if the cache could not be
+    enabled (best-effort: benchmarks must run without it).
+    """
+    cache_dir = path or os.environ.get(ENV_VAR) or _DEFAULT
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 - cache is an optimization only
+        return None
+    return cache_dir

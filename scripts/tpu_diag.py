@@ -1,0 +1,73 @@
+"""Staged TPU diagnostic: find where the tunneled worker stalls.
+
+Each stage prints a timestamped line BEFORE it starts so a hang is
+attributable.  Run directly; safe to kill at any point.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+T0 = time.time()
+
+
+def log(msg: str) -> None:
+    print(f"[{time.time() - T0:7.1f}s] {msg}", flush=True)
+
+
+def main() -> None:
+    log("importing jax")
+    import jax
+    import jax.numpy as jnp
+
+    log(f"backend init: {jax.default_backend()} devices={jax.devices()}")
+
+    log("tiny op (1+1)")
+    x = jnp.ones((8, 128)) + 1.0
+    x.block_until_ready()
+    log("tiny op done")
+
+    log("small matmul compile+run")
+    a = jnp.ones((512, 512), jnp.bfloat16)
+    (a @ a).block_until_ready()
+    log("matmul done")
+
+    log("loading payload")
+    import yaml
+
+    from asyncflow_tpu.schemas.payload import SimulationPayload
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "yaml_input", "data", "two_servers_lb.yml",
+    )
+    data = yaml.safe_load(open(path).read())
+    data["sim_settings"]["total_simulation_time"] = int(
+        os.environ.get("DIAG_HORIZON", "600"),
+    )
+    payload = SimulationPayload.model_validate(data)
+
+    from asyncflow_tpu.parallel.sweep import SweepRunner
+
+    runner = SweepRunner(payload)
+    log(f"plan compiled; engine={runner.engine_kind}")
+
+    for chunk in (16, 128, 512, 2048):
+        log(f"chunk {chunk}: compile+first run")
+        t = time.time()
+        runner.run(chunk, seed=1, chunk_size=chunk)
+        log(f"chunk {chunk}: cold {time.time() - t:.2f}s; warm run")
+        t = time.time()
+        runner.run(chunk, seed=2, chunk_size=chunk)
+        warm = time.time() - t
+        log(f"chunk {chunk}: warm {warm:.2f}s -> {chunk / warm:.1f} scen/s")
+
+    log("diag complete")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""One-shot TPU measurement: compile + run a single chunk shape.
+
+Usage: SHOT_CHUNK=128 python scripts/tpu_shot.py
+       SHOT_CHUNK=512 SHOT_INNER=16 python scripts/tpu_shot.py   # scanned
+
+Compiles exactly one sweep-chunk shape (with the persistent compilation
+cache enabled, so a successful compile is reused by every later process),
+then reports cold/warm timings and the measured rate.  Used to map which
+shapes the tunneled worker can handle; bench.py uses the result.
+
+With SHOT_INNER set, the scanned fast path is used (an in-program
+``lax.scan`` over blocks of SHOT_INNER scenarios — the shape bench.py runs
+on accelerators), so a successful shot pre-populates the cache with the
+exact executable the benchmark needs.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+T0 = time.time()
+
+
+def log(msg: str) -> None:
+    print(f"[{time.time() - T0:7.1f}s] {msg}", flush=True)
+
+
+def main() -> None:
+    chunk = int(os.environ.get("SHOT_CHUNK", "128"))
+    horizon = int(os.environ.get("SHOT_HORIZON", "600"))
+    repeat = int(os.environ.get("SHOT_REPEAT", "2"))
+    inner = int(os.environ.get("SHOT_INNER", "0"))
+
+    import jax
+
+    from asyncflow_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    log(f"backend: {jax.default_backend()}; chunk={chunk} horizon={horizon}")
+
+    import yaml
+
+    from asyncflow_tpu.parallel.sweep import SweepRunner
+    from asyncflow_tpu.schemas.payload import SimulationPayload
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "yaml_input", "data", "two_servers_lb.yml",
+    )
+    data = yaml.safe_load(open(path).read())
+    data["sim_settings"]["total_simulation_time"] = horizon
+    payload = SimulationPayload.model_validate(data)
+    runner = SweepRunner(payload, scan_inner=inner)
+    log(
+        f"plan ready; engine={runner.engine_kind} "
+        f"scan_inner={getattr(runner, '_scan_inner', 0)}; starting cold run",
+    )
+
+    t = time.time()
+    runner.run(chunk, seed=11, chunk_size=chunk)
+    log(f"cold {time.time() - t:.1f}s")
+    for i in range(repeat):
+        t = time.time()
+        rep = runner.run(chunk, seed=12 + i, chunk_size=chunk)
+        warm = time.time() - t
+        log(
+            f"warm#{i} {warm:.2f}s -> {chunk / warm:.1f} scen/s "
+            f"(p95 {rep.summary()['latency_p95_s'] * 1e3:.1f} ms, "
+            f"completed {rep.summary()['completed_total']})",
+        )
+    log("shot complete")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""One-shot TPU measurement for the Pallas event kernel.
+
+Usage: SHOT_CHUNK=128 SHOT_HORIZON=600 python scripts/tpu_shot_pallas.py
+
+First compiled run of the VMEM-resident event kernel on real hardware:
+reports Mosaic compile time, warm per-chunk time, and scenario rate, plus a
+sanity check of the result against expectations (p95 in the tens of ms for
+the flagship LB scenario).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+T0 = time.time()
+
+
+def log(msg: str) -> None:
+    print(f"[{time.time() - T0:7.1f}s] {msg}", flush=True)
+
+
+def main() -> None:
+    chunk = int(os.environ.get("SHOT_CHUNK", "128"))
+    horizon = int(os.environ.get("SHOT_HORIZON", "600"))
+    repeat = int(os.environ.get("SHOT_REPEAT", "2"))
+    block = int(os.environ.get("SHOT_BLOCK", "128"))
+
+    import jax
+
+    from asyncflow_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    log(
+        f"backend: {jax.default_backend()}; chunk={chunk} horizon={horizon} "
+        f"block={block}",
+    )
+
+    import yaml
+
+    from asyncflow_tpu.compiler import compile_payload
+    from asyncflow_tpu.engines.jaxsim.engine import scenario_keys
+    from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
+    from asyncflow_tpu.schemas.payload import SimulationPayload
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "yaml_input", "data", "two_servers_lb.yml",
+    )
+    data = yaml.safe_load(open(path).read())
+    data["sim_settings"]["total_simulation_time"] = horizon
+    payload = SimulationPayload.model_validate(data)
+    plan = compile_payload(payload)
+    eng = PallasEngine(plan, block=block)
+    log(
+        f"plan ready; pool={plan.pool_size} max_iter={plan.max_iterations}; "
+        "starting cold run (Mosaic compile)",
+    )
+
+    keys = scenario_keys(31, chunk)
+    t = time.time()
+    st = eng.run_batch(keys)
+    log(
+        f"cold {time.time() - t:.1f}s; completed={int(st.lat_count.sum())} "
+        f"trunc={int(st.truncated.sum())} overflow={int(st.n_overflow.sum())}",
+    )
+    from asyncflow_tpu.engines.jaxsim.params import hist_edges
+    from asyncflow_tpu.engines.results import hist_percentile
+
+    for i in range(repeat):
+        keys = scenario_keys(41 + i, chunk)
+        t = time.time()
+        st = eng.run_batch(keys)
+        warm = time.time() - t
+        p95 = hist_percentile(st.hist.sum(0), hist_edges(1024), 95)
+        log(
+            f"warm#{i} {warm:.2f}s -> {chunk / warm:.1f} scen/s "
+            f"(p95 {p95 * 1e3:.1f} ms, completed {int(st.lat_count.sum())})",
+        )
+    log("shot complete")
+
+
+if __name__ == "__main__":
+    main()

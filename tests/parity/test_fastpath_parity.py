@@ -684,3 +684,36 @@ def test_fastpath_heavy_spike_flood() -> None:
     frac_fast = float(np.mean(lat_fast > 1.0))
     frac_oracle = float(np.mean(lat_oracle > 1.0))
     assert abs(frac_fast - frac_oracle) < 0.02
+
+
+def test_scanned_batch_matches_vmapped() -> None:
+    """run_batch_scanned (the TPU chunk-loop program) must reproduce
+    run_batch exactly per scenario, including tail padding and per-scenario
+    overrides."""
+    import jax
+
+    from asyncflow_tpu.engines.jaxsim.params import ScenarioOverrides, base_overrides
+
+    payload = _payload("examples/yaml_input/data/two_servers_lb.yml")
+    payload.sim_settings.total_simulation_time = 30
+    plan = compile_payload(payload)
+    assert plan.fastpath_ok, plan.fastpath_reason
+    engine = FastEngine(plan)
+    keys = scenario_keys(9, 21)  # deliberately not a multiple of inner
+    base = base_overrides(plan)
+    users = np.linspace(20.0, 60.0, 21).astype(np.float32)
+    ov = ScenarioOverrides(
+        edge_mean=base.edge_mean,
+        edge_var=base.edge_var,
+        edge_dropout=base.edge_dropout,
+        user_mean=users,
+        req_rate=base.req_rate,
+    )
+    plain = engine.run_batch(keys, ov)
+    scanned = engine.run_batch_scanned(keys, ov, inner=8, total=32)
+    for name in ("hist", "lat_count", "lat_sum", "thr", "n_generated",
+                 "n_dropped", "n_overflow"):
+        a = np.asarray(getattr(plain, name))
+        b = np.asarray(getattr(scanned, name))
+        np.testing.assert_allclose(a, b, rtol=1e-6, err_msg=name)
+    assert scanned.hist.shape[0] == 21

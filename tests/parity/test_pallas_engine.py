@@ -265,6 +265,43 @@ def test_overflow_counted_loudly() -> None:
     assert (ps.n_generated >= ps.lat_count + ps.n_dropped + ps.n_overflow).all()
 
 
+def test_mesh_sharded_matches_unsharded() -> None:
+    """shard_map over the virtual 8-device mesh must agree exactly with the
+    unsharded kernel on the same keys (same counter RNG per scenario)."""
+    from asyncflow_tpu.parallel.mesh import scenario_mesh, scenario_sharding
+
+    data = _base(horizon=6.0)
+    payload = SimulationPayload.model_validate(data)
+    plan = compile_payload(payload)
+    keys = scenario_keys(23, 32)
+    solo = PallasEngine(plan, block=4).run_batch(keys)
+
+    import jax
+
+    mesh = scenario_mesh()
+    sharded_keys = jax.device_put(keys, scenario_sharding(mesh))
+    ps = PallasEngine(plan, block=4, mesh=mesh).run_batch(sharded_keys)
+    np.testing.assert_array_equal(ps.hist, solo.hist)
+    np.testing.assert_array_equal(ps.lat_count, solo.lat_count)
+    np.testing.assert_allclose(ps.lat_sum, solo.lat_sum, rtol=1e-6)
+    np.testing.assert_array_equal(ps.n_generated, solo.n_generated)
+
+
+def test_sweep_runner_pallas_mesh() -> None:
+    """SweepRunner(engine='pallas') shards over the mesh when one is live."""
+    from asyncflow_tpu.parallel.sweep import SweepRunner
+
+    payload = SimulationPayload.model_validate(_base(horizon=6.0))
+    runner = SweepRunner(payload, engine="pallas", use_mesh=True)
+    assert runner.engine_kind == "pallas"
+    assert runner.mesh is not None
+    assert runner.engine.mesh is runner.mesh
+    report = runner.run(16, seed=3, chunk_size=16)
+    s = report.summary()
+    assert s["completed_total"] > 100
+    assert np.isfinite(s["latency_p95_s"])
+
+
 def test_sweep_runner_pallas_engine() -> None:
     """SweepRunner(engine='pallas') produces a coherent report."""
     from asyncflow_tpu.parallel.sweep import SweepRunner
