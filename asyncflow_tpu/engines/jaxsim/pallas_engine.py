@@ -1044,7 +1044,9 @@ class PallasEngine:
         s = keys.shape[0]
         ne = self.plan.n_edges
         n_dev = len(self.mesh.devices.flat) if self.mesh is not None else 1
-        blk = min(self.block, max(s, 1))
+        # block from the per-device shard, not the global batch, so a small
+        # sharded chunk doesn't pad every device up to a full global block
+        blk = min(self.block, max(-(-s // n_dev), 1))
         # pad so every device's shard is a whole number of blocks; padded
         # rows carry lam=0 and are inert
         pad = (-s) % (blk * n_dev)

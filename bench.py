@@ -185,23 +185,58 @@ def run_measurement() -> None:
     )
 
 
+def _accel_probe(env: dict) -> bool:
+    """Can a fresh process run a tiny op on the accelerator?
+
+    A wedged tunnel worker hangs backend init indefinitely; probing first
+    costs ~10 s and saves the full watchdog wait when the worker is dead.
+    """
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax, jax.numpy as jnp; "
+                "assert jax.default_backend() != 'cpu'; "
+                "(jnp.ones((4, 128)) + 1).block_until_ready(); print('ok')",
+            ],
+            env=env,
+            timeout=120,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return proc.returncode == 0 and "ok" in proc.stdout
+
+
 def main() -> None:
     if os.environ.get("BENCH_CHILD") == "1":
         run_measurement()
         return
 
     env = dict(os.environ, BENCH_CHILD="1")
-    for platform in ("default", "cpu"):
+    platforms = ("default", "cpu")
+    if not _accel_probe(dict(os.environ)):
+        print(
+            "WARNING: accelerator probe failed (wedged tunnel or no "
+            "accelerator); measuring on CPU only",
+            file=sys.stderr,
+        )
+        platforms = ("cpu",)
+
+    for platform in platforms:
         if platform == "cpu":
             env["BENCH_PLATFORM"] = "cpu"
             # a wedged accelerator tunnel can hang backend init for ANY
-            # process; disable the plugin registration for the CPU retry so
+            # process; disable the plugin registration for the CPU run so
             # the fallback cannot inherit the hang
             env["PALLAS_AXON_POOL_IPS"] = ""
-            print(
-                "WARNING: accelerator run failed or hung; retrying on CPU",
-                file=sys.stderr,
-            )
+            if len(platforms) > 1:
+                print(
+                    "WARNING: accelerator run failed or hung; retrying on CPU",
+                    file=sys.stderr,
+                )
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],

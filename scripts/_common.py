@@ -1,0 +1,32 @@
+"""Shared helpers for the TPU diagnostic scripts."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+_T0 = time.time()
+
+
+def log(msg: str) -> None:
+    """Timestamped progress line (hang attribution on the tunneled worker)."""
+    print(f"[{time.time() - _T0:7.1f}s] {msg}", flush=True)
+
+
+def load_example_payload(horizon: int):
+    """The flagship 1-LB/2-server example at the given horizon."""
+    import yaml
+
+    from asyncflow_tpu.schemas.payload import SimulationPayload
+
+    path = os.path.join(
+        REPO, "examples", "yaml_input", "data", "two_servers_lb.yml",
+    )
+    data = yaml.safe_load(open(path).read())
+    data["sim_settings"]["total_simulation_time"] = horizon
+    return SimulationPayload.model_validate(data)

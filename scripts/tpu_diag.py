@@ -7,16 +7,9 @@ attributable.  Run directly; safe to kill at any point.
 from __future__ import annotations
 
 import os
-import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-T0 = time.time()
-
-
-def log(msg: str) -> None:
-    print(f"[{time.time() - T0:7.1f}s] {msg}", flush=True)
+from _common import load_example_payload, log
 
 
 def main() -> None:
@@ -37,19 +30,7 @@ def main() -> None:
     log("matmul done")
 
     log("loading payload")
-    import yaml
-
-    from asyncflow_tpu.schemas.payload import SimulationPayload
-
-    path = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "examples", "yaml_input", "data", "two_servers_lb.yml",
-    )
-    data = yaml.safe_load(open(path).read())
-    data["sim_settings"]["total_simulation_time"] = int(
-        os.environ.get("DIAG_HORIZON", "600"),
-    )
-    payload = SimulationPayload.model_validate(data)
+    payload = load_example_payload(int(os.environ.get("DIAG_HORIZON", "600")))
 
     from asyncflow_tpu.parallel.sweep import SweepRunner
 

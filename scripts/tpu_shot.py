@@ -17,16 +17,9 @@ exact executable the benchmark needs.
 from __future__ import annotations
 
 import os
-import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-T0 = time.time()
-
-
-def log(msg: str) -> None:
-    print(f"[{time.time() - T0:7.1f}s] {msg}", flush=True)
+from _common import load_example_payload, log
 
 
 def main() -> None:
@@ -42,18 +35,9 @@ def main() -> None:
     enable_compile_cache()
     log(f"backend: {jax.default_backend()}; chunk={chunk} horizon={horizon}")
 
-    import yaml
-
     from asyncflow_tpu.parallel.sweep import SweepRunner
-    from asyncflow_tpu.schemas.payload import SimulationPayload
 
-    path = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "examples", "yaml_input", "data", "two_servers_lb.yml",
-    )
-    data = yaml.safe_load(open(path).read())
-    data["sim_settings"]["total_simulation_time"] = horizon
-    payload = SimulationPayload.model_validate(data)
+    payload = load_example_payload(horizon)
     runner = SweepRunner(payload, scan_inner=inner)
     log(
         f"plan ready; engine={runner.engine_kind} "

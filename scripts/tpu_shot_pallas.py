@@ -11,16 +11,9 @@ the flagship LB scenario).
 from __future__ import annotations
 
 import os
-import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-T0 = time.time()
-
-
-def log(msg: str) -> None:
-    print(f"[{time.time() - T0:7.1f}s] {msg}", flush=True)
+from _common import load_example_payload, log
 
 
 def main() -> None:
@@ -39,20 +32,11 @@ def main() -> None:
         f"block={block}",
     )
 
-    import yaml
-
     from asyncflow_tpu.compiler import compile_payload
     from asyncflow_tpu.engines.jaxsim.engine import scenario_keys
     from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
-    from asyncflow_tpu.schemas.payload import SimulationPayload
 
-    path = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "examples", "yaml_input", "data", "two_servers_lb.yml",
-    )
-    data = yaml.safe_load(open(path).read())
-    data["sim_settings"]["total_simulation_time"] = horizon
-    payload = SimulationPayload.model_validate(data)
+    payload = load_example_payload(horizon)
     plan = compile_payload(payload)
     eng = PallasEngine(plan, block=block)
     log(
@@ -67,6 +51,7 @@ def main() -> None:
         f"cold {time.time() - t:.1f}s; completed={int(st.lat_count.sum())} "
         f"trunc={int(st.truncated.sum())} overflow={int(st.n_overflow.sum())}",
     )
+
     from asyncflow_tpu.engines.jaxsim.params import hist_edges
     from asyncflow_tpu.engines.results import hist_percentile
 
