@@ -27,6 +27,7 @@ def main() -> None:
     horizon = int(os.environ.get("SHOT_HORIZON", "600"))
     repeat = int(os.environ.get("SHOT_REPEAT", "2"))
     inner = int(os.environ.get("SHOT_INNER", "0"))
+    engine = os.environ.get("SHOT_ENGINE", "auto")
 
     import jax
 
@@ -38,7 +39,7 @@ def main() -> None:
     from asyncflow_tpu.parallel.sweep import SweepRunner
 
     payload = load_example_payload(horizon)
-    runner = SweepRunner(payload, scan_inner=inner)
+    runner = SweepRunner(payload, engine=engine, scan_inner=inner)
     log(
         f"plan ready; engine={runner.engine_kind} "
         f"scan_inner={getattr(runner, '_scan_inner', 0)}; starting cold run",
