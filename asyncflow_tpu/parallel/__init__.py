@@ -1,12 +1,18 @@
-"""Scenario-parallel sweep execution over device meshes."""
+"""Scenario-parallel sweep execution over device meshes and process fleets."""
 
 from asyncflow_tpu.parallel.mesh import scenario_mesh, scenario_sharding
+from asyncflow_tpu.parallel.multihost import (
+    initialize_multihost,
+    run_multihost_sweep,
+)
 from asyncflow_tpu.parallel.sweep import SweepReport, SweepRunner, make_overrides
 
 __all__ = [
     "SweepReport",
     "SweepRunner",
+    "initialize_multihost",
     "make_overrides",
+    "run_multihost_sweep",
     "scenario_mesh",
     "scenario_sharding",
 ]

@@ -16,8 +16,16 @@ SCENARIO_AXIS = "scenario"
 
 
 def scenario_mesh(n_devices: int | None = None) -> Mesh:
-    """A 1-D mesh over (the first ``n_devices``) local devices."""
-    devices = jax.devices()
+    """A 1-D mesh over (the first ``n_devices``) process-local devices.
+
+    Process-local deliberately: in a multi-process runtime each process
+    sweeps its own scenario block on its own chips (ICI only), and
+    cross-process traffic is confined to the terminal all-gather in
+    ``parallel/multihost.py`` (DCN).  A global mesh here would force every
+    sweep chunk through cross-host collectives for zero benefit —
+    scenarios never communicate.
+    """
+    devices = jax.local_devices()
     if n_devices is not None:
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (SCENARIO_AXIS,))

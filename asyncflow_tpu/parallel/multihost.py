@@ -1,0 +1,222 @@
+"""Multi-host sweep execution: ICI within a slice, DCN across slices.
+
+The reference is single-process by construction
+(`/root/reference/README.md:337-339`); its roadmap's Monte-Carlo multi-run
+milestone (`/root/reference/ROADMAP.md:23-29`) is what the sweep runner
+implements, and this module is the scale-out seam: N processes (one per
+TPU host/slice) each simulate a disjoint contiguous block of the scenario
+grid on their local devices, then pool metrics with one terminal
+collective.  Scenarios never communicate, so the only cross-host traffic
+is that reduction — histograms and counters ride DCN once per sweep, a few
+MB regardless of sweep size.
+
+Design rules:
+
+- **The scenario grid is global and deterministic.**  Every process derives
+  the same `scenario_keys(seed, n)` grid and takes rows
+  ``[first_scenario, first_scenario + local_n)``; results are therefore
+  identical to a single-process sweep of ``n`` scenarios, bit-for-bit per
+  scenario, regardless of the process count.
+- **Merging is an all-gather of per-scenario rows** (not a psum of
+  pre-reduced summaries), so per-scenario accessors — percentiles, gauge
+  means, truncation flags — survive scale-out unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from asyncflow_tpu.engines.results import SweepResults
+
+__all__ = [
+    "initialize_multihost",
+    "local_block",
+    "merge_process_results",
+    "run_multihost_sweep",
+]
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> tuple[int, int]:
+    """Join (or create) a multi-process JAX runtime; returns (pid, nproc).
+
+    On TPU pods the three arguments come from the environment and may all
+    be ``None`` (jax auto-detects); on CPU/GPU fleets pass them explicitly
+    or via ``ASYNCFLOW_COORDINATOR`` / ``ASYNCFLOW_NUM_PROCESSES`` /
+    ``ASYNCFLOW_PROCESS_ID``.  A no-op returning ``(0, 1)`` when no
+    multi-process configuration is present.
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "ASYNCFLOW_COORDINATOR",
+    )
+    if num_processes is None and "ASYNCFLOW_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["ASYNCFLOW_NUM_PROCESSES"])
+    if process_id is None and "ASYNCFLOW_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["ASYNCFLOW_PROCESS_ID"])
+
+    explicit = {
+        "coordinator_address": coordinator_address,
+        "num_processes": num_processes,
+        "process_id": process_id,
+    }
+    given = [k for k, v in explicit.items() if v is not None]
+    in_pod = os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get(
+        "MEGASCALE_COORDINATOR_ADDRESS",
+    )
+    if not given and not in_pod:
+        return 0, 1
+    if given and len(given) < len(explicit):
+        # mixing explicit values with auto-detection is never meaningful
+        # (and off-pod it dies deep inside jax cluster setup with an
+        # obscure error): name the missing pieces here
+        missing = sorted(set(explicit) - set(given))
+        msg = (
+            "multi-host configuration is incomplete: "
+            f"{', '.join(given)} given but {', '.join(missing)} missing "
+            "(set all three, e.g. via ASYNCFLOW_COORDINATOR / "
+            "ASYNCFLOW_NUM_PROCESSES / ASYNCFLOW_PROCESS_ID)"
+        )
+        raise ValueError(msg)
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_index(), jax.process_count()
+
+
+def local_block(n_scenarios: int, pid: int, nproc: int) -> tuple[int, int]:
+    """(first_scenario, local_n) — contiguous split, remainder to the front.
+
+    Deterministic in (n, pid, nproc) so every process agrees on the grid
+    without communicating.
+    """
+    base, rem = divmod(n_scenarios, nproc)
+    local_n = base + (1 if pid < rem else 0)
+    first = pid * base + min(pid, rem)
+    return first, local_n
+
+
+def merge_process_results(local: SweepResults, n_scenarios: int) -> SweepResults:
+    """All-gather every process's scenario rows into the global SweepResults.
+
+    Rows are padded to the largest local block for the collective and
+    reassembled in process order (the contiguous `local_block` layout), so
+    the merged result is row-identical to a single-process sweep.  The
+    gather runs as one jax collective per field — DCN across slices, ICI
+    within — and every process returns the same full result (SPMD).
+    """
+    import jax
+    from jax.experimental import multihost_utils
+
+    nproc = jax.process_count()
+    if nproc == 1:
+        return local
+
+    pid = jax.process_index()
+    blocks = [local_block(n_scenarios, p, nproc) for p in range(nproc)]
+    max_n = max(ln for _, ln in blocks)
+
+    def pad(arr: np.ndarray) -> np.ndarray:
+        arr = np.asarray(arr)
+        if arr.shape[0] == max_n:
+            return arr
+        widths = [(0, max_n - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+        return np.pad(arr, widths)
+
+    def gather(arr: np.ndarray | None) -> np.ndarray | None:
+        if arr is None:
+            # None-ness is structural (same plan + engine everywhere), so
+            # every process skips the same fields: no collective needed
+            return None
+        stacked = multihost_utils.process_allgather(pad(arr))  # (P, max_n, ...)
+        rows = [stacked[p, :ln] for p, (_, ln) in enumerate(blocks)]
+        return np.concatenate(rows, axis=0)
+
+    assert local.completed.shape[0] == blocks[pid][1], (
+        "local results do not match this process's scenario block"
+    )
+    return SweepResults(
+        settings=local.settings,
+        completed=gather(local.completed),
+        latency_hist=gather(local.latency_hist),
+        hist_edges=local.hist_edges,
+        latency_sum=gather(local.latency_sum),
+        latency_sumsq=gather(local.latency_sumsq),
+        latency_min=gather(local.latency_min),
+        latency_max=gather(local.latency_max),
+        throughput=gather(local.throughput),
+        total_generated=gather(local.total_generated),
+        total_dropped=gather(local.total_dropped),
+        overflow_dropped=gather(local.overflow_dropped),
+        gauge_means=gather(local.gauge_means),
+        truncated=gather(local.truncated),
+    )
+
+
+def run_multihost_sweep(
+    runner,
+    n_scenarios: int,
+    *,
+    seed: int = 0,
+    overrides=None,
+    chunk_size: int | None = None,
+    checkpoint_dir: str | None = None,
+):
+    """Run ``runner``'s sweep sharded across every process, merged globally.
+
+    Each process simulates its `local_block` of the deterministic scenario
+    grid on its local devices (the runner's own mesh/chunking applies
+    within the process), then rows are all-gathered.  Returns the same
+    ``SweepReport`` a single-process ``runner.run(n_scenarios)`` would,
+    on every process.
+    """
+    import jax
+
+    from asyncflow_tpu.engines.jaxsim.params import base_overrides
+    from asyncflow_tpu.parallel.sweep import SweepReport, _slice_overrides
+
+    pid, nproc = jax.process_index(), jax.process_count()
+    if nproc > n_scenarios:
+        # symmetric error on every process (each knows n and nproc): an
+        # empty block would crash one process and deadlock the rest in the
+        # terminal collective
+        msg = (
+            f"n_scenarios={n_scenarios} < process count {nproc}: every "
+            "process needs at least one scenario"
+        )
+        raise ValueError(msg)
+    first, local_n = local_block(n_scenarios, pid, nproc)
+    local_ov = (
+        _slice_overrides(overrides, base_overrides(runner.plan), first, local_n)
+        if overrides is not None
+        else None
+    )
+    ckpt = (
+        os.path.join(checkpoint_dir, f"proc_{pid:03d}")
+        if checkpoint_dir
+        else None
+    )
+    report = runner.run(
+        local_n,
+        seed=seed,
+        overrides=local_ov,
+        chunk_size=chunk_size,
+        checkpoint_dir=ckpt,
+        first_scenario=first,
+    )
+    merged = merge_process_results(report.results, n_scenarios)
+    return SweepReport(
+        results=merged,
+        n_scenarios=n_scenarios,
+        wall_seconds=report.wall_seconds,
+        plan=runner.plan,
+    )

@@ -179,7 +179,12 @@ class SweepRunner:
             raise ValueError(msg)
         self.payload = payload
         self.plan = compile_payload(payload, pool_size=pool_size)
-        self.mesh = scenario_mesh() if use_mesh and len(jax.devices()) > 1 else None
+        # process-local like scenario_mesh itself: a multihost process with
+        # one chip must not build a 1-device mesh (it would disable the
+        # scanned fast path and force the pathological big-batch compile)
+        self.mesh = (
+            scenario_mesh() if use_mesh and len(jax.local_devices()) > 1 else None
+        )
         if engine == "fast" or (engine == "auto" and self.plan.fastpath_ok):
             from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
 
@@ -265,6 +270,7 @@ class SweepRunner:
         overrides: ScenarioOverrides | None = None,
         chunk_size: int | None = None,
         checkpoint_dir: str | None = None,
+        first_scenario: int = 0,
     ) -> SweepReport:
         """Execute the sweep, chunking to bound memory and kernel runtime.
 
@@ -272,6 +278,14 @@ class SweepRunner:
         interrupted sweep resumes from the last finished chunk (the chunk
         grid and per-scenario keys are deterministic functions of the
         arguments, so resumed results are identical to uninterrupted ones).
+
+        ``first_scenario`` offsets this run's block within the global
+        deterministic scenario grid: scenario ``first_scenario + i`` here
+        is bit-identical to scenario ``first_scenario + i`` of any other
+        run with the same seed — the multi-host seam
+        (:func:`asyncflow_tpu.parallel.multihost.run_multihost_sweep`)
+        gives each process its own block this way.  ``overrides`` stay
+        indexed by *local* row (the caller slices globally).
         """
         import time
 
@@ -289,12 +303,18 @@ class SweepRunner:
                 chunk,
                 identity=self._checkpoint_identity(overrides),
                 settings=self.payload.sim_settings,
+                first_scenario=first_scenario,
             )
             if checkpoint_dir
             else None
         )
 
         t0 = time.time()
+        # one key-grid derivation for the whole run (jax.random.split is
+        # prefix-stable in n, so slicing the full grid per chunk is
+        # bit-identical to deriving each chunk's prefix separately); n_dev-1
+        # extra rows cover the tail chunk's round-up to a device multiple
+        all_keys = scenario_keys(seed, first_scenario + n_scenarios + n_dev - 1)
         partials: list[SweepResults] = []
         inflight: list[tuple[int, object]] = []
         done = 0
@@ -306,7 +326,8 @@ class SweepRunner:
                 partials.append(cached)
                 done += take
                 continue
-            keys = scenario_keys(seed, done + take)[done : done + take]
+            lo = first_scenario + done
+            keys = all_keys[lo : lo + take]
             ov = (
                 _slice_overrides(overrides, base_overrides(self.plan), done, take)
                 if overrides
@@ -373,10 +394,16 @@ class _SweepCheckpoint:
         *,
         identity: str,
         settings,
+        first_scenario: int = 0,
     ) -> None:
         from pathlib import Path
 
-        self.dir = Path(root) / f"sweep_s{seed}_n{n_scenarios}_c{chunk}_{identity}"
+        # the grid offset is part of the chunk identity: the same local row
+        # means a different global scenario in another process's block
+        off = f"_o{first_scenario}" if first_scenario else ""
+        self.dir = (
+            Path(root) / f"sweep_s{seed}_n{n_scenarios}_c{chunk}{off}_{identity}"
+        )
         self.dir.mkdir(parents=True, exist_ok=True)
         self._settings = settings
 

@@ -46,7 +46,9 @@ def test_parallel_surface() -> None:
     assert set(parallel.__all__) == {
         "SweepReport",
         "SweepRunner",
+        "initialize_multihost",
         "make_overrides",
+        "run_multihost_sweep",
         "scenario_mesh",
         "scenario_sharding",
     }
