@@ -170,6 +170,7 @@ def run_measurement() -> None:
                     "engine": runner.engine_kind,
                     "platform": jax.default_backend(),
                     "chunk": chunk,
+                    "scan_inner": getattr(runner, "_scan_inner", 0),
                     "oracle_wall_s_per_scenario": round(oracle_wall, 3),
                     "native_oracle_wall_s_per_scenario": (
                         round(native_wall, 4) if native_wall is not None else None

@@ -1,0 +1,86 @@
+"""Multi-host Monte-Carlo sweep: one process per host, merged globally.
+
+Demonstrates `parallel/multihost.py` — the scale-out seam for sweeps
+larger than one host/slice.  Run directly, it self-spawns WORKERS local
+processes joined through a `jax.distributed` coordinator (the CPU
+rehearsal of a multi-host TPU fleet; on a real fleet each host simply
+runs the worker body with its pod-provided configuration).
+
+    python examples/sweeps/multihost_sweep.py
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+WORKERS = int(os.environ.get("WORKERS", "2"))
+SCENARIOS = int(os.environ.get("SCENARIOS", "64"))
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def worker() -> None:
+    """Body every host runs: sweep my block, receive the merged report."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # CPU rehearsal; no-op on TPU
+    sys.path.insert(0, REPO)
+
+    from asyncflow_tpu.parallel import (
+        SweepRunner,
+        initialize_multihost,
+        run_multihost_sweep,
+    )
+    from asyncflow_tpu.runtime.runner import SimulationRunner
+
+    pid, nproc = initialize_multihost()
+    payload = SimulationRunner.from_yaml(
+        os.path.join(REPO, "examples", "yaml_input", "data", "two_servers_lb.yml"),
+    ).simulation_input
+    payload.sim_settings.total_simulation_time = 60
+
+    runner = SweepRunner(payload)
+    report = run_multihost_sweep(runner, SCENARIOS, seed=7)
+    s = report.summary()
+    # every process holds the identical merged report
+    print(
+        f"[proc {pid}/{nproc}] merged: {report.n_scenarios} scenarios, "
+        f"{s['completed_total']} completions, "
+        f"p95 {s['latency_p95_s'] * 1e3:.1f} ms, "
+        f"overflow {s['overflow_total']}",
+        flush=True,
+    )
+
+
+def main() -> None:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for pid in range(WORKERS):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            PALLAS_AXON_POOL_IPS="",
+            XLA_FLAGS="--xla_force_host_platform_device_count=2",
+            ASYNCFLOW_COORDINATOR=f"127.0.0.1:{port}",
+            ASYNCFLOW_NUM_PROCESSES=str(WORKERS),
+            ASYNCFLOW_PROCESS_ID=str(pid),
+            ASYNCFLOW_MH_WORKER="1",
+        )
+        procs.append(
+            subprocess.Popen([sys.executable, os.path.abspath(__file__)], env=env),
+        )
+    rc = max(p.wait() for p in procs)
+    if rc:
+        msg = f"a worker failed with exit code {rc}"
+        raise SystemExit(msg)
+
+
+if __name__ == "__main__":
+    if os.environ.get("ASYNCFLOW_MH_WORKER") == "1":
+        worker()
+    else:
+        main()
