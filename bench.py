@@ -108,7 +108,11 @@ def run_measurement() -> None:
 
     from asyncflow_tpu.parallel.sweep import SweepRunner
 
-    runner = SweepRunner(payload)
+    scan_inner = os.environ.get("BENCH_SCAN_INNER")
+    runner = SweepRunner(
+        payload,
+        scan_inner=int(scan_inner) if scan_inner else None,
+    )
     on_accel = jax.default_backend() != "cpu"
     env_chunk = os.environ.get("BENCH_CHUNK")
     default = SweepRunner.default_chunk(runner.engine_kind)
