@@ -164,9 +164,10 @@ class SweepRunner:
 
         ``scan_inner``: fast-path block size for the in-program chunk loop
         (``FastEngine.run_batch_scanned``).  ``None`` auto-enables blocks of
-        16 on TPU — XLA-TPU compile time explodes with the vmapped batch
-        size there, while CPU compiles are flat and prefer one big vmap.
-        ``0`` disables the scanned path explicitly.  With a live multi-device
+        16: on TPU that is the only compile-safe shape (XLA-TPU compile
+        time explodes with the vmapped batch size), and on CPU the block
+        loop is ~40% faster than one big vmap at sweep shapes.  ``0``
+        disables the scanned path explicitly.  With a live multi-device
         mesh the scanned path is unavailable (its block reshape conflicts
         with the scenario-axis sharding); an explicit ``scan_inner`` is then
         ignored with a warning and per-device chunk sizes should stay at a
@@ -191,7 +192,11 @@ class SweepRunner:
             self.engine = FastEngine(self.plan, n_hist_bins=n_hist_bins)
             self.engine_kind = "fast"
             if scan_inner is None:
-                scan_inner = 16 if jax.default_backend() == "tpu" else 0
+                # default everywhere: on TPU the scanned program is the only
+                # compile-safe shape (fastpath.md §8); on CPU it measures
+                # ~40% faster than one big vmap at sweep shapes (better
+                # cache locality of per-block (16, N) working sets)
+                scan_inner = 16
             elif scan_inner and self.mesh is not None:
                 import warnings
 
