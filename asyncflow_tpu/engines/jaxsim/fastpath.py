@@ -33,9 +33,13 @@ collapses into pure array code:
    time depends on earlier visits' waits, so multi-burst plans relax to the
    fixed point (2*kb + 2 sweeps; statistically indistinguishable from the
    oracle — deviations across key ensembles span +/-2-3% at rho 0.6, the
-   same spread disjoint oracle ensembles show against each other); with one
-   burst per endpoint a single sweep is exact, reproducing the classic
-   formulation.  Servers whose RAM admission
+   same spread disjoint oracle ensembles show against each other).  The
+   fixed point is only faithful up to nominal utilization RELAX_RHO_MAX
+   (0.70): past it the merged-stream FIFO-order approximation biases
+   latency high (+28% p95 at rho 0.75, measured), so the compiler fences
+   multi-burst servers above the envelope onto the event engine
+   (docs/internals/fastpath.md §5).  With one burst per endpoint a single
+   sweep is exact at any utilization, reproducing the classic formulation.  Servers whose RAM admission
    can bind are settled by ``_ram_core_scan`` instead: one exact
    arrival-order pass over (admission slots, cores) jointly.
 5. Chained servers (app -> DB) are processed in exit-DAG topological order.

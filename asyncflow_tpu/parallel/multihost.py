@@ -141,9 +141,15 @@ def merge_process_results(local: SweepResults, n_scenarios: int) -> SweepResults
         rows = [stacked[p, :ln] for p, (_, ln) in enumerate(blocks)]
         return np.concatenate(rows, axis=0)
 
-    assert local.completed.shape[0] == blocks[pid][1], (
-        "local results do not match this process's scenario block"
-    )
+    if local.completed.shape[0] != blocks[pid][1]:
+        # correctness-critical shape invariant: a mismatched local block
+        # would be silently reassembled into a wrong global result (and a
+        # bare assert vanishes under ``python -O``)
+        msg = (
+            f"local results have {local.completed.shape[0]} scenario rows "
+            f"but process {pid}'s block is {blocks[pid][1]} rows"
+        )
+        raise ValueError(msg)
     return SweepResults(
         settings=local.settings,
         completed=gather(local.completed),
@@ -214,9 +220,20 @@ def run_multihost_sweep(
         first_scenario=first,
     )
     merged = merge_process_results(report.results, n_scenarios)
+    wall = report.wall_seconds
+    if nproc > 1:
+        # the sweep's wall time is set by the slowest process; one more tiny
+        # allgather makes wall_seconds / scenarios_per_second identical on
+        # every process (as the merged-results contract promises)
+        from jax.experimental import multihost_utils
+
+        walls = multihost_utils.process_allgather(
+            np.asarray(wall, np.float64),
+        )
+        wall = float(np.max(walls))
     return SweepReport(
         results=merged,
         n_scenarios=n_scenarios,
-        wall_seconds=report.wall_seconds,
+        wall_seconds=wall,
         plan=runner.plan,
     )

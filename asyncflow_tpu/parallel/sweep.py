@@ -258,10 +258,16 @@ class SweepRunner:
     DEFAULT_CHUNK = 64  # event engine: while-loop iterations dominate
     DEFAULT_CHUNK_FAST = 512  # scan engine: (S, N) array memory dominates
     DEFAULT_CHUNK_PALLAS = 256  # VMEM kernel: two blocks of 128 per call
+    # non-checkpoint pipelining window: how many chunks' device-resident
+    # result states may be alive at once (2-4 is enough to overlap host
+    # conversion with device compute; unbounded would grow device memory
+    # linearly with the sweep, defeating the chunking memory guarantee)
+    INFLIGHT_CHUNKS = 3
 
     @classmethod
     def default_chunk(cls, engine_kind: str) -> int:
-        """Single source of the per-engine chunk default (bench.py uses it)."""
+        """Per-engine chunk default (bench.py mirrors these in its jax-free
+        parent process — keep `bench._bench_shape` in sync)."""
         return {
             "fast": cls.DEFAULT_CHUNK_FAST,
             "pallas": cls.DEFAULT_CHUNK_PALLAS,
@@ -352,12 +358,19 @@ class SweepRunner:
                 ckpt.save(done, part)
                 partials.append(part)
             else:
-                # pipeline: jax dispatch is async, so queue the device work
-                # for every chunk and convert to host arrays afterwards —
-                # device compute overlaps the host merge and (on tunneled
-                # accelerators) the per-dispatch round trip
+                # pipeline: jax dispatch is async, so keep a small window of
+                # chunks in flight and convert the oldest to host arrays as
+                # new ones are dispatched — device compute overlaps the host
+                # merge and (on tunneled accelerators) the per-dispatch round
+                # trip, while device memory for results stays bounded by the
+                # window instead of growing with the sweep
                 partials.append(None)  # ordered placeholder
                 inflight.append((len(partials) - 1, final))
+                while len(inflight) > self.INFLIGHT_CHUNKS:
+                    slot, oldest = inflight.pop(0)
+                    partials[slot] = sweep_results(
+                        self.engine, oldest, self.payload.sim_settings,
+                    )
             done += take
         for slot, final in inflight:
             partials[slot] = sweep_results(
@@ -464,12 +477,27 @@ def _guard_overrides_against_plan(
     if overrides is None:
         return
     tier1 = len(plan.ram_slots) and bool(np.any(plan.ram_slots == -1))
-    if not tier1 and plan.lc_ring == 0:
+    if not tier1 and plan.lc_ring == 0 and plan.relax_rho == 0.0:
         return
     base = base_overrides(plan)
     base_rate = float(base.user_mean) * float(base.req_rate)
     max_rate = _sweep_max(overrides.user_mean) * _sweep_max(overrides.req_rate)
     rate_raised = max_rate > base_rate * 1.001
+    # multi-burst relaxation envelope: eligibility was proven at the base
+    # workload's utilization; a rate-scaling override moves every multi-burst
+    # server's rho proportionally and must stay inside the envelope
+    if plan.relax_rho > 0.0 and base_rate > 0:
+        from asyncflow_tpu.compiler.plan import RELAX_RHO_MAX
+
+        if plan.relax_rho * (max_rate / base_rate) > RELAX_RHO_MAX:
+            msg = (
+                "overrides scale the workload to utilization "
+                f"{plan.relax_rho * max_rate / base_rate:.2f} on a "
+                f"multi-burst server, outside the relaxation's validity "
+                f"envelope ({RELAX_RHO_MAX}); use "
+                "SweepRunner(..., engine='event') for these scenarios"
+            )
+            raise _FastpathOverrideError(msg)
     lb_mean_raised = False
     if plan.lc_ring > 0:
         # the ring bound was proven from the worst LB-edge delay: compare
@@ -482,7 +510,9 @@ def _guard_overrides_against_plan(
             if float(np.max(col)) > float(base_mean[e]) * 1.001:
                 lb_mean_raised = True
                 break
-    if rate_raised or lb_mean_raised:
+    # a rate raise only matters to the proofs that depend on the rate (a
+    # plan can reach this point with relax_rho alone, already checked above)
+    if (rate_raised and (tier1 or plan.lc_ring > 0)) or lb_mean_raised:
         if rate_raised and tier1:
             proof = "RAM non-binding proof"
         else:

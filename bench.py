@@ -10,10 +10,19 @@ workload, 600 s horizon) and prints ONE JSON line:
 architecture runs one scenario at a time; our Python oracle engine stands in
 for its SimPy loop — same algorithmic class, same machine).
 
-Robustness: the tunneled TPU worker in this environment sometimes wedges on
-long-running kernels, so the measured sweep runs in a child process with a
-watchdog; if the accelerator hangs, the benchmark reruns on CPU and reports
-the platform honestly in `detail.platform`.
+Robustness (hard-won, rounds 1-2): the tunneled TPU worker wedges on
+long/pathological XLA compiles, and a wedged worker hangs backend init for
+EVERY process.  So the benchmark
+
+1. probes the accelerator with a tiny op in a disposable subprocess;
+2. pre-warms the persistent compile cache at the exact scanned-sweep shape
+   in a second disposable subprocess with a hard kill — the measurement
+   process NEVER triggers an uncached XLA compile;
+3. writes a calibration-only result file right after the first warm chunk,
+   so even if the measured sweep later hangs, the parent emits a real
+   on-chip number instead of falling back to CPU;
+4. reports the platform honestly in `detail.platform`, plus a device-time
+   breakdown (`detail.device`) separating kernel time from tunnel RTT.
 """
 
 from __future__ import annotations
@@ -26,6 +35,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+REPO = os.path.dirname(os.path.abspath(__file__))
+
 # On an accelerator the sweep targets the north star (10k-scenario sweep,
 # BASELINE.md) but adapts the measured size to the wall budget from a
 # calibration run, so one healthy-worker shot always produces a number.
@@ -37,14 +48,13 @@ SEED = 1234
 WATCHDOG_S = int(os.environ.get("BENCH_WATCHDOG_S", "2400"))
 # wall budget for the measured sweep itself (excludes compile/calibration)
 MEASURE_BUDGET_S = float(os.environ.get("BENCH_MEASURE_BUDGET_S", "240"))
-# per-kernel ceiling: the tunneled worker kills kernels past ~60 s
-KERNEL_BUDGET_S = float(os.environ.get("BENCH_KERNEL_BUDGET_S", "25"))
-# Every distinct chunk shape costs a full XLA compile which runs on the far
-# side of the tunnel (~2 minutes measured at batch 16, unbounded at larger
-# batches) and is the riskiest moment for wedging the worker — so the
-# accelerator path compiles EXACTLY ONE shape and persists it via the shared
-# compilation cache (utils/compile_cache.py) so the next bench invocation
-# skips the compile entirely.
+# pre-warm subprocess budget: S=16-block scanned compiles took ~2 min cold
+# on the tunneled worker; anything much past that means the compile is
+# heading for the known pathological regime and must be killed
+PREWARM_WATCHDOG_S = int(os.environ.get("BENCH_PREWARM_WATCHDOG_S", "900"))
+PARTIAL_PATH = os.environ.get(
+    "BENCH_PARTIAL_PATH", os.path.join(REPO, ".bench_partial.json"),
+)
 
 
 def _payload():
@@ -53,15 +63,52 @@ def _payload():
     from asyncflow_tpu.schemas.payload import SimulationPayload
 
     path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        "examples",
-        "yaml_input",
-        "data",
-        "two_servers_lb.yml",
+        REPO, "examples", "yaml_input", "data", "two_servers_lb.yml",
     )
     data = yaml.safe_load(open(path).read())
     data["sim_settings"]["total_simulation_time"] = HORIZON
     return SimulationPayload.model_validate(data)
+
+
+def _bench_shape() -> tuple[int, int]:
+    """(chunk, scan_inner) for the sweep — single source shared by the
+    pre-warm subprocess and the measurement child so they compile and reuse
+    the SAME executable (the accelerator child uses these verbatim; only the
+    CPU fallback clamps the chunk to its smaller sweep).
+
+    Engine-aware defaults mirror ``SweepRunner.default_chunk``: 512 for the
+    scan fast path, 256 for the engines the accelerator would fall back to
+    (jax-free here on purpose — the parent process must never import jax
+    while the tunnel may be wedged)."""
+    from asyncflow_tpu.compiler import compile_payload  # numpy-only
+
+    fast = compile_payload(_payload()).fastpath_ok
+    chunk_env = os.environ.get("BENCH_CHUNK")
+    chunk = int(chunk_env) if chunk_env else (512 if fast else 256)
+    chunk = min(chunk, N_ACCEL)
+    inner_env = os.environ.get("BENCH_SCAN_INNER")
+    inner = int(inner_env) if inner_env else (16 if fast else 0)
+    return chunk, inner
+
+
+def _emit(payload: dict) -> None:
+    print(json.dumps(payload), flush=True)
+
+
+def _result_json(
+    *,
+    value: float,
+    n_scenarios: int,
+    baseline_rate: float,
+    detail: dict,
+) -> dict:
+    return {
+        "metric": f"scenarios/sec ({n_scenarios}-sweep, lb-2srv-{HORIZON}s)",
+        "value": round(value, 3),
+        "unit": "scenarios/sec",
+        "vs_baseline": round(value / baseline_rate, 2),
+        "detail": detail,
+    }
 
 
 def run_measurement() -> None:
@@ -104,42 +151,79 @@ def run_measurement() -> None:
         native_wall = None
 
     # --- batched JAX sweep -------------------------------------------------
-    import jax
-
     from asyncflow_tpu.parallel.sweep import SweepRunner
 
-    scan_inner = os.environ.get("BENCH_SCAN_INNER")
-    runner = SweepRunner(
-        payload,
-        scan_inner=int(scan_inner) if scan_inner else None,
-    )
+    chunk_cfg, inner_cfg = _bench_shape()
     on_accel = jax.default_backend() != "cpu"
-    env_chunk = os.environ.get("BENCH_CHUNK")
-    default = SweepRunner.default_chunk(runner.engine_kind)
-    chunk = min(int(env_chunk) if env_chunk else default, n_scenarios)
+    runner = SweepRunner(payload, scan_inner=inner_cfg)
     if on_accel:
-        # ONE compiled shape (see CACHE_DIR note above): compile + warm at
-        # the measurement chunk itself, then size the measured sweep so it
-        # fits the wall budget at the calibrated rate.
+        # verbatim the pre-warmed shape: the accelerator child must never
+        # compile anything the pre-warm subprocess didn't already cache
+        chunk = chunk_cfg
+        n_scenarios = max(n_scenarios, chunk)
+    else:
+        chunk = min(chunk_cfg, n_scenarios)
+
+    detail_base = {
+        "engine": runner.engine_kind,
+        "platform": jax.default_backend(),
+        "chunk": chunk,
+        "scan_inner": getattr(runner, "_scan_inner", 0),
+        "oracle_wall_s_per_scenario": round(oracle_wall, 3),
+        "native_oracle_wall_s_per_scenario": (
+            round(native_wall, 4) if native_wall is not None else None
+        ),
+    }
+
+    if on_accel:
+        # tunnel RTT reference: a trivially small cached op, round-tripped
+        tiny = jax.jit(lambda x: x + 1)
+        import jax.numpy as jnp
+
+        x = jnp.ones((4, 128))
+        tiny(x).block_until_ready()
+        t0 = time.time()
+        tiny(x).block_until_ready()
+        rtt = time.time() - t0
+
+        # The compile cache was pre-warmed by the parent at this exact shape,
+        # so "cold" here is cache-load + link, not a fresh XLA compile.
         t0 = time.time()
         runner.run(chunk, seed=SEED, chunk_size=chunk)
         cold = time.time() - t0
         t0 = time.time()
-        runner.run(chunk, seed=SEED + 1, chunk_size=chunk)
+        rep1 = runner.run(chunk, seed=SEED + 1, chunk_size=chunk)
         warm = time.time() - t0
+        rate = chunk / max(warm, 1e-9)
         print(
-            f"calibration: chunk {chunk} cold {cold:.1f}s warm {warm:.2f}s",
+            f"calibration: chunk {chunk} cache-cold {cold:.1f}s "
+            f"warm {warm:.2f}s ({rate:.1f} scen/s), tunnel rtt {rtt * 1e3:.0f} ms",
             file=sys.stderr,
         )
-        if warm > KERNEL_BUDGET_S:
-            print(
-                f"WARNING: warm chunk time {warm:.1f}s exceeds the "
-                f"{KERNEL_BUDGET_S:.0f}s kernel budget; the tunneled worker "
-                "may kill long kernels — proceeding at this chunk anyway "
-                "(recompiling a smaller shape is riskier than running it)",
-                file=sys.stderr,
-            )
-        rate = chunk / max(warm, 1e-9)
+
+        # calibration-only safety net: a real on-chip number survives even
+        # if the measured sweep below hangs the worker
+        summary1 = rep1.summary()
+        partial = _result_json(
+            value=rate,
+            n_scenarios=chunk,
+            baseline_rate=baseline_rate,
+            detail={
+                **detail_base,
+                "note": "calibration-only (single warm chunk)",
+                "sweep_wall_s": round(warm, 3),
+                "latency_p95_ms": round(summary1["latency_p95_s"] * 1e3, 3),
+                "completed_total": summary1["completed_total"],
+                "overflow_total": summary1["overflow_total"],
+                "device": {
+                    "tunnel_rtt_s": round(rtt, 4),
+                    "warm_chunk_wall_s": round(warm, 4),
+                },
+            },
+        )
+        with open(PARTIAL_PATH, "w") as fh:
+            json.dump(partial, fh)
+
         n_budget = max(chunk, int(rate * MEASURE_BUDGET_S) // chunk * chunk)
         if n_budget < n_scenarios:
             print(
@@ -151,6 +235,8 @@ def run_measurement() -> None:
     else:
         # warm-up compile at the exact chunk shape the measured run uses
         runner.run(chunk, seed=SEED, chunk_size=chunk)
+        warm = rtt = None
+
     report = runner.run(n_scenarios, seed=SEED, chunk_size=chunk)
     summary = report.summary()
 
@@ -161,33 +247,51 @@ def run_measurement() -> None:
         )
 
     value = report.scenarios_per_second
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"scenarios/sec ({n_scenarios}-sweep, lb-2srv-{HORIZON}s)"
-                ),
-                "value": round(value, 3),
-                "unit": "scenarios/sec",
-                "vs_baseline": round(value / baseline_rate, 2),
-                "detail": {
-                    "engine": runner.engine_kind,
-                    "platform": jax.default_backend(),
-                    "chunk": chunk,
-                    "scan_inner": getattr(runner, "_scan_inner", 0),
-                    "oracle_wall_s_per_scenario": round(oracle_wall, 3),
-                    "native_oracle_wall_s_per_scenario": (
-                        round(native_wall, 4) if native_wall is not None else None
-                    ),
-                    "sweep_wall_s": round(report.wall_seconds, 3),
-                    "latency_p95_ms": round(summary["latency_p95_s"] * 1e3, 3),
-                    "completed_total": summary["completed_total"],
-                    "overflow_total": summary["overflow_total"],
-                },
-            },
+    detail = {
+        **detail_base,
+        "sweep_wall_s": round(report.wall_seconds, 3),
+        "latency_p95_ms": round(summary["latency_p95_s"] * 1e3, 3),
+        "completed_total": summary["completed_total"],
+        "overflow_total": summary["overflow_total"],
+    }
+    if on_accel:
+        # Device-time breakdown.  One blocking dispatch costs
+        # warm_chunk_wall_s = kernel time + tunnel round trip, and the RTT
+        # of a trivially small op isolates the tunnel's share — so
+        # kernel_s_est = warm - rtt is the per-chunk device-busy estimate.
+        # The measured sweep pipelines chunks (async dispatch, bounded
+        # in-flight window); device_util_est = estimated kernel time as a
+        # share of measured wall, and rtt_overlap = how much of the
+        # blocking-dispatch overhead pipelining recovered.
+        n_chunks = max(1, -(-n_scenarios // chunk))
+        pipelined_chunk = report.wall_seconds / n_chunks
+        kernel_est = max(0.0, warm - rtt)
+        device_time_est = kernel_est * n_chunks
+        detail["device"] = {
+            "tunnel_rtt_s": round(rtt, 4),
+            "warm_chunk_wall_s": round(warm, 4),
+            "pipelined_chunk_s": round(pipelined_chunk, 4),
+            "kernel_s_est": round(kernel_est, 4),
+            "device_time_s_est": round(device_time_est, 3),
+            "wall_s": round(report.wall_seconds, 3),
+            "device_util_est": round(
+                min(1.0, device_time_est / max(report.wall_seconds, 1e-9)), 3,
+            ),
+            "rtt_overlap": round(
+                max(0.0, 1.0 - pipelined_chunk / max(warm, 1e-9)), 3,
+            ),
+        }
+    _emit(
+        _result_json(
+            value=value,
+            n_scenarios=n_scenarios,
+            baseline_rate=baseline_rate,
+            detail=detail,
         ),
-        flush=True,
     )
+    # a full result supersedes the calibration-only partial
+    if on_accel and os.path.exists(PARTIAL_PATH):
+        os.unlink(PARTIAL_PATH)
 
 
 def _accel_probe(env: dict) -> bool:
@@ -215,11 +319,56 @@ def _accel_probe(env: dict) -> bool:
     return proc.returncode == 0 and "ok" in proc.stdout
 
 
+def _prewarm(env: dict) -> bool:
+    """Compile the exact benchmark executable into the persistent cache from
+    a disposable subprocess with a hard kill.
+
+    The pathological-compile wedge (rounds 1-2) can only hit this sacrificial
+    process; the measurement child then loads the executable from the cache
+    without ever invoking the XLA compiler on an uncached shape.
+    """
+    chunk, inner = _bench_shape()
+    pre_env = dict(
+        env,
+        SHOT_CHUNK=str(chunk),
+        SHOT_INNER=str(inner),
+        SHOT_REPEAT="1",
+        SHOT_HORIZON=str(HORIZON),
+    )
+    pre_env.pop("BENCH_CHILD", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "tpu_shot.py")],
+            env=pre_env,
+            timeout=PREWARM_WATCHDOG_S,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        print(
+            f"WARNING: pre-warm compile exceeded {PREWARM_WATCHDOG_S}s and "
+            "was killed (pathological XLA-TPU compile); the worker may need "
+            "quiet time to recover",
+            file=sys.stderr,
+        )
+        return False
+    sys.stderr.write(proc.stderr[-2000:])
+    if proc.returncode != 0:
+        print(
+            f"WARNING: pre-warm subprocess failed (rc={proc.returncode})",
+            file=sys.stderr,
+        )
+        return False
+    return True
+
+
 def main() -> None:
     if os.environ.get("BENCH_CHILD") == "1":
         run_measurement()
         return
 
+    if os.path.exists(PARTIAL_PATH):
+        os.unlink(PARTIAL_PATH)
     env = dict(os.environ, BENCH_CHILD="1")
     platforms = ("default", "cpu")
     if not _accel_probe(dict(os.environ)):
@@ -228,6 +377,22 @@ def main() -> None:
             "accelerator); measuring on CPU only",
             file=sys.stderr,
         )
+        platforms = ("cpu",)
+    elif not _prewarm(dict(os.environ)):
+        # Without a successful pre-warm the measurement child would trigger
+        # the uncached XLA compile itself — the exact pathological path the
+        # pre-warm exists to absorb.  Never send it to the accelerator.
+        if _accel_probe(dict(os.environ)):
+            print(
+                "WARNING: pre-warm failed (worker alive); measuring on CPU "
+                "only — fix the pre-warm before expecting a TPU number",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                "WARNING: worker wedged during pre-warm; measuring on CPU only",
+                file=sys.stderr,
+            )
         platforms = ("cpu",)
 
     for platform in platforms:
@@ -251,12 +416,28 @@ def main() -> None:
                 text=True,
             )
         except subprocess.TimeoutExpired:
-            continue
-        if proc.returncode == 0 and proc.stdout.strip():
+            proc = None
+        if proc is not None and proc.returncode == 0 and proc.stdout.strip():
             sys.stderr.write(proc.stderr)
             print(proc.stdout.strip().splitlines()[-1])
+            if os.path.exists(PARTIAL_PATH):
+                os.unlink(PARTIAL_PATH)
             return
-        sys.stderr.write(proc.stderr)
+        if proc is not None:
+            sys.stderr.write(proc.stderr)
+        # the accelerator child died or hung mid-sweep — if it got far
+        # enough to calibrate, its on-chip number is still the result
+        if platform != "cpu" and os.path.exists(PARTIAL_PATH):
+            with open(PARTIAL_PATH) as fh:
+                partial = json.load(fh)
+            print(
+                "WARNING: measured sweep did not complete; reporting the "
+                "calibration-only on-chip result",
+                file=sys.stderr,
+            )
+            _emit(partial)
+            os.unlink(PARTIAL_PATH)
+            return
     msg = "benchmark failed on both accelerator and CPU"
     raise SystemExit(msg)
 

@@ -1,0 +1,123 @@
+"""Measure the multi-burst relaxation's accuracy envelope vs utilization.
+
+For a 2-burst endpoint (CPU 18 ms -> IO 15 ms -> CPU 12 ms -> IO 5 ms,
+one core) the fast path solves the merged visit stream by fixed-point
+relaxation; this experiment sweeps the offered load through near-critical
+utilizations and compares the fast path's pooled latency percentiles
+against the oracle — alongside an oracle-vs-oracle disjoint-ensemble
+comparison that measures the Monte-Carlo noise floor the tolerance has to
+live above.
+
+Output: one line per rho level with fast-vs-oracle and oracle-vs-oracle
+p50/p95/mean relative deviations.  Used to set RELAX_RHO_MAX in
+`asyncflow_tpu/compiler/plan.py` (documented in
+docs/internals/fastpath.md §5).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import yaml
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.engines.jaxsim.engine import scenario_keys
+from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+from asyncflow_tpu.engines.oracle.native import native_available, run_native
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+SEEDS = int(os.environ.get("ENV_SEEDS", "24"))
+HORIZON = int(os.environ.get("ENV_HORIZON", "300"))
+BASE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "..", "tests", "integration", "data", "single_server.yml",
+)
+CPU_TOTAL = 0.030  # 18 + 12 ms over two bursts
+
+
+def payload_at(users: int) -> SimulationPayload:
+    data = yaml.safe_load(open(BASE).read())
+    server = data["topology_graph"]["nodes"]["servers"][0]
+    server["endpoints"][0]["steps"] = [
+        {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.018}},
+        {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.015}},
+        {"kind": "cpu_bound_operation", "step_operation": {"cpu_time": 0.012}},
+        {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.005}},
+    ]
+    data["rqs_input"]["avg_active_users"]["mean"] = users
+    data["sim_settings"]["total_simulation_time"] = HORIZON
+    return SimulationPayload.model_validate(data)
+
+
+RELAX_SWEEPS = (
+    int(os.environ["ENV_RELAX_SWEEPS"])
+    if os.environ.get("ENV_RELAX_SWEEPS")
+    else None
+)
+USERS_LEVELS = tuple(
+    int(u) for u in os.environ.get("ENV_USERS", "60,75,85,90,94").split(",")
+)
+
+
+def fast_latencies(payload, seed0: int, n: int) -> np.ndarray:
+    plan = compile_payload(payload)
+    if not plan.fastpath_ok:
+        return None
+    engine = FastEngine(plan, collect_clocks=True, relax_sweeps=RELAX_SWEEPS)
+    final = engine.run_batch(scenario_keys(seed0, n))
+    clock = np.asarray(final.clock)
+    counts = np.asarray(final.clock_n)
+    return np.concatenate(
+        [clock[i, : counts[i], 1] - clock[i, : counts[i], 0] for i in range(n)],
+    )
+
+
+def oracle_latencies(payload, seed0: int, n: int) -> np.ndarray:
+    plan = compile_payload(payload)
+    return np.concatenate(
+        [
+            run_native(plan, seed=seed0 + s, collect_gauges=False).latencies
+            for s in range(n)
+        ],
+    )
+
+
+def devs(a: np.ndarray, b: np.ndarray) -> dict:
+    out = {}
+    for q in (50, 95):
+        pa, pb = np.percentile(a, q), np.percentile(b, q)
+        out[f"p{q}"] = (pa - pb) / pb
+    out["mean"] = (a.mean() - b.mean()) / b.mean()
+    return out
+
+
+def main() -> None:
+    assert native_available()
+    for users in USERS_LEVELS:
+        rate = users * 20.0 / 60.0
+        rho = rate * CPU_TOTAL
+        p = payload_at(users)
+        fast = fast_latencies(p, 11, SEEDS)
+        ora = oracle_latencies(p, 0, SEEDS)
+        ora2 = oracle_latencies(p, 1000, SEEDS)
+        if fast is None:
+            print(f"users={users} rho={rho:.2f}: fast path ineligible")
+            continue
+        fo = devs(fast, ora)
+        oo = devs(ora2, ora)
+        print(
+            f"users={users} rho={rho:.3f} "
+            f"fast-vs-oracle p50 {fo['p50']:+.3f} p95 {fo['p95']:+.3f} "
+            f"mean {fo['mean']:+.3f} | oracle-noise p50 {oo['p50']:+.3f} "
+            f"p95 {oo['p95']:+.3f} mean {oo['mean']:+.3f}",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Background watcher: probe the tunneled TPU worker until it recovers, then
+# run the measurement ladder (scripts/tpu_session.sh) exactly once.
+#
+# A wedged worker needs every client killed and minutes of quiet to recover,
+# so the probe itself is a short-lived subprocess under a hard timeout and
+# probes are spaced well apart.  Append-only log; safe to tail.
+
+set -u
+cd "$(dirname "$0")/.."
+LOG="${TPU_WATCH_LOG:-tpu_watch.log}"
+
+PROBE='import jax, jax.numpy as jnp; assert jax.default_backend()!="cpu"; (jnp.ones((4,128))+1).block_until_ready(); print("PROBE_OK")'
+
+attempt=0
+while true; do
+    attempt=$((attempt + 1))
+    if timeout 90 python -c "$PROBE" 2>/dev/null | grep -q PROBE_OK; then
+        echo "$(date +%H:%M:%S) probe $attempt: WORKER ALIVE — starting session" >> "$LOG"
+        bash scripts/tpu_session.sh >> "$LOG" 2>&1
+        echo "$(date +%H:%M:%S) session finished (rc=$?)" >> "$LOG"
+        exit 0
+    fi
+    echo "$(date +%H:%M:%S) probe $attempt: wedged" >> "$LOG"
+    sleep 240
+done

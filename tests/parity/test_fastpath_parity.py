@@ -218,6 +218,42 @@ class TestEligibility:
         assert plan.fastpath_ok, plan.fastpath_reason
         assert plan.max_bursts == 2
 
+    def test_multi_burst_outside_envelope_falls_back(self) -> None:
+        """Multi-burst past the measured relaxation envelope (rho > 0.70)
+        must route to the event engine — the fixed point is biased high
+        (+28% p95 at rho 0.75, scripts/relaxation_envelope.py), far outside
+        the ±2% parity target.  Single-burst stays eligible at any rho."""
+
+        def mutate(data: dict) -> None:
+            server = data["topology_graph"]["nodes"]["servers"][0]
+            server["endpoints"][0]["steps"] = [
+                {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.018}},
+                {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.015}},
+                {"kind": "cpu_bound_operation", "step_operation": {"cpu_time": 0.012}},
+            ]
+            data["rqs_input"]["avg_active_users"]["mean"] = 80  # rho ~ 0.8
+
+        plan = compile_payload(_payload(BASE, mutate))
+        assert not plan.fastpath_ok
+        assert "validity envelope" in plan.fastpath_reason
+
+        from asyncflow_tpu.parallel.sweep import SweepRunner
+
+        runner = SweepRunner(_payload(BASE, mutate), use_mesh=False)
+        assert runner.engine_kind == "event"
+
+        # the same load on a SINGLE-burst endpoint stays on the fast path
+        # (no relaxation involved: Lindley waits are exact per scenario)
+        def single(data: dict) -> None:
+            server = data["topology_graph"]["nodes"]["servers"][0]
+            server["endpoints"][0]["steps"] = [
+                {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.030}},
+                {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.015}},
+            ]
+            data["rqs_input"]["avg_active_users"]["mean"] = 80
+
+        assert compile_payload(_payload(BASE, single)).fastpath_ok
+
     def test_binding_homogeneous_ram_is_modeled(self) -> None:
         def mutate(data: dict) -> None:
             server = data["topology_graph"]["nodes"]["servers"][0]
@@ -525,6 +561,35 @@ def test_fastpath_multi_burst_contended() -> None:
     assert plan.fastpath_ok, plan.fastpath_reason
     assert plan.max_bursts == 2
     _assert_parity(_fast_latencies(payload, SEEDS), _oracle_latencies(payload, SEEDS), 0.05)
+
+
+def test_fastpath_multi_burst_envelope_boundary() -> None:
+    """Multi-burst at the TOP of the relaxation's validity envelope
+    (rho ~ 0.70, the highest utilization the compiler still routes to the
+    fast path): parity must hold within the measured noise band.
+
+    Measured at these settings (scripts/relaxation_envelope.py, 24-seed
+    ensembles): fast-vs-oracle p95 -4.7%, mean -3.4%; disjoint
+    oracle-vs-oracle ensembles differ by up to 13% p95 — the tolerance
+    covers relaxation bias + residual seed noise at this utilization."""
+
+    def mutate(data: dict) -> None:
+        server = data["topology_graph"]["nodes"]["servers"][0]
+        server["endpoints"][0]["steps"] = [
+            {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.018}},
+            {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.015}},
+            {"kind": "cpu_bound_operation", "step_operation": {"cpu_time": 0.012}},
+            {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.005}},
+        ]
+        data["rqs_input"]["avg_active_users"]["mean"] = 70  # rho ~ 0.70
+        data["sim_settings"]["total_simulation_time"] = 300
+
+    payload = _payload(BASE, mutate)
+    plan = compile_payload(payload)
+    assert plan.fastpath_ok, plan.fastpath_reason
+    _assert_parity(
+        _fast_latencies(payload, SEEDS), _oracle_latencies(payload, SEEDS), 0.15,
+    )
 
 
 def test_fastpath_io_first_endpoint() -> None:

@@ -95,7 +95,13 @@ def test_two_process_sweep_matches_single(tmp_path) -> None:
     payload = SimulationRunner.from_yaml(
         os.path.join(repo, "tests", "integration", "data", "single_server.yml"),
     ).simulation_input
-    ref = SweepRunner(payload, use_mesh=False).run(11, seed=21, chunk_size=4)
+    # scan_inner=0 matches the workers' execution shape: with a live mesh the
+    # scanned fast path is disabled, so the workers run the plain vmapped
+    # program — exact equality across differently-compiled programs is only
+    # reasonable when both sides trace the same vmapped computation
+    ref = SweepRunner(payload, use_mesh=False, scan_inner=0).run(
+        11, seed=21, chunk_size=4,
+    )
 
     for out in outs:
         with np.load(out) as data:

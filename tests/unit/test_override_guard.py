@@ -1,0 +1,61 @@
+"""Sweep override guards: compile-time fast-path proofs must survive
+per-scenario workload overrides or refuse them loudly."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import yaml
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.parallel import SweepRunner, make_overrides
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+BASE = "tests/integration/data/single_server.yml"
+
+
+def _multi_burst_payload(users: int) -> SimulationPayload:
+    data = yaml.safe_load(open(BASE).read())
+    server = data["topology_graph"]["nodes"]["servers"][0]
+    server["endpoints"][0]["steps"] = [
+        {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.018}},
+        {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.015}},
+        {"kind": "cpu_bound_operation", "step_operation": {"cpu_time": 0.012}},
+    ]
+    data["rqs_input"]["avg_active_users"]["mean"] = users
+    data["sim_settings"]["total_simulation_time"] = 60
+    return SimulationPayload.model_validate(data)
+
+
+def test_envelope_guard_blocks_rate_raising_overrides() -> None:
+    """Base rho ~ 0.5 is eligible; an override scaling users x1.6 would put
+    the multi-burst server at rho ~ 0.8 — outside the measured relaxation
+    envelope — and must be refused, not silently simulated with bias."""
+    payload = _multi_burst_payload(50)  # rho = 50*20/60*0.03 = 0.50
+    plan = compile_payload(payload)
+    assert plan.fastpath_ok, plan.fastpath_reason
+    assert plan.relax_rho == pytest.approx(0.50, abs=0.01)
+
+    runner = SweepRunner(payload, use_mesh=False)
+    n = 4
+    bad = make_overrides(runner.plan, n, user_mean=np.full(n, 80.0))
+    with pytest.raises(ValueError, match="validity envelope"):
+        runner.run(n, seed=0, overrides=bad, chunk_size=n)
+
+
+def test_envelope_guard_allows_inside_envelope_overrides() -> None:
+    payload = _multi_burst_payload(50)
+    runner = SweepRunner(payload, use_mesh=False)
+    n = 4
+    ok = make_overrides(runner.plan, n, user_mean=np.full(n, 65.0))  # rho 0.65
+    report = runner.run(n, seed=0, overrides=ok, chunk_size=n)
+    assert report.summary()["completed_total"] > 0
+
+
+def test_envelope_guard_ignores_rate_lowering_overrides() -> None:
+    payload = _multi_burst_payload(60)
+    runner = SweepRunner(payload, use_mesh=False)
+    n = 4
+    down = make_overrides(runner.plan, n, user_mean=np.full(n, 20.0))
+    report = runner.run(n, seed=0, overrides=down, chunk_size=n)
+    assert report.summary()["completed_total"] > 0
