@@ -976,11 +976,32 @@ def sweep_results(
     engine: Engine,
     final: EngineState,
     settings=None,
+    gauge_sel: np.ndarray | None = None,
 ) -> SweepResults:
-    """Reduce a batched final state to host-side SweepResults."""
+    """Reduce a batched final state to host-side SweepResults.
+
+    ``gauge_sel``: indices of the gauges whose streaming time series should
+    be materialized (fast path with ``gauge_series_stride``; the cumsum and
+    the column slice run on device so only the selected coarse series cross
+    to the host).
+    """
     from asyncflow_tpu.engines.jaxsim.params import hist_edges as _edges
 
+    gauge_series = None
+    series_period = None
+    stride = getattr(engine, "gauge_series_stride", 0)
+    if gauge_sel is not None and stride:
+        import jax.numpy as jnp
+
+        # slice the selected columns BEFORE the cumsum: only k columns are
+        # materialized, not a second full (S, T+2, n_gauges) grid
+        selected = final.gauge[:, :, np.asarray(gauge_sel)]
+        gauge_series = np.asarray(jnp.cumsum(selected, axis=1)[:, 1:-1])
+        series_period = engine.plan.sample_period * stride
+
     return SweepResults(
+        gauge_series=gauge_series,
+        gauge_series_period=series_period,
         settings=settings,
         completed=np.asarray(final.lat_count),
         latency_hist=np.asarray(final.hist),

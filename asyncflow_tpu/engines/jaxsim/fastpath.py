@@ -217,16 +217,39 @@ class FastEngine:
         n_hist_bins: int = 1024,
         max_requests: int | None = None,
         relax_sweeps: int | None = None,
+        gauge_series_stride: int = 0,
     ) -> None:
+        """``gauge_series_stride``: with ``collect_gauges=False``, a stride
+        k > 0 collects every gauge on a grid coarsened k-fold
+        (period ``sample_period * k``) — the sweep-scale streaming series:
+        device memory per scenario drops from ``n_samples`` rows to
+        ``n_samples // k``, and the value at each coarse tick is exactly the
+        fine-grid value at that time (the interval-endpoint scatter uses the
+        same tick-inclusion rule on either grid).  Ignored when the exact
+        grid is already being collected."""
         if not plan.fastpath_ok:
             msg = f"plan not eligible for the fast path: {plan.fastpath_reason}"
             raise ValueError(msg)
         if relax_sweeps is not None and relax_sweeps < 1:
             msg = f"relax_sweeps must be >= 1, got {relax_sweeps}"
             raise ValueError(msg)
+        if gauge_series_stride < 0:
+            msg = f"gauge_series_stride must be >= 0, got {gauge_series_stride}"
+            raise ValueError(msg)
         self.plan = plan
         self.collect_gauges = collect_gauges
         self.collect_clocks = collect_clocks
+        if collect_gauges:
+            self._gauge_period = plan.sample_period
+            self._gauge_samples = plan.n_samples
+        elif gauge_series_stride:
+            self._gauge_period = plan.sample_period * gauge_series_stride
+            self._gauge_samples = plan.n_samples // gauge_series_stride
+        else:
+            self._gauge_period = plan.sample_period
+            self._gauge_samples = 0
+        self._collect_gauge_grid = collect_gauges or gauge_series_stride > 0
+        self.gauge_series_stride = 0 if collect_gauges else gauge_series_stride
         self.n_hist_bins = n_hist_bins
         self.relax_sweeps = relax_sweeps
         self.n = max_requests or plan.max_requests
@@ -456,11 +479,11 @@ class FastEngine:
     # ------------------------------------------------------------------
 
     def _bucket(self, t):
-        return sample_bucket(t, self.plan.sample_period, self.plan.n_samples)
+        return sample_bucket(t, self._gauge_period, self._gauge_samples)
 
     def _gauge_intervals(self, gauge, gidx, t0, t1, amount, on):
         """Scatter +amount at enter and -amount at leave times (masked)."""
-        if not self.collect_gauges:
+        if not self._collect_gauge_grid:
             return gauge
         val = jnp.where(on, amount, 0.0)
         gauge = gauge.at[self._bucket(t0), gidx].add(val)
@@ -473,8 +496,10 @@ class FastEngine:
     def _run_one(self, key, ov: ScenarioOverrides) -> FastState:
         plan = self.plan
         n = self.n
-        n_gauge_rows = plan.n_samples + 2 if self.collect_gauges else 1
-        n_gauges = plan.n_gauges if self.collect_gauges else 1
+        n_gauge_rows = (
+            self._gauge_samples + 2 if self._collect_gauge_grid else 1
+        )
+        n_gauges = plan.n_gauges if self._collect_gauge_grid else 1
         gauge = jnp.zeros((n_gauge_rows, n_gauges), jnp.float32)
 
         t, alive, overflow = self._arrivals(jax.random.fold_in(key, 0), ov)

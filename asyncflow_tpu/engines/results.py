@@ -77,6 +77,13 @@ class SweepResults:
     #: horizon, so this scenario's results cover only part of the run (event
     #: engine only; always False on the fast path).
     truncated: np.ndarray | None = None
+    #: (S, T_g, k) per-scenario streaming gauge time series on the coarse
+    #: resample grid (fast-path sweeps with a gauge_series spec; None
+    #: otherwise).  Column j is the j-th selected gauge; the value at row i
+    #: is exactly the fine-grid gauge value at t = (i + 1) * series period.
+    gauge_series: np.ndarray | None = None
+    #: seconds between gauge_series rows (sample_period * stride).
+    gauge_series_period: float | None = None
 
     def __getitem__(self, idx) -> SweepResults:
         """Slice along the scenario axis."""
@@ -97,6 +104,10 @@ class SweepResults:
                 self.gauge_means[idx] if self.gauge_means is not None else None
             ),
             truncated=self.truncated[idx] if self.truncated is not None else None,
+            gauge_series=(
+                self.gauge_series[idx] if self.gauge_series is not None else None
+            ),
+            gauge_series_period=self.gauge_series_period,
         )
 
     def percentile(self, q: float) -> np.ndarray:

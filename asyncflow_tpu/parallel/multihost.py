@@ -165,6 +165,8 @@ def merge_process_results(local: SweepResults, n_scenarios: int) -> SweepResults
         overflow_dropped=gather(local.overflow_dropped),
         gauge_means=gather(local.gauge_means),
         truncated=gather(local.truncated),
+        gauge_series=gather(local.gauge_series),
+        gauge_series_period=local.gauge_series_period,
     )
 
 
@@ -236,4 +238,5 @@ def run_multihost_sweep(
         n_scenarios=n_scenarios,
         wall_seconds=wall,
         plan=runner.plan,
+        gauge_series_ids=getattr(runner, "_gauge_series_ids", None),
     )

@@ -66,6 +66,72 @@ def make_overrides(
     )
 
 
+def _gauge_index(plan: StaticPlan, metric: str, component_id: str) -> int:
+    """Gauge-array column of one (metric, component) pair."""
+    from asyncflow_tpu.config.constants import SampledMetricName as Metric
+
+    def server_idx() -> int:
+        if component_id not in plan.server_ids:
+            msg = f"unknown server {component_id!r}; valid: {plan.server_ids}"
+            raise ValueError(msg)
+        return plan.server_ids.index(component_id)
+
+    if metric == Metric.EDGE_CONCURRENT_CONNECTION:
+        if component_id not in plan.edge_ids:
+            msg = f"unknown edge {component_id!r}; valid: {plan.edge_ids}"
+            raise ValueError(msg)
+        return plan.gauge_edge(plan.edge_ids.index(component_id))
+    if metric == Metric.READY_QUEUE_LEN:
+        return plan.gauge_ready(server_idx())
+    if metric == Metric.EVENT_LOOP_IO_SLEEP:
+        return plan.gauge_io(server_idx())
+    if metric == Metric.RAM_IN_USE:
+        return plan.gauge_ram(server_idx())
+    msg = f"unknown sampled metric {metric!r}"
+    raise ValueError(msg)
+
+
+def _resolve_gauge_series(
+    plan: StaticPlan,
+    spec: tuple,
+) -> tuple[np.ndarray, int, list[str]]:
+    """Validate a ``(metric, component_ids, resample_s)`` spec against the
+    plan; returns (gauge column indices, grid stride, component ids)."""
+    try:
+        metric, component_ids, resample_s = spec
+    except (TypeError, ValueError):
+        msg = (
+            "gauge_series must be a (metric, component_ids, resample_s) "
+            f"tuple, got {spec!r}"
+        )
+        raise ValueError(msg) from None
+    if isinstance(component_ids, str):
+        component_ids = [component_ids]
+    component_ids = list(component_ids)
+    resample_s = float(resample_s)
+    if resample_s < plan.sample_period:
+        # a sub-sample_period resample would silently fall back to the FULL
+        # fine-grained grid per scenario — the memory blow-up this feature
+        # exists to avoid; demand an explicit, coarser-than-fine grid
+        msg = (
+            f"resample_s={resample_s} is finer than the sample period "
+            f"({plan.sample_period}s); streaming series need a coarser grid"
+        )
+        raise ValueError(msg)
+    stride = max(1, round(resample_s / plan.sample_period))
+    if plan.n_samples // stride < 1:
+        msg = (
+            f"resample_s={resample_s} leaves no grid rows inside the "
+            f"{plan.horizon}s horizon"
+        )
+        raise ValueError(msg)
+    sel = np.array(
+        [_gauge_index(plan, metric, cid) for cid in component_ids],
+        dtype=np.int64,
+    )
+    return sel, stride, component_ids
+
+
 @dataclass
 class SweepReport:
     """Host-side sweep summary with per-scenario and aggregate statistics."""
@@ -74,6 +140,8 @@ class SweepReport:
     n_scenarios: int
     wall_seconds: float
     plan: StaticPlan | None = None
+    #: component ids of gauge_series columns (the sweep's gauge_series spec)
+    gauge_series_ids: list[str] | None = None
 
     def mean_gauge(self, metric: str, component_id: str) -> np.ndarray:
         """(S,) per-scenario time-average of one gauge (fast path sweeps).
@@ -81,34 +149,36 @@ class SweepReport:
         ``metric`` is a :class:`SampledMetricName` value; ``component_id`` an
         edge id (edge concurrency) or server id (ready/io/ram).
         """
-        from asyncflow_tpu.config.constants import SampledMetricName as Metric
-
         if self.results.gauge_means is None or self.plan is None:
             msg = "per-scenario gauge means are only recorded by the fast path"
             raise ValueError(msg)
-        plan = self.plan
+        return self.results.gauge_means[:, _gauge_index(self.plan, metric, component_id)]
 
-        def server_idx() -> int:
-            if component_id not in plan.server_ids:
-                msg = f"unknown server {component_id!r}; valid: {plan.server_ids}"
-                raise ValueError(msg)
-            return plan.server_ids.index(component_id)
+    def gauge_series(self, component_id: str) -> tuple[np.ndarray, np.ndarray]:
+        """(times, (S, T) series) of one component's streaming gauge.
 
-        if metric == Metric.EDGE_CONCURRENT_CONNECTION:
-            if component_id not in plan.edge_ids:
-                msg = f"unknown edge {component_id!r}; valid: {plan.edge_ids}"
-                raise ValueError(msg)
-            idx = plan.gauge_edge(plan.edge_ids.index(component_id))
-        elif metric == Metric.READY_QUEUE_LEN:
-            idx = plan.gauge_ready(server_idx())
-        elif metric == Metric.EVENT_LOOP_IO_SLEEP:
-            idx = plan.gauge_io(server_idx())
-        elif metric == Metric.RAM_IN_USE:
-            idx = plan.gauge_ram(server_idx())
-        else:
-            msg = f"unknown sampled metric {metric!r}"
+        Requires the sweep to have been run with a ``gauge_series`` spec
+        naming ``component_id``; the metric is the spec's metric.  ``times``
+        are the coarse tick timestamps (seconds).
+        """
+        if self.results.gauge_series is None or self.gauge_series_ids is None:
+            msg = (
+                "no streaming gauge series were collected: construct "
+                "SweepRunner(..., gauge_series=(metric, component_ids, "
+                "resample_s))"
+            )
             raise ValueError(msg)
-        return self.results.gauge_means[:, idx]
+        if component_id not in self.gauge_series_ids:
+            msg = (
+                f"{component_id!r} is not in this sweep's gauge_series spec "
+                f"{self.gauge_series_ids}"
+            )
+            raise ValueError(msg)
+        col = self.gauge_series_ids.index(component_id)
+        period = self.results.gauge_series_period
+        n = self.results.gauge_series.shape[1]
+        times = (np.arange(1, n + 1) * period).astype(np.float64)
+        return times, self.results.gauge_series[:, :, col]
 
     @property
     def scenarios_per_second(self) -> float:
@@ -156,11 +226,23 @@ class SweepRunner:
         use_mesh: bool = True,
         engine: str = "auto",
         scan_inner: int | None = None,
+        gauge_series: tuple | None = None,
     ) -> None:
         """``engine``: "auto" picks the scan fast path when the plan is
         eligible (orders of magnitude faster), then the Pallas event kernel
         on TPU (VMEM-resident loop; no per-iteration launch overhead), then
         the general XLA event engine; "event"/"fast"/"pallas" force one.
+
+        ``gauge_series``: ``(metric, component_ids, resample_s)`` — collect
+        per-scenario streaming time series of the named gauge for the named
+        components, resampled to ``resample_s`` seconds (fast path only).
+        ``metric`` is a :class:`SampledMetricName` (or its string value);
+        ``component_ids`` a list of edge ids (edge concurrency) or server
+        ids (ready/io/ram).  The coarse grid is computed on device, so a
+        100k-scenario sweep streams a few hundred floats per scenario to
+        the host instead of the full fine-grained grid; the value at each
+        coarse tick is exactly the fine-grid value at that time.  Access
+        via :meth:`SweepReport.gauge_series`.
 
         ``scan_inner``: fast-path block size for the in-program chunk loop
         (``FastEngine.run_batch_scanned``).  ``None`` auto-enables blocks of
@@ -186,10 +268,21 @@ class SweepRunner:
         self.mesh = (
             scenario_mesh() if use_mesh and len(jax.local_devices()) > 1 else None
         )
+        self._gauge_sel: np.ndarray | None = None
+        self._gauge_series_ids: list[str] | None = None
+        gauge_stride = 0
+        if gauge_series is not None:
+            self._gauge_sel, gauge_stride, self._gauge_series_ids = (
+                _resolve_gauge_series(self.plan, gauge_series)
+            )
         if engine == "fast" or (engine == "auto" and self.plan.fastpath_ok):
             from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
 
-            self.engine = FastEngine(self.plan, n_hist_bins=n_hist_bins)
+            self.engine = FastEngine(
+                self.plan,
+                n_hist_bins=n_hist_bins,
+                gauge_series_stride=gauge_stride,
+            )
             self.engine_kind = "fast"
             if scan_inner is None:
                 # default everywhere: on TPU the scanned program is the only
@@ -227,6 +320,18 @@ class SweepRunner:
                 n_hist_bins=n_hist_bins,
             )
             self.engine_kind = "event"
+        if self._gauge_sel is not None and self.engine_kind != "fast":
+            msg = (
+                "gauge_series needs the fast-path engine (streaming series "
+                f"ride its interval-endpoint grid); this plan runs on "
+                f"'{self.engine_kind}'"
+                + (
+                    f" because: {self.plan.fastpath_reason}"
+                    if self.plan.fastpath_reason
+                    else ""
+                )
+            )
+            raise ValueError(msg)
 
     def _guard_fastpath_overrides(self, overrides: ScenarioOverrides | None) -> None:
         if self.engine_kind == "fast":
@@ -248,6 +353,11 @@ class SweepRunner:
         # chunks computed under different capacities must never be merged
         digest.update(str(self.plan.pool_size).encode())
         digest.update(str(self.plan.max_requests).encode())
+        # the streaming-series spec changes the per-chunk npz contents
+        if self._gauge_sel is not None:
+            digest.update(b"gauge-series")
+            digest.update(np.asarray(self._gauge_sel).tobytes())
+            digest.update(str(self.engine.gauge_series_stride).encode())
         if overrides is not None:
             for field in overrides:
                 digest.update(np.asarray(field).tobytes())
@@ -354,7 +464,12 @@ class SweepRunner:
                 final = self.engine.run_batch(keys, ov)
             if ckpt:
                 # checkpointing persists each chunk as numpy -> sync per chunk
-                part = sweep_results(self.engine, final, self.payload.sim_settings)
+                part = sweep_results(
+                    self.engine,
+                    final,
+                    self.payload.sim_settings,
+                    gauge_sel=self._gauge_sel,
+                )
                 ckpt.save(done, part)
                 partials.append(part)
             else:
@@ -369,12 +484,18 @@ class SweepRunner:
                 while len(inflight) > self.INFLIGHT_CHUNKS:
                     slot, oldest = inflight.pop(0)
                     partials[slot] = sweep_results(
-                        self.engine, oldest, self.payload.sim_settings,
+                        self.engine,
+                        oldest,
+                        self.payload.sim_settings,
+                        gauge_sel=self._gauge_sel,
                     )
             done += take
         for slot, final in inflight:
             partials[slot] = sweep_results(
-                self.engine, final, self.payload.sim_settings,
+                self.engine,
+                final,
+                self.payload.sim_settings,
+                gauge_sel=self._gauge_sel,
             )
         wall = time.time() - t0
 
@@ -384,6 +505,7 @@ class SweepRunner:
             n_scenarios=n_scenarios,
             wall_seconds=wall,
             plan=self.plan,
+            gauge_series_ids=self._gauge_series_ids,
         )
 
 
@@ -435,6 +557,9 @@ class _SweepCheckpoint:
         payload["hist_edges"] = part.hist_edges
         if part.gauge_means is not None:
             payload["gauge_means"] = part.gauge_means
+        if part.gauge_series is not None:
+            payload["gauge_series"] = part.gauge_series
+            payload["gauge_series_period"] = np.float64(part.gauge_series_period)
         if part.truncated is not None:
             payload["truncated"] = part.truncated
         # atomic write so an interrupt never leaves a half-written chunk
@@ -451,6 +576,14 @@ class _SweepCheckpoint:
                 settings=self._settings,
                 hist_edges=data["hist_edges"],
                 gauge_means=data["gauge_means"] if "gauge_means" in data else None,
+                gauge_series=(
+                    data["gauge_series"] if "gauge_series" in data else None
+                ),
+                gauge_series_period=(
+                    float(data["gauge_series_period"])
+                    if "gauge_series_period" in data
+                    else None
+                ),
                 truncated=data["truncated"] if "truncated" in data else None,
                 **{name: data[name] for name in self._ARRAY_FIELDS},
             )
@@ -573,6 +706,12 @@ def _concat_sweeps(parts: list[SweepResults]) -> SweepResults:
                 if all(p.truncated is not None for p in parts)
                 else None
             ),
+            gauge_series=(
+                np.concatenate([p.gauge_series for p in parts])
+                if all(p.gauge_series is not None for p in parts)
+                else None
+            ),
+            gauge_series_period=first.gauge_series_period,
         )
     return merged
 
