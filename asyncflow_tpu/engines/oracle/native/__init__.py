@@ -265,6 +265,10 @@ def run_native(
         sampled=sampled,
         total_generated=generated,
         total_dropped=dropped,
+        # clock-table truncation surfaced as a counter, not just a warning:
+        # sweeps (parallel/sweep.py _NativeSweepEngine) aggregate it into
+        # overflow_total so saturated native runs never look clean
+        overflow_dropped=clock_overflow,
         server_ids=plan.server_ids,
         edge_ids=plan.edge_ids,
     )

@@ -108,6 +108,11 @@ def _resolve_gauge_series(
     if isinstance(component_ids, str):
         component_ids = [component_ids]
     component_ids = list(component_ids)
+    if not component_ids:
+        # an empty selection would still allocate the coarse grid for every
+        # gauge on device (and bust the checkpoint digest) to collect nothing
+        msg = "gauge_series component_ids must name at least one component"
+        raise ValueError(msg)
     resample_s = float(resample_s)
     if resample_s < plan.sample_period:
         # a sub-sample_period resample would silently fall back to the FULL
@@ -231,7 +236,10 @@ class SweepRunner:
         """``engine``: "auto" picks the scan fast path when the plan is
         eligible (orders of magnitude faster), then the Pallas event kernel
         on TPU (VMEM-resident loop; no per-iteration launch overhead), then
-        the general XLA event engine; "event"/"fast"/"pallas" force one.
+        the general XLA event engine; "event"/"fast"/"pallas"/"native"
+        force one ("native" loops the sequential C++ oracle core over the
+        deterministic scenario grid — the fastest option on one CPU core
+        with no accelerator present).
 
         ``gauge_series``: ``(metric, component_ids, resample_s)`` — collect
         per-scenario streaming time series of the named gauge for the named
@@ -254,10 +262,10 @@ class SweepRunner:
         with the scenario-axis sharding); an explicit ``scan_inner`` is then
         ignored with a warning and per-device chunk sizes should stay at a
         compile-safe scale."""
-        if engine not in ("auto", "fast", "event", "pallas"):
+        if engine not in ("auto", "fast", "event", "pallas", "native"):
             msg = (
-                f"engine must be 'auto', 'fast', 'event' or 'pallas', "
-                f"got {engine!r}"
+                f"engine must be 'auto', 'fast', 'event', 'pallas' or "
+                f"'native', got {engine!r}"
             )
             raise ValueError(msg)
         self.payload = payload
@@ -265,8 +273,12 @@ class SweepRunner:
         # process-local like scenario_mesh itself: a multihost process with
         # one chip must not build a 1-device mesh (it would disable the
         # scanned fast path and force the pathological big-batch compile)
+        # native is a host-side sequential loop: don't touch jax devices
+        # (jax.local_devices() would initialize the accelerator backend)
         self.mesh = (
-            scenario_mesh() if use_mesh and len(jax.local_devices()) > 1 else None
+            scenario_mesh()
+            if use_mesh and engine != "native" and len(jax.local_devices()) > 1
+            else None
         )
         self._gauge_sel: np.ndarray | None = None
         self._gauge_series_ids: list[str] | None = None
@@ -275,7 +287,22 @@ class SweepRunner:
             self._gauge_sel, gauge_stride, self._gauge_series_ids = (
                 _resolve_gauge_series(self.plan, gauge_series)
             )
-        if engine == "fast" or (engine == "auto" and self.plan.fastpath_ok):
+        if engine == "native":
+            # the single-core C++ oracle, looped over the scenario grid:
+            # no batching, but the lowest per-scenario constant of any
+            # engine on one CPU core — the right sweep engine when no
+            # accelerator is present and the sweep is small enough that
+            # sequential x ~60x-oracle wins (bench.py picks it by
+            # calibration on CPU)
+            from asyncflow_tpu.engines.oracle.native import native_available
+
+            if not native_available():
+                msg = "native sweep engine requested but the C++ core is unavailable"
+                raise RuntimeError(msg)
+            self.engine = _NativeSweepEngine(self.plan, n_hist_bins=n_hist_bins)
+            self.engine_kind = "native"
+            self._scan_inner = 0
+        elif engine == "fast" or (engine == "auto" and self.plan.fastpath_ok):
             from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
 
             self.engine = FastEngine(
@@ -435,7 +462,12 @@ class SweepRunner:
         # prefix-stable in n, so slicing the full grid per chunk is
         # bit-identical to deriving each chunk's prefix separately); n_dev-1
         # extra rows cover the tail chunk's round-up to a device multiple
-        all_keys = scenario_keys(seed, first_scenario + n_scenarios + n_dev - 1)
+        # (the native engine derives its own host-side per-scenario seeds)
+        all_keys = (
+            None
+            if self.engine_kind == "native"
+            else scenario_keys(seed, first_scenario + n_scenarios + n_dev - 1)
+        )
         partials: list[SweepResults] = []
         inflight: list[tuple[int, object]] = []
         done = 0
@@ -448,12 +480,21 @@ class SweepRunner:
                 done += take
                 continue
             lo = first_scenario + done
-            keys = all_keys[lo : lo + take]
             ov = (
                 _slice_overrides(overrides, base_overrides(self.plan), done, take)
                 if overrides
                 else None
             )
+            if self.engine_kind == "native":
+                part = self.engine.run_chunk(
+                    seed, lo, take, ov, self.payload.sim_settings,
+                )
+                if ckpt:
+                    ckpt.save(done, part)
+                partials.append(part)
+                done += take
+                continue
+            keys = all_keys[lo : lo + take]
             if self.mesh is not None:
                 keys = jax.device_put(keys, scenario_sharding(self.mesh))
             if self.engine_kind == "fast" and getattr(self, "_scan_inner", 0):
@@ -506,6 +547,113 @@ class SweepRunner:
             wall_seconds=wall,
             plan=self.plan,
             gauge_series_ids=self._gauge_series_ids,
+        )
+
+
+class _NativeSweepEngine:
+    """Sequential sweep executor on the C++ oracle core (host-side, no jax).
+
+    Per-scenario seeds derive from ``SeedSequence([seed, global_index])``,
+    so results are deterministic in (seed, scenario index) regardless of
+    chunking or process layout — the same grid contract the JAX engines
+    keep, with an independent RNG family (parity is distributional).
+    Per-scenario overrides apply by re-materializing the plan's scaled
+    fields for each run (numpy copies; negligible next to the simulation).
+    """
+
+    def __init__(self, plan: StaticPlan, *, n_hist_bins: int = 1024) -> None:
+        self.plan = plan
+        self.n_hist_bins = n_hist_bins
+
+    def _plan_for(self, ov: ScenarioOverrides | None, row: int) -> StaticPlan:
+        if ov is None:
+            return self.plan
+        import dataclasses
+
+        def pick(field, base_ndim: int):
+            arr = np.asarray(field)
+            return arr[row] if arr.ndim > base_ndim else arr
+
+        return dataclasses.replace(
+            self.plan,
+            edge_mean=np.asarray(pick(ov.edge_mean, 1), np.float32),
+            edge_var=np.asarray(pick(ov.edge_var, 1), np.float32),
+            edge_dropout=np.asarray(pick(ov.edge_dropout, 1), np.float32),
+            user_mean=float(pick(ov.user_mean, 0)),
+            req_per_user_per_sec=float(pick(ov.req_rate, 0)),
+        )
+
+    def run_chunk(
+        self,
+        seed: int,
+        first_global: int,
+        count: int,
+        ov: ScenarioOverrides | None,
+        settings,
+    ) -> SweepResults:
+        from asyncflow_tpu.engines.jaxsim.params import hist_edges
+        from asyncflow_tpu.engines.oracle.native import run_native
+
+        edges = hist_edges(self.n_hist_bins)
+        n_thr = max(1, int(np.ceil(self.plan.horizon)))
+        s = count
+        completed = np.zeros(s, np.int64)
+        hist = np.zeros((s, self.n_hist_bins), np.int64)
+        lat_sum = np.zeros(s)
+        lat_sumsq = np.zeros(s)
+        lat_min = np.full(s, np.inf)
+        lat_max = np.zeros(s)
+        thr = np.zeros((s, n_thr), np.int64)
+        gen = np.zeros(s, np.int64)
+        dropped = np.zeros(s, np.int64)
+        overflow = np.zeros(s, np.int64)
+        for i in range(s):
+            # full 64-bit seed entropy: seeds differing only in high bits
+            # must produce distinct streams (SeedSequence takes arbitrary
+            # non-negative ints; the modulo only folds negatives in)
+            seed64 = int(
+                np.random.SeedSequence(
+                    [int(seed) % (2**64), first_global + i],
+                ).generate_state(1, np.uint64)[0],
+            )
+            res = run_native(
+                self._plan_for(ov, i),
+                seed=seed64,
+                collect_gauges=False,
+                settings=settings,
+            )
+            lat = res.latencies
+            completed[i] = lat.size
+            if lat.size:
+                # clip into the shared bin range (identical semantics to the
+                # JAX engines' clipped latency_bin)
+                clipped = np.clip(lat, edges[0] * (1 + 1e-9), edges[-1] * (1 - 1e-9))
+                hist[i] = np.histogram(clipped, bins=edges)[0]
+                lat_sum[i] = lat.sum()
+                lat_sumsq[i] = (lat * lat).sum()
+                lat_min[i] = lat.min()
+                lat_max[i] = lat.max()
+                finish = res.rqs_clock[:, 1]
+                thr[i] = np.bincount(
+                    np.clip(finish.astype(np.int64), 0, n_thr - 1),
+                    minlength=n_thr,
+                )
+            gen[i] = res.total_generated
+            dropped[i] = res.total_dropped
+            overflow[i] = res.overflow_dropped
+        return SweepResults(
+            settings=settings,
+            completed=completed,
+            latency_hist=hist,
+            hist_edges=edges,
+            latency_sum=lat_sum,
+            latency_sumsq=lat_sumsq,
+            latency_min=lat_min,
+            latency_max=lat_max,
+            throughput=thr,
+            total_generated=gen,
+            total_dropped=dropped,
+            overflow_dropped=overflow,
         )
 
 

@@ -1,0 +1,84 @@
+"""Native-engine sweeps: the sequential C++ core behind the sweep API.
+
+Deterministic per-(seed, scenario-index) grid like the JAX engines (with an
+independent RNG family, so parity is distributional), chunk-layout
+independent, checkpointable, and override-aware.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import yaml
+
+from asyncflow_tpu.engines.oracle.native import native_available
+from asyncflow_tpu.parallel import SweepRunner, make_overrides
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+pytestmark = [
+    pytest.mark.integration,
+    pytest.mark.skipif(not native_available(), reason="no C++ toolchain"),
+]
+
+LB = "tests/integration/data/two_servers_lb.yml"
+
+
+def _payload(horizon: int = 120) -> SimulationPayload:
+    data = yaml.safe_load(open(LB).read())
+    data["sim_settings"]["total_simulation_time"] = horizon
+    return SimulationPayload.model_validate(data)
+
+
+def test_native_sweep_matches_fast_sweep() -> None:
+    payload = _payload()
+    n = 48
+    rep_n = SweepRunner(payload, engine="native").run(n, seed=3)
+    rep_f = SweepRunner(payload, use_mesh=False).run(n, seed=3)
+    sn, sf = rep_n.summary(), rep_f.summary()
+    for key in ("latency_p95_s", "latency_p50_s", "latency_mean_s"):
+        assert abs(sn[key] - sf[key]) / sf[key] < 0.03, (key, sn[key], sf[key])
+    assert (
+        abs(sn["completed_total"] - sf["completed_total"])
+        / sf["completed_total"]
+        < 0.02
+    )
+    assert sn["overflow_total"] == 0
+
+
+def test_native_sweep_chunk_layout_independent(tmp_path) -> None:
+    payload = _payload(horizon=60)
+    a = SweepRunner(payload, engine="native").run(24, seed=9, chunk_size=8)
+    b = SweepRunner(payload, engine="native").run(24, seed=9, chunk_size=24)
+    np.testing.assert_array_equal(a.results.completed, b.results.completed)
+    np.testing.assert_array_equal(a.results.latency_hist, b.results.latency_hist)
+
+    # checkpoint round trip is bit-identical too
+    c = SweepRunner(payload, engine="native").run(
+        24, seed=9, chunk_size=8, checkpoint_dir=str(tmp_path),
+    )
+    d = SweepRunner(payload, engine="native").run(
+        24, seed=9, chunk_size=8, checkpoint_dir=str(tmp_path),
+    )
+    np.testing.assert_array_equal(c.results.latency_hist, a.results.latency_hist)
+    np.testing.assert_array_equal(d.results.latency_hist, c.results.latency_hist)
+
+
+def test_native_sweep_overrides() -> None:
+    payload = _payload(horizon=60)
+    runner = SweepRunner(payload, engine="native")
+    n = 12
+    ov = make_overrides(
+        runner.plan,
+        n,
+        edge_mean_scale=np.linspace(1.0, 8.0, n),
+    )
+    rep = runner.run(n, seed=5, overrides=ov)
+    p50 = rep.results.percentile(50)
+    # stretched RTTs must raise per-scenario medians monotonically (in trend)
+    assert p50[-1] > p50[0] * 2.0
+    assert np.corrcoef(np.arange(n), p50)[0, 1] > 0.9
+
+    # workload override drives generated counts
+    ov2 = make_overrides(runner.plan, n, user_mean=np.full(n, 30.0))
+    rep2 = runner.run(n, seed=5, overrides=ov2)
+    assert rep2.results.total_generated.mean() < rep.results.total_generated.mean()
