@@ -33,6 +33,7 @@ import numpy as np
 
 from asyncflow_tpu.config.constants import (
     Distribution,
+    EndpointStepIO,
     EventDescription,
     LbAlgorithmsName,
 )
@@ -43,6 +44,12 @@ from asyncflow_tpu.schemas.payload import SimulationPayload
 SEG_END = 0
 SEG_CPU = 1
 SEG_IO = 2
+# an io_db run on a server whose finite db_connection_pool may bind: the
+# request must hold one of K FIFO connections for the segment's duration
+# (core released, RAM held — the connection wait parks in the event loop).
+# Only emitted when the compiler cannot prove the pool non-binding; plans
+# containing SEG_DB run on the event engines (oracle/native/jax-event).
+SEG_DB = 3
 
 # Multi-burst relaxation envelope: nominal per-server core utilization above
 # which the fast path's fixed-point relaxation is measurably biased vs the
@@ -64,16 +71,33 @@ _DIST_IDS = {
 }
 
 
-def _compile_endpoint(endpoint: Endpoint) -> tuple[list[tuple[int, float]], float]:
-    """Merge step runs into alternating (kind, duration) segments + RAM total."""
+def _compile_endpoint(
+    endpoint: Endpoint,
+    *,
+    db_pooled: bool = False,
+) -> tuple[list[tuple[int, float]], float]:
+    """Merge step runs into alternating (kind, duration) segments + RAM total.
+
+    With ``db_pooled``, each ``io_db`` step lowers to its own
+    :data:`SEG_DB` segment — adjacent io_db steps must NOT merge, because
+    each query releases its connection and re-acquires (joining the FIFO
+    tail behind any waiters), exactly like two sequential awaits on a real
+    pool and like the oracle's per-step FifoTokens discipline; otherwise
+    io_db merges into plain IO exactly as before.
+    """
     segments: list[tuple[int, float]] = []
     total_ram = 0.0
     for step in endpoint.steps:
         if step.is_ram:
             total_ram += step.quantity
             continue
-        kind = SEG_CPU if step.is_cpu else SEG_IO
-        if segments and segments[-1][0] == kind:
+        if step.is_cpu:
+            kind = SEG_CPU
+        elif db_pooled and step.kind == EndpointStepIO.DB:
+            kind = SEG_DB
+        else:
+            kind = SEG_IO
+        if segments and segments[-1][0] == kind and kind != SEG_DB:
             segments[-1] = (kind, segments[-1][1] + step.quantity)
         else:
             segments.append((kind, step.quantity))
@@ -96,12 +120,12 @@ def _burst_decomposition(
     burst_pre: list[float] = []
     io_acc = 0.0
     for kind, dur in segs:
-        if kind == SEG_IO:
-            io_acc += dur
-        else:
+        if kind == SEG_CPU:
             burst_pre.append(io_acc)
             burst_dur.append(dur)
             io_acc = 0.0
+        else:  # SEG_IO and SEG_DB both hold no core
+            io_acc += dur
     return burst_dur, burst_pre, io_acc
 
 
@@ -195,6 +219,22 @@ class StaticPlan:
     #: overrides that scale the workload must keep
     #: relax_rho * scale <= RELAX_RHO_MAX (enforced by the sweep guard).
     relax_rho: float = 0.0
+    #: (NS,) i32 modeled DB connection pool size; -1 = unlimited (no pool,
+    #: or one proven non-binding and lowered away).  Servers with a value
+    #: >= 0 have SEG_DB segments whose execution must hold one of the K
+    #: FIFO connections (reference roadmap milestone 4, activated).
+    server_db_pool: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.int32),
+    )
+    #: max workload-rate scale under which every lowered-away (proven
+    #: non-binding) connection pool stays provably non-binding; inf when
+    #: no pool was lowered away.  Sweep overrides must stay below it.
+    db_rate_headroom: float = math.inf
+
+    @property
+    def has_db_pool(self) -> bool:
+        """True when any server's connection pool is actually modeled."""
+        return bool(self.server_db_pool.size and np.any(self.server_db_pool >= 0))
 
     @property
     def n_gauges(self) -> int:
@@ -214,6 +254,68 @@ class StaticPlan:
 
     def gauge_ram(self, server_idx: int) -> int:
         return self.n_edges + 2 * self.n_servers + server_idx
+
+
+def _server_entry_rates(payload: SimulationPayload) -> np.ndarray | None:
+    """(NS,) nominal request rate into each server.
+
+    The entry chain is walked ``generator -> (client ->)* first LB/server``
+    (mirroring the lowering); an LB spreads the rate uniformly over covered
+    servers (round-robin is uniform, least-connections levels load), and
+    server->server chains pass their rate downstream in topological order.
+    Returns None when the server chain graph has a cycle (rates undefined;
+    callers must be conservative).  Dropout is ignored — rates are upper
+    bounds used by non-binding proofs.
+    """
+    servers = payload.topology_graph.nodes.servers
+    server_index = {server.id: s for s, server in enumerate(servers)}
+    lb = payload.topology_graph.nodes.load_balancer
+    workload = payload.rqs_input
+    rate = (
+        float(workload.avg_active_users.mean)
+        * float(workload.avg_request_per_minute_per_user.mean)
+        / 60.0
+    )
+    out_edge = {e.source: e for e in payload.topology_graph.edges}
+
+    srv_rate = np.zeros(len(servers))
+    node = workload.id
+    for _ in range(len(payload.topology_graph.edges) + 1):
+        e = out_edge.get(node)
+        if e is None:
+            break
+        if e.target in server_index:
+            srv_rate[server_index[e.target]] += rate
+            break
+        if lb is not None and e.target == lb.id:
+            covered = sorted(lb.server_covered)
+            for sid in covered:
+                srv_rate[server_index[sid]] += rate / len(covered)
+            break
+        node = e.target
+
+    # server -> server chain edges, propagated in topological order
+    child = {}
+    indeg = [0] * len(servers)
+    for server in servers:
+        e = out_edge.get(server.id)
+        if e is not None and e.target in server_index:
+            child[server_index[server.id]] = server_index[e.target]
+            indeg[server_index[e.target]] += 1
+    frontier = [s for s in range(len(servers)) if indeg[s] == 0]
+    seen = 0
+    while frontier:
+        s = frontier.pop()
+        seen += 1
+        t = child.get(s)
+        if t is not None:
+            srv_rate[t] += srv_rate[s]
+            indeg[t] -= 1
+            if indeg[t] == 0:
+                frontier.append(t)
+    if seen != len(servers):
+        return None  # cycle: no well-defined rates
+    return srv_rate
 
 
 def _estimate_capacity(payload: SimulationPayload) -> tuple[int, int]:
@@ -356,9 +458,68 @@ def compile_payload(
 
     # ---- servers ----
     max_endpoints = max(len(server.endpoints) for server in servers)
+
+    # DB connection pools (activates the reference's reserved
+    # ServerResources.db_connection_pool field — its roadmap milestone 4,
+    # `/root/reference/ROADMAP.md` §4, which the reference never wired up).
+    # Tiered like RAM admission: a pool proven non-binding (K comfortably
+    # above the 6-sigma Poisson bound on concurrent io_db holders,
+    # Little's law at the server's burst-inflated entry rate) is not
+    # modeled — io_db lowers to plain IO and every engine, including the
+    # fast path, stays exact.  A pool that may bind lowers io_db to SEG_DB
+    # segments: the event engines model the K-connection FIFO, and the
+    # fast path declines the plan.
+    srv_rates_est = _server_entry_rates(payload)
+    users_est = float(payload.rqs_input.avg_active_users.mean)
+    db_model: list[bool] = []
+    db_rate_headroom = math.inf
+    for s, server in enumerate(servers):
+        pool_k = server.server_resources.db_connection_pool
+        if pool_k is None:
+            db_model.append(False)
+            continue
+        db_dur = max(
+            (
+                sum(
+                    step.quantity
+                    for step in ep.steps
+                    if step.is_io and step.kind == EndpointStepIO.DB
+                )
+                for ep in server.endpoints
+            ),
+            default=0.0,
+        )
+        if db_dur <= 0:
+            db_model.append(False)  # a pool with no io_db steps is inert
+            continue
+        if srv_rates_est is None:
+            db_model.append(True)  # cyclic chain: no rate bound, model it
+            continue
+        burst = srv_rates_est[s] * (1.0 + 3.0 / math.sqrt(max(users_est, 1.0)))
+        m = burst * db_dur
+        binding = not pool_k >= m + 6.0 * math.sqrt(max(m, 1.0)) + 8.0
+        db_model.append(binding)
+        if not binding and pool_k > 8:
+            # the proof holds up to a rate scale f: K >= f*m + 6*sqrt(f*m)+8
+            # (sweep overrides that scale the workload past this must be
+            # refused — the lowered-away pool could silently bind)
+            t = (-6.0 + math.sqrt(36.0 + 4.0 * (pool_k - 8.0))) / 2.0
+            db_rate_headroom = min(db_rate_headroom, (t * t) / max(m, 1e-12))
+
     compiled: list[list[tuple[list[tuple[int, float]], float]]] = [
-        [_compile_endpoint(ep) for ep in server.endpoints] for server in servers
+        [
+            _compile_endpoint(ep, db_pooled=db_model[s])
+            for ep in server.endpoints
+        ]
+        for s, server in enumerate(servers)
     ]
+    server_db_pool = np.array(
+        [
+            server.server_resources.db_connection_pool if db_model[s] else -1
+            for s, server in enumerate(servers)
+        ],
+        dtype=np.int32,
+    )
     max_segments = max(
         (len(segs) for per_server in compiled for segs, _ in per_server),
         default=0,
@@ -551,6 +712,8 @@ def compile_payload(
         ram_slots=ram_slots,
         lc_ring=lc_ring,
         relax_rho=relax_rho,
+        server_db_pool=server_db_pool,
+        db_rate_headroom=db_rate_headroom,
     )
 
 
@@ -651,6 +814,18 @@ def _fastpath_analysis(
 
     ram_slots = np.zeros(n_servers, dtype=np.int32)
     for s, server in enumerate(servers):
+        if any(k == SEG_DB for segs, _ in compiled[s] for k, _ in segs):
+            # a pool the compiler could not prove non-binding: the FIFO
+            # connection queue needs the event engines' waiter machinery
+            return (
+                False,
+                f"server {server.id}: binding DB connection pool "
+                "(modeled on the event engines)",
+                [],
+                no_slots,
+                0,
+                0.0,
+            )
         if exit_kind[s] == TARGET_LB:
             return (
                 False,
@@ -782,31 +957,9 @@ def _fastpath_analysis(
     ]
     relax_rho = 0.0
     if any(v > 1 for v in max_visits_per_server):
-        server_index = {server.id: s for s, server in enumerate(servers)}
-        srv_rate = np.zeros(n_servers)
-        # walk the entry chain from the generator to the first LB/server —
-        # the same `generator -> (client ->)* first LB/server` walk the
-        # lowering performs, so topologies without a client hop are covered
-        out_edge = {e.source: e for e in payload.topology_graph.edges}
-        node = payload.rqs_input.id
-        for _ in range(len(payload.topology_graph.edges) + 1):
-            e = out_edge.get(node)
-            if e is None:
-                break
-            if e.target in server_index:
-                srv_rate[server_index[e.target]] += rate
-                break
-            if lb is not None and e.target == lb.id:
-                covered = sorted(lb.server_covered)
-                for sid in covered:
-                    # round-robin is uniform; least-connections levels
-                    # load, so uniform is the right first moment for both
-                    srv_rate[server_index[sid]] += rate / len(covered)
-                break
-            node = e.target
-        for s in topo:  # chains pass their rate downstream (dropout ignored)
-            if exit_kind[s] == TARGET_SERVER:
-                srv_rate[int(exit_target[s])] += srv_rate[s]
+        srv_rate = _server_entry_rates(payload)
+        if srv_rate is None:  # pragma: no cover - cycles rejected above
+            return False, "server exit chain has a cycle", [], no_slots, 0, 0.0
         for s in range(n_servers):
             if max_visits_per_server[s] <= 1:
                 continue

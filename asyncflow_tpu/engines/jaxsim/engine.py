@@ -38,6 +38,7 @@ import numpy as np
 
 from asyncflow_tpu.compiler.plan import (
     SEG_CPU,
+    SEG_DB,
     SEG_END,
     SEG_IO,
     TARGET_CLIENT,
@@ -75,6 +76,7 @@ from asyncflow_tpu.engines.jaxsim.params import (
     EV_RESUME,
     EV_SEG_END,
     EV_WAIT_CPU,
+    EV_WAIT_DB,
     EV_WAIT_RAM,
     INF,
     NO_TICKET,
@@ -118,6 +120,9 @@ class Engine:
         # statically prune the RAM admission/grant machinery (several pool
         # scans per iteration) for the many plans with no RAM steps at all
         self._has_ram = bool(np.max(plan.endpoint_ram) > 0)
+        # static pruning: db-pool machinery compiles in only when the plan
+        # actually models a finite connection pool (SEG_DB segments exist)
+        self._has_db = bool(np.any(plan.seg_kind == SEG_DB))
         self._compiled: dict = {}
 
     # ==================================================================
@@ -395,6 +400,21 @@ class Engine:
         cpu_wait = is_cpu & ~can_take
 
         run_now = cpu_run | is_io
+        db_wait = jnp.bool_(False)
+        if self._has_db:
+            # DB connection acquire-or-wait: same strict-FIFO discipline as
+            # the core queue, but the holder sleeps (io) instead of running
+            is_db = pred & (kind == SEG_DB)
+            db_can = (st.db_free[s] > 0) & ~(st.db_wait_n[s] > 0)
+            db_run = is_db & db_can
+            db_wait = is_db & ~db_can
+            run_now = run_now | db_run
+            st = st._replace(
+                db_free=st.db_free.at[s].add(jnp.where(db_run, -1, 0)),
+                db_ticket=st.db_ticket.at[s].add(jnp.where(db_wait, 1, 0)),
+                db_wait_n=st.db_wait_n.at[s].add(jnp.where(db_wait, 1, 0)),
+            )
+            is_io = is_io | is_db  # the io-sleep gauge counts db segments
         st = st._replace(
             cores_free=st.cores_free.at[s].add(jnp.where(cpu_run, -1, 0)),
             cpu_ticket=st.cpu_ticket.at[s].add(jnp.where(cpu_wait, 1, 0)),
@@ -403,14 +423,26 @@ class Engine:
                 jnp.where(
                     run_now,
                     EV_SEG_END,
-                    jnp.where(cpu_wait, EV_WAIT_CPU, st.req_ev[i]),
+                    jnp.where(
+                        cpu_wait,
+                        EV_WAIT_CPU,
+                        jnp.where(db_wait, EV_WAIT_DB, st.req_ev[i]),
+                    ),
                 ),
             ),
             req_t=st.req_t.at[i].set(
-                jnp.where(run_now, now + dur, jnp.where(cpu_wait, INF, st.req_t[i])),
+                jnp.where(
+                    run_now,
+                    now + dur,
+                    jnp.where(cpu_wait | db_wait, INF, st.req_t[i]),
+                ),
             ),
             req_ticket=st.req_ticket.at[i].set(
-                jnp.where(cpu_wait, st.cpu_ticket[s], st.req_ticket[i]),
+                jnp.where(
+                    cpu_wait,
+                    st.cpu_ticket[s],
+                    jnp.where(db_wait, st.db_ticket[s], st.req_ticket[i]),
+                ),
             ),
             req_seg=st.req_seg.at[i].set(jnp.where(pred, seg, st.req_seg[i])),
         )
@@ -663,6 +695,25 @@ class Engine:
         )
         st = self._gauge_add(st, now, self._g_ready(s), -1.0, grant)
 
+        if self._has_db:
+            # DB connection handoff, mirroring the core queue's discipline
+            was_db = pred & (kind == SEG_DB)
+            was_io = was_io | was_db
+            dwaiting = (st.req_ev == EV_WAIT_DB) & (st.req_srv == s)
+            dtick = jnp.where(dwaiting, st.req_ticket, NO_TICKET)
+            dj = jnp.argmin(dtick).astype(jnp.int32)
+            dgrant = was_db & (dtick[dj] < NO_TICKET)
+            drelease = was_db & ~dgrant
+            djdur = p.seg_dur[st.req_srv[dj], st.req_ep[dj], st.req_seg[dj]]
+            djidx = jnp.where(dgrant, dj, jnp.int32(self.pool))
+            st = st._replace(
+                db_free=st.db_free.at[s].add(jnp.where(drelease, 1, 0)),
+                db_wait_n=st.db_wait_n.at[s].add(jnp.where(dgrant, -1, 0)),
+                req_ev=st.req_ev.at[djidx].set(EV_SEG_END, mode="drop"),
+                req_t=st.req_t.at[djidx].set(now + djdur, mode="drop"),
+                req_ticket=st.req_ticket.at[djidx].set(NO_TICKET, mode="drop"),
+            )
+
         # leave the IO queue
         st = self._gauge_add(st, now, self._g_io(s), -1.0, was_io)
 
@@ -695,6 +746,15 @@ class Engine:
             ram_ticket=jnp.zeros(plan.n_servers, jnp.int32),
             cpu_wait_n=jnp.zeros(plan.n_servers, jnp.int32),
             ram_wait_n=jnp.zeros(plan.n_servers, jnp.int32),
+            # -1 (unlimited / not modeled) becomes a huge free count so the
+            # acquire test never blocks without a branch
+            db_free=jnp.where(
+                jnp.asarray(plan.server_db_pool) >= 0,
+                jnp.asarray(plan.server_db_pool),
+                jnp.int32(2**30),
+            ),
+            db_ticket=jnp.zeros(plan.n_servers, jnp.int32),
+            db_wait_n=jnp.zeros(plan.n_servers, jnp.int32),
             lb_order=jnp.arange(elp, dtype=jnp.int32),
             lb_len=jnp.int32(plan.n_lb_edges),
             lb_conn=jnp.zeros(elp, jnp.int32),

@@ -22,6 +22,7 @@ EV_SEG_END = 3
 EV_RESUME = 4  # RAM granted; start endpoint segments at time t
 EV_WAIT_CPU = 5
 EV_WAIT_RAM = 6
+EV_WAIT_DB = 7  # parked in the server's DB connection-pool FIFO
 
 
 class PlanParams(NamedTuple):
@@ -101,6 +102,9 @@ class EngineState(NamedTuple):
     ram_ticket: jnp.ndarray  # (NS,) i32
     cpu_wait_n: jnp.ndarray  # (NS,) i32: live CPU waiter counts
     ram_wait_n: jnp.ndarray  # (NS,) i32: live RAM waiter counts
+    db_free: jnp.ndarray  # (NS,) i32: free DB connections (big = unlimited)
+    db_ticket: jnp.ndarray  # (NS,) i32
+    db_wait_n: jnp.ndarray  # (NS,) i32: live DB-pool waiter counts
     # load balancer
     lb_order: jnp.ndarray  # (EL,) i32
     lb_len: jnp.ndarray  # scalar i32

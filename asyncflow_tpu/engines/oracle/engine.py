@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from asyncflow_tpu.config.constants import (
+    EndpointStepIO,
     EventDescription,
     LbAlgorithmsName,
     SampledMetricName,
@@ -121,6 +122,12 @@ class _ServerRuntime:
         self.cfg = cfg
         self.cpu = FifoTokens(engine.sim, cfg.server_resources.cpu_cores)
         self.ram = FifoContainer(engine.sim, float(cfg.server_resources.ram_mb))
+        # DB connection pool (the reference's reserved db_connection_pool
+        # field, activated — its roadmap milestone 4): every io_db step
+        # must hold one of K FIFO connections for its duration; the wait
+        # parks in the event loop (core released, RAM held)
+        pool = cfg.server_resources.db_connection_pool
+        self.db = FifoTokens(engine.sim, pool) if pool is not None else None
         self.ready_queue_len = 0
         self.io_queue_len = 0
         self.ram_in_use = 0.0
@@ -175,7 +182,14 @@ class _ServerRuntime:
                 elif not in_io_queue:
                     in_io_queue = True
                     self.io_queue_len += 1
-                yield Timeout(step.quantity)
+                if self.db is not None and step.kind == EndpointStepIO.DB:
+                    # hold one of K FIFO connections for the query; the
+                    # wait (if any) parks in the event loop like any await
+                    yield AcquireToken(self.db)
+                    yield Timeout(step.quantity)
+                    self.db.release()
+                else:
+                    yield Timeout(step.quantity)
 
         if core_locked:
             self.cpu.release()

@@ -41,6 +41,7 @@ class _PlanC(ctypes.Structure):
         ("max_segments", ctypes.c_int32),
         ("server_cores", _i32p),
         ("server_ram", _f32p),
+        ("server_db_pool", _i32p),
         ("n_endpoints", _i32p),
         ("seg_kind", _i32p),
         ("seg_dur", _f32p),
@@ -183,6 +184,7 @@ def run_native(
         max_segments=plan.max_segments,
         server_cores=i32(plan.server_cores),
         server_ram=f32(plan.server_ram),
+        server_db_pool=i32(plan.server_db_pool),
         n_endpoints=i32(plan.n_endpoints),
         seg_kind=i32(plan.seg_kind),
         seg_dur=f32(plan.seg_dur),

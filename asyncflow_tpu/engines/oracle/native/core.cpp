@@ -23,6 +23,7 @@ namespace {
 // segment kinds (compiler order)
 constexpr int SEG_CPU = 1;
 constexpr int SEG_IO = 2;
+constexpr int SEG_DB = 3;  // io_db holding one of K FIFO pool connections
 
 // hop targets (compiler order)
 constexpr int TARGET_SERVER = 1;
@@ -53,6 +54,7 @@ struct PlanC {
     int32_t max_segments;  // seg arrays have max_segments + 1 columns
     const int32_t* server_cores;
     const float* server_ram;
+    const int32_t* server_db_pool;  // -1 = unlimited / not modeled
     const int32_t* n_endpoints;
     const int32_t* seg_kind;  // [NS][NEP][NSEG+1]
     const float* seg_dur;
@@ -101,8 +103,10 @@ struct Server {
     double ram_in_use = 0.0;
     int32_t ready_len = 0;
     int32_t io_len = 0;
+    int32_t db_free = -1;  // -1 = unlimited (pool not modeled)
     std::deque<int32_t> cpu_wait;                      // request idx, FIFO
     std::deque<std::pair<double, int32_t>> ram_wait;   // (amount, request)
+    std::deque<int32_t> db_wait;                       // request idx, FIFO
 };
 
 enum EvType : int32_t {
@@ -160,6 +164,7 @@ struct Sim {
         for (int s = 0; s < p.n_servers; ++s) {
             servers[s].cores_free = p.server_cores[s];
             servers[s].ram_free = p.server_ram[s];
+            servers[s].db_free = p.server_db_pool ? p.server_db_pool[s] : -1;
         }
         lb_rotation.resize(p.n_lb_edges);
         for (int i = 0; i < p.n_lb_edges; ++i) lb_rotation[i] = i;
@@ -293,6 +298,16 @@ struct Sim {
         } else if (kind == SEG_IO) {
             ++sv.io_len;
             push(now + dur, EV_SEG_END, i);
+        } else if (kind == SEG_DB) {
+            // hold one of K FIFO connections for the query; the wait (if
+            // any) parks in the event loop and counts as io sleep
+            ++sv.io_len;
+            if (sv.db_free != 0 && sv.db_wait.empty()) {  // -1 = unlimited
+                if (sv.db_free > 0) --sv.db_free;
+                push(now + dur, EV_SEG_END, i);
+            } else {
+                sv.db_wait.push_back(i);
+            }
         } else {
             exit_server(i);
         }
@@ -416,6 +431,16 @@ struct Sim {
         if (kind == SEG_CPU) {
             ++sv.cores_free;
             grant_cores(r.srv);
+        } else if (kind == SEG_DB) {
+            --sv.io_len;
+            if (!sv.db_wait.empty()) {  // hand the connection to the head
+                int32_t j = sv.db_wait.front();
+                sv.db_wait.pop_front();
+                double jdur = durs(reqs[j].srv, reqs[j].ep)[reqs[j].seg];
+                push(now + jdur, EV_SEG_END, j);
+            } else if (sv.db_free >= 0) {
+                ++sv.db_free;
+            }
         } else {
             --sv.io_len;
         }

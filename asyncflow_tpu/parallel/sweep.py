@@ -199,6 +199,50 @@ class SweepReport:
         pooled = dataclasses.replace(self.results, latency_hist=pooled_hist)
         return float(pooled.percentile(q)[0])
 
+    def percentile_ci(
+        self,
+        q: float,
+        level: float = 0.95,
+    ) -> tuple[float, float, float]:
+        """(point, lo, hi): the across-scenario mean of the per-scenario
+        latency percentile ``q`` with a ``level`` confidence interval.
+
+        The sweep's scenarios are i.i.d. replications, so the CI is the
+        classic normal-approximation interval on the mean of the
+        per-scenario percentile estimates — the "confidence intervals"
+        deliverable of the reference's Monte-Carlo roadmap milestone
+        (`/root/reference/ROADMAP.md` §3), computed from per-scenario
+        histograms at any sweep size.
+        """
+        per = self.results.percentile(q)
+        return _mean_ci(per[np.isfinite(per)], level)
+
+    def metric_ci(
+        self,
+        values: np.ndarray,
+        level: float = 0.95,
+    ) -> tuple[float, float, float]:
+        """(point, lo, hi) CI on the mean of any per-scenario metric array
+        (e.g. ``results.completed``, ``mean_gauge(...)``)."""
+        values = np.asarray(values, np.float64)
+        return _mean_ci(values[np.isfinite(values)], level)
+
+    def gauge_series_band(
+        self,
+        component_id: str,
+        lo_q: float = 10.0,
+        hi_q: float = 90.0,
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """(times, lo, median, hi): across-scenario band of a streamed gauge.
+
+        The "bands over time series" of the reference's Monte-Carlo
+        milestone: at every coarse tick, the ``lo_q``/50/``hi_q``
+        percentiles of the gauge value across all scenarios.
+        """
+        times, series = self.gauge_series(component_id)
+        lo, med, hi = np.percentile(series, [lo_q, 50.0, hi_q], axis=0)
+        return times, lo, med, hi
+
     def summary(self) -> dict:
         res = self.results
         completed = res.completed.sum()
@@ -328,7 +372,9 @@ class SweepRunner:
                 )
             self._scan_inner = scan_inner if self.mesh is None else 0
         elif engine == "pallas" or (
-            engine == "auto" and jax.default_backend() == "tpu"
+            engine == "auto"
+            and jax.default_backend() == "tpu"
+            and not self.plan.has_db_pool  # VMEM kernel has no pool FIFO
         ):
             from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
 
@@ -363,6 +409,10 @@ class SweepRunner:
     def _guard_fastpath_overrides(self, overrides: ScenarioOverrides | None) -> None:
         if self.engine_kind == "fast":
             _guard_overrides_against_plan(self.plan, overrides)
+        # the db-pool non-binding proof was lowered into EVERY plan-driven
+        # engine (fast, event, native, pallas all skip a lowered pool), so
+        # its rate headroom binds regardless of engine choice
+        _guard_db_headroom(self.plan, overrides)
 
     def _checkpoint_identity(self, overrides: ScenarioOverrides | None) -> str:
         """Hash of everything that shapes per-chunk results: reusing a chunk
@@ -737,12 +787,59 @@ class _SweepCheckpoint:
             )
 
 
+def _mean_ci(values: np.ndarray, level: float) -> tuple[float, float, float]:
+    """Normal-approximation CI on the mean of i.i.d. per-scenario values."""
+    if not 0.0 < level < 1.0:
+        msg = f"confidence level must be in (0, 1), got {level}"
+        raise ValueError(msg)
+    if values.size == 0:
+        return float("nan"), float("nan"), float("nan")
+    from statistics import NormalDist
+
+    point = float(values.mean())
+    if values.size == 1:
+        return point, float("nan"), float("nan")
+    z = NormalDist().inv_cdf(0.5 + level / 2.0)
+    half = z * float(values.std(ddof=1)) / float(np.sqrt(values.size))
+    return point, point - half, point + half
+
+
 def _sweep_max(value) -> float:
     return float(np.max(np.asarray(value)))
 
 
 class _FastpathOverrideError(ValueError):
     pass
+
+
+def _override_rate_scale(plan, overrides: ScenarioOverrides) -> float:
+    """Worst-case workload-rate scale an override set applies vs the base
+    plan (shared by every proof-headroom guard)."""
+    base = base_overrides(plan)
+    base_rate = float(base.user_mean) * float(base.req_rate)
+    if base_rate <= 0:
+        return 1.0
+    max_rate = _sweep_max(overrides.user_mean) * _sweep_max(overrides.req_rate)
+    return max_rate / base_rate
+
+
+def _guard_db_headroom(plan, overrides: ScenarioOverrides | None) -> None:
+    """Refuse rate-raising overrides that would push a lowered-away
+    (proven non-binding) DB connection pool past its proof's headroom."""
+    import math
+
+    if overrides is None or math.isinf(plan.db_rate_headroom):
+        return
+    scale = _override_rate_scale(plan, overrides)
+    if scale > plan.db_rate_headroom * 1.001:
+        msg = (
+            f"overrides scale the workload {scale:.2f}x, past the "
+            f"{plan.db_rate_headroom:.2f}x headroom of the DB-pool "
+            "non-binding proof (the pool was lowered away at the base "
+            "rate and could bind at this one); raise the base workload so "
+            "the compiler models the pool"
+        )
+        raise _FastpathOverrideError(msg)
 
 
 def _guard_overrides_against_plan(

@@ -1,0 +1,97 @@
+"""Capacity-planning a DB connection pool — activates the reference's
+reserved ``db_connection_pool`` field (its roadmap milestone 4).
+
+For each candidate pool size K, a Monte-Carlo sweep (native sweep engine:
+the C++ core models the FIFO pool exactly) measures the latency
+distribution of a server whose endpoint holds a connection for a 60 ms
+query.  The resulting p50/p95-vs-K curve is the sizing answer: where the
+tail stops improving is the right pool.
+
+Run:  python examples/sweeps/db_pool_sizing.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+import yaml
+
+from asyncflow_tpu.parallel import SweepRunner
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+N_SCENARIOS = 32
+HORIZON_S = 120
+POOL_SIZES = (1, 2, 3, 4, 6, None)  # None = unlimited baseline
+
+
+def payload_with_pool(pool: int | None) -> SimulationPayload:
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "..", "yaml_input", "data", "single_server.yml",
+    )
+    data = yaml.safe_load(open(path).read())
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    srv["endpoints"][0]["steps"] = [
+        {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.002}},
+        {"kind": "io_db", "step_operation": {"io_waiting_time": 0.060}},
+    ]
+    if pool is not None:
+        srv["server_resources"]["db_connection_pool"] = pool
+    data["rqs_input"]["avg_active_users"]["mean"] = 60  # ~20 rps x 60 ms
+    data["sim_settings"]["total_simulation_time"] = HORIZON_S
+    return SimulationPayload.model_validate(data)
+
+
+def main() -> None:
+    rows = []
+    for pool in POOL_SIZES:
+        runner = SweepRunner(payload_with_pool(pool), engine="native")
+        report = runner.run(N_SCENARIOS, seed=11)
+        s = report.summary()
+        p95_point, p95_lo, p95_hi = report.percentile_ci(95)
+        rows.append((pool, s["latency_p50_s"], p95_point, p95_lo, p95_hi))
+        label = pool if pool is not None else "unlimited"
+        print(
+            f"pool={label!s:>9}: p50 {s['latency_p50_s'] * 1e3:6.1f} ms   "
+            f"p95 {p95_point * 1e3:6.1f} ms "
+            f"(95% CI {p95_lo * 1e3:.1f}-{p95_hi * 1e3:.1f})",
+        )
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    ks = [r[0] if r[0] is not None else max(POOL_SIZES[:-1]) + 2 for r in rows]
+    fig, ax = plt.subplots(figsize=(7, 4))
+    ax.errorbar(
+        ks,
+        [r[2] * 1e3 for r in rows],
+        yerr=[
+            [max(0.0, (r[2] - r[3]) * 1e3) for r in rows],
+            [max(0.0, (r[4] - r[2]) * 1e3) for r in rows],
+        ],
+        marker="o",
+        label="p95 (95% CI)",
+    )
+    ax.plot(ks, [r[1] * 1e3 for r in rows], marker="s", label="p50")
+    ax.set_xticks(ks)
+    ax.set_xticklabels(
+        [str(r[0]) if r[0] is not None else "∞" for r in rows],
+    )
+    ax.set_xlabel("DB connection pool size")
+    ax.set_ylabel("latency (ms)")
+    ax.set_title("Pool sizing: 20 rps of 60 ms queries")
+    ax.legend()
+    fig.tight_layout()
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "db_pool_sizing.png")
+    fig.savefig(out, dpi=130)
+    print(f"saved {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -49,13 +49,14 @@ def main() -> None:
         gauge_series=("ready_queue_len", ["srv-1"], 1.0),
     )
     report = runner.run(N_SCENARIOS, seed=7)
-    times, series = report.gauge_series("srv-1")  # (T,), (S, T)
-
-    p10, p50, p90 = np.percentile(series, [10, 50, 90], axis=0)
+    times, p10, p50, p90 = report.gauge_series_band("srv-1", 10, 90)
+    point, lo, hi = report.percentile_ci(95)
     print(
         f"{N_SCENARIOS} scenarios, {report.scenarios_per_second:.1f} scen/s; "
         f"ready-queue median {p50.mean():.2f}, "
-        f"10-90% band width {np.mean(p90 - p10):.2f}",
+        f"10-90% band width {np.mean(p90 - p10):.2f}; "
+        f"p95 latency {point * 1e3:.2f} ms "
+        f"(95% CI [{lo * 1e3:.2f}, {hi * 1e3:.2f}])",
     )
 
     import matplotlib

@@ -1,0 +1,215 @@
+"""DB connection pools (the reference's reserved ``db_connection_pool``
+field, activated — its roadmap milestone 4).
+
+Semantics under test: every ``io_db`` step on a server with a finite pool
+holds one of K FIFO connections for its duration; the wait parks in the
+event loop (core released, RAM held, io-sleep gauge counts it).  The
+compiler models the pool only when it cannot prove it non-binding; binding
+pools run on the event engines (oracle / native / jax-event) and the fast
+path declines with a named reason.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import yaml
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.engines.jaxsim.engine import Engine, scenario_keys
+from asyncflow_tpu.engines.oracle.engine import OracleEngine
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+pytestmark = pytest.mark.integration
+
+BASE = "tests/integration/data/single_server.yml"
+SEEDS = 12
+
+
+def _payload(pool: int | None, *, users: int = 60, horizon: int = 200):
+    data = yaml.safe_load(open(BASE).read())
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    srv["endpoints"][0]["steps"] = [
+        {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.002}},
+        {"kind": "io_db", "step_operation": {"io_waiting_time": 0.060}},
+    ]
+    if pool is not None:
+        srv["server_resources"]["db_connection_pool"] = pool
+    data["rqs_input"]["avg_active_users"]["mean"] = users
+    data["sim_settings"]["total_simulation_time"] = horizon
+    return SimulationPayload.model_validate(data)
+
+
+def _oracle_latencies(payload, n: int) -> np.ndarray:
+    return np.concatenate(
+        [OracleEngine(payload, seed=s).run().latencies for s in range(n)],
+    )
+
+
+def _event_latencies(payload, n: int) -> np.ndarray:
+    plan = compile_payload(payload)
+    engine = Engine(plan, collect_clocks=True)
+    final = engine.run_batch(scenario_keys(11, n))
+    clock = np.asarray(final.clock)
+    counts = np.asarray(final.clock_n)
+    return np.concatenate(
+        [clock[i, : counts[i], 1] - clock[i, : counts[i], 0] for i in range(n)],
+    )
+
+
+class TestCompilerTiering:
+    def test_no_pool_unchanged(self) -> None:
+        plan = compile_payload(_payload(None))
+        assert not plan.has_db_pool
+        assert plan.fastpath_ok, plan.fastpath_reason
+
+    def test_inert_pool_without_io_db(self) -> None:
+        data = yaml.safe_load(open(BASE).read())
+        data["topology_graph"]["nodes"]["servers"][0]["server_resources"][
+            "db_connection_pool"
+        ] = 2
+        plan = compile_payload(SimulationPayload.model_validate(data))
+        assert not plan.has_db_pool  # no io_db steps: nothing to gate
+
+    def test_nonbinding_pool_stays_fast(self) -> None:
+        # 20 rps x 60 ms ~ 1.2 concurrent connections; K=500 is far above
+        # the 6-sigma bound, so the pool is lowered away and the fast path
+        # keeps the plan (exactness preserved: the pool can never queue)
+        plan = compile_payload(_payload(500))
+        assert not plan.has_db_pool
+        assert plan.fastpath_ok, plan.fastpath_reason
+
+    def test_binding_pool_routes_to_event_engine(self) -> None:
+        plan = compile_payload(_payload(2))
+        assert plan.has_db_pool
+        assert plan.server_db_pool[0] == 2
+        assert not plan.fastpath_ok
+        assert "DB connection pool" in plan.fastpath_reason
+
+        from asyncflow_tpu.parallel import SweepRunner
+
+        assert SweepRunner(_payload(2), use_mesh=False).engine_kind == "event"
+
+    def test_pallas_declines_pooled_plans(self) -> None:
+        from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
+
+        with pytest.raises(ValueError, match="DB connection"):
+            PallasEngine(compile_payload(_payload(2)))
+
+
+def test_override_guard_protects_lowered_pools() -> None:
+    """A pool proven non-binding at the base rate is lowered away in the
+    plan; sweep overrides scaling the workload past the proof's headroom
+    must be refused, not silently simulated without the pool."""
+    from asyncflow_tpu.parallel import SweepRunner, make_overrides
+
+    payload = _payload(40)  # 20 rps x 60 ms ~ 1.2 conns; K=40 non-binding
+    runner = SweepRunner(payload, use_mesh=False)
+    plan = runner.plan
+    assert not plan.has_db_pool
+    assert 1.0 < plan.db_rate_headroom < np.inf
+
+    n = 4
+    safe_users = 60.0 * min(1.5, plan.db_rate_headroom * 0.5)
+    ok = make_overrides(plan, n, user_mean=np.full(n, safe_users))
+    runner.run(n, seed=0, overrides=ok, chunk_size=n)  # inside headroom
+
+    bad_users = 60.0 * plan.db_rate_headroom * 2.0
+    bad = make_overrides(plan, n, user_mean=np.full(n, bad_users))
+    with pytest.raises(ValueError, match="DB-pool non-binding proof"):
+        runner.run(n, seed=0, overrides=bad, chunk_size=n)
+
+
+def test_pool_contention_raises_latency_monotonically() -> None:
+    """K=1 must hurt more than K=3, which must hurt more than unlimited —
+    the basic capacity-planning story the feature exists to tell."""
+    mean_by_pool = {}
+    for pool in (1, 2, None):
+        lat = _oracle_latencies(_payload(pool, users=60, horizon=120), 6)
+        mean_by_pool[pool] = lat.mean()
+    # 20 rps of 60 ms queries: K=1 (capacity 16.7 rps) is saturated and
+    # collapses; K=2 binds transiently; unlimited is the floor
+    assert mean_by_pool[1] > mean_by_pool[2] * 2.0
+    assert mean_by_pool[2] > mean_by_pool[None] * 1.10
+
+
+def test_event_engine_matches_oracle_under_binding_pool() -> None:
+    """The jax event engine's FIFO pool machinery vs the oracle's, at a
+    pool that adds ~30% to mean latency.  Measured deviation at these
+    settings: p50 -1.6%, mean -2.7% (8 seeds); tolerance covers the
+    ensemble noise of pool queueing near saturation."""
+    payload = _payload(2)
+    lat_o = _oracle_latencies(payload, SEEDS)
+    lat_e = _event_latencies(payload, SEEDS)
+    assert lat_o.size > 10000 and lat_e.size > 10000
+    for q in (50, 95):
+        po, pe = np.percentile(lat_o, q), np.percentile(lat_e, q)
+        assert abs(pe - po) / po < 0.08, (q, po, pe)
+    assert abs(lat_e.mean() - lat_o.mean()) / lat_o.mean() < 0.06
+
+
+def test_native_matches_oracle_under_binding_pool() -> None:
+    from asyncflow_tpu.engines.oracle.native import native_available, run_native
+
+    if not native_available():
+        pytest.skip("no C++ toolchain")
+    payload = _payload(2)
+    plan = compile_payload(payload)
+    lat_n = np.concatenate(
+        [
+            run_native(plan, seed=s, collect_gauges=False).latencies
+            for s in range(SEEDS)
+        ],
+    )
+    lat_o = _oracle_latencies(payload, SEEDS)
+    for q in (50, 95):
+        pn, po = np.percentile(lat_n, q), np.percentile(lat_o, q)
+        assert abs(pn - po) / po < 0.08, (q, po, pn)
+    assert abs(lat_n.mean() - lat_o.mean()) / lat_o.mean() < 0.06
+
+
+def test_adjacent_io_db_steps_release_between_queries() -> None:
+    """Two back-to-back io_db steps are two acquisitions: the connection is
+    released between them (the second acquire joins the FIFO tail behind
+    any waiters), matching the oracle's per-step discipline — the compiler
+    must NOT merge adjacent SEG_DB segments."""
+    data = yaml.safe_load(open(BASE).read())
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    srv["endpoints"][0]["steps"] = [
+        {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.002}},
+        {"kind": "io_db", "step_operation": {"io_waiting_time": 0.030}},
+        {"kind": "io_db", "step_operation": {"io_waiting_time": 0.030}},
+    ]
+    srv["server_resources"]["db_connection_pool"] = 1
+    data["rqs_input"]["avg_active_users"]["mean"] = 30
+    data["sim_settings"]["total_simulation_time"] = 150
+    payload = SimulationPayload.model_validate(data)
+    plan = compile_payload(payload)
+    from asyncflow_tpu.compiler.plan import SEG_DB
+
+    assert int(np.sum(plan.seg_kind[0, 0] == SEG_DB)) == 2  # not merged
+
+    # measured noise floor at this near-saturated K=1 config: disjoint
+    # 8-seed oracle-vs-oracle ensembles differ by 8-11% in mean and
+    # 12-15% in p95 — the tolerance covers that, and the structural
+    # assertion above is the real regression guard (merged segments would
+    # shift the mean far outside it AND change the segment count)
+    lat_o = _oracle_latencies(payload, 16)
+    lat_e = _event_latencies(payload, 16)
+    assert abs(lat_e.mean() - lat_o.mean()) / lat_o.mean() < 0.12
+    for q in (50, 95):
+        po, pe = np.percentile(lat_o, q), np.percentile(lat_e, q)
+        assert abs(pe - po) / po < 0.15, (q, po, pe)
+
+
+def test_pool_wait_counts_as_io_sleep() -> None:
+    """The connection wait parks in the event loop: the io-sleep gauge must
+    rise when the pool binds (identical gauge semantics on both engines)."""
+    from asyncflow_tpu.config.constants import SampledMetricName
+
+    res_pool = OracleEngine(_payload(1, users=40, horizon=60), seed=3).run()
+    res_free = OracleEngine(_payload(None, users=40, horizon=60), seed=3).run()
+    key = SampledMetricName.EVENT_LOOP_IO_SLEEP.value
+    io_pool = res_pool.sampled[key]["srv-1"].mean()
+    io_free = res_free.sampled[key]["srv-1"].mean()
+    assert io_pool > io_free * 1.5  # waiters pile up in the event loop

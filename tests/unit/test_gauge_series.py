@@ -97,6 +97,37 @@ def test_series_checkpoint_roundtrip(tmp_path) -> None:
     assert other.results.gauge_series is None
 
 
+def test_confidence_intervals_and_bands() -> None:
+    """Reference ROADMAP §3 deliverables: CIs on Monte-Carlo metrics and
+    percentile bands over streamed time series."""
+    payload = _payload()
+    runner = SweepRunner(
+        payload,
+        use_mesh=False,
+        gauge_series=("ram_in_use", ["srv-1"], RESAMPLE_S),
+    )
+    report = runner.run(32, seed=2, chunk_size=16)
+
+    point, lo, hi = report.percentile_ci(95)
+    assert lo < point < hi
+    assert np.isfinite(lo) and hi - lo < point  # a meaningful interval
+    # wider confidence -> wider interval
+    _, lo99, hi99 = report.percentile_ci(95, level=0.99)
+    assert hi99 - lo99 > hi - lo
+
+    c_point, c_lo, c_hi = report.metric_ci(report.results.completed)
+    assert c_lo < c_point < c_hi
+
+    times, b_lo, b_med, b_hi = report.gauge_series_band("srv-1")
+    assert times.shape == b_lo.shape == b_med.shape == b_hi.shape
+    assert np.all(b_lo <= b_med + 1e-9) and np.all(b_med <= b_hi + 1e-9)
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="confidence level"):
+        report.percentile_ci(95, level=1.5)
+
+
 def test_series_requires_fast_path() -> None:
     data = yaml.safe_load(open(BASE).read())
     data["topology_graph"]["edges"][0]["latency"]["distribution"] = "poisson"
