@@ -50,6 +50,11 @@ SEG_IO = 2
 # Only emitted when the compiler cannot prove the pool non-binding; plans
 # containing SEG_DB run on the event engines (oracle/native/jax-event).
 SEG_DB = 3
+# an io_cache step with hit/miss dynamics: the sleep is a per-request
+# two-point mixture (hit latency with probability p, else the backing
+# store's miss latency).  Modeled by the event engines; the fast path's
+# static visit tables decline it.
+SEG_CACHE = 4
 
 # Multi-burst relaxation envelope: nominal per-server core utilization above
 # which the fast path's fixed-point relaxation is measurably biased vs the
@@ -75,8 +80,9 @@ def _compile_endpoint(
     endpoint: Endpoint,
     *,
     db_pooled: bool = False,
-) -> tuple[list[tuple[int, float]], float]:
-    """Merge step runs into alternating (kind, duration) segments + RAM total.
+) -> tuple[list[tuple[int, float]], float, list[tuple[float, float] | None]]:
+    """Merge step runs into alternating (kind, duration) segments + RAM total
+    + per-segment cache mixture params.
 
     With ``db_pooled``, each ``io_db`` step lowers to its own
     :data:`SEG_DB` segment — adjacent io_db steps must NOT merge, because
@@ -84,8 +90,14 @@ def _compile_endpoint(
     tail behind any waiters), exactly like two sequential awaits on a real
     pool and like the oracle's per-step FifoTokens discipline; otherwise
     io_db merges into plain IO exactly as before.
+
+    Stochastic ``io_cache`` steps (hit/miss dynamics) lower to their own
+    :data:`SEG_CACHE` segments carrying ``(hit_probability, miss_time)``
+    in the returned ``cache`` list (aligned with the segments; None for
+    deterministic segments); the segment duration is the HIT latency.
     """
     segments: list[tuple[int, float]] = []
+    cache: list[tuple[float, float] | None] = []
     total_ram = 0.0
     for step in endpoint.steps:
         if step.is_ram:
@@ -93,15 +105,26 @@ def _compile_endpoint(
             continue
         if step.is_cpu:
             kind = SEG_CPU
+        elif step.is_stochastic_cache:
+            kind = SEG_CACHE
         elif db_pooled and step.kind == EndpointStepIO.DB:
             kind = SEG_DB
         else:
             kind = SEG_IO
-        if segments and segments[-1][0] == kind and kind != SEG_DB:
+        if (
+            segments
+            and segments[-1][0] == kind
+            and kind not in (SEG_DB, SEG_CACHE)
+        ):
             segments[-1] = (kind, segments[-1][1] + step.quantity)
         else:
             segments.append((kind, step.quantity))
-    return segments, total_ram
+            cache.append(
+                (float(step.cache_hit_probability), float(step.cache_miss_time))
+                if kind == SEG_CACHE
+                else None,
+            )
+    return segments, total_ram, cache
 
 
 def _burst_decomposition(
@@ -230,6 +253,19 @@ class StaticPlan:
     #: non-binding) connection pool stays provably non-binding; inf when
     #: no pool was lowered away.  Sweep overrides must stay below it.
     db_rate_headroom: float = math.inf
+    #: (NS, NEP, NSEG+1) f32 — SEG_CACHE hit probability (0 elsewhere) and
+    #: miss latency; seg_dur holds the hit latency.
+    seg_hit_prob: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0, 0), np.float32),
+    )
+    seg_miss_dur: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0, 0), np.float32),
+    )
+
+    @property
+    def has_stochastic_cache(self) -> bool:
+        """True when any segment is a cache hit/miss mixture."""
+        return bool(self.seg_hit_prob.size and np.any(self.seg_hit_prob > 0))
 
     @property
     def has_db_pool(self) -> bool:
@@ -358,7 +394,16 @@ def _estimate_capacity(payload: SimulationPayload) -> tuple[int, int]:
         io_req = 0.0
         ram_req = 0.0
         for endpoint in server.endpoints:
-            segs, ram = _compile_endpoint(endpoint)
+            segs, ram, cache = _compile_endpoint(endpoint)
+            # capacity bounds use the worst-case (miss) duration of
+            # stochastic cache segments — relabeled SEG_IO so they enter
+            # the io/residence sums below (SEG_CACHE is an IO sleep)
+            segs = [
+                (SEG_IO, max(d, cache[i][1]))
+                if cache[i] is not None
+                else (k, d)
+                for i, (k, d) in enumerate(segs)
+            ]
             cpu_req = max(
                 cpu_req,
                 sum(dur for kind, dur in segs if kind == SEG_CPU),
@@ -521,16 +566,24 @@ def compile_payload(
         dtype=np.int32,
     )
     max_segments = max(
-        (len(segs) for per_server in compiled for segs, _ in per_server),
+        (len(segs) for per_server in compiled for segs, *_ in per_server),
         default=0,
     )
 
     seg_kind = np.zeros((n_servers, max_endpoints, max_segments + 1), dtype=np.int32)
     seg_dur = np.zeros((n_servers, max_endpoints, max_segments + 1), dtype=np.float32)
+    # SEG_CACHE mixtures: seg_dur holds the hit latency; these two hold the
+    # hit probability (0 = deterministic segment) and the miss latency
+    seg_hit_prob = np.zeros(
+        (n_servers, max_endpoints, max_segments + 1), dtype=np.float32,
+    )
+    seg_miss_dur = np.zeros(
+        (n_servers, max_endpoints, max_segments + 1), dtype=np.float32,
+    )
     endpoint_ram = np.zeros((n_servers, max_endpoints), dtype=np.float32)
     n_endpoints = np.zeros(n_servers, dtype=np.int32)
     bursts = [
-        [_burst_decomposition(segs) for segs, _ in per_server]
+        [_burst_decomposition(segs) for segs, *_ in per_server]
         for per_server in compiled
     ]
     max_bursts = max(
@@ -544,11 +597,14 @@ def compile_payload(
     endpoint_post_io = np.zeros((n_servers, max_endpoints), dtype=np.float32)
     for s, per_server in enumerate(compiled):
         n_endpoints[s] = len(per_server)
-        for e, (segs, ram) in enumerate(per_server):
+        for e, (segs, ram, cache) in enumerate(per_server):
             endpoint_ram[s, e] = ram
             for k, (seg_k, dur) in enumerate(segs):
                 seg_kind[s, e, k] = seg_k
                 seg_dur[s, e, k] = dur
+                if cache[k] is not None:
+                    seg_hit_prob[s, e, k] = cache[k][0]
+                    seg_miss_dur[s, e, k] = cache[k][1]
             dur_list, pre_list, post = bursts[s][e]
             n_bursts[s, e] = len(dur_list)
             burst_dur[s, e, : len(dur_list)] = dur_list
@@ -714,6 +770,8 @@ def compile_payload(
         relax_rho=relax_rho,
         server_db_pool=server_db_pool,
         db_rate_headroom=db_rate_headroom,
+        seg_hit_prob=seg_hit_prob,
+        seg_miss_dur=seg_miss_dur,
     )
 
 
@@ -803,7 +861,7 @@ def _fastpath_analysis(
         (
             sum(1 for k, _ in segs if k == SEG_CPU)
             for per_server in compiled
-            for segs, _ in per_server
+            for segs, *_ in per_server
         ),
         default=0,
     )
@@ -814,7 +872,18 @@ def _fastpath_analysis(
 
     ram_slots = np.zeros(n_servers, dtype=np.int32)
     for s, server in enumerate(servers):
-        if any(k == SEG_DB for segs, _ in compiled[s] for k, _ in segs):
+        if any(k == SEG_CACHE for segs, *_ in compiled[s] for k, _ in segs):
+            # per-request mixture sleeps don't fit the static visit tables
+            return (
+                False,
+                f"server {server.id}: stochastic cache step (hit/miss "
+                "mixture) — modeled on the event engines",
+                [],
+                no_slots,
+                0,
+                0.0,
+            )
+        if any(k == SEG_DB for segs, *_ in compiled[s] for k, _ in segs):
             # a pool the compiler could not prove non-binding: the FIFO
             # connection queue needs the event engines' waiter machinery
             return (
@@ -840,7 +909,7 @@ def _fastpath_analysis(
         cpu_dur = 0.0
         visits = 1
         needs: set[float] = set()
-        for segs, ram in compiled[s]:
+        for segs, ram, _ in compiled[s]:
             max_ram = max(max_ram, ram)
             if ram > 0:
                 needs.add(ram)
@@ -867,7 +936,7 @@ def _fastpath_analysis(
         # per endpoint, no zero-RAM endpoints that would bypass admission and
         # overtake in the core queue, and a uniform pre-burst IO (a longer
         # pre-IO on one endpoint would let later grants enqueue earlier).
-        if len(needs) == 1 and min(ram for _, ram in compiled[s]) > 0:
+        if len(needs) == 1 and min(ram for _, ram, _ in compiled[s]) > 0:
             if visits > 1:
                 return (
                     False,
@@ -879,7 +948,7 @@ def _fastpath_analysis(
                 )
             pre_ios = {
                 _burst_decomposition(segs)[1][0]
-                for segs, _ in compiled[s]
+                for segs, *_ in compiled[s]
                 if any(k == SEG_CPU for k, _ in segs)
             }
             if len(pre_ios) > 1:
@@ -950,7 +1019,7 @@ def _fastpath_analysis(
     # envelope are routed to the event engine.
     max_visits_per_server = [
         max(
-            (sum(1 for k, _ in segs if k == SEG_CPU) for segs, _ in compiled[s]),
+            (sum(1 for k, _ in segs if k == SEG_CPU) for segs, *_ in compiled[s]),
             default=0,
         )
         for s in range(n_servers)
@@ -964,7 +1033,7 @@ def _fastpath_analysis(
             if max_visits_per_server[s] <= 1:
                 continue
             cpu_dur = max(
-                (sum(d for k, d in segs if k == SEG_CPU) for segs, _ in compiled[s]),
+                (sum(d for k, d in segs if k == SEG_CPU) for segs, *_ in compiled[s]),
                 default=0.0,
             )
             cores = servers[s].server_resources.cpu_cores

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from asyncflow_tpu.compiler.plan import (
+    SEG_CACHE,
     SEG_CPU,
     SEG_DB,
     SEG_END,
@@ -123,6 +124,7 @@ class Engine:
         # static pruning: db-pool machinery compiles in only when the plan
         # actually models a finite connection pool (SEG_DB segments exist)
         self._has_db = bool(np.any(plan.seg_kind == SEG_DB))
+        self._has_cache = bool(np.any(plan.seg_kind == SEG_CACHE))
         self._compiled: dict = {}
 
     # ==================================================================
@@ -393,6 +395,18 @@ class Engine:
         is_cpu = pred & (kind == SEG_CPU)
         is_io = pred & (kind == SEG_IO)
         is_end = pred & (kind == SEG_END)
+        if self._has_cache:
+            # a SEG_CACHE is an IO sleep whose duration is a per-request
+            # hit/miss mixture: hit latency (seg_dur) with probability
+            # seg_hit_prob, else the backing store's miss latency
+            is_cache = pred & (kind == SEG_CACHE)
+            u_cache = jax.random.uniform(jax.random.fold_in(key, 24))
+            dur = jnp.where(
+                is_cache & (u_cache >= p.seg_hit_prob[s, ep, seg]),
+                p.seg_miss_dur[s, ep, seg],
+                dur,
+            )
+            is_io = is_io | is_cache
 
         has_waiters = st.cpu_wait_n[s] > 0
         can_take = (st.cores_free[s] > 0) & ~has_waiters
@@ -677,6 +691,8 @@ class Engine:
         kind = p.seg_kind[s, ep, seg]
         was_cpu = pred & (kind == SEG_CPU)
         was_io = pred & (kind == SEG_IO)
+        if self._has_cache:
+            was_io = was_io | (pred & (kind == SEG_CACHE))
 
         # CPU handoff: grant the longest-waiting request on this server
         waiting = (st.req_ev == EV_WAIT_CPU) & (st.req_srv == s)

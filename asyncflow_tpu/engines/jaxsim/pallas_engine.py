@@ -298,12 +298,13 @@ class PallasEngine:
         kernel on its scenario shard (the kernel itself is a single-device
         program — GSPMD cannot partition a ``pallas_call``, so the sharding
         seam has to be explicit)."""
-        if plan.has_db_pool:
-            # the VMEM kernel has no DB-pool FIFO machinery; the compiler
-            # routes such plans to the general event engine
+        if plan.has_db_pool or plan.has_stochastic_cache:
+            # the VMEM kernel has no DB-pool FIFO machinery and no cache
+            # mixture draws; the compiler routes such plans to the general
+            # event engine
             msg = (
                 "the Pallas kernel does not model binding DB connection "
-                "pools; use the event engine for this plan"
+                "pools or stochastic cache steps; use the event engine"
             )
             raise ValueError(msg)
         self.plan = plan

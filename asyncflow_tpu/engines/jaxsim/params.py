@@ -37,6 +37,8 @@ class PlanParams(NamedTuple):
     n_endpoints: jnp.ndarray
     seg_kind: jnp.ndarray
     seg_dur: jnp.ndarray
+    seg_hit_prob: jnp.ndarray  # SEG_CACHE mixtures (0 = deterministic)
+    seg_miss_dur: jnp.ndarray
     endpoint_ram: jnp.ndarray
     exit_edge: jnp.ndarray
     exit_kind: jnp.ndarray
@@ -65,6 +67,8 @@ def params_from_plan(plan: StaticPlan) -> PlanParams:
         n_endpoints=jnp.asarray(plan.n_endpoints),
         seg_kind=jnp.asarray(plan.seg_kind),
         seg_dur=jnp.asarray(plan.seg_dur),
+        seg_hit_prob=jnp.asarray(plan.seg_hit_prob),
+        seg_miss_dur=jnp.asarray(plan.seg_miss_dur),
         endpoint_ram=jnp.asarray(plan.endpoint_ram),
         exit_edge=jnp.asarray(plan.exit_edge),
         exit_kind=jnp.asarray(plan.exit_kind),

@@ -188,6 +188,13 @@ class _ServerRuntime:
                     yield AcquireToken(self.db)
                     yield Timeout(step.quantity)
                     self.db.release()
+                elif step.is_stochastic_cache:
+                    # per-request hit/miss mixture: hit latency with
+                    # probability p, else the backing store's miss latency
+                    hit = engine.rng.uniform() < step.cache_hit_probability
+                    yield Timeout(
+                        step.quantity if hit else step.cache_miss_time,
+                    )
                 else:
                     yield Timeout(step.quantity)
 

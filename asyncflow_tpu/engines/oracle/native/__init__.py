@@ -45,6 +45,8 @@ class _PlanC(ctypes.Structure):
         ("n_endpoints", _i32p),
         ("seg_kind", _i32p),
         ("seg_dur", _f32p),
+        ("seg_hit_prob", _f32p),
+        ("seg_miss_dur", _f32p),
         ("endpoint_ram", _f32p),
         ("exit_edge", _i32p),
         ("exit_kind", _i32p),
@@ -188,6 +190,8 @@ def run_native(
         n_endpoints=i32(plan.n_endpoints),
         seg_kind=i32(plan.seg_kind),
         seg_dur=f32(plan.seg_dur),
+        seg_hit_prob=f32(plan.seg_hit_prob),
+        seg_miss_dur=f32(plan.seg_miss_dur),
         endpoint_ram=f32(plan.endpoint_ram),
         exit_edge=i32(plan.exit_edge),
         exit_kind=i32(plan.exit_kind),

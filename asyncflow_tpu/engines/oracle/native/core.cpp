@@ -24,6 +24,7 @@ namespace {
 constexpr int SEG_CPU = 1;
 constexpr int SEG_IO = 2;
 constexpr int SEG_DB = 3;  // io_db holding one of K FIFO pool connections
+constexpr int SEG_CACHE = 4;  // io_cache hit/miss mixture sleep
 
 // hop targets (compiler order)
 constexpr int TARGET_SERVER = 1;
@@ -58,6 +59,8 @@ struct PlanC {
     const int32_t* n_endpoints;
     const int32_t* seg_kind;  // [NS][NEP][NSEG+1]
     const float* seg_dur;
+    const float* seg_hit_prob;  // SEG_CACHE: hit probability (0 = deterministic)
+    const float* seg_miss_dur;  // SEG_CACHE: miss latency
     const float* endpoint_ram;  // [NS][NEP]
     const int32_t* exit_edge;
     const int32_t* exit_kind;
@@ -280,6 +283,9 @@ struct Sim {
         return p.seg_dur + ((int64_t)s * p.max_endpoints + ep)
                                * (p.max_segments + 1);
     }
+    int64_t seg_off(int s, int ep, int k) const {
+        return ((int64_t)s * p.max_endpoints + ep) * (p.max_segments + 1) + k;
+    }
 
     // ---- server machinery ---------------------------------------------
     void start_segment(int32_t i) {
@@ -297,6 +303,13 @@ struct Sim {
             }
         } else if (kind == SEG_IO) {
             ++sv.io_len;
+            push(now + dur, EV_SEG_END, i);
+        } else if (kind == SEG_CACHE) {
+            // per-request hit/miss mixture: hit latency (dur) with
+            // probability hit_prob, else the backing store's miss latency
+            ++sv.io_len;
+            int64_t off = seg_off(r.srv, r.ep, r.seg);
+            if (uniform() >= p.seg_hit_prob[off]) dur = p.seg_miss_dur[off];
             push(now + dur, EV_SEG_END, i);
         } else if (kind == SEG_DB) {
             // hold one of K FIFO connections for the query; the wait (if

@@ -374,7 +374,9 @@ class SweepRunner:
         elif engine == "pallas" or (
             engine == "auto"
             and jax.default_backend() == "tpu"
-            and not self.plan.has_db_pool  # VMEM kernel has no pool FIFO
+            # the VMEM kernel models neither pool FIFOs nor cache mixtures
+            and not self.plan.has_db_pool
+            and not self.plan.has_stochastic_cache
         ):
             from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
 

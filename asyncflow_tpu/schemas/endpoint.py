@@ -28,10 +28,21 @@ _EXPECTED_OPERATION: dict[type, StepOperation] = {
 
 
 class Step(BaseModel):
-    """One unit of work inside an endpoint."""
+    """One unit of work inside an endpoint.
+
+    ``io_cache`` steps may additionally carry **hit/miss dynamics**
+    (beyond the reference, whose roadmap milestone 4 plans them): with
+    ``cache_hit_probability`` p, the step sleeps ``io_waiting_time``
+    (the hit latency) with probability p and ``cache_miss_time`` (the
+    backing-store latency) otherwise, drawn independently per request.
+    Both fields must be given together and only on io_cache steps;
+    omitted, the step is a plain deterministic sleep as before.
+    """
 
     kind: StepKind
     step_operation: dict[StepOperation, PositiveFloat | PositiveInt]
+    cache_hit_probability: float | None = None
+    cache_miss_time: PositiveFloat | None = None
 
     @field_validator("step_operation", mode="before")
     @classmethod
@@ -56,7 +67,34 @@ class Step(BaseModel):
                 raise ValueError(msg)
         return self
 
+    @model_validator(mode="after")
+    def _cache_fields_coherent(self) -> Step:
+        has_p = self.cache_hit_probability is not None
+        has_m = self.cache_miss_time is not None
+        if not has_p and not has_m:
+            return self
+        if not (has_p and has_m):
+            msg = (
+                "cache_hit_probability and cache_miss_time must be given "
+                "together"
+            )
+            raise ValueError(msg)
+        if self.kind != EndpointStepIO.CACHE:
+            msg = "cache hit/miss dynamics are only valid on io_cache steps"
+            raise ValueError(msg)
+        if not 0.0 < self.cache_hit_probability < 1.0:
+            msg = (
+                "cache_hit_probability must be in (0, 1) — use a plain "
+                "io_cache step for the degenerate cases"
+            )
+            raise ValueError(msg)
+        return self
+
     # -- typed accessors used by the compiler / engines --------------------
+
+    @property
+    def is_stochastic_cache(self) -> bool:
+        return self.cache_hit_probability is not None
 
     @property
     def quantity(self) -> float:
