@@ -249,10 +249,24 @@ class StaticPlan:
     server_db_pool: np.ndarray = field(
         default_factory=lambda: np.empty(0, np.int32),
     )
-    #: max workload-rate scale under which every lowered-away (proven
-    #: non-binding) connection pool stays provably non-binding; inf when
-    #: no pool was lowered away.  Sweep overrides must stay below it.
-    db_rate_headroom: float = math.inf
+    #: max workload-rate scale under which every lowered-away non-binding
+    #: proof (DB pools, ready-queue caps) still holds; inf when nothing was
+    #: lowered away.  Sweep overrides must stay below it.
+    proof_rate_headroom: float = math.inf
+    #: (NS,) i32 modeled ready-queue cap (load shedding); -1 = unbounded or
+    #: proven effectively-unreachable and lowered away.  Servers with a
+    #: value >= 0 shed requests that would join a full CPU ready queue
+    #: (reference roadmap milestone 5).
+    server_queue_cap: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.int32),
+    )
+
+    @property
+    def has_queue_cap(self) -> bool:
+        """True when any server's ready-queue cap is actually modeled."""
+        return bool(
+            self.server_queue_cap.size and np.any(self.server_queue_cap >= 0)
+        )
     #: (NS, NEP, NSEG+1) f32 — SEG_CACHE hit probability (0 elsewhere) and
     #: miss latency; seg_dur holds the hit latency.
     seg_hit_prob: np.ndarray = field(
@@ -517,7 +531,7 @@ def compile_payload(
     srv_rates_est = _server_entry_rates(payload)
     users_est = float(payload.rqs_input.avg_active_users.mean)
     db_model: list[bool] = []
-    db_rate_headroom = math.inf
+    proof_rate_headroom = math.inf
     for s, server in enumerate(servers):
         pool_k = server.server_resources.db_connection_pool
         if pool_k is None:
@@ -549,9 +563,52 @@ def compile_payload(
             # (sweep overrides that scale the workload past this must be
             # refused — the lowered-away pool could silently bind)
             t = (-6.0 + math.sqrt(36.0 + 4.0 * (pool_k - 8.0))) / 2.0
-            db_rate_headroom = min(db_rate_headroom, (t * t) / max(m, 1e-12))
+            proof_rate_headroom = min(
+                proof_rate_headroom, (t * t) / max(m, 1e-12),
+            )
 
-    compiled: list[list[tuple[list[tuple[int, float]], float]]] = [
+    # Ready-queue caps (load shedding — reference roadmap milestone 5):
+    # modeled only when the cap is actually reachable.  For a stable queue
+    # (rho_b < 0.9, burst-inflated) the stationary queue-length tail is
+    # geometrically bounded, so a cap with rho_b^(cap-16) < 1e-12 is
+    # effectively unreachable and lowers away (every engine skips it; the
+    # fast path stays exact).  Reachable caps are modeled by the event
+    # engines and decline the fast path.
+    queue_cap_model = np.full(n_servers, -1, dtype=np.int32)
+    for s_i, server in enumerate(servers):
+        cap = server.overload.max_ready_queue if server.overload else None
+        if cap is None:
+            continue
+        cpu_dur = max(
+            (
+                sum(st.quantity for st in ep.steps if st.is_cpu)
+                for ep in server.endpoints
+            ),
+            default=0.0,
+        )
+        if cpu_dur <= 0 or srv_rates_est is None:
+            queue_cap_model[s_i] = cap if cpu_dur > 0 else -1
+            continue
+        cores = server.server_resources.cpu_cores
+        burst = srv_rates_est[s_i] * (1.0 + 3.0 / math.sqrt(max(users_est, 1.0)))
+        rho_b = burst * cpu_dur / max(cores, 1)
+        needed = (
+            math.inf
+            if rho_b >= 0.9
+            else math.log(1e-12) / math.log(max(rho_b, 1e-9)) + 16.0
+        )
+        if cap >= needed:
+            # lowered away; record the rate scale that keeps the proof
+            rho_max = min(0.9, math.exp(math.log(1e-12) / max(cap - 16.0, 1.0)))
+            proof_rate_headroom = min(
+                proof_rate_headroom, rho_max / max(rho_b, 1e-12),
+            )
+        else:
+            queue_cap_model[s_i] = cap
+
+    compiled: list[
+        list[tuple[list[tuple[int, float]], float, list]]
+    ] = [
         [
             _compile_endpoint(ep, db_pooled=db_model[s])
             for ep in server.endpoints
@@ -705,6 +762,7 @@ def compile_payload(
             len(outages),
             lb_edge_means=[float(edge_mean[e]) for e in lb_slots],
             max_spike=float(spike_values.max()) if spike_values.size else 0.0,
+            server_queue_cap=queue_cap_model,
         )
     )
 
@@ -769,7 +827,8 @@ def compile_payload(
         lc_ring=lc_ring,
         relax_rho=relax_rho,
         server_db_pool=server_db_pool,
-        db_rate_headroom=db_rate_headroom,
+        proof_rate_headroom=proof_rate_headroom,
+        server_queue_cap=queue_cap_model,
         seg_hit_prob=seg_hit_prob,
         seg_miss_dur=seg_miss_dur,
     )
@@ -777,7 +836,7 @@ def compile_payload(
 
 def _fastpath_analysis(
     payload: SimulationPayload,
-    compiled: list[list[tuple[list[tuple[int, float]], float]]],
+    compiled: list[list[tuple[list[tuple[int, float]], float, list]]],
     exit_kind: np.ndarray,
     exit_target: np.ndarray,
     lb_algo: int,
@@ -785,6 +844,7 @@ def _fastpath_analysis(
     *,
     lb_edge_means: list[float] | None = None,
     max_spike: float = 0.0,
+    server_queue_cap: np.ndarray | None = None,
 ) -> tuple[bool, str, list[int], np.ndarray, int, float]:
     """Decide whether the scan engine can execute this plan faithfully.
 
@@ -872,6 +932,18 @@ def _fastpath_analysis(
 
     ram_slots = np.zeros(n_servers, dtype=np.int32)
     for s, server in enumerate(servers):
+        if server_queue_cap is not None and server_queue_cap[s] >= 0:
+            # a reachable ready-queue cap sheds requests mid-endpoint; the
+            # closed-form recursions have no rejection channel
+            return (
+                False,
+                f"server {server.id}: reachable ready-queue cap "
+                "(load shedding modeled on the event engines)",
+                [],
+                no_slots,
+                0,
+                0.0,
+            )
         if any(k == SEG_CACHE for segs, *_ in compiled[s] for k, _ in segs):
             # per-request mixture sleeps don't fit the static visit tables
             return (

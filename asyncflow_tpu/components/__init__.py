@@ -3,7 +3,13 @@
 from asyncflow_tpu.schemas.edges import Edge
 from asyncflow_tpu.schemas.endpoint import Endpoint, Step
 from asyncflow_tpu.schemas.events import EventInjection
-from asyncflow_tpu.schemas.nodes import Client, LoadBalancer, Server, ServerResources
+from asyncflow_tpu.schemas.nodes import (
+    Client,
+    LoadBalancer,
+    OverloadPolicy,
+    Server,
+    ServerResources,
+)
 
 __all__ = [
     "Client",
@@ -11,6 +17,7 @@ __all__ = [
     "Endpoint",
     "EventInjection",
     "LoadBalancer",
+    "OverloadPolicy",
     "Server",
     "ServerResources",
     "Step",

@@ -125,6 +125,7 @@ class Engine:
         # actually models a finite connection pool (SEG_DB segments exist)
         self._has_db = bool(np.any(plan.seg_kind == SEG_DB))
         self._has_cache = bool(np.any(plan.seg_kind == SEG_CACHE))
+        self._has_shed = plan.has_queue_cap
         self._compiled: dict = {}
 
     # ==================================================================
@@ -413,6 +414,15 @@ class Engine:
         cpu_run = is_cpu & can_take
         cpu_wait = is_cpu & ~can_take
 
+        shed = jnp.bool_(False)
+        if self._has_shed:
+            # overload policy: a request that would join a FULL ready queue
+            # is shed — it releases its RAM and leaves the system, counted
+            # in n_rejected (reference roadmap milestone 5's queue cap)
+            cap = p.server_queue_cap[s]
+            shed = cpu_wait & (cap >= 0) & (st.cpu_wait_n[s] >= cap)
+            cpu_wait = cpu_wait & ~shed
+
         run_now = cpu_run | is_io
         db_wait = jnp.bool_(False)
         if self._has_db:
@@ -462,7 +472,83 @@ class Engine:
         )
         st = self._gauge_add(st, now, self._g_ready(s), 1.0, cpu_wait)
         st = self._gauge_add(st, now, self._g_io(s), 1.0, is_io)
+        if self._has_shed:
+            st = self._release_ram(st, i, s, now, shed)
+            st = st._replace(
+                req_ev=st.req_ev.at[i].set(
+                    jnp.where(shed, EV_IDLE, st.req_ev[i]),
+                ),
+                req_t=st.req_t.at[i].set(
+                    jnp.where(shed, INF, st.req_t[i]),
+                ),
+                req_ram=st.req_ram.at[i].set(
+                    jnp.where(shed, 0.0, st.req_ram[i]),
+                ),
+                req_ticket=st.req_ticket.at[i].set(
+                    jnp.where(shed, NO_TICKET, st.req_ticket[i]),
+                ),
+                n_rejected=st.n_rejected + jnp.where(shed, 1, 0),
+            )
         return self._exit_flow(st, i, s, now, key, ov, is_end)
+
+    def _release_ram(self, st, i, s, now, pred) -> EngineState:
+        """Return slot ``i``'s RAM to server ``s`` and run the strict-FIFO
+        grant cascade (no-op when the plan has no RAM steps)."""
+        if not self._has_ram:
+            return st
+        ram_amt = st.req_ram[i]
+        st = st._replace(
+            ram_free=st.ram_free.at[s].add(jnp.where(pred, ram_amt, 0.0)),
+        )
+        st = self._gauge_add(
+            st,
+            now,
+            self._g_ram(s),
+            -ram_amt,
+            pred & (ram_amt > 0),
+        )
+
+        # strict-FIFO RAM grant loop: grant heads while they fit
+        def gcond(carry):
+            req_ev, _t, req_tk, ram_free_s, wait_n, go = carry
+            waiting = (req_ev == EV_WAIT_RAM) & (st.req_srv == s)
+            tick = jnp.where(waiting, req_tk, NO_TICKET)
+            head = jnp.argmin(tick).astype(jnp.int32)
+            return go & (tick[head] < NO_TICKET) & (st.req_ram[head] <= ram_free_s)
+
+        def gbody(carry):
+            req_ev, req_t, req_tk, ram_free_s, wait_n, go = carry
+            waiting = (req_ev == EV_WAIT_RAM) & (st.req_srv == s)
+            tick = jnp.where(waiting, req_tk, NO_TICKET)
+            head = jnp.argmin(tick).astype(jnp.int32)
+            return (
+                req_ev.at[head].set(EV_RESUME),
+                req_t.at[head].set(now),
+                req_tk.at[head].set(NO_TICKET),
+                ram_free_s - st.req_ram[head],
+                wait_n - 1,
+                go,
+            )
+
+        req_ev, req_t, req_tk, ram_free_s, wait_n, _ = jax.lax.while_loop(
+            gcond,
+            gbody,
+            (
+                st.req_ev,
+                st.req_t,
+                st.req_ticket,
+                st.ram_free[s],
+                st.ram_wait_n[s],
+                pred,
+            ),
+        )
+        return st._replace(
+            req_ev=req_ev,
+            req_t=req_t,
+            req_ticket=req_tk,
+            ram_free=st.ram_free.at[s].set(ram_free_s),
+            ram_wait_n=st.ram_wait_n.at[s].set(wait_n),
+        )
 
     def _exit_flow(self, st, i, s, now, key, ov, pred) -> EngineState:
         """Endpoint finished: release RAM (FIFO grants), route the exit edge,
@@ -470,60 +556,7 @@ class Engine:
         p = self.params
         plan = self.plan
 
-        if self._has_ram:
-            ram_amt = st.req_ram[i]
-            st = st._replace(
-                ram_free=st.ram_free.at[s].add(jnp.where(pred, ram_amt, 0.0)),
-            )
-            st = self._gauge_add(
-                st,
-                now,
-                self._g_ram(s),
-                -ram_amt,
-                pred & (ram_amt > 0),
-            )
-
-            # strict-FIFO RAM grant loop: grant heads while they fit
-            def gcond(carry):
-                req_ev, _t, req_tk, ram_free_s, wait_n, go = carry
-                waiting = (req_ev == EV_WAIT_RAM) & (st.req_srv == s)
-                tick = jnp.where(waiting, req_tk, NO_TICKET)
-                head = jnp.argmin(tick).astype(jnp.int32)
-                return go & (tick[head] < NO_TICKET) & (st.req_ram[head] <= ram_free_s)
-
-            def gbody(carry):
-                req_ev, req_t, req_tk, ram_free_s, wait_n, go = carry
-                waiting = (req_ev == EV_WAIT_RAM) & (st.req_srv == s)
-                tick = jnp.where(waiting, req_tk, NO_TICKET)
-                head = jnp.argmin(tick).astype(jnp.int32)
-                return (
-                    req_ev.at[head].set(EV_RESUME),
-                    req_t.at[head].set(now),
-                    req_tk.at[head].set(NO_TICKET),
-                    ram_free_s - st.req_ram[head],
-                    wait_n - 1,
-                    go,
-                )
-
-            req_ev, req_t, req_tk, ram_free_s, wait_n, _ = jax.lax.while_loop(
-                gcond,
-                gbody,
-                (
-                    st.req_ev,
-                    st.req_t,
-                    st.req_ticket,
-                    st.ram_free[s],
-                    st.ram_wait_n[s],
-                    pred,
-                ),
-            )
-            st = st._replace(
-                req_ev=req_ev,
-                req_t=req_t,
-                req_ticket=req_tk,
-                ram_free=st.ram_free.at[s].set(ram_free_s),
-                ram_wait_n=st.ram_wait_n.at[s].set(wait_n),
-            )
+        st = self._release_ram(st, i, s, now, pred)
 
         # route the single exit edge of this server
         e = p.exit_edge[s]
@@ -794,6 +827,7 @@ class Engine:
             clock=jnp.zeros((maxn, 2), jnp.float32),
             clock_n=jnp.int32(0),
             n_generated=jnp.int32(0),
+            n_rejected=jnp.int32(0),
             n_dropped=jnp.int32(0),
             n_overflow=jnp.int32(0),
         )
@@ -1043,6 +1077,7 @@ def run_single(
         total_generated=int(state.n_generated),
         total_dropped=int(state.n_dropped),
         overflow_dropped=int(state.n_overflow),
+        total_rejected=int(getattr(state, "n_rejected", 0)),
         server_ids=plan.server_ids,
         edge_ids=plan.edge_ids,
     )
@@ -1090,6 +1125,11 @@ def sweep_results(
         total_generated=np.asarray(final.n_generated),
         total_dropped=np.asarray(final.n_dropped),
         overflow_dropped=np.asarray(final.n_overflow),
+        total_rejected=(
+            np.asarray(final.n_rejected)
+            if hasattr(final, "n_rejected")
+            else None
+        ),
         gauge_means=(
             np.asarray(final.gauge_means)
             if hasattr(final, "gauge_means")

@@ -34,6 +34,7 @@ class PlanParams(NamedTuple):
     edge_dropout: jnp.ndarray
     server_cores: jnp.ndarray
     server_ram: jnp.ndarray
+    server_queue_cap: jnp.ndarray  # (NS,) i32 ready-queue cap (-1 unbounded)
     n_endpoints: jnp.ndarray
     seg_kind: jnp.ndarray
     seg_dur: jnp.ndarray
@@ -64,6 +65,11 @@ def params_from_plan(plan: StaticPlan) -> PlanParams:
         edge_dropout=jnp.asarray(plan.edge_dropout),
         server_cores=jnp.asarray(plan.server_cores),
         server_ram=jnp.asarray(plan.server_ram),
+        server_queue_cap=jnp.asarray(
+            plan.server_queue_cap
+            if plan.server_queue_cap.size
+            else np.full(plan.n_servers, -1, np.int32),
+        ),
         n_endpoints=jnp.asarray(plan.n_endpoints),
         seg_kind=jnp.asarray(plan.seg_kind),
         seg_dur=jnp.asarray(plan.seg_dur),
@@ -141,6 +147,7 @@ class EngineState(NamedTuple):
     n_generated: jnp.ndarray
     n_dropped: jnp.ndarray
     n_overflow: jnp.ndarray
+    n_rejected: jnp.ndarray  # requests shed by overload policies
 
 
 class ScenarioOverrides(NamedTuple):

@@ -128,6 +128,10 @@ class _ServerRuntime:
         # parks in the event loop (core released, RAM held)
         pool = cfg.server_resources.db_connection_pool
         self.db = FifoTokens(engine.sim, pool) if pool is not None else None
+        # overload policy: shed requests that would join a full ready queue
+        self.queue_cap = (
+            cfg.overload.max_ready_queue if cfg.overload is not None else None
+        )
         self.ready_queue_len = 0
         self.io_queue_len = 0
         self.ram_in_use = 0.0
@@ -164,6 +168,23 @@ class _ServerRuntime:
                     self.io_queue_len -= 1
                 if not core_locked:
                     if self.cpu.would_block:
+                        if (
+                            self.queue_cap is not None
+                            and self.ready_queue_len >= self.queue_cap
+                        ):
+                            # overload policy: shed instead of queueing —
+                            # release held RAM, count, and leave the system
+                            if total_ram:
+                                self.ram_in_use -= total_ram
+                                self.ram.release(total_ram)
+                            req.finish_time = engine.sim.now
+                            req.record_hop(
+                                SystemNodes.SERVER,
+                                f"{self.cfg.id}-rejected",
+                                engine.sim.now,
+                            )
+                            engine.total_rejected += 1
+                            return
                         waiting_cpu = True
                         self.ready_queue_len += 1
                     yield AcquireToken(self.cpu)
@@ -229,6 +250,7 @@ class OracleEngine:
 
         self.total_generated = 0
         self.total_dropped = 0
+        self.total_rejected = 0
         self.rqs_clock: list[tuple[float, float]] = []
         self.edge_spike: dict[str, float] = {}
 
@@ -467,6 +489,7 @@ class OracleEngine:
             sampled=sampled,
             total_generated=self.total_generated,
             total_dropped=self.total_dropped,
+            total_rejected=self.total_rejected,
             server_ids=list(self.servers),
             edge_ids=list(self.edges),
             traces=self.traces if self.collect_traces else None,

@@ -42,6 +42,7 @@ class _PlanC(ctypes.Structure):
         ("server_cores", _i32p),
         ("server_ram", _f32p),
         ("server_db_pool", _i32p),
+        ("server_queue_cap", _i32p),
         ("n_endpoints", _i32p),
         ("seg_kind", _i32p),
         ("seg_dur", _f32p),
@@ -187,6 +188,11 @@ def run_native(
         server_cores=i32(plan.server_cores),
         server_ram=f32(plan.server_ram),
         server_db_pool=i32(plan.server_db_pool),
+        server_queue_cap=i32(
+            plan.server_queue_cap
+            if plan.server_queue_cap.size
+            else np.full(plan.n_servers, -1, np.int32),
+        ),
         n_endpoints=i32(plan.n_endpoints),
         seg_kind=i32(plan.seg_kind),
         seg_dur=f32(plan.seg_dur),
@@ -223,7 +229,7 @@ def run_native(
         if collect_gauges
         else None
     )
-    counters = np.zeros(4, dtype=np.int64)
+    counters = np.zeros(5, dtype=np.int64)
 
     lib.afnative_run(
         ctypes.byref(c),
@@ -232,7 +238,9 @@ def run_native(
         gauges.ctypes.data_as(_f32p) if gauges is not None else _f32p(),
         counters.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
-    generated, dropped, clock_n, clock_overflow = (int(x) for x in counters)
+    generated, dropped, clock_n, clock_overflow, rejected = (
+        int(x) for x in counters
+    )
     if clock_overflow > 0:
         import warnings
 
@@ -271,6 +279,7 @@ def run_native(
         sampled=sampled,
         total_generated=generated,
         total_dropped=dropped,
+        total_rejected=rejected,
         # clock-table truncation surfaced as a counter, not just a warning:
         # sweeps (parallel/sweep.py _NativeSweepEngine) aggregate it into
         # overflow_total so saturated native runs never look clean

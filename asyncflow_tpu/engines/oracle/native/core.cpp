@@ -56,6 +56,7 @@ struct PlanC {
     const int32_t* server_cores;
     const float* server_ram;
     const int32_t* server_db_pool;  // -1 = unlimited / not modeled
+    const int32_t* server_queue_cap;  // -1 = unbounded ready queue
     const int32_t* n_endpoints;
     const int32_t* seg_kind;  // [NS][NEP][NSEG+1]
     const float* seg_dur;
@@ -160,7 +161,7 @@ struct Sim {
     int64_t clock_n = 0;
     int64_t clock_overflow = 0;  // completions past the clock capacity
     float* out_gauges = nullptr;  // [n_samples][NG] or nullptr
-    int64_t generated = 0, dropped = 0;
+    int64_t generated = 0, dropped = 0, rejected = 0;
 
     explicit Sim(const PlanC& plan, uint64_t seed) : p(plan), rng(seed) {
         servers.resize(p.n_servers);
@@ -297,6 +298,19 @@ struct Sim {
             if (sv.cores_free > 0 && sv.cpu_wait.empty()) {
                 --sv.cores_free;
                 push(now + dur, EV_SEG_END, i);
+            } else if (p.server_queue_cap && p.server_queue_cap[r.srv] >= 0
+                       && (int32_t)sv.cpu_wait.size()
+                              >= p.server_queue_cap[r.srv]) {
+                // overload policy: the ready queue is full — shed the
+                // request (release its RAM, count it, free the slot)
+                if (r.ram > 0.0) {
+                    sv.ram_free += r.ram;
+                    sv.ram_in_use -= r.ram;
+                    r.ram = 0.0;
+                    grant_ram(r.srv);
+                }
+                ++rejected;
+                release(i);
             } else {
                 sv.cpu_wait.push_back(i);
                 ++sv.ready_len;
@@ -539,13 +553,15 @@ int64_t afnative_run(
     uint64_t seed,
     double* out_clock,
     float* out_gauges,  // may be null
-    int64_t* out_counters /* [generated, dropped, clock_n, clock_overflow] */) {
+    int64_t* out_counters
+    /* [generated, dropped, clock_n, clock_overflow, rejected] */) {
     Sim sim(*plan, seed);
     sim.out_clock = out_clock;
     sim.out_gauges = out_gauges;
     sim.run();
     out_counters[0] = sim.generated;
     out_counters[1] = sim.dropped;
+    out_counters[4] = sim.rejected;
     out_counters[2] = sim.clock_n;
     out_counters[3] = sim.clock_overflow;
     return 0;

@@ -29,6 +29,8 @@ class SimulationResults:
     #: requests lost because the engine's request pool was full (JAX engine
     #: only; non-zero values mean the pool must be enlarged).
     overflow_dropped: int = 0
+    #: requests shed by a server's overload policy (ready-queue cap).
+    total_rejected: int = 0
     #: server ids in topology order (stable ordering for accessors/plots).
     server_ids: list[str] = field(default_factory=list)
     #: edge ids in topology order.
@@ -84,6 +86,11 @@ class SweepResults:
     gauge_series: np.ndarray | None = None
     #: seconds between gauge_series rows (sample_period * stride).
     gauge_series_period: float | None = None
+    #: (S,) requests shed by overload policies per scenario.  The event and
+    #: native engines always populate it (zeros when no cap binds); None
+    #: only for engines with no shed channel at all (fast path / Pallas,
+    #: which the compiler restricts to plans without reachable caps).
+    total_rejected: np.ndarray | None = None
 
     def __getitem__(self, idx) -> SweepResults:
         """Slice along the scenario axis."""
@@ -108,6 +115,11 @@ class SweepResults:
                 self.gauge_series[idx] if self.gauge_series is not None else None
             ),
             gauge_series_period=self.gauge_series_period,
+            total_rejected=(
+                self.total_rejected[idx]
+                if self.total_rejected is not None
+                else None
+            ),
         )
 
     def percentile(self, q: float) -> np.ndarray:

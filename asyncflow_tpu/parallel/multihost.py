@@ -167,6 +167,7 @@ def merge_process_results(local: SweepResults, n_scenarios: int) -> SweepResults
         truncated=gather(local.truncated),
         gauge_series=gather(local.gauge_series),
         gauge_series_period=local.gauge_series_period,
+        total_rejected=gather(local.total_rejected),
     )
 
 

@@ -253,6 +253,11 @@ class SweepReport:
             "completed_total": int(completed),
             "dropped_total": int(res.total_dropped.sum()),
             "overflow_total": int(res.overflow_dropped.sum()),
+            "rejected_total": (
+                int(res.total_rejected.sum())
+                if res.total_rejected is not None
+                else 0
+            ),
             "truncated_total": (
                 int(res.truncated.sum()) if res.truncated is not None else 0
             ),
@@ -374,9 +379,11 @@ class SweepRunner:
         elif engine == "pallas" or (
             engine == "auto"
             and jax.default_backend() == "tpu"
-            # the VMEM kernel models neither pool FIFOs nor cache mixtures
+            # the VMEM kernel models neither pool FIFOs, cache mixtures,
+            # nor ready-queue shedding
             and not self.plan.has_db_pool
             and not self.plan.has_stochastic_cache
+            and not self.plan.has_queue_cap
         ):
             from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
 
@@ -424,7 +431,7 @@ class SweepRunner:
         digest = hashlib.sha256()
         # bump when the per-chunk npz schema changes so stale chunks are
         # never silently merged (e.g. pre-gauge_means chunks)
-        digest.update(b"chunk-schema-v3")
+        digest.update(b"chunk-schema-v4")
         digest.update(self.payload.model_dump_json().encode())
         digest.update(self.engine_kind.encode())
         digest.update(str(self.engine.n_hist_bins).encode())
@@ -659,6 +666,7 @@ class _NativeSweepEngine:
         gen = np.zeros(s, np.int64)
         dropped = np.zeros(s, np.int64)
         overflow = np.zeros(s, np.int64)
+        rejected = np.zeros(s, np.int64)
         for i in range(s):
             # full 64-bit seed entropy: seeds differing only in high bits
             # must produce distinct streams (SeedSequence takes arbitrary
@@ -693,6 +701,7 @@ class _NativeSweepEngine:
             gen[i] = res.total_generated
             dropped[i] = res.total_dropped
             overflow[i] = res.overflow_dropped
+            rejected[i] = res.total_rejected
         return SweepResults(
             settings=settings,
             completed=completed,
@@ -706,6 +715,7 @@ class _NativeSweepEngine:
             total_generated=gen,
             total_dropped=dropped,
             overflow_dropped=overflow,
+            total_rejected=rejected,
         )
 
 
@@ -760,6 +770,8 @@ class _SweepCheckpoint:
         if part.gauge_series is not None:
             payload["gauge_series"] = part.gauge_series
             payload["gauge_series_period"] = np.float64(part.gauge_series_period)
+        if part.total_rejected is not None:
+            payload["total_rejected"] = part.total_rejected
         if part.truncated is not None:
             payload["truncated"] = part.truncated
         # atomic write so an interrupt never leaves a half-written chunk
@@ -783,6 +795,9 @@ class _SweepCheckpoint:
                     float(data["gauge_series_period"])
                     if "gauge_series_period" in data
                     else None
+                ),
+                total_rejected=(
+                    data["total_rejected"] if "total_rejected" in data else None
                 ),
                 truncated=data["truncated"] if "truncated" in data else None,
                 **{name: data[name] for name in self._ARRAY_FIELDS},
@@ -827,19 +842,19 @@ def _override_rate_scale(plan, overrides: ScenarioOverrides) -> float:
 
 def _guard_db_headroom(plan, overrides: ScenarioOverrides | None) -> None:
     """Refuse rate-raising overrides that would push a lowered-away
-    (proven non-binding) DB connection pool past its proof's headroom."""
+    non-binding proof (DB pool / ready-queue cap) past its headroom."""
     import math
 
-    if overrides is None or math.isinf(plan.db_rate_headroom):
+    if overrides is None or math.isinf(plan.proof_rate_headroom):
         return
     scale = _override_rate_scale(plan, overrides)
-    if scale > plan.db_rate_headroom * 1.001:
+    if scale > plan.proof_rate_headroom * 1.001:
         msg = (
             f"overrides scale the workload {scale:.2f}x, past the "
-            f"{plan.db_rate_headroom:.2f}x headroom of the DB-pool "
-            "non-binding proof (the pool was lowered away at the base "
-            "rate and could bind at this one); raise the base workload so "
-            "the compiler models the pool"
+            f"{plan.proof_rate_headroom:.2f}x headroom of a non-binding "
+            "proof (a DB pool or ready-queue cap was lowered away at the "
+            "base rate and could bind at this one); raise the base "
+            "workload so the compiler models it"
         )
         raise _FastpathOverrideError(msg)
 
@@ -959,6 +974,11 @@ def _concat_sweeps(parts: list[SweepResults]) -> SweepResults:
                 else None
             ),
             gauge_series_period=first.gauge_series_period,
+            total_rejected=(
+                np.concatenate([p.total_rejected for p in parts])
+                if all(p.total_rejected is not None for p in parts)
+                else None
+            ),
         )
     return merged
 

@@ -61,6 +61,24 @@ class ServerResources(BaseModel):
     )
 
 
+class OverloadPolicy(BaseModel):
+    """How a server protects itself under overload (beyond the reference,
+    whose roadmap milestone 5 plans these controls).
+
+    ``max_ready_queue``: bound on the CPU ready queue — a request that
+    would join the queue when ``max_ready_queue`` waiters are already
+    parked is **shed** (rejected: it leaves the system immediately,
+    releases its RAM, is excluded from latency stats, and counts in
+    ``total_rejected``).  The check applies at every core acquisition,
+    including re-acquisition after I/O — the semantics of a bounded
+    executor queue.  ``None`` = unbounded (reference behavior).
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    max_ready_queue: PositiveInt | None = None
+
+
 class Server(BaseModel):
     """An event-loop server exposing one or more endpoints."""
 
@@ -68,6 +86,8 @@ class Server(BaseModel):
     type: SystemNodes = SystemNodes.SERVER
     server_resources: ServerResources
     endpoints: list[Endpoint]
+    #: optional load-shedding controls (reference roadmap milestone 5)
+    overload: OverloadPolicy | None = None
 
     _check_type = field_validator("type", mode="after")(_fixed_type(SystemNodes.SERVER))
 

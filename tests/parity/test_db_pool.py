@@ -107,16 +107,16 @@ def test_override_guard_protects_lowered_pools() -> None:
     runner = SweepRunner(payload, use_mesh=False)
     plan = runner.plan
     assert not plan.has_db_pool
-    assert 1.0 < plan.db_rate_headroom < np.inf
+    assert 1.0 < plan.proof_rate_headroom < np.inf
 
     n = 4
-    safe_users = 60.0 * min(1.5, plan.db_rate_headroom * 0.5)
+    safe_users = 60.0 * min(1.5, plan.proof_rate_headroom * 0.5)
     ok = make_overrides(plan, n, user_mean=np.full(n, safe_users))
     runner.run(n, seed=0, overrides=ok, chunk_size=n)  # inside headroom
 
-    bad_users = 60.0 * plan.db_rate_headroom * 2.0
+    bad_users = 60.0 * plan.proof_rate_headroom * 2.0
     bad = make_overrides(plan, n, user_mean=np.full(n, bad_users))
-    with pytest.raises(ValueError, match="DB-pool non-binding proof"):
+    with pytest.raises(ValueError, match="non-binding"):
         runner.run(n, seed=0, overrides=bad, chunk_size=n)
 
 

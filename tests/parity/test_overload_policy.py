@@ -1,0 +1,157 @@
+"""Overload policy: ready-queue caps (load shedding) — the first slice of
+the reference's roadmap milestone 5 ("queue caps, deadlines, circuit
+breakers").
+
+Semantics: a request that would join a server's CPU ready queue when
+``max_ready_queue`` waiters are already parked is shed — it releases its
+RAM, leaves the system immediately, is excluded from latency stats, and
+counts in ``total_rejected``.  The check applies at every core
+acquisition (including after I/O).  Caps the compiler proves effectively
+unreachable (geometric queue-tail bound at rho_b < 0.9) lower away and
+keep the fast path; reachable caps are modeled by the event engines.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import yaml
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.engines.jaxsim.engine import Engine, scenario_keys, sweep_results
+from asyncflow_tpu.engines.oracle.engine import OracleEngine
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+pytestmark = pytest.mark.integration
+
+BASE = "tests/integration/data/single_server.yml"
+
+
+def _payload(cap: int | None, *, users: int = 60, horizon: int = 150):
+    data = yaml.safe_load(open(BASE).read())
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    srv["endpoints"][0]["steps"] = [
+        {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.040}},
+        {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.010}},
+    ]
+    if cap is not None:
+        srv["overload"] = {"max_ready_queue": cap}
+    data["rqs_input"]["avg_active_users"]["mean"] = users
+    data["sim_settings"]["total_simulation_time"] = horizon
+    return SimulationPayload.model_validate(data)
+
+
+class TestCompilerTiering:
+    def test_no_policy_unchanged(self) -> None:
+        plan = compile_payload(_payload(None))
+        assert not plan.has_queue_cap
+        assert plan.fastpath_ok, plan.fastpath_reason
+
+    def test_reachable_cap_routes_to_event_engine(self) -> None:
+        plan = compile_payload(_payload(3))
+        assert plan.has_queue_cap
+        assert plan.server_queue_cap[0] == 3
+        assert not plan.fastpath_ok
+        assert "ready-queue cap" in plan.fastpath_reason
+
+        from asyncflow_tpu.parallel import SweepRunner
+
+        assert SweepRunner(_payload(3), use_mesh=False).engine_kind == "event"
+
+    def test_saturated_server_always_models_the_cap(self) -> None:
+        # rho_b ~ 1.1 at these settings: the queue grows without bound, so
+        # even a huge cap is reachable and must be modeled
+        plan = compile_payload(_payload(4000))
+        assert plan.has_queue_cap
+
+    def test_unreachable_cap_lowers_away_with_headroom(self) -> None:
+        # users=30 -> rho_b ~ 0.62: a 4000-deep queue is beyond the
+        # geometric tail bound, so the cap costs nothing and the fast path
+        # keeps the plan; the proof records a finite rate headroom
+        plan = compile_payload(_payload(4000, users=30))
+        assert not plan.has_queue_cap
+        assert plan.fastpath_ok, plan.fastpath_reason
+        assert 1.0 < plan.proof_rate_headroom < np.inf
+
+        from asyncflow_tpu.parallel import SweepRunner, make_overrides
+
+        runner = SweepRunner(_payload(4000, users=30), use_mesh=False)
+        bad = make_overrides(
+            runner.plan, 4,
+            user_mean=np.full(4, 30.0 * runner.plan.proof_rate_headroom * 3.0),
+        )
+        with pytest.raises(ValueError, match="non-binding"):
+            runner.run(4, seed=0, overrides=bad, chunk_size=4)
+
+
+def test_three_engine_shed_parity() -> None:
+    """Measured at these settings (rho ~ 0.8, cap 3, 8 seeds): all three
+    engines shed 5.5-5.8% with mean/p95 within 1% of each other."""
+    payload = _payload(3)
+    plan = compile_payload(payload)
+    n = 8
+
+    res_o = [OracleEngine(payload, seed=s).run() for s in range(n)]
+    rej_o = sum(r.total_rejected for r in res_o)
+    gen_o = sum(r.total_generated for r in res_o)
+    assert rej_o > 0.02 * gen_o  # the cap really binds
+
+    engine = Engine(plan, collect_clocks=True)
+    final = engine.run_batch(scenario_keys(11, n))
+    sw = sweep_results(engine, final, payload.sim_settings)
+    rej_e = int(sw.total_rejected.sum())
+    gen_e = int(sw.total_generated.sum())
+    assert abs(rej_e / gen_e - rej_o / gen_o) < 0.02
+
+    lat_o = np.concatenate([r.latencies for r in res_o])
+    clock = np.asarray(final.clock)
+    counts = np.asarray(final.clock_n)
+    lat_e = np.concatenate(
+        [clock[i, : counts[i], 1] - clock[i, : counts[i], 0] for i in range(n)],
+    )
+    assert abs(lat_e.mean() - lat_o.mean()) / lat_o.mean() < 0.05
+    for q in (50, 95):
+        po, pe = np.percentile(lat_o, q), np.percentile(lat_e, q)
+        assert abs(pe - po) / po < 0.06, (q, po, pe)
+
+    from asyncflow_tpu.engines.oracle.native import native_available, run_native
+
+    if native_available():
+        res_n = [
+            run_native(plan, seed=s, collect_gauges=False) for s in range(n)
+        ]
+        rej_n = sum(r.total_rejected for r in res_n)
+        gen_n = sum(r.total_generated for r in res_n)
+        assert abs(rej_n / gen_n - rej_o / gen_o) < 0.02
+        lat_n = np.concatenate([r.latencies for r in res_n])
+        assert abs(lat_n.mean() - lat_o.mean()) / lat_o.mean() < 0.05
+
+
+def test_shedding_bounds_tail_latency() -> None:
+    """The whole point of the policy: a tight cap trades completions for a
+    bounded tail — p99 with cap 2 must be far below the uncapped p99, and
+    fewer requests complete."""
+    capped = [OracleEngine(_payload(2), seed=s).run() for s in range(6)]
+    free = [OracleEngine(_payload(None), seed=s).run() for s in range(6)]
+    lat_c = np.concatenate([r.latencies for r in capped])
+    lat_f = np.concatenate([r.latencies for r in free])
+    assert np.percentile(lat_c, 99) < np.percentile(lat_f, 99) * 0.5
+    assert sum(r.total_rejected for r in capped) > 0
+    assert lat_c.size < lat_f.size
+
+
+def test_request_conservation_with_shedding() -> None:
+    """generated == completed + dropped + rejected + in-flight at horizon
+    (event engine, exact counters)."""
+    payload = _payload(3, horizon=60)
+    plan = compile_payload(payload)
+    engine = Engine(plan, collect_clocks=True)
+    final = engine.run_batch(scenario_keys(5, 4))
+    sw = sweep_results(engine, final, payload.sim_settings)
+    for i in range(4):
+        gen = int(sw.total_generated[i])
+        done = int(sw.completed[i])
+        dropped = int(sw.total_dropped[i])
+        rej = int(sw.total_rejected[i])
+        in_flight = gen - done - dropped - rej
+        assert 0 <= in_flight < 64, (gen, done, dropped, rej)

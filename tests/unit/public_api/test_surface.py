@@ -24,6 +24,7 @@ def test_components_surface() -> None:
         "Endpoint",
         "EventInjection",
         "LoadBalancer",
+        "OverloadPolicy",
         "Server",
         "ServerResources",
         "Step",
