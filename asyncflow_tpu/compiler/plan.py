@@ -530,6 +530,10 @@ def compile_payload(
     # fast path declines the plan.
     srv_rates_est = _server_entry_rates(payload)
     users_est = float(payload.rqs_input.avg_active_users.mean)
+    # one burst-inflation model for every non-binding proof tier (DB pools,
+    # queue caps, and _fastpath_analysis's bounds use the same 3-sigma
+    # user-draw inflation — keep them in lockstep)
+    burst_factor = 1.0 + 3.0 / math.sqrt(max(users_est, 1.0))
     db_model: list[bool] = []
     proof_rate_headroom = math.inf
     for s, server in enumerate(servers):
@@ -554,7 +558,7 @@ def compile_payload(
         if srv_rates_est is None:
             db_model.append(True)  # cyclic chain: no rate bound, model it
             continue
-        burst = srv_rates_est[s] * (1.0 + 3.0 / math.sqrt(max(users_est, 1.0)))
+        burst = srv_rates_est[s] * burst_factor
         m = burst * db_dur
         binding = not pool_k >= m + 6.0 * math.sqrt(max(m, 1.0)) + 8.0
         db_model.append(binding)
@@ -590,13 +594,13 @@ def compile_payload(
             queue_cap_model[s_i] = cap if cpu_dur > 0 else -1
             continue
         cores = server.server_resources.cpu_cores
-        burst = srv_rates_est[s_i] * (1.0 + 3.0 / math.sqrt(max(users_est, 1.0)))
-        rho_b = burst * cpu_dur / max(cores, 1)
+        rho_b = srv_rates_est[s_i] * burst_factor * cpu_dur / max(cores, 1)
         needed = (
             math.inf
             if rho_b >= 0.9
             else math.log(1e-12) / math.log(max(rho_b, 1e-9)) + 16.0
         )
+        cap = min(cap, 2**31 - 1)  # int32 table; larger = unbounded anyway
         if cap >= needed:
             # lowered away; record the rate scale that keeps the proof
             rho_max = min(0.9, math.exp(math.log(1e-12) / max(cap - 16.0, 1.0)))

@@ -1,0 +1,100 @@
+"""Load shedding study: bounded tails vs unbounded queues under overload.
+
+Sweeps the offered load through ~60-110% of a server's capacity twice —
+once unbounded (reference behavior) and once with a ready-queue cap of 8 —
+and plots p99 latency and the shed fraction.  The capped server trades a
+few percent of completions for a tail that stays flat through overload:
+the "how gracefully it degrades" answer of the reference roadmap's
+resilience milestone, measured.
+
+Run:  python examples/sweeps/overload_policy.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+import yaml
+
+from asyncflow_tpu.parallel import SweepRunner, make_overrides
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+N_SCENARIOS = 24
+HORIZON_S = 120
+LOAD_POINTS = (0.6, 0.75, 0.9, 1.0, 1.1)  # fraction of one core's capacity
+BASE_USERS = 100  # at 20 rpm and 30 ms cpu: ~1.0 utilization
+
+
+def payload_with(cap: int | None) -> SimulationPayload:
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "..", "yaml_input", "data", "single_server.yml",
+    )
+    data = yaml.safe_load(open(path).read())
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    srv["endpoints"][0]["steps"] = [
+        {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.030}},
+        {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.010}},
+    ]
+    if cap is not None:
+        srv["overload"] = {"max_ready_queue": cap}
+    data["rqs_input"]["avg_active_users"]["mean"] = BASE_USERS
+    data["sim_settings"]["total_simulation_time"] = HORIZON_S
+    return SimulationPayload.model_validate(data)
+
+
+def main() -> None:
+    rows: dict[int | None, list[tuple[float, float, float]]] = {}
+    for cap in (None, 8):
+        runner = SweepRunner(payload_with(cap), engine="native", use_mesh=False)
+        rows[cap] = []
+        for load in LOAD_POINTS:
+            ov = make_overrides(
+                runner.plan,
+                N_SCENARIOS,
+                user_mean=np.full(N_SCENARIOS, BASE_USERS * load),
+            )
+            rep = runner.run(N_SCENARIOS, seed=3, overrides=ov)
+            s = rep.summary()
+            shed = s["rejected_total"] / max(
+                s["rejected_total"] + s["completed_total"], 1,
+            )
+            rows[cap].append((load, s["latency_p99_s"], shed))
+            label = "unbounded" if cap is None else f"cap={cap}"
+            print(
+                f"{label:>9} load {load:4.0%}: p99 {s['latency_p99_s'] * 1e3:7.1f} ms"
+                f"   shed {shed:6.2%}",
+            )
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(10, 4))
+    for cap, data in rows.items():
+        label = "unbounded" if cap is None else f"ready-queue cap {cap}"
+        ax1.plot([d[0] for d in data], [d[1] * 1e3 for d in data], "o-", label=label)
+        ax2.plot([d[0] for d in data], [d[2] * 100 for d in data], "s-", label=label)
+    ax1.set_xlabel("offered load (fraction of capacity)")
+    ax1.set_ylabel("p99 latency (ms)")
+    ax1.set_title("Tail latency under overload")
+    ax1.legend()
+    ax2.set_xlabel("offered load (fraction of capacity)")
+    ax2.set_ylabel("requests shed (%)")
+    ax2.set_title("The price: shed fraction")
+    ax2.legend()
+    fig.tight_layout()
+    out = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "overload_policy.png",
+    )
+    fig.savefig(out, dpi=130)
+    print(f"saved {out}")
+
+
+if __name__ == "__main__":
+    main()
