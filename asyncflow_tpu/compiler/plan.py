@@ -260,12 +260,26 @@ class StaticPlan:
     server_queue_cap: np.ndarray = field(
         default_factory=lambda: np.empty(0, np.int32),
     )
+    #: (NS,) i32 modeled socket/connection capacity; -1 = unbounded or
+    #: proven effectively-unreachable.  Servers with a value >= 0 refuse
+    #: arrivals when that many requests are already resident (reference
+    #: roadmap milestone 1's socket capacity).
+    server_conn_cap: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.int32),
+    )
 
     @property
     def has_queue_cap(self) -> bool:
         """True when any server's ready-queue cap is actually modeled."""
         return bool(
             self.server_queue_cap.size and np.any(self.server_queue_cap >= 0)
+        )
+
+    @property
+    def has_conn_cap(self) -> bool:
+        """True when any server's connection capacity is actually modeled."""
+        return bool(
+            self.server_conn_cap.size and np.any(self.server_conn_cap >= 0)
         )
     #: (NS, NEP, NSEG+1) f32 — SEG_CACHE hit probability (0 elsewhere) and
     #: miss latency; seg_dur holds the hit latency.
@@ -610,6 +624,93 @@ def compile_payload(
         else:
             queue_cap_model[s_i] = cap
 
+    # Socket / connection capacity (the reference roadmap's network
+    # baseline, milestone 1): concurrent residents ~ rate x (residence +
+    # core-queue waits) by Little's law; a capacity comfortably above the
+    # burst-inflated bound is effectively unreachable and lowers away.
+    # Reachable capacities refuse arrivals on the event engines.
+    conn_cap_model = np.full(n_servers, -1, dtype=np.int32)
+    for s_i, server in enumerate(servers):
+        cap = server.overload.max_connections if server.overload else None
+        if cap is None:
+            continue
+        cap = min(cap, 2**31 - 1)
+        if srv_rates_est is None or db_model[s_i]:
+            # no rate bound (cyclic chain), or a MODELED (binding) DB pool
+            # whose queue waits the residence bound below cannot see —
+            # always model the capacity
+            conn_cap_model[s_i] = cap
+            continue
+
+        def _worst(step) -> float:
+            # worst-case duration: stochastic cache steps may sleep the
+            # miss latency
+            if step.is_stochastic_cache:
+                return max(float(step.quantity), float(step.cache_miss_time))
+            return float(step.quantity)
+
+        residence = max(
+            (
+                sum(_worst(st) for st in ep.steps if not st.is_ram)
+                for ep in server.endpoints
+            ),
+            default=0.0,
+        )
+        cpu_dur = max(
+            (
+                sum(st.quantity for st in ep.steps if st.is_cpu)
+                for ep in server.endpoints
+            ),
+            default=0.0,
+        )
+        visits = max(
+            (
+                sum(1 for st in ep.steps if st.is_cpu)
+                for ep in server.endpoints
+            ),
+            default=0,
+        )
+        max_ram = max(
+            (
+                sum(st.quantity for st in ep.steps if st.is_ram)
+                for ep in server.endpoints
+            ),
+            default=0.0,
+        )
+        cores = server.server_resources.cpu_cores
+        capacity_mb = float(server.server_resources.ram_mb)
+
+        def conn_proof_holds(scale: float, cap=cap, residence=residence,
+                             cpu_dur=cpu_dur, visits=visits, cores=cores,
+                             max_ram=max_ram, capacity_mb=capacity_mb,
+                             rate_here=srv_rates_est[s_i]) -> bool:
+            burst = rate_here * burst_factor * scale
+            rho = burst * cpu_dur / max(cores, 1)
+            if rho >= 0.95:
+                return False
+            wait = visits * rho / (1.0 - rho) * cpu_dur / max(cores, 1)
+            if max_ram > 0:
+                # RAM admission waits are not in the residence bound: the
+                # proof only holds while RAM itself is tier-1 non-binding
+                # (same 4x margin as _fastpath_analysis)
+                if capacity_mb / max_ram < 4.0 * burst * (residence + wait) + 4.0:
+                    return False
+            m = burst * (residence + wait)
+            return cap >= 4.0 * m + 8.0
+
+        if conn_proof_holds(1.0):
+            # bisect the largest rate scale the proof still covers
+            lo, hi = 1.0, 1e6
+            for _ in range(48):
+                mid = (lo + hi) / 2.0
+                if conn_proof_holds(mid):
+                    lo = mid
+                else:
+                    hi = mid
+            proof_rate_headroom = min(proof_rate_headroom, lo)
+        else:
+            conn_cap_model[s_i] = cap
+
     compiled: list[
         list[tuple[list[tuple[int, float]], float, list]]
     ] = [
@@ -767,6 +868,7 @@ def compile_payload(
             lb_edge_means=[float(edge_mean[e]) for e in lb_slots],
             max_spike=float(spike_values.max()) if spike_values.size else 0.0,
             server_queue_cap=queue_cap_model,
+            server_conn_cap=conn_cap_model,
         )
     )
 
@@ -833,6 +935,7 @@ def compile_payload(
         server_db_pool=server_db_pool,
         proof_rate_headroom=proof_rate_headroom,
         server_queue_cap=queue_cap_model,
+        server_conn_cap=conn_cap_model,
         seg_hit_prob=seg_hit_prob,
         seg_miss_dur=seg_miss_dur,
     )
@@ -849,6 +952,7 @@ def _fastpath_analysis(
     lb_edge_means: list[float] | None = None,
     max_spike: float = 0.0,
     server_queue_cap: np.ndarray | None = None,
+    server_conn_cap: np.ndarray | None = None,
 ) -> tuple[bool, str, list[int], np.ndarray, int, float]:
     """Decide whether the scan engine can execute this plan faithfully.
 
@@ -936,6 +1040,18 @@ def _fastpath_analysis(
 
     ram_slots = np.zeros(n_servers, dtype=np.int32)
     for s, server in enumerate(servers):
+        if server_conn_cap is not None and server_conn_cap[s] >= 0:
+            # a reachable connection capacity refuses arrivals; the
+            # closed-form recursions have no refusal channel
+            return (
+                False,
+                f"server {server.id}: reachable connection capacity "
+                "(socket refusal modeled on the event engines)",
+                [],
+                no_slots,
+                0,
+                0.0,
+            )
         if server_queue_cap is not None and server_queue_cap[s] >= 0:
             # a reachable ready-queue cap sheds requests mid-endpoint; the
             # closed-form recursions have no rejection channel

@@ -126,6 +126,7 @@ class Engine:
         self._has_db = bool(np.any(plan.seg_kind == SEG_DB))
         self._has_cache = bool(np.any(plan.seg_kind == SEG_CACHE))
         self._has_shed = plan.has_queue_cap
+        self._has_conn = plan.has_conn_cap
         self._compiled: dict = {}
 
     # ==================================================================
@@ -474,6 +475,10 @@ class Engine:
         st = self._gauge_add(st, now, self._g_io(s), 1.0, is_io)
         if self._has_shed:
             st = self._release_ram(st, i, s, now, shed)
+            if self._has_conn:
+                st = st._replace(
+                    srv_conn=st.srv_conn.at[s].add(jnp.where(shed, -1, 0)),
+                )
             st = st._replace(
                 req_ev=st.req_ev.at[i].set(
                     jnp.where(shed, EV_IDLE, st.req_ev[i]),
@@ -557,6 +562,10 @@ class Engine:
         plan = self.plan
 
         st = self._release_ram(st, i, s, now, pred)
+        if self._has_conn:
+            st = st._replace(
+                srv_conn=st.srv_conn.at[s].add(jnp.where(pred, -1, 0)),
+            )
 
         # route the single exit edge of this server
         e = p.exit_edge[s]
@@ -661,6 +670,24 @@ class Engine:
                 req_lbslot=st.req_lbslot.at[i].set(
                     jnp.where(pred, -1, st.req_lbslot[i]),
                 ),
+            )
+
+        if self._has_conn:
+            # socket capacity: refuse the arrival when the server is full
+            cap = p.server_conn_cap[s]
+            refuse = pred & (cap >= 0) & (st.srv_conn[s] >= cap)
+            st = st._replace(
+                req_ev=st.req_ev.at[i].set(
+                    jnp.where(refuse, EV_IDLE, st.req_ev[i]),
+                ),
+                req_t=st.req_t.at[i].set(
+                    jnp.where(refuse, INF, st.req_t[i]),
+                ),
+                n_rejected=st.n_rejected + jnp.where(refuse, 1, 0),
+            )
+            pred = pred & ~refuse
+            st = st._replace(
+                srv_conn=st.srv_conn.at[s].add(jnp.where(pred, 1, 0)),
             )
 
         u = jax.random.uniform(jax.random.fold_in(key, 16))
@@ -802,6 +829,7 @@ class Engine:
                 jnp.asarray(plan.server_db_pool),
                 jnp.int32(2**30),
             ),
+            srv_conn=jnp.zeros(plan.n_servers, jnp.int32),
             db_ticket=jnp.zeros(plan.n_servers, jnp.int32),
             db_wait_n=jnp.zeros(plan.n_servers, jnp.int32),
             lb_order=jnp.arange(elp, dtype=jnp.int32),

@@ -298,14 +298,19 @@ class PallasEngine:
         kernel on its scenario shard (the kernel itself is a single-device
         program — GSPMD cannot partition a ``pallas_call``, so the sharding
         seam has to be explicit)."""
-        if plan.has_db_pool or plan.has_stochastic_cache or plan.has_queue_cap:
+        if (
+            plan.has_db_pool
+            or plan.has_stochastic_cache
+            or plan.has_queue_cap
+            or plan.has_conn_cap
+        ):
             # the VMEM kernel has no DB-pool FIFO machinery, no cache
-            # mixture draws, and no shed path; the compiler routes such
-            # plans to the general event engine
+            # mixture draws, and no shed/refusal paths; the compiler routes
+            # such plans to the general event engine
             msg = (
                 "the Pallas kernel does not model binding DB connection "
-                "pools, stochastic cache steps, or reachable ready-queue "
-                "caps; use the event engine"
+                "pools, stochastic cache steps, or reachable overload "
+                "policies; use the event engine"
             )
             raise ValueError(msg)
         self.plan = plan

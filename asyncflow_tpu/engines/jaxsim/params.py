@@ -35,6 +35,7 @@ class PlanParams(NamedTuple):
     server_cores: jnp.ndarray
     server_ram: jnp.ndarray
     server_queue_cap: jnp.ndarray  # (NS,) i32 ready-queue cap (-1 unbounded)
+    server_conn_cap: jnp.ndarray  # (NS,) i32 socket capacity (-1 unbounded)
     n_endpoints: jnp.ndarray
     seg_kind: jnp.ndarray
     seg_dur: jnp.ndarray
@@ -68,6 +69,11 @@ def params_from_plan(plan: StaticPlan) -> PlanParams:
         server_queue_cap=jnp.asarray(
             plan.server_queue_cap
             if plan.server_queue_cap.size
+            else np.full(plan.n_servers, -1, np.int32),
+        ),
+        server_conn_cap=jnp.asarray(
+            plan.server_conn_cap
+            if plan.server_conn_cap.size
             else np.full(plan.n_servers, -1, np.int32),
         ),
         n_endpoints=jnp.asarray(plan.n_endpoints),
@@ -113,6 +119,7 @@ class EngineState(NamedTuple):
     cpu_wait_n: jnp.ndarray  # (NS,) i32: live CPU waiter counts
     ram_wait_n: jnp.ndarray  # (NS,) i32: live RAM waiter counts
     db_free: jnp.ndarray  # (NS,) i32: free DB connections (big = unlimited)
+    srv_conn: jnp.ndarray  # (NS,) i32: accepted arrivals currently resident
     db_ticket: jnp.ndarray  # (NS,) i32
     db_wait_n: jnp.ndarray  # (NS,) i32: live DB-pool waiter counts
     # load balancer

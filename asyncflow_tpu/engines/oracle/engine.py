@@ -132,6 +132,12 @@ class _ServerRuntime:
         self.queue_cap = (
             cfg.overload.max_ready_queue if cfg.overload is not None else None
         )
+        # socket capacity: refuse arrivals when this many requests are
+        # already resident on the server (accepted arrival -> exit)
+        self.conn_cap = (
+            cfg.overload.max_connections if cfg.overload is not None else None
+        )
+        self.residents = 0
         self.ready_queue_len = 0
         self.io_queue_len = 0
         self.ram_in_use = 0.0
@@ -143,9 +149,26 @@ class _ServerRuntime:
         }
 
     def receive(self, req: Request) -> None:
+        if self.conn_cap is not None and self.residents >= self.conn_cap:
+            # connection refused: the server is at socket capacity
+            req.finish_time = self.engine.sim.now
+            req.record_hop(
+                SystemNodes.SERVER,
+                f"{self.cfg.id}-refused",
+                self.engine.sim.now,
+            )
+            self.engine.total_rejected += 1
+            return
+        self.residents += 1
         self.engine.sim.process(self._handle(req))
 
     def _handle(self, req: Request):
+        try:
+            yield from self._run_endpoint(req)
+        finally:
+            self.residents -= 1
+
+    def _run_endpoint(self, req: Request):
         engine = self.engine
         req.record_hop(SystemNodes.SERVER, self.cfg.id, engine.sim.now)
 

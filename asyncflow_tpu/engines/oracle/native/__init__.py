@@ -43,6 +43,7 @@ class _PlanC(ctypes.Structure):
         ("server_ram", _f32p),
         ("server_db_pool", _i32p),
         ("server_queue_cap", _i32p),
+        ("server_conn_cap", _i32p),
         ("n_endpoints", _i32p),
         ("seg_kind", _i32p),
         ("seg_dur", _f32p),
@@ -191,6 +192,11 @@ def run_native(
         server_queue_cap=i32(
             plan.server_queue_cap
             if plan.server_queue_cap.size
+            else np.full(plan.n_servers, -1, np.int32),
+        ),
+        server_conn_cap=i32(
+            plan.server_conn_cap
+            if plan.server_conn_cap.size
             else np.full(plan.n_servers, -1, np.int32),
         ),
         n_endpoints=i32(plan.n_endpoints),

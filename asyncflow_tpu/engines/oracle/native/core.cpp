@@ -57,6 +57,7 @@ struct PlanC {
     const float* server_ram;
     const int32_t* server_db_pool;  // -1 = unlimited / not modeled
     const int32_t* server_queue_cap;  // -1 = unbounded ready queue
+    const int32_t* server_conn_cap;   // -1 = unbounded socket capacity
     const int32_t* n_endpoints;
     const int32_t* seg_kind;  // [NS][NEP][NSEG+1]
     const float* seg_dur;
@@ -108,6 +109,7 @@ struct Server {
     int32_t ready_len = 0;
     int32_t io_len = 0;
     int32_t db_free = -1;  // -1 = unlimited (pool not modeled)
+    int32_t residents = 0; // accepted arrivals currently on the server
     std::deque<int32_t> cpu_wait;                      // request idx, FIFO
     std::deque<std::pair<double, int32_t>> ram_wait;   // (amount, request)
     std::deque<int32_t> db_wait;                       // request idx, FIFO
@@ -310,6 +312,7 @@ struct Sim {
                     grant_ram(r.srv);
                 }
                 ++rejected;
+                --sv.residents;
                 release(i);
             } else {
                 sv.cpu_wait.push_back(i);
@@ -368,6 +371,7 @@ struct Sim {
         Request& r = reqs[i];
         int s = r.srv;
         Server& sv = servers[s];
+        --sv.residents;
         if (r.ram > 0.0) {
             sv.ram_free += r.ram;
             sv.ram_in_use -= r.ram;
@@ -436,6 +440,14 @@ struct Sim {
         Request& r = reqs[i];
         if (r.lbslot >= 0) { --lb_conn[r.lbslot]; r.lbslot = -1; }
         Server& sv = servers[r.srv];
+        if (p.server_conn_cap && p.server_conn_cap[r.srv] >= 0
+            && sv.residents >= p.server_conn_cap[r.srv]) {
+            // connection refused: the server is at socket capacity
+            ++rejected;
+            release(i);
+            return;
+        }
+        ++sv.residents;
         int nep = p.n_endpoints[r.srv];
         r.ep = (int32_t)std::min<long>((long)(uniform() * nep), nep - 1);
         r.seg = 0;

@@ -380,10 +380,11 @@ class SweepRunner:
             engine == "auto"
             and jax.default_backend() == "tpu"
             # the VMEM kernel models neither pool FIFOs, cache mixtures,
-            # nor ready-queue shedding
+            # nor overload policies (shedding / refusal)
             and not self.plan.has_db_pool
             and not self.plan.has_stochastic_cache
             and not self.plan.has_queue_cap
+            and not self.plan.has_conn_cap
         ):
             from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
 

@@ -72,11 +72,18 @@ class OverloadPolicy(BaseModel):
     ``total_rejected``).  The check applies at every core acquisition,
     including re-acquisition after I/O — the semantics of a bounded
     executor queue.  ``None`` = unbounded (reference behavior).
+
+    ``max_connections``: socket capacity — the number of requests
+    concurrently resident on the server (from accepted arrival to exit,
+    through every queue and sleep).  An arrival at a full server is
+    refused (same rejected accounting).  The connection-capacity half of
+    the reference roadmap's network-baseline milestone.
     """
 
     model_config = ConfigDict(extra="forbid")
 
     max_ready_queue: PositiveInt | None = None
+    max_connections: PositiveInt | None = None
 
 
 class Server(BaseModel):

@@ -27,18 +27,30 @@ pytestmark = pytest.mark.integration
 BASE = "tests/integration/data/single_server.yml"
 
 
-def _payload(cap: int | None, *, users: int = 60, horizon: int = 150):
+_SHED_STEPS = [
+    {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.040}},
+    {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.010}},
+]
+_CONN_STEPS = [
+    {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.002}},
+    {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.200}},
+]
+
+
+def _build(steps, overload, *, users: int = 60, horizon: int = 150):
     data = yaml.safe_load(open(BASE).read())
     srv = data["topology_graph"]["nodes"]["servers"][0]
-    srv["endpoints"][0]["steps"] = [
-        {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.040}},
-        {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.010}},
-    ]
-    if cap is not None:
-        srv["overload"] = {"max_ready_queue": cap}
+    srv["endpoints"][0]["steps"] = steps
+    if overload:
+        srv["overload"] = overload
     data["rqs_input"]["avg_active_users"]["mean"] = users
     data["sim_settings"]["total_simulation_time"] = horizon
     return SimulationPayload.model_validate(data)
+
+
+def _payload(cap: int | None, *, users: int = 60, horizon: int = 150):
+    overload = {"max_ready_queue": cap} if cap is not None else None
+    return _build(_SHED_STEPS, overload, users=users, horizon=horizon)
 
 
 class TestCompilerTiering:
@@ -155,3 +167,119 @@ def test_request_conservation_with_shedding() -> None:
         rej = int(sw.total_rejected[i])
         in_flight = gen - done - dropped - rej
         assert 0 <= in_flight < 64, (gen, done, dropped, rej)
+
+
+def _conn_payload(cap: int | None, *, horizon: int = 150):
+    overload = {"max_connections": cap} if cap is not None else None
+    return _build(_CONN_STEPS, overload, horizon=horizon)
+
+
+class TestConnectionCapacity:
+    """Socket capacity (reference roadmap milestone 1's network baseline):
+    arrivals at a server with max_connections residents are refused."""
+
+    def test_reachable_capacity_routes_to_event_engine(self) -> None:
+        # ~20 rps x 0.2 s residence -> ~4 residents; capacity 4 binds hard
+        plan = compile_payload(_conn_payload(4))
+        assert plan.has_conn_cap
+        assert plan.server_conn_cap[0] == 4
+        assert not plan.fastpath_ok
+        assert "connection capacity" in plan.fastpath_reason
+
+    def test_unreachable_capacity_lowers_away(self) -> None:
+        plan = compile_payload(_conn_payload(100000))
+        assert not plan.has_conn_cap
+        assert plan.fastpath_ok, plan.fastpath_reason
+        assert 1.0 < plan.proof_rate_headroom < np.inf
+
+    def test_three_engine_refusal_parity(self) -> None:
+        """Measured at capacity 4 (~30% refused): all engines within 2%."""
+        payload = _conn_payload(4)
+        plan = compile_payload(payload)
+        n = 8
+
+        res_o = [OracleEngine(payload, seed=s).run() for s in range(n)]
+        frac_o = sum(r.total_rejected for r in res_o) / sum(
+            r.total_generated for r in res_o
+        )
+        assert 0.1 < frac_o < 0.5
+
+        engine = Engine(plan, collect_clocks=True)
+        final = engine.run_batch(scenario_keys(11, n))
+        sw = sweep_results(engine, final, payload.sim_settings)
+        frac_e = int(sw.total_rejected.sum()) / int(sw.total_generated.sum())
+        assert abs(frac_e - frac_o) < 0.03
+
+        from asyncflow_tpu.engines.oracle.native import (
+            native_available,
+            run_native,
+        )
+
+        if native_available():
+            res_n = [
+                run_native(plan, seed=s, collect_gauges=False)
+                for s in range(n)
+            ]
+            frac_n = sum(r.total_rejected for r in res_n) / sum(
+                r.total_generated for r in res_n
+            )
+            assert abs(frac_n - frac_o) < 0.03
+
+        # accepted requests are never refused mid-flight: completed +
+        # rejected + dropped + in-flight conserves generated per scenario
+        for i in range(n):
+            slack = (
+                int(sw.total_generated[i])
+                - int(sw.completed[i])
+                - int(sw.total_dropped[i])
+                - int(sw.total_rejected[i])
+            )
+            assert 0 <= slack < 64
+
+    def test_hidden_wait_sources_keep_the_cap_modeled(self) -> None:
+        """The unreachability proof must NOT fire when residence is
+        underestimated: a binding DB pool (queue waits) or a stochastic
+        cache (miss latency) keeps the capacity modeled."""
+        data = yaml.safe_load(open(BASE).read())
+        srv = data["topology_graph"]["nodes"]["servers"][0]
+        srv["endpoints"][0]["steps"] = [
+            {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.001}},
+            {"kind": "io_db", "step_operation": {"io_waiting_time": 0.010}},
+        ]
+        srv["server_resources"]["db_connection_pool"] = 1
+        srv["overload"] = {"max_connections": 16}
+        data["rqs_input"]["avg_active_users"]["mean"] = 290  # pool rho ~ 0.97
+        data["sim_settings"]["total_simulation_time"] = 60
+        plan = compile_payload(SimulationPayload.model_validate(data))
+        assert plan.has_db_pool  # the pool binds...
+        assert plan.has_conn_cap  # ...so the capacity stays modeled too
+
+        data = yaml.safe_load(open(BASE).read())
+        srv = data["topology_graph"]["nodes"]["servers"][0]
+        srv["endpoints"][0]["steps"] = [
+            {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.001}},
+            {
+                "kind": "io_cache",
+                "step_operation": {"io_waiting_time": 0.001},
+                "cache_hit_probability": 0.1,
+                "cache_miss_time": 1.0,
+            },
+        ]
+        srv["overload"] = {"max_connections": 16}
+        data["rqs_input"]["avg_active_users"]["mean"] = 60  # ~18 residents
+        data["sim_settings"]["total_simulation_time"] = 60
+        plan = compile_payload(SimulationPayload.model_validate(data))
+        assert plan.has_conn_cap  # miss latency dominates residence
+
+    def test_capacity_bounds_concurrency(self) -> None:
+        """The refused fraction rises as capacity shrinks."""
+        fracs = {}
+        for cap in (2, 4, None):
+            res = [
+                OracleEngine(_conn_payload(cap, horizon=80), seed=s).run()
+                for s in range(4)
+            ]
+            fracs[cap] = sum(r.total_rejected for r in res) / sum(
+                r.total_generated for r in res
+            )
+        assert fracs[2] > fracs[4] > fracs[None] == 0.0
