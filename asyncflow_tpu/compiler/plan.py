@@ -268,19 +268,25 @@ class StaticPlan:
         default_factory=lambda: np.empty(0, np.int32),
     )
 
+    def __post_init__(self) -> None:
+        """Normalize legacy size-0 per-server arrays to explicit "-1 =
+        unlimited" vectors ONCE, so no engine needs a per-call-site
+        fallback (ADVICE r3: a size-0 ``server_db_pool`` handed the C++
+        core a non-null pointer to a 0-length buffer; the jax engines had
+        the same latent shape hazard)."""
+        for name in ("server_db_pool", "server_queue_cap", "server_conn_cap"):
+            if not getattr(self, name).size:
+                setattr(self, name, np.full(self.n_servers, -1, np.int32))
+
     @property
     def has_queue_cap(self) -> bool:
         """True when any server's ready-queue cap is actually modeled."""
-        return bool(
-            self.server_queue_cap.size and np.any(self.server_queue_cap >= 0)
-        )
+        return bool(np.any(self.server_queue_cap >= 0))
 
     @property
     def has_conn_cap(self) -> bool:
         """True when any server's connection capacity is actually modeled."""
-        return bool(
-            self.server_conn_cap.size and np.any(self.server_conn_cap >= 0)
-        )
+        return bool(np.any(self.server_conn_cap >= 0))
     #: (NS, NEP, NSEG+1) f32 — SEG_CACHE hit probability (0 elsewhere) and
     #: miss latency; seg_dur holds the hit latency.
     seg_hit_prob: np.ndarray = field(
@@ -298,7 +304,7 @@ class StaticPlan:
     @property
     def has_db_pool(self) -> bool:
         """True when any server's connection pool is actually modeled."""
-        return bool(self.server_db_pool.size and np.any(self.server_db_pool >= 0))
+        return bool(np.any(self.server_db_pool >= 0))
 
     @property
     def n_gauges(self) -> int:
@@ -382,6 +388,24 @@ def _server_entry_rates(payload: SimulationPayload) -> np.ndarray | None:
     return srv_rate
 
 
+def _server_db_hold(server) -> float:
+    """Worst-case per-request DB-connection hold time (seconds): the max
+    over endpoints of the summed ``io_db`` step durations.  Single source
+    for the pool non-binding proof and the request-pool capacity estimate —
+    the two must never disagree (ADVICE r3)."""
+    return max(
+        (
+            sum(
+                float(step.quantity)
+                for step in ep.steps
+                if step.is_io and step.kind == EndpointStepIO.DB
+            )
+            for ep in server.endpoints
+        ),
+        default=0.0,
+    )
+
+
 def _estimate_capacity(payload: SimulationPayload) -> tuple[int, int]:
     """(max_requests, pool_size) estimates.
 
@@ -446,6 +470,17 @@ def _estimate_capacity(payload: SimulationPayload) -> tuple[int, int]:
         if ram_req > 0 and residence > 0:
             concurrent = server.server_resources.ram_mb / ram_req
             capacity = min(capacity, concurrent / residence)
+        pool_k = server.server_resources.db_connection_pool
+        if pool_k is not None:
+            db_req = _server_db_hold(server)
+            if db_req > 0:
+                # a binding K-connection pool caps throughput at
+                # K / hold-time; saturated pooled workloads park FIFO
+                # waiters in the request pool, so the fluid backlog must
+                # see the pool as a capacity (ADVICE r3: without this,
+                # pooled saturation sweeps overflow unless pool_size is
+                # set by hand)
+                capacity = min(capacity, float(pool_k) / db_req)
         if capacity < math.inf:
             backlog += max(0.0, rate - capacity) * horizon
             burst_backlog += max(0.0, burst_rate - capacity) * min(window, horizon)
@@ -555,17 +590,7 @@ def compile_payload(
         if pool_k is None:
             db_model.append(False)
             continue
-        db_dur = max(
-            (
-                sum(
-                    step.quantity
-                    for step in ep.steps
-                    if step.is_io and step.kind == EndpointStepIO.DB
-                )
-                for ep in server.endpoints
-            ),
-            default=0.0,
-        )
+        db_dur = _server_db_hold(server)
         if db_dur <= 0:
             db_model.append(False)  # a pool with no io_db steps is inert
             continue

@@ -822,6 +822,71 @@ class FastEngine:
             self._compiled[sig] = jax.jit(jax.vmap(self._run_one, in_axes=(0, axes)))
         return self._compiled[sig](keys, ov)
 
+    def scanned_fn(self):
+        """The scanned sweep program: ``lax.scan`` over (blocks, inner, ...)
+        leading axes of (keys, per-scenario overrides), vmapping
+        :meth:`_run_one` across each block.  Single source for execution
+        (:meth:`run_batch_scanned`) and for the compile-scaling
+        measurement/CI gate (``asyncflow_tpu.utils.program_size``) — both
+        must see the SAME program (docs/internals/compile-pathology.md).
+        """
+        axes = ScenarioOverrides(*([0] * len(ScenarioOverrides._fields)))
+        vm = jax.vmap(self._run_one, in_axes=(0, axes))
+
+        def scanned(kb, ob):
+            def body(_, xs):
+                k, o = xs
+                return None, vm(k, o)
+
+            _, out = jax.lax.scan(body, None, (kb, ob))
+            return out
+
+        return scanned
+
+    def scanned_inputs(
+        self,
+        keys: jnp.ndarray,
+        overrides: ScenarioOverrides | None = None,
+        *,
+        inner: int = 16,
+        total: int | None = None,
+    ) -> tuple[jnp.ndarray, ScenarioOverrides, int, int]:
+        """Shape (keys, overrides) into the scanned program's inputs.
+
+        Returns ``(keys_b, ov_b, s, t)``: keys reshaped to (blocks, inner,
+        2), every override field materialized to a (blocks, inner, ...)
+        batch (scalar-per-sweep fields broadcast, short sweeps edge-padded
+        to ``total``), plus the realized (requested, padded) sizes.  Single
+        source for execution (:meth:`run_batch_scanned`) and the
+        compile-scaling gate (``asyncflow_tpu.utils.program_size``) — the
+        gate must trace the SAME program production compiles.
+        """
+        ov = overrides if overrides is not None else base_overrides(self.plan)
+        s = keys.shape[0]
+        t = total or s
+        t = max(t, s)
+        t += (-t) % inner
+        blocks = t // inner
+
+        base = base_overrides(self.plan)
+
+        def batched(field, ref):
+            arr = jnp.asarray(field, jnp.float32)
+            ref_nd = jnp.asarray(ref).ndim
+            if arr.ndim == ref_nd:  # scalar-per-sweep -> broadcast
+                arr = jnp.broadcast_to(arr, (s, *arr.shape))
+            if s < t:
+                pad_width = [(0, t - s)] + [(0, 0)] * (arr.ndim - 1)
+                arr = jnp.pad(arr, pad_width, mode="edge")
+            return arr.reshape((blocks, inner, *arr.shape[1:]))
+
+        ov_b = ScenarioOverrides(*[batched(o, b) for o, b in zip(ov, base)])
+        if s < t:
+            pad_width = [(0, t - s)] + [(0, 0)] * (keys.ndim - 1)
+            keys = jnp.pad(keys, pad_width, mode="edge")
+        keys_b = keys.reshape((blocks, inner, *keys.shape[1:]))
+        return keys_b, ov_b, s, t
+
     def run_batch_scanned(
         self,
         keys: jnp.ndarray,
@@ -845,47 +910,13 @@ class FastEngine:
         ``total`` is padded (padded rows are simulated and discarded), so
         every call reuses one executable regardless of tail-chunk size.
         """
-        ov = overrides if overrides is not None else base_overrides(self.plan)
-        s = keys.shape[0]
-        t = total or s
-        t = max(t, s)
-        t += (-t) % inner
+        keys_b, ov_b, s, t = self.scanned_inputs(
+            keys, overrides, inner=inner, total=total,
+        )
         blocks = t // inner
-
-        # materialize every override field to a full per-scenario batch so
-        # the scan carries one uniform (blocks, inner, ...) xs pytree
-        base = base_overrides(self.plan)
-
-        def batched(field, ref):
-            arr = jnp.asarray(field, jnp.float32)
-            ref_nd = jnp.asarray(ref).ndim
-            if arr.ndim == ref_nd:  # scalar-per-sweep -> broadcast
-                arr = jnp.broadcast_to(arr, (s, *arr.shape))
-            if s < t:
-                pad_width = [(0, t - s)] + [(0, 0)] * (arr.ndim - 1)
-                arr = jnp.pad(arr, pad_width, mode="edge")
-            return arr.reshape((blocks, inner, *arr.shape[1:]))
-
-        ov_b = ScenarioOverrides(*[batched(o, b) for o, b in zip(ov, base)])
-        if s < t:
-            pad_width = [(0, t - s)] + [(0, 0)] * (keys.ndim - 1)
-            keys = jnp.pad(keys, pad_width, mode="edge")
-        keys_b = keys.reshape((blocks, inner, *keys.shape[1:]))
-
         sig = ("scan", inner, blocks)
         if sig not in self._compiled:
-            axes = ScenarioOverrides(*([0] * len(base)))
-            vm = jax.vmap(self._run_one, in_axes=(0, axes))
-
-            def scanned(kb, ob):
-                def body(_, xs):
-                    k, o = xs
-                    return None, vm(k, o)
-
-                _, out = jax.lax.scan(body, None, (kb, ob))
-                return out
-
-            self._compiled[sig] = jax.jit(scanned)
+            self._compiled[sig] = jax.jit(self.scanned_fn())
         out = self._compiled[sig](keys_b, ov_b)
         return jax.tree_util.tree_map(
             lambda a: a.reshape((t, *a.shape[2:]))[:s], out,

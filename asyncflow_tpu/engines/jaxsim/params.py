@@ -66,16 +66,9 @@ def params_from_plan(plan: StaticPlan) -> PlanParams:
         edge_dropout=jnp.asarray(plan.edge_dropout),
         server_cores=jnp.asarray(plan.server_cores),
         server_ram=jnp.asarray(plan.server_ram),
-        server_queue_cap=jnp.asarray(
-            plan.server_queue_cap
-            if plan.server_queue_cap.size
-            else np.full(plan.n_servers, -1, np.int32),
-        ),
-        server_conn_cap=jnp.asarray(
-            plan.server_conn_cap
-            if plan.server_conn_cap.size
-            else np.full(plan.n_servers, -1, np.int32),
-        ),
+        # size-0 arrays are normalized to (-1,)*NS by StaticPlan.__post_init__
+        server_queue_cap=jnp.asarray(plan.server_queue_cap),
+        server_conn_cap=jnp.asarray(plan.server_conn_cap),
         n_endpoints=jnp.asarray(plan.n_endpoints),
         seg_kind=jnp.asarray(plan.seg_kind),
         seg_dur=jnp.asarray(plan.seg_dur),

@@ -188,17 +188,10 @@ def run_native(
         max_segments=plan.max_segments,
         server_cores=i32(plan.server_cores),
         server_ram=f32(plan.server_ram),
+        # size-0 arrays are normalized to (-1,)*NS by StaticPlan.__post_init__
         server_db_pool=i32(plan.server_db_pool),
-        server_queue_cap=i32(
-            plan.server_queue_cap
-            if plan.server_queue_cap.size
-            else np.full(plan.n_servers, -1, np.int32),
-        ),
-        server_conn_cap=i32(
-            plan.server_conn_cap
-            if plan.server_conn_cap.size
-            else np.full(plan.n_servers, -1, np.int32),
-        ),
+        server_queue_cap=i32(plan.server_queue_cap),
+        server_conn_cap=i32(plan.server_conn_cap),
         n_endpoints=i32(plan.n_endpoints),
         seg_kind=i32(plan.seg_kind),
         seg_dur=f32(plan.seg_dur),

@@ -1,0 +1,53 @@
+"""Program-size introspection for the scanned fast path.
+
+Single source for the compile-scaling measurement script
+(``scripts/compile_scaling.py``) and the CI flatness gate
+(``tests/unit/jax_engine/test_compile_scaling.py``): both must count the
+SAME program the same way, or the gate stops guarding the published table
+(docs/internals/compile-pathology.md).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+import jax
+
+if TYPE_CHECKING:
+    from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+
+
+def count_jaxpr_eqns(jaxpr) -> int:
+    """Total equation count, recursing into sub-jaxprs (scan/cond bodies)."""
+    n = len(jaxpr.eqns)
+    for eqn in jaxpr.eqns:
+        for v in eqn.params.values():
+            if hasattr(v, "jaxpr"):
+                n += count_jaxpr_eqns(v.jaxpr)
+            elif isinstance(v, (list, tuple)):
+                for w in v:
+                    if hasattr(w, "jaxpr"):
+                        n += count_jaxpr_eqns(w.jaxpr)
+    return n
+
+
+def trace_scanned(engine: FastEngine, inner: int, blocks: int):
+    """Trace (without compiling) the scanned fast-path program at the given
+    (vmap width, scan length) shape; returns the jitted ``Traced`` object.
+
+    Uses the PRODUCTION program builder and input shaping
+    (:meth:`FastEngine.scanned_fn` / :meth:`FastEngine.scanned_inputs`), so
+    the gate measures exactly the executable ``run_batch_scanned`` runs."""
+    keys = jax.random.split(jax.random.PRNGKey(0), inner * blocks)
+    keys_b, ov_b, _, _ = engine.scanned_inputs(keys, inner=inner)
+    return jax.jit(engine.scanned_fn()).trace(keys_b, ov_b)
+
+
+def scanned_program_size(
+    engine: FastEngine, inner: int, blocks: int,
+) -> tuple[int, int]:
+    """(jaxpr equation count, StableHLO line count) of the scanned program."""
+    traced = trace_scanned(engine, inner, blocks)
+    n_eqns = count_jaxpr_eqns(traced.jaxpr.jaxpr)
+    n_lines = traced.lower().as_text().count("\n")
+    return n_eqns, n_lines

@@ -1,0 +1,80 @@
+"""Measure XLA program size and compile time of the fast path vs chunk shape.
+
+VERDICT r3 #1: the scanned fast path's TPU compile went from ~125 s at
+chunk=16 to never-returning at chunk=128.  This script measures, on CPU
+(no TPU needed for compile-scaling data):
+
+  * jaxpr equation count of the jitted program,
+  * StableHLO line count after lowering,
+  * optimized HLO instruction count after XLA compilation,
+  * lower() and compile() wall time,
+
+for a grid of (scan_inner, blocks) shapes of the bench config, so the
+super-linear term can be located and fixed.  Results + analysis:
+docs/internals/compile-pathology.md; the CI gate pinning program flatness:
+tests/unit/jax_engine/test_compile_scaling.py (both share
+asyncflow_tpu.utils.program_size so they count the same program).
+
+Usage: JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python scripts/compile_scaling.py [16x1,16x8,...]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from _common import load_example_payload, log  # noqa: E402
+
+
+def main() -> None:
+    from asyncflow_tpu.compiler.plan import compile_payload
+    from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+    from asyncflow_tpu.utils.program_size import count_jaxpr_eqns, trace_scanned
+
+    horizon = int(os.environ.get("SHOT_HORIZON", "600"))
+    payload = load_example_payload(horizon)
+    plan = compile_payload(payload)
+    log(
+        f"plan: n={plan.max_requests} servers={plan.n_servers} "
+        f"edges={plan.n_edges} fastpath_ok={plan.fastpath_ok}",
+    )
+
+    grid = [(16, 1), (16, 2), (16, 4), (16, 8), (4, 1), (64, 1)]
+    if len(sys.argv) > 1:
+        grid = [tuple(map(int, pair.split("x"))) for pair in sys.argv[1].split(",")]
+
+    eng = FastEngine(plan)
+    for inner, blocks in grid:
+        t0 = time.time()
+        traced = trace_scanned(eng, inner, blocks)
+        n_eqns = count_jaxpr_eqns(traced.jaxpr.jaxpr)
+        t_trace = time.time() - t0
+
+        t0 = time.time()
+        lowered = traced.lower()
+        n_stablehlo = lowered.as_text().count("\n")
+        t_lower = time.time() - t0
+
+        t0 = time.time()
+        compiled = lowered.compile()
+        t_compile = time.time() - t0
+        try:
+            mods = compiled.runtime_executable().hlo_modules()
+            n_opt = sum(m.to_string().count("\n") for m in mods)
+        except Exception:
+            n_opt = -1
+
+        log(
+            f"inner={inner:4d} blocks={blocks:3d} total={inner * blocks:5d}: "
+            f"jaxpr_eqns={n_eqns} stablehlo_lines={n_stablehlo} "
+            f"opt_hlo_lines={n_opt} trace={t_trace:.1f}s lower={t_lower:.1f}s "
+            f"compile={t_compile:.1f}s",
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Probe-only watcher (round 4): log worker liveness every 4 min; do NOT
+# launch any workload on recovery — round 4 decides what to run by hand.
+set -u
+cd "$(dirname "$0")/.."
+LOG="${TPU_PROBE_LOG:-tpu_probe_loop.log}"
+PROBE='import jax, jax.numpy as jnp; assert jax.default_backend()!="cpu"; (jnp.ones((4,128))+1).block_until_ready(); print("PROBE_OK")'
+attempt=0
+while true; do
+    attempt=$((attempt + 1))
+    if timeout 90 python -c "$PROBE" 2>/dev/null | grep -q PROBE_OK; then
+        echo "$(date +%H:%M:%S) probe $attempt: ALIVE" >> "$LOG"
+        sleep 240
+    else
+        echo "$(date +%H:%M:%S) probe $attempt: wedged" >> "$LOG"
+        sleep 240
+    fi
+done

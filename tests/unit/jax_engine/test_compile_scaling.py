@@ -1,0 +1,51 @@
+"""Program-size flatness gate (docs/internals/compile-pathology.md).
+
+The round-3 TPU compile blow-up scaled with the vmap batch width while the
+program itself was shape-flat.  These tests pin the flatness: the jitted
+fast-path program (jaxpr equation count and StableHLO size) must be
+IDENTICAL across vmap widths and scan lengths, so any future edit that
+makes the program grow with chunk fails here, on CPU, before it can wedge
+a TPU worker.  The metric is computed by the same helper the measurement
+script uses (``asyncflow_tpu.utils.program_size``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+from asyncflow_tpu.compiler.plan import compile_payload
+from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+from asyncflow_tpu.utils.program_size import scanned_program_size
+
+_SCRIPTS = os.path.join(
+    os.path.dirname(__file__), "..", "..", "..", "scripts",
+)
+
+
+@pytest.fixture(scope="module")
+def fast_engine() -> FastEngine:
+    sys.path.insert(0, _SCRIPTS)
+    try:
+        from _common import load_example_payload
+    finally:
+        sys.path.remove(_SCRIPTS)
+    # small horizon keeps the trace fast; program *structure* is
+    # horizon-independent, which is exactly what these tests pin
+    plan = compile_payload(load_example_payload(30))
+    assert plan.fastpath_ok, plan.fastpath_reason
+    return FastEngine(plan)
+
+
+def test_program_flat_in_vmap_width(fast_engine: FastEngine) -> None:
+    small = scanned_program_size(fast_engine, inner=2, blocks=1)
+    wide = scanned_program_size(fast_engine, inner=16, blocks=1)
+    assert small == wide
+
+
+def test_program_flat_in_scan_length(fast_engine: FastEngine) -> None:
+    short = scanned_program_size(fast_engine, inner=4, blocks=2)
+    long = scanned_program_size(fast_engine, inner=4, blocks=16)
+    assert short == long
