@@ -47,13 +47,16 @@ SEG_IO = 2
 # an io_db run on a server whose finite db_connection_pool may bind: the
 # request must hold one of K FIFO connections for the segment's duration
 # (core released, RAM held — the connection wait parks in the event loop).
-# Only emitted when the compiler cannot prove the pool non-binding; plans
-# containing SEG_DB run on the event engines (oracle/native/jax-event).
+# Only emitted when the compiler cannot prove the pool non-binding.  Modeled
+# by the event engines, and by the fast path as one extra FIFO G/G/K
+# station per server when every endpoint's (single) query follows its last
+# CPU burst (_fastpath_lowering).
 SEG_DB = 3
 # an io_cache step with hit/miss dynamics: the sleep is a per-request
 # two-point mixture (hit latency with probability p, else the backing
-# store's miss latency).  Modeled by the event engines; the fast path's
-# static visit tables decline it.
+# store's miss latency).  Modeled by the event engines, and by the fast
+# path as per-request duration extras on the visit tables
+# (fp_cache_slot/fp_cache_miss_prob/fp_cache_extra).
 SEG_CACHE = 4
 
 # Multi-burst relaxation envelope: nominal per-server core utilization above
@@ -125,6 +128,75 @@ def _compile_endpoint(
                 else None,
             )
     return segments, total_ram, cache
+
+
+# fastpath cache-placement sentinels (fp_cache_slot values < 0):
+# a stochastic cache segment's miss-extra lands either in one of the
+# CPU-burst pre-IO slots (slot index >= 0), in the trailing IO before the
+# (single) DB segment, or in the trailing IO after it.
+CACHE_PRE_DB = -2
+CACHE_POST_DB = -3
+CACHE_UNUSED = -1
+
+
+def _fastpath_lowering(
+    segs: list[tuple[int, float]],
+    cache: list[tuple[float, float] | None],
+) -> tuple[tuple[float, float, float], list[tuple[int, float, float]], str]:
+    """Lower one endpoint's segments to the fast path's stochastic tables.
+
+    Returns ``((db_pre, db_dur, db_post), cache_places, reason)``:
+
+    - the trailing IO split around the endpoint's (single) :data:`SEG_DB`
+      segment — ``db_pre`` seconds of plain/cache-hit IO after the last CPU
+      burst, then the connection-holding query of ``db_dur`` seconds, then
+      ``db_post`` (all zeros when the endpoint has no DB segment);
+    - one ``(slot, miss_prob, miss_extra)`` triple per :data:`SEG_CACHE`
+      segment: ``slot`` is the CPU-burst index whose pre-IO contains the
+      segment, or :data:`CACHE_PRE_DB`/:data:`CACHE_POST_DB` for trailing
+      placement; ``miss_extra`` is ``miss - hit`` duration;
+    - a non-empty ``reason`` when the shape is outside the fast path's
+      model (more than one DB segment, or a DB query before a CPU burst —
+      its FIFO wait would feed back into the core-queue enqueue times).
+    """
+    n_cpu = sum(1 for k, _ in segs if k == SEG_CPU)
+    db_seen = 0
+    burst_idx = 0
+    db_pre = db_dur = db_post = 0.0
+    places: list[tuple[int, float, float]] = []
+    for i, (kind, dur) in enumerate(segs):
+        if kind == SEG_CPU:
+            burst_idx += 1
+            continue
+        trailing = burst_idx >= n_cpu
+        if kind == SEG_DB:
+            if db_seen:
+                return (0.0, 0.0, 0.0), [], "multiple DB queries per endpoint"
+            if not trailing:
+                return (
+                    (0.0, 0.0, 0.0),
+                    [],
+                    "DB query before a CPU burst (pool wait feeds back "
+                    "into the core queue)",
+                )
+            db_seen = 1
+            db_dur = dur
+            continue
+        if kind == SEG_CACHE:
+            hit_prob, miss = cache[i]
+            slot = (
+                burst_idx
+                if not trailing
+                else (CACHE_POST_DB if db_seen else CACHE_PRE_DB)
+            )
+            places.append((slot, 1.0 - hit_prob, miss - dur))
+        # SEG_IO / SEG_CACHE hit duration accumulates into the split
+        if trailing:
+            if db_seen:
+                db_post += dur
+            else:
+                db_pre += dur
+    return (db_pre, db_dur, db_post), places, ""
 
 
 def _burst_decomposition(
@@ -293,6 +365,31 @@ class StaticPlan:
         default_factory=lambda: np.empty((0, 0, 0), np.float32),
     )
     seg_miss_dur: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0, 0), np.float32),
+    )
+
+    #: fast-path stochastic tables (docstring: :func:`_fastpath_lowering`).
+    #: (NS, NEP) f32 split of the trailing IO around the single DB segment
+    #: (all zeros when no endpoint queries a modeled pool) ...
+    fp_db_pre: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0), np.float32),
+    )
+    fp_db_dur: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0), np.float32),
+    )
+    fp_db_post: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0), np.float32),
+    )
+    #: ... and (NS, NEP, CMAX) cache-mixture placements: burst slot (or
+    #: CACHE_PRE_DB/CACHE_POST_DB/CACHE_UNUSED), miss probability, and
+    #: miss-minus-hit duration extra per stochastic cache segment.
+    fp_cache_slot: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0, 0), np.int32),
+    )
+    fp_cache_miss_prob: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0, 0), np.float32),
+    )
+    fp_cache_extra: np.ndarray = field(
         default_factory=lambda: np.empty((0, 0, 0), np.float32),
     )
 
@@ -798,6 +895,39 @@ def compile_payload(
             burst_pre_io[s, e, : len(pre_list)] = pre_list
             endpoint_post_io[s, e] = post
 
+    # fast-path stochastic tables: trailing-IO split around the DB segment
+    # + cache-mixture placements (zero-filled where the endpoint has none;
+    # _fastpath_analysis declines the shapes _fastpath_lowering rejects)
+    fp_lowered = [
+        [_fastpath_lowering(segs, cache) for segs, _, cache in per_server]
+        for per_server in compiled
+    ]
+    cmax = max(
+        (len(places) for per_server in fp_lowered for _, places, _ in per_server),
+        default=0,
+    )
+    fp_db_pre = np.zeros((n_servers, max_endpoints), dtype=np.float32)
+    fp_db_dur = np.zeros((n_servers, max_endpoints), dtype=np.float32)
+    fp_db_post = np.zeros((n_servers, max_endpoints), dtype=np.float32)
+    fp_cache_slot = np.full(
+        (n_servers, max_endpoints, cmax), CACHE_UNUSED, dtype=np.int32,
+    )
+    fp_cache_miss_prob = np.zeros(
+        (n_servers, max_endpoints, cmax), dtype=np.float32,
+    )
+    fp_cache_extra = np.zeros((n_servers, max_endpoints, cmax), dtype=np.float32)
+    for s, per_server in enumerate(fp_lowered):
+        for e, ((dpre, ddur, dpost), places, reason) in enumerate(per_server):
+            if reason:
+                continue  # analysis declines the plan; keep zeros
+            fp_db_pre[s, e] = dpre
+            fp_db_dur[s, e] = ddur
+            fp_db_post[s, e] = dpost
+            for j, (slot, miss_p, extra) in enumerate(places):
+                fp_cache_slot[s, e, j] = slot
+                fp_cache_miss_prob[s, e, j] = miss_p
+                fp_cache_extra[s, e, j] = extra
+
     server_cores = np.array(
         [server.server_resources.cpu_cores for server in servers],
         dtype=np.int32,
@@ -894,6 +1024,8 @@ def compile_payload(
             max_spike=float(spike_values.max()) if spike_values.size else 0.0,
             server_queue_cap=queue_cap_model,
             server_conn_cap=conn_cap_model,
+            server_db_pool=server_db_pool,
+            fp_lowered=fp_lowered,
         )
     )
 
@@ -963,6 +1095,12 @@ def compile_payload(
         server_conn_cap=conn_cap_model,
         seg_hit_prob=seg_hit_prob,
         seg_miss_dur=seg_miss_dur,
+        fp_db_pre=fp_db_pre,
+        fp_db_dur=fp_db_dur,
+        fp_db_post=fp_db_post,
+        fp_cache_slot=fp_cache_slot,
+        fp_cache_miss_prob=fp_cache_miss_prob,
+        fp_cache_extra=fp_cache_extra,
     )
 
 
@@ -978,6 +1116,8 @@ def _fastpath_analysis(
     max_spike: float = 0.0,
     server_queue_cap: np.ndarray | None = None,
     server_conn_cap: np.ndarray | None = None,
+    server_db_pool: np.ndarray | None = None,
+    fp_lowered: list | None = None,
 ) -> tuple[bool, str, list[int], np.ndarray, int, float]:
     """Decide whether the scan engine can execute this plan faithfully.
 
@@ -1089,29 +1229,25 @@ def _fastpath_analysis(
                 0,
                 0.0,
             )
-        if any(k == SEG_CACHE for segs, *_ in compiled[s] for k, _ in segs):
-            # per-request mixture sleeps don't fit the static visit tables
-            return (
-                False,
-                f"server {server.id}: stochastic cache step (hit/miss "
-                "mixture) — modeled on the event engines",
-                [],
-                no_slots,
-                0,
-                0.0,
-            )
-        if any(k == SEG_DB for segs, *_ in compiled[s] for k, _ in segs):
-            # a pool the compiler could not prove non-binding: the FIFO
-            # connection queue needs the event engines' waiter machinery
-            return (
-                False,
-                f"server {server.id}: binding DB connection pool "
-                "(modeled on the event engines)",
-                [],
-                no_slots,
-                0,
-                0.0,
-            )
+        # Stochastic cache segments are per-request duration extras and DB
+        # pools are one extra FIFO G/G/K station per server on the fast
+        # path (round 4) — eligible as long as every endpoint's shape fits
+        # the lowering model (_fastpath_lowering): at most one DB query,
+        # positioned after the last CPU burst so its FIFO wait never feeds
+        # back into the core-queue enqueue times.
+        if fp_lowered is not None:
+            for e, (_, _, reason) in enumerate(fp_lowered[s]):
+                if reason:
+                    return (
+                        False,
+                        f"server {server.id} endpoint "
+                        f"{server.endpoints[e].endpoint_name}: {reason} "
+                        "(modeled on the event engines)",
+                        [],
+                        no_slots,
+                        0,
+                        0.0,
+                    )
         if exit_kind[s] == TARGET_LB:
             return (
                 False,
@@ -1124,25 +1260,56 @@ def _fastpath_analysis(
         max_ram = 0.0
         residence = 0.0
         cpu_dur = 0.0
+        db_dur_max = 0.0
         visits = 1
         needs: set[float] = set()
-        for segs, ram, _ in compiled[s]:
+        for segs, ram, cache in compiled[s]:
             max_ram = max(max_ram, ram)
             if ram > 0:
                 needs.add(ram)
-            residence = max(residence, sum(d for _, d in segs))
+            # worst-case residence: stochastic cache segments may sleep the
+            # miss latency (the tier-1 proof must hold for every draw)
+            residence = max(
+                residence,
+                sum(
+                    max(d, cache[i][1]) if cache[i] is not None else d
+                    for i, (_, d) in enumerate(segs)
+                ),
+            )
             cpu_dur = max(cpu_dur, sum(d for k, d in segs if k == SEG_CPU))
+            db_dur_max = max(
+                db_dur_max, sum(d for k, d in segs if k == SEG_DB),
+            )
             visits = max(visits, sum(1 for k, _ in segs if k == SEG_CPU))
+        has_db_station = bool(
+            server_db_pool is not None and server_db_pool[s] >= 0 and db_dur_max > 0,
+        )
         if max_ram <= 0:
             continue  # ram_slots[s] stays 0: nothing to admit
         # Tier 1: RAM provably non-binding.  RAM is held from admission to
         # endpoint end, INCLUDING every CPU queue wait — bound the waits with
-        # an M/M/c-style estimate per core-queue visit.
+        # an M/M/c-style estimate per core-queue visit (plus the DB pool's
+        # FIFO wait when a modeled station can park the request).
         cores = server.server_resources.cpu_cores
         rho = burst_rate * cpu_dur / cores
         capacity_mb = float(server.server_resources.ram_mb)
         if rho < 0.95:
             wait_est = visits * rho / (1.0 - rho) * cpu_dur / cores
+            if has_db_station:
+                pool_k = int(server_db_pool[s])
+                rho_db = burst_rate * db_dur_max / pool_k
+                if rho_db >= 0.95:
+                    return (
+                        False,
+                        f"server {server.id}: binding RAM with a saturated "
+                        "DB pool (no wait bound; modeled on the event "
+                        "engines)",
+                        [],
+                        no_slots,
+                        0,
+                        0.0,
+                    )
+                wait_est += rho_db / (1.0 - rho_db) * db_dur_max / pool_k
             if capacity_mb / max_ram >= 4.0 * burst_rate * (residence + wait_est) + 4.0:
                 ram_slots[s] = -1
                 continue
@@ -1153,6 +1320,34 @@ def _fastpath_analysis(
         # per endpoint, no zero-RAM endpoints that would bypass admission and
         # overtake in the core queue, and a uniform pre-burst IO (a longer
         # pre-IO on one endpoint would let later grants enqueue earlier).
+        if has_db_station:
+            # the joint admission+core pass cannot carry a third (pool)
+            # queue: RAM release depends on the DB wait and vice versa
+            return (
+                False,
+                f"server {server.id}: binding RAM with a binding DB pool",
+                [],
+                no_slots,
+                0,
+                0.0,
+            )
+        if fp_lowered is not None and any(
+            slot >= 0
+            for _, places, _ in fp_lowered[s]
+            for slot, _, _ in places
+        ):
+            # a stochastic pre-burst IO would let later RAM grants enqueue
+            # earlier, breaking the arrival-order identity the joint pass
+            # relies on
+            return (
+                False,
+                f"server {server.id}: stochastic cache before a CPU burst "
+                "with binding RAM",
+                [],
+                no_slots,
+                0,
+                0.0,
+            )
         if len(needs) == 1 and min(ram for _, ram, _ in compiled[s]) > 0:
             if visits > 1:
                 return (

@@ -43,6 +43,19 @@ collapses into pure array code:
    can bind are settled by ``_ram_core_scan`` instead: one exact
    arrival-order pass over (admission slots, cores) jointly.
 5. Chained servers (app -> DB) are processed in exit-DAG topological order.
+6. **Stochastic cache segments** (hit/miss mixtures) are per-request
+   duration extras on the visit tables: a miss draw adds ``miss - hit``
+   seconds to the burst pre-IO slot or trailing IO the segment occupies
+   (compiler: ``_fastpath_lowering``) — the queueing recursions are G/G/c,
+   so random service data changes nothing structurally.
+7. **Binding DB connection pools** are one extra FIFO G/G/K station per
+   server: every endpoint's (single) ``io_db`` query follows its last CPU
+   burst, so the station's FIFO wait — Lindley for K=1, Kiefer-Wolfowitz
+   for K>1, over the merged per-server stream ordered by station-enqueue
+   time — only delays departures, never feeds back into the core queue:
+   exact at any utilization.  Shapes outside the model (multiple queries,
+   query before a burst, binding RAM + binding pool) decline with named
+   reasons and run on the event engines.
 
 Everything is (N,) array work per scenario, vmapped over the batch: the
 whole Monte-Carlo sweep becomes sorts + scans + elementwise math — exactly
@@ -60,6 +73,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from asyncflow_tpu.compiler.plan import (
+    CACHE_POST_DB,
+    CACHE_PRE_DB,
+    CACHE_UNUSED,
     TARGET_SERVER,
     StaticPlan,
 )
@@ -603,6 +619,36 @@ class FastEngine:
             ram = jnp.asarray(plan.endpoint_ram)[s, ep]
             post = post_io_t[s, ep]
             n_cores = int(plan.server_cores[s])
+
+            # stochastic cache segments: per-request miss draws add
+            # (miss - hit) extras to the burst pre-IO slot or trailing IO
+            # the segment occupies (compiler: _fastpath_lowering)
+            cmax = int(plan.fp_cache_slot.shape[2]) if plan.fp_cache_slot.size else 0
+            server_has_cache = cmax > 0 and bool(
+                np.any(np.asarray(plan.fp_cache_slot[s]) != CACHE_UNUSED),
+            )
+            trail_extra = jnp.zeros(n, jnp.float32)
+            trail_extra_post_db = jnp.zeros(n, jnp.float32)
+            cache_extra_r = None
+            cache_slot_r = None
+            if server_has_cache:
+                u_c = jax.random.uniform(
+                    jax.random.fold_in(key, 160 + s), (n, cmax),
+                )
+                cache_slot_r = jnp.asarray(plan.fp_cache_slot)[s, ep]  # (n, cmax)
+                missed = u_c < jnp.asarray(plan.fp_cache_miss_prob)[s, ep]
+                cache_extra_r = jnp.where(
+                    missed, jnp.asarray(plan.fp_cache_extra)[s, ep], 0.0,
+                )
+                trail_extra = jnp.sum(
+                    jnp.where(cache_slot_r == CACHE_PRE_DB, cache_extra_r, 0.0),
+                    axis=1,
+                )
+                trail_extra_post_db = jnp.sum(
+                    jnp.where(cache_slot_r == CACHE_POST_DB, cache_extra_r, 0.0),
+                    axis=1,
+                )
+                post = post + trail_extra + trail_extra_post_db
             # static per-server visit count: max CPU bursts over its endpoints
             kb = int(plan.n_bursts[s, :nep].max()) if nep else 0
             # RAM admission tier (see compiler): k > 0 models a FIFO
@@ -647,6 +693,17 @@ class FastEngine:
                 validb = mine[:, None] & (ks[None, :] < nb[:, None])  # (n, kb)
                 dur = jnp.where(validb, burst_dur_t[s, ep][:, :kb], 0.0)
                 pre = jnp.where(validb, burst_pre_t[s, ep][:, :kb], 0.0)
+                if server_has_cache:
+                    # per-request cache-miss extras on the pre-IO slots
+                    pre_extra = jnp.sum(
+                        jnp.where(
+                            cache_slot_r[:, :, None] == ks[None, None, :],
+                            cache_extra_r[:, :, None],
+                            0.0,
+                        ),
+                        axis=1,
+                    )
+                    pre = pre + jnp.where(validb, pre_extra, 0.0)
                 pre_cum = jnp.cumsum(pre, axis=1)
 
                 def queue_waits(waits):
@@ -718,17 +775,51 @@ class FastEngine:
                     span(E[:, k] - pre[:, k], E[:, k], vb),
                 )
 
-            # trailing IO sleep and RAM residency (admission to departure)
+            # modeled DB connection pool: one extra FIFO G/G/K station per
+            # server.  Every endpoint's (single) query follows its last CPU
+            # burst (compiler: _fastpath_lowering), so the station's FIFO
+            # wait only delays the departure — no feedback into the core
+            # queue, exact at any utilization.  The merged per-server
+            # stream is ordered by station-enqueue time; K = 1 rides the
+            # log-depth Lindley scan, K > 1 the Kiefer-Wolfowitz vector.
+            trail_start = dep - post
+            pool_k = int(plan.server_db_pool[s])
+            server_has_db = pool_k > 0 and bool(
+                np.any(np.asarray(plan.fp_db_dur[s]) > 0),
+            )
+            if server_has_db:
+                db_dur_r = jnp.where(mine, jnp.asarray(plan.fp_db_dur)[s, ep], 0.0)
+                db_pre_r = jnp.asarray(plan.fp_db_pre)[s, ep] + trail_extra
+                use_db = mine & (db_dur_r > 0)
+                enq_db = jnp.where(use_db, trail_start + db_pre_r, INF)
+                order_db = jnp.argsort(enq_db)
+                if pool_k == 1:
+                    w_s = _lindley_waits(
+                        enq_db[order_db], db_dur_r[order_db], use_db[order_db],
+                    )
+                else:
+                    w_s = _kw_waits(
+                        enq_db[order_db],
+                        db_dur_r[order_db],
+                        use_db[order_db],
+                        pool_k,
+                    )
+                w_db = jnp.zeros(n).at[order_db].set(w_s)
+                dep = dep + jnp.where(use_db, w_db, 0.0)
+
+            # trailing IO sleep (including any DB pool wait: the reference
+            # parks connection waiters in the event loop, counted by the
+            # io-sleep gauge) and RAM residency (admission to departure)
             gauge = self._gauge_intervals(
                 gauge,
                 plan.gauge_io(s),
-                dep - post,
+                trail_start,
                 dep,
                 1.0,
-                mine & (post > 0),
+                mine & (dep > trail_start),
             )
             gauge_means = gauge_means.at[plan.gauge_io(s)].add(
-                span(dep - post, dep, mine & (post > 0)),
+                span(trail_start, dep, mine & (dep > trail_start)),
             )
             gauge = self._gauge_intervals(
                 gauge,

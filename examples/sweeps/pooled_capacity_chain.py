@@ -1,0 +1,142 @@
+"""Binding-pool capacity chain on the batched fast engine (round 4).
+
+The flagship milestone-4 scenario — ``client -> LB -> {app-1, app-2} ->
+db`` where the DB tier's **binding** connection pool is the bottleneck —
+used to fall to the event engine (the slowest TPU path).  Round 4 models
+the pool on the scan fast path as one FIFO G/G/K station per server
+(docs/internals/fastpath.md §5), so the whole load-response curve of a
+pooled tier is now one batched sweep.
+
+Each scenario runs the chain at a different load fraction; the printed
+curve shows the pool saturating (p95 blowing up) as load crosses the
+pool's capacity K / hold-time.  `engine_kind` is asserted to be the fast
+path — the point of the round.
+
+Run:  python examples/sweeps/pooled_capacity_chain.py [n_scenarios]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+from asyncflow_tpu.builder import AsyncFlow
+from asyncflow_tpu.components import (
+    Client,
+    Edge,
+    Endpoint,
+    LoadBalancer,
+    Server,
+    ServerResources,
+    Step,
+)
+from asyncflow_tpu.parallel import SweepRunner, make_overrides
+from asyncflow_tpu.settings import SimulationSettings
+from asyncflow_tpu.workload import RVConfig, RqsGenerator
+
+MAX_USERS = 150.0  # ~50 rps at the top of the swept range
+POOL_K = 4  # 4 connections x 60 ms hold => ~66 rps pool capacity
+HORIZON_S = 120
+
+
+def build_payload():
+    """gen -> client -> LB -> {app-1, app-2} -> db(pool K) -> client."""
+
+    def exp(mean: float) -> RVConfig:
+        return RVConfig(mean=mean, distribution="exponential")
+
+    app_ep = Endpoint(
+        endpoint_name="/work",
+        steps=[
+            Step(kind="initial_parsing", step_operation={"cpu_time": 0.004}),
+            Step(kind="io_wait", step_operation={"io_waiting_time": 0.010}),
+        ],
+    )
+    db_ep = Endpoint(
+        endpoint_name="/query",
+        steps=[
+            Step(kind="initial_parsing", step_operation={"cpu_time": 0.002}),
+            Step(kind="io_db", step_operation={"io_waiting_time": 0.060}),
+        ],
+    )
+    return (
+        AsyncFlow()
+        .add_generator(
+            RqsGenerator(
+                id="rqs-1",
+                avg_active_users=RVConfig(mean=MAX_USERS),
+                avg_request_per_minute_per_user=RVConfig(mean=20),
+                user_sampling_window=60,
+            ),
+        )
+        .add_client(Client(id="client-1"))
+        .add_load_balancer(
+            LoadBalancer(
+                id="lb-1",
+                algorithms="round_robin",
+                server_covered={"app-1", "app-2"},
+            ),
+        )
+        .add_servers(
+            Server(
+                id="app-1",
+                server_resources=ServerResources(cpu_cores=2, ram_mb=2048),
+                endpoints=[app_ep],
+            ),
+            Server(
+                id="app-2",
+                server_resources=ServerResources(cpu_cores=2, ram_mb=2048),
+                endpoints=[app_ep],
+            ),
+            Server(
+                id="db-1",
+                server_resources=ServerResources(
+                    cpu_cores=4, ram_mb=4096, db_connection_pool=POOL_K,
+                ),
+                endpoints=[db_ep],
+            ),
+        )
+        .add_edges(
+            Edge(id="gen-client", source="rqs-1", target="client-1", latency=exp(0.003)),
+            Edge(id="client-lb", source="client-1", target="lb-1", latency=exp(0.002)),
+            Edge(id="lb-app1", source="lb-1", target="app-1", latency=exp(0.002)),
+            Edge(id="lb-app2", source="lb-1", target="app-2", latency=exp(0.002)),
+            Edge(id="app1-db", source="app-1", target="db-1", latency=exp(0.002)),
+            Edge(id="app2-db", source="app-2", target="db-1", latency=exp(0.002)),
+            Edge(id="db-client", source="db-1", target="client-1", latency=exp(0.003)),
+        )
+        .add_simulation_settings(
+            SimulationSettings(total_simulation_time=HORIZON_S, sample_period_s=0.05),
+        )
+        .build_payload()
+    )
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    runner = SweepRunner(build_payload(), use_mesh=False)
+    assert runner.engine_kind == "fast", runner.plan.fastpath_reason
+    assert runner.plan.has_db_pool  # the pool is modeled, not lowered away
+
+    scales = np.linspace(0.2, 1.0, n)
+    overrides = make_overrides(
+        runner.plan, n, user_mean=(MAX_USERS * scales).astype(np.float32),
+    )
+    report = runner.run(n, seed=11, overrides=overrides)
+    p50 = report.results.percentile(50)
+    p95 = report.results.percentile(95)
+    print(f"engine: {runner.engine_kind}; pool K={POOL_K} on db-1")
+    for i, sc in enumerate(scales):
+        rps = sc * MAX_USERS * 20.0 / 60.0
+        print(
+            f"load {sc * 100.0:5.1f}%  ({rps:5.1f} rps): "
+            f"p50 {p50[i] * 1e3:7.1f} ms   p95 {p95[i] * 1e3:7.1f} ms",
+        )
+
+
+if __name__ == "__main__":
+    main()

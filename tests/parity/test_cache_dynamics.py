@@ -2,7 +2,8 @@
 milestone 4): an ``io_cache`` step with ``cache_hit_probability`` p sleeps
 its ``io_waiting_time`` (hit) with probability p and ``cache_miss_time``
 otherwise, drawn per request.  Modeled by the oracle, native, and jax event
-engines; the fast path and the Pallas kernel decline with named reasons.
+engines, and — round 4 — by the fast path as per-request miss-extra draws
+on its visit tables; the Pallas kernel declines with a named reason.
 """
 
 from __future__ import annotations
@@ -105,15 +106,20 @@ def test_compiler_lowering_and_fallback() -> None:
     assert plan.seg_hit_prob[0, 0, k] == pytest.approx(HIT_P)
     assert plan.seg_miss_dur[0, 0, k] == pytest.approx(MISS_T)
     assert plan.seg_dur[0, 0, k] == pytest.approx(HIT_T)
-    assert not plan.fastpath_ok
-    assert "cache" in plan.fastpath_reason
+    # round 4: mixtures are per-request extras on the fast path's tables
+    assert plan.fastpath_ok, plan.fastpath_reason
+    from asyncflow_tpu.compiler.plan import CACHE_PRE_DB
+
+    assert plan.fp_cache_slot[0, 0, 0] == CACHE_PRE_DB  # trailing, no DB
+    assert plan.fp_cache_miss_prob[0, 0, 0] == pytest.approx(1.0 - HIT_P)
+    assert plan.fp_cache_extra[0, 0, 0] == pytest.approx(MISS_T - HIT_T)
 
     from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
     from asyncflow_tpu.parallel import SweepRunner
 
     with pytest.raises(ValueError, match="cache"):
         PallasEngine(plan)
-    assert SweepRunner(_payload(), use_mesh=False).engine_kind == "event"
+    assert SweepRunner(_payload(), use_mesh=False).engine_kind == "fast"
 
 
 def test_capacity_sizing_uses_worst_case_miss() -> None:
@@ -141,8 +147,8 @@ def test_capacity_sizing_uses_worst_case_miss() -> None:
 
 
 def test_three_engine_parity_and_miss_fraction() -> None:
-    """Oracle / native / event agree on the mixture (measured: within 0.2%
-    mean at 8 seeds) and reproduce the 20% miss fraction."""
+    """Oracle / native / event / fast agree on the mixture (measured:
+    within 0.2% mean at 8 seeds) and reproduce the 20% miss fraction."""
     payload = _payload()
     plan = compile_payload(payload)
     n = 8
@@ -164,6 +170,25 @@ def test_three_engine_parity_and_miss_fraction() -> None:
     for q in (50, 95):
         po, pe = np.percentile(lat_o, q), np.percentile(lat_e, q)
         assert abs(pe - po) / po < 0.05, (q, po, pe)
+
+    from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+
+    fast = FastEngine(plan, collect_clocks=True)
+    ffinal = fast.run_batch(scenario_keys(11, n))
+    fclock = np.asarray(ffinal.clock)
+    fcounts = np.asarray(ffinal.clock_n)
+    lat_f = np.concatenate(
+        [
+            fclock[i, : fcounts[i], 1] - fclock[i, : fcounts[i], 0]
+            for i in range(n)
+        ],
+    )
+    frac_miss_f = float(np.mean(lat_f > MISS_T * 0.9))
+    assert abs(frac_miss_f - (1.0 - HIT_P)) < 0.02
+    assert abs(lat_f.mean() - lat_o.mean()) / lat_o.mean() < 0.04
+    for q in (50, 95):
+        po, pf = np.percentile(lat_o, q), np.percentile(lat_f, q)
+        assert abs(pf - po) / po < 0.05, (q, po, pf)
 
     from asyncflow_tpu.engines.oracle.native import native_available, run_native
 
