@@ -349,6 +349,11 @@ class StaticPlan:
         for name in ("server_db_pool", "server_queue_cap", "server_conn_cap"):
             if not getattr(self, name).size:
                 setattr(self, name, np.full(self.n_servers, -1, np.int32))
+        for name in ("server_rate_limit", "server_queue_timeout"):
+            if not getattr(self, name).size:
+                setattr(self, name, np.full(self.n_servers, -1.0, np.float32))
+        if not self.server_rate_burst.size:
+            self.server_rate_burst = np.zeros(self.n_servers, np.int32)
 
     @property
     def has_queue_cap(self) -> bool:
@@ -367,6 +372,30 @@ class StaticPlan:
     seg_miss_dur: np.ndarray = field(
         default_factory=lambda: np.empty((0, 0, 0), np.float32),
     )
+
+    #: (NS,) f32 modeled token-bucket refill rate (requests/s); -1 = no
+    #: limiter or one proven effectively-unreachable and lowered away.
+    server_rate_limit: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.float32),
+    )
+    #: (NS,) i32 token-bucket capacity for modeled limiters (0 elsewhere).
+    server_rate_burst: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.int32),
+    )
+    #: (NS,) f32 modeled ready-queue deadline (seconds); -1 = none or
+    #: proven unreachable.  Checked at dequeue (see OverloadPolicy).
+    server_queue_timeout: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.float32),
+    )
+    #: LB circuit breaker (0 threshold = not modeled): consecutive-failure
+    #: threshold, cooldown seconds, half-open probe slots.
+    breaker_threshold: int = 0
+    breaker_cooldown: float = 0.0
+    breaker_probes: int = 0
+    #: True when a configured breaker was lowered away because no failure
+    #: channel exists — sweep overrides that could create one (raising
+    #: LB-edge dropout) must be refused.
+    breaker_lowered: bool = False
 
     #: fast-path stochastic tables (docstring: :func:`_fastpath_lowering`).
     #: (NS, NEP) f32 split of the trailing IO around the single DB segment
@@ -397,6 +426,16 @@ class StaticPlan:
     def has_stochastic_cache(self) -> bool:
         """True when any segment is a cache hit/miss mixture."""
         return bool(self.seg_hit_prob.size and np.any(self.seg_hit_prob > 0))
+
+    @property
+    def has_rate_limit(self) -> bool:
+        """True when any server's token-bucket limiter is actually modeled."""
+        return bool(np.any(self.server_rate_limit >= 0))
+
+    @property
+    def has_queue_timeout(self) -> bool:
+        """True when any server's dequeue deadline is actually modeled."""
+        return bool(np.any(self.server_queue_timeout >= 0))
 
     @property
     def has_db_pool(self) -> bool:
@@ -833,6 +872,77 @@ def compile_payload(
         else:
             conn_cap_model[s_i] = cap
 
+    # Rate limiting (reference roadmap milestone 5): a token bucket of
+    # ``effective_burst`` tokens refilled at ``rate_limit_rps`` refuses
+    # arrivals that find no whole token.  With burst-inflated demand
+    # comfortably below the refill rate the bucket's deficit random walk
+    # has negative drift and a geometrically bounded tail, so a bucket
+    # with rho_rl^(burst-8) < 1e-12 can effectively never empty and the
+    # limiter lowers away; otherwise it is modeled (event engines; the
+    # fast path declines).
+    rate_limit_model = np.full(n_servers, -1.0, dtype=np.float32)
+    rate_burst_model = np.zeros(n_servers, dtype=np.int32)
+    for s_i, server in enumerate(servers):
+        rps = server.overload.rate_limit_rps if server.overload else None
+        if rps is None:
+            continue
+        burst = int(server.overload.effective_burst)
+        if srv_rates_est is None:
+            rate_limit_model[s_i] = rps
+            rate_burst_model[s_i] = burst
+            continue
+        rho_rl = srv_rates_est[s_i] * burst_factor / rps
+        if rho_rl < 0.9 and rho_rl ** max(burst - 8.0, 1.0) < 1e-12:
+            rho_max = min(
+                0.9, math.exp(math.log(1e-12) / max(burst - 8.0, 1.0)),
+            )
+            proof_rate_headroom = min(
+                proof_rate_headroom, rho_max / max(rho_rl, 1e-12),
+            )
+        else:
+            rate_limit_model[s_i] = rps
+            rate_burst_model[s_i] = burst
+
+    # Queue-wait deadlines (reference roadmap milestone 5): a request
+    # whose ready-queue wait exceeds ``queue_timeout_s`` abandons at
+    # dequeue.  A wait of D needs ~D * cores / cpu_dur requests ahead in
+    # the queue, so the queue-cap geometric tail bound applies with that
+    # equivalent length; deadlines it proves unreachable lower away.
+    queue_timeout_model = np.full(n_servers, -1.0, dtype=np.float32)
+    for s_i, server in enumerate(servers):
+        deadline = server.overload.queue_timeout_s if server.overload else None
+        if deadline is None:
+            continue
+        cpu_dur = max(
+            (
+                sum(st.quantity for st in ep.steps if st.is_cpu)
+                for ep in server.endpoints
+            ),
+            default=0.0,
+        )
+        if cpu_dur <= 0:
+            continue  # no core queue: the deadline is inert
+        if srv_rates_est is None:
+            queue_timeout_model[s_i] = deadline
+            continue
+        cores = server.server_resources.cpu_cores
+        rho_b = srv_rates_est[s_i] * burst_factor * cpu_dur / max(cores, 1)
+        eq_len = deadline * cores / cpu_dur
+        needed = (
+            math.inf
+            if rho_b >= 0.9
+            else math.log(1e-12) / math.log(max(rho_b, 1e-9)) + 16.0
+        )
+        if eq_len >= needed:
+            rho_max = min(
+                0.9, math.exp(math.log(1e-12) / max(eq_len - 16.0, 1.0)),
+            )
+            proof_rate_headroom = min(
+                proof_rate_headroom, rho_max / max(rho_b, 1e-12),
+            )
+        else:
+            queue_timeout_model[s_i] = deadline
+
     compiled: list[
         list[tuple[list[tuple[int, float]], float, list]]
     ] = [
@@ -964,6 +1074,33 @@ def compile_payload(
         else 0
     )
 
+    # Circuit breaker (reference roadmap milestone 5): modeled only when a
+    # failure channel exists on some covered target — a modeled refusal /
+    # shed / rate-limit / deadline on a target server, or dropout on an LB
+    # out-edge.  With no channel the breaker can never trip and lowers
+    # away; ``breaker_lowered`` flags the plan so sweep overrides that
+    # could CREATE a channel (raising LB-edge dropout) are refused.
+    breaker = lb.circuit_breaker if lb is not None else None
+    breaker_threshold = 0
+    breaker_cooldown = 0.0
+    breaker_probes = 0
+    breaker_lowered = False
+    if breaker is not None and lb_slots:
+        covered = {server_index[edges[eidx].target] for eidx in lb_slots}
+        has_channel = any(
+            queue_cap_model[s_c] >= 0
+            or conn_cap_model[s_c] >= 0
+            or rate_limit_model[s_c] >= 0
+            or queue_timeout_model[s_c] >= 0
+            for s_c in covered
+        ) or any(float(edges[eidx].dropout_rate) > 0 for eidx in lb_slots)
+        if has_channel:
+            breaker_threshold = int(breaker.failure_threshold)
+            breaker_cooldown = float(breaker.cooldown_s)
+            breaker_probes = int(breaker.half_open_probes)
+        else:
+            breaker_lowered = True
+
     # ---- events ----
     spikes: list[tuple[float, float, int]] = []  # (time, delta, edge)
     outages: list[tuple[float, int, int, int]] = []  # (time, start_mark, down, slot)
@@ -1026,6 +1163,9 @@ def compile_payload(
             server_conn_cap=conn_cap_model,
             server_db_pool=server_db_pool,
             fp_lowered=fp_lowered,
+            server_rate_limit=rate_limit_model,
+            server_queue_timeout=queue_timeout_model,
+            breaker_threshold=breaker_threshold,
         )
     )
 
@@ -1093,6 +1233,13 @@ def compile_payload(
         proof_rate_headroom=proof_rate_headroom,
         server_queue_cap=queue_cap_model,
         server_conn_cap=conn_cap_model,
+        server_rate_limit=rate_limit_model,
+        server_rate_burst=rate_burst_model,
+        server_queue_timeout=queue_timeout_model,
+        breaker_threshold=breaker_threshold,
+        breaker_cooldown=breaker_cooldown,
+        breaker_probes=breaker_probes,
+        breaker_lowered=breaker_lowered,
         seg_hit_prob=seg_hit_prob,
         seg_miss_dur=seg_miss_dur,
         fp_db_pre=fp_db_pre,
@@ -1118,6 +1265,9 @@ def _fastpath_analysis(
     server_conn_cap: np.ndarray | None = None,
     server_db_pool: np.ndarray | None = None,
     fp_lowered: list | None = None,
+    server_rate_limit: np.ndarray | None = None,
+    server_queue_timeout: np.ndarray | None = None,
+    breaker_threshold: int = 0,
 ) -> tuple[bool, str, list[int], np.ndarray, int, float]:
     """Decide whether the scan engine can execute this plan faithfully.
 
@@ -1203,6 +1353,19 @@ def _fastpath_analysis(
         # beyond this the general event engine is the better engine
         return False, f"endpoint with {max_visits} CPU bursts", [], no_slots, 0, 0.0
 
+    if breaker_threshold > 0:
+        # breaker state is feedback from downstream rejections into the
+        # rotation; only the event engines carry it
+        return (
+            False,
+            "load balancer: circuit breaker with a live failure channel "
+            "(modeled on the event engines)",
+            [],
+            no_slots,
+            0,
+            0.0,
+        )
+
     ram_slots = np.zeros(n_servers, dtype=np.int32)
     for s, server in enumerate(servers):
         if server_conn_cap is not None and server_conn_cap[s] >= 0:
@@ -1224,6 +1387,29 @@ def _fastpath_analysis(
                 False,
                 f"server {server.id}: reachable ready-queue cap "
                 "(load shedding modeled on the event engines)",
+                [],
+                no_slots,
+                0,
+                0.0,
+            )
+        if server_rate_limit is not None and server_rate_limit[s] >= 0:
+            # a reachable token-bucket limiter refuses arrivals; no
+            # refusal channel in the closed-form recursions
+            return (
+                False,
+                f"server {server.id}: reachable rate limit "
+                "(token bucket modeled on the event engines)",
+                [],
+                no_slots,
+                0,
+                0.0,
+            )
+        if server_queue_timeout is not None and server_queue_timeout[s] >= 0:
+            # a reachable dequeue deadline abandons requests mid-endpoint
+            return (
+                False,
+                f"server {server.id}: reachable queue deadline "
+                "(timeouts modeled on the event engines)",
                 [],
                 no_slots,
                 0,

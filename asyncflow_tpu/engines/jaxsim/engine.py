@@ -72,6 +72,7 @@ from asyncflow_tpu.engines.jaxsim.rotation import (
 )
 from asyncflow_tpu.engines.jaxsim.params import (
     EV_ARRIVE_LB,
+    EV_ABANDON,
     EV_ARRIVE_SRV,
     EV_IDLE,
     EV_RESUME,
@@ -127,6 +128,9 @@ class Engine:
         self._has_cache = bool(np.any(plan.seg_kind == SEG_CACHE))
         self._has_shed = plan.has_queue_cap
         self._has_conn = plan.has_conn_cap
+        self._has_rl = plan.has_rate_limit
+        self._has_timeout = plan.has_queue_timeout
+        self._has_breaker = plan.breaker_threshold > 0
         self._compiled: dict = {}
 
     # ==================================================================
@@ -316,6 +320,31 @@ class Engine:
         best = jnp.argmin(order_key).astype(jnp.int32)
         return st.lb_order[best], st.lb_order
 
+    def _lb_pick_breaker(self, st: EngineState, admits):
+        """(slot, rotated order, none_admitting) honoring breaker state.
+
+        Round robin: FIRST admitting slot in rotation order is picked and
+        moved to the tail; non-admitting slots keep their positions (the
+        breaker skips, it does not reorder).  Least connections: masked
+        argmin over admitting rotation members."""
+        el = max(self.plan.n_lb_edges, 1)
+        pos = jnp.arange(el, dtype=jnp.int32)
+        valid = pos < st.lb_len
+        elig = valid & admits[st.lb_order]
+        any_elig = jnp.any(elig)
+        if self.plan.lb_algo == 0:
+            first = jnp.argmax(elig).astype(jnp.int32)
+            slot = st.lb_order[first]
+            order, length = self._lb_remove(
+                st.lb_order, st.lb_len, slot, any_elig,
+            )
+            order, _ = self._lb_insert(order, length, slot, any_elig)
+            return slot, order, ~any_elig
+        conn = st.lb_conn[st.lb_order]
+        order_key = jnp.where(elig, conn * el + pos, jnp.int32(2**30))
+        best = jnp.argmin(order_key).astype(jnp.int32)
+        return st.lb_order[best], st.lb_order, ~any_elig
+
     def _lb_remove(self, order, length, slot, pred):
         return rotation_remove(order, length, slot, pred, max(self.plan.n_lb_edges, 1))
 
@@ -440,6 +469,12 @@ class Engine:
                 db_wait_n=st.db_wait_n.at[s].add(jnp.where(db_wait, 1, 0)),
             )
             is_io = is_io | is_db  # the io-sleep gauge counts db segments
+        if self._has_timeout:
+            st = st._replace(
+                req_wait_t=st.req_wait_t.at[i].set(
+                    jnp.where(cpu_wait, now, st.req_wait_t[i]),
+                ),
+            )
         st = st._replace(
             cores_free=st.cores_free.at[s].add(jnp.where(cpu_run, -1, 0)),
             cpu_ticket=st.cpu_ticket.at[s].add(jnp.where(cpu_wait, 1, 0)),
@@ -493,6 +528,9 @@ class Engine:
                     jnp.where(shed, NO_TICKET, st.req_ticket[i]),
                 ),
                 n_rejected=st.n_rejected + jnp.where(shed, 1, 0),
+            )
+            st = self._breaker_server_report(
+                st, i, now, jnp.bool_(True), shed,
             )
         return self._exit_flow(st, i, s, now, key, ov, is_end)
 
@@ -566,6 +604,8 @@ class Engine:
             st = st._replace(
                 srv_conn=st.srv_conn.at[s].add(jnp.where(pred, -1, 0)),
             )
+        # departing the routed target is the breaker's success signal
+        st = self._breaker_server_report(st, i, now, jnp.bool_(False), pred)
 
         # route the single exit edge of this server
         e = p.exit_edge[s]
@@ -616,8 +656,90 @@ class Engine:
         )
         return st
 
+    def _breaker_report(self, st, slot, is_probe, failed, now, pred):
+        """Apply one success/failure report to breaker slot ``slot``.
+
+        Mirrors the oracle's ``breaker_failure``/``breaker_success``:
+        probe outcomes settle the half-open round (failure re-opens,
+        ``half_open_probes`` successes close); closed-state failures count
+        consecutively toward the threshold, successes reset the count.
+        """
+        plan = self.plan
+        probe = pred & is_probe
+        plain = pred & ~is_probe
+        stt = st.cb_state[slot]
+        # probe bookkeeping
+        st = st._replace(
+            cb_probes_out=st.cb_probes_out.at[slot].add(
+                jnp.where(probe, -1, 0),
+            ),
+        )
+        st = st._replace(
+            cb_probes_out=st.cb_probes_out.at[slot].max(0),
+        )
+        # probe failure: immediate re-open
+        p_fail = probe & failed
+        # closed-state consecutive failures
+        c_fail = plain & failed & (stt == 0)
+        consec = st.cb_consec[slot] + jnp.where(c_fail, 1, 0)
+        trips = c_fail & (consec >= plan.breaker_threshold)
+        opens = p_fail | trips
+        st = st._replace(
+            cb_consec=st.cb_consec.at[slot].set(
+                jnp.where(
+                    trips | (plain & ~failed & (stt == 0)),
+                    0,
+                    consec,
+                ),
+            ),
+            cb_state=st.cb_state.at[slot].set(
+                jnp.where(opens, 1, st.cb_state[slot]),
+            ),
+            cb_open_until=st.cb_open_until.at[slot].set(
+                jnp.where(
+                    opens,
+                    now + jnp.float32(plan.breaker_cooldown),
+                    st.cb_open_until[slot],
+                ),
+            ),
+        )
+        # probe success: count toward closing the half-open round
+        p_ok = probe & ~failed
+        probe_ok = st.cb_probe_ok[slot] + jnp.where(p_ok, 1, 0)
+        closes = p_ok & (stt == 2) & (probe_ok >= plan.breaker_probes)
+        return st._replace(
+            cb_probe_ok=st.cb_probe_ok.at[slot].set(probe_ok),
+            cb_state=st.cb_state.at[slot].set(
+                jnp.where(closes, 0, st.cb_state[slot]),
+            ),
+            cb_consec=st.cb_consec.at[slot].set(
+                jnp.where(closes, 0, st.cb_consec[slot]),
+            ),
+        )
+
+    def _breaker_server_report(self, st, i, now, failed, pred):
+        """Report slot ``i``'s routing outcome once (no-op after clearing)."""
+        if not self._has_breaker:
+            return st
+        slot = st.req_cbslot[i]
+        act = pred & (slot >= 0)
+        slot_c = jnp.clip(slot, 0, None)
+        st = self._breaker_report(
+            st, slot_c, st.req_probe[i] > 0, failed, now, act,
+        )
+        return st._replace(
+            req_cbslot=st.req_cbslot.at[i].set(
+                jnp.where(act, -1, st.req_cbslot[i]),
+            ),
+            req_probe=st.req_probe.at[i].set(
+                jnp.where(act, 0, st.req_probe[i]),
+            ),
+        )
+
     def _arrive_lb_branch(self, st, i, now, key, ov, pred) -> EngineState:
-        """Route one request at the LB (empty rotation drops the request)."""
+        """Route one request at the LB (empty rotation drops the request;
+        with a circuit breaker, open slots are skipped in place and a fully
+        open rotation REJECTS the request — an overload protection)."""
         if self.plan.n_lb_edges == 0:
             return st
         p = self.params
@@ -625,13 +747,56 @@ class Engine:
         drop_empty = pred & empty
         route = pred & ~empty
 
-        slot, rotated = self._lb_pick(st)
+        if self._has_breaker:
+            # lazy cooldown expiry: open slots whose cooldown has elapsed
+            # become half-open with fresh probe slots
+            wake = route & (st.cb_state == 1) & (now >= st.cb_open_until)
+            st = st._replace(
+                cb_state=jnp.where(wake, 2, st.cb_state),
+                cb_probes_out=jnp.where(wake, 0, st.cb_probes_out),
+                cb_probe_ok=jnp.where(wake, 0, st.cb_probe_ok),
+            )
+            admits = (st.cb_state == 0) | (
+                (st.cb_state == 2)
+                & (st.cb_probes_out < self.plan.breaker_probes)
+            )
+            slot, rotated, none_open = self._lb_pick_breaker(st, admits)
+            reject = route & none_open
+            route = route & ~none_open
+            st = st._replace(
+                n_rejected=st.n_rejected + jnp.where(reject, 1, 0),
+                req_ev=st.req_ev.at[i].set(
+                    jnp.where(reject, EV_IDLE, st.req_ev[i]),
+                ),
+                req_t=st.req_t.at[i].set(
+                    jnp.where(reject, INF, st.req_t[i]),
+                ),
+            )
+            probe = route & (st.cb_state[slot] == 2)
+            st = st._replace(
+                cb_probes_out=st.cb_probes_out.at[slot].add(
+                    jnp.where(probe, 1, 0),
+                ),
+                req_cbslot=st.req_cbslot.at[i].set(
+                    jnp.where(route, slot, st.req_cbslot[i]),
+                ),
+                req_probe=st.req_probe.at[i].set(
+                    jnp.where(probe, 1, jnp.where(route, 0, st.req_probe[i])),
+                ),
+            )
+        else:
+            slot, rotated = self._lb_pick(st)
         order = jnp.where(route, rotated, st.lb_order)
         e = p.lb_edge_index[slot]
         dropped, delay = self._sample_edge(e, now, jax.random.fold_in(key, 32), ov)
         arrive = now + delay
         ok = route & ~dropped
         drop_edge = route & dropped
+        if self._has_breaker:
+            # a dropped send on the routing edge is a connection failure
+            st = self._breaker_server_report(
+                st, i, now, jnp.bool_(True), drop_edge,
+            )
 
         st = self._edge_interval(st, e, now, arrive, ok)
         free = drop_empty | drop_edge
@@ -672,6 +837,40 @@ class Engine:
                 ),
             )
 
+        if self._has_rl:
+            # token-bucket rate limiter: lazy refill at arrival, refuse
+            # when no whole token remains (runs before the socket check)
+            rps = p.server_rate_limit[s]
+            has_rl = pred & (rps >= 0)
+            tokens = jnp.minimum(
+                p.server_rate_burst[s].astype(jnp.float32),
+                st.rl_tokens[s]
+                + (now - st.rl_last[s]) * jnp.maximum(rps, 0.0),
+            )
+            limited = has_rl & (tokens < 1.0)
+            st = st._replace(
+                rl_tokens=st.rl_tokens.at[s].set(
+                    jnp.where(
+                        has_rl,
+                        tokens - jnp.where(limited, 0.0, 1.0),
+                        st.rl_tokens[s],
+                    ),
+                ),
+                rl_last=st.rl_last.at[s].set(
+                    jnp.where(has_rl, now, st.rl_last[s]),
+                ),
+                req_ev=st.req_ev.at[i].set(
+                    jnp.where(limited, EV_IDLE, st.req_ev[i]),
+                ),
+                req_t=st.req_t.at[i].set(
+                    jnp.where(limited, INF, st.req_t[i]),
+                ),
+                n_rejected=st.n_rejected + jnp.where(limited, 1, 0),
+            )
+            st = self._breaker_server_report(
+                st, i, now, jnp.bool_(True), limited,
+            )
+            pred = pred & ~limited
         if self._has_conn:
             # socket capacity: refuse the arrival when the server is full
             cap = p.server_conn_cap[s]
@@ -684,6 +883,9 @@ class Engine:
                     jnp.where(refuse, INF, st.req_t[i]),
                 ),
                 n_rejected=st.n_rejected + jnp.where(refuse, 1, 0),
+            )
+            st = self._breaker_server_report(
+                st, i, now, jnp.bool_(True), refuse,
             )
             pred = pred & ~refuse
             st = st._replace(
@@ -741,6 +943,60 @@ class Engine:
         )
         return self._seg_start(st, i, s, ep, jnp.int32(0), now, key, ov, pred)
 
+    def _cpu_handoff(self, st, s, now, was_cpu) -> EngineState:
+        """Release one core of server ``s`` or grant it to the head FIFO
+        waiter.  With dequeue deadlines, an expired grantee takes the core
+        for ZERO service as an immediate EV_ABANDON event (it hands the
+        core onward and leaves when that event fires — the oracle's
+        acquire-check-release at the same timestamp)."""
+        p = self.params
+        waiting = (st.req_ev == EV_WAIT_CPU) & (st.req_srv == s)
+        tick = jnp.where(waiting, st.req_ticket, NO_TICKET)
+        j = jnp.argmin(tick).astype(jnp.int32)
+        grant = was_cpu & (tick[j] < NO_TICKET)
+        release = was_cpu & ~grant
+        jdur = p.seg_dur[st.req_srv[j], st.req_ep[j], st.req_seg[j]]
+        ev_next = jnp.int32(EV_SEG_END)
+        t_next = now + jdur
+        if self._has_timeout:
+            deadline = p.server_queue_timeout[s]
+            expired = (
+                grant
+                & (deadline >= 0)
+                & (now - st.req_wait_t[j] > deadline)
+            )
+            ev_next = jnp.where(expired, EV_ABANDON, ev_next)
+            t_next = jnp.where(expired, now, t_next)
+        jidx = jnp.where(grant, j, jnp.int32(self.pool))
+        st = st._replace(
+            cores_free=st.cores_free.at[s].add(jnp.where(release, 1, 0)),
+            cpu_wait_n=st.cpu_wait_n.at[s].add(jnp.where(grant, -1, 0)),
+            req_ev=st.req_ev.at[jidx].set(ev_next, mode="drop"),
+            req_t=st.req_t.at[jidx].set(t_next, mode="drop"),
+            req_ticket=st.req_ticket.at[jidx].set(NO_TICKET, mode="drop"),
+        )
+        return self._gauge_add(st, now, self._g_ready(s), -1.0, grant)
+
+    def _abandon_branch(self, st, i, now, key, ov, pred) -> EngineState:
+        """Dequeue deadline exceeded: the request holds the core for zero
+        service — hand it onward, release RAM/connection, count rejected."""
+        if not self._has_timeout:
+            return st
+        s = st.req_srv[i]
+        st = self._cpu_handoff(st, s, now, pred)
+        st = self._release_ram(st, i, s, now, pred)
+        if self._has_conn:
+            st = st._replace(
+                srv_conn=st.srv_conn.at[s].add(jnp.where(pred, -1, 0)),
+            )
+        st = st._replace(
+            req_ev=st.req_ev.at[i].set(jnp.where(pred, EV_IDLE, st.req_ev[i])),
+            req_t=st.req_t.at[i].set(jnp.where(pred, INF, st.req_t[i])),
+            req_ram=st.req_ram.at[i].set(jnp.where(pred, 0.0, st.req_ram[i])),
+            n_rejected=st.n_rejected + jnp.where(pred, 1, 0),
+        )
+        return self._breaker_server_report(st, i, now, jnp.bool_(True), pred)
+
     def _seg_end_branch(self, st, i, now, key, ov, pred) -> EngineState:
         """A CPU burst or IO sleep finished: hand off the core / leave the IO
         queue, then start the next segment."""
@@ -754,22 +1010,7 @@ class Engine:
         if self._has_cache:
             was_io = was_io | (pred & (kind == SEG_CACHE))
 
-        # CPU handoff: grant the longest-waiting request on this server
-        waiting = (st.req_ev == EV_WAIT_CPU) & (st.req_srv == s)
-        tick = jnp.where(waiting, st.req_ticket, NO_TICKET)
-        j = jnp.argmin(tick).astype(jnp.int32)
-        grant = was_cpu & (tick[j] < NO_TICKET)
-        release = was_cpu & ~grant
-        jdur = p.seg_dur[st.req_srv[j], st.req_ep[j], st.req_seg[j]]
-        jidx = jnp.where(grant, j, jnp.int32(self.pool))
-        st = st._replace(
-            cores_free=st.cores_free.at[s].add(jnp.where(release, 1, 0)),
-            cpu_wait_n=st.cpu_wait_n.at[s].add(jnp.where(grant, -1, 0)),
-            req_ev=st.req_ev.at[jidx].set(EV_SEG_END, mode="drop"),
-            req_t=st.req_t.at[jidx].set(now + jdur, mode="drop"),
-            req_ticket=st.req_ticket.at[jidx].set(NO_TICKET, mode="drop"),
-        )
-        st = self._gauge_add(st, now, self._g_ready(s), -1.0, grant)
+        st = self._cpu_handoff(st, s, now, was_cpu)
 
         if self._has_db:
             # DB connection handoff, mirroring the core queue's discipline
@@ -839,6 +1080,38 @@ class Engine:
             smp_window_end=jnp.float32(0.0),
             smp_lam=jnp.float32(0.0),
             next_arrival=jnp.float32(0.0),
+            req_wait_t=(
+                jnp.zeros(pool, jnp.float32)
+                if self._has_timeout
+                else jnp.zeros(1, jnp.float32)
+            ),
+            req_cbslot=(
+                jnp.full(pool, -1, jnp.int32)
+                if self._has_breaker
+                else jnp.zeros(1, jnp.int32)
+            ),
+            req_probe=(
+                jnp.zeros(pool, jnp.int32)
+                if self._has_breaker
+                else jnp.zeros(1, jnp.int32)
+            ),
+            rl_tokens=(
+                jnp.asarray(plan.server_rate_burst, jnp.float32)
+                if self._has_rl
+                else jnp.zeros(1, jnp.float32)
+            ),
+            rl_last=jnp.zeros(
+                plan.n_servers if self._has_rl else 1, jnp.float32,
+            ),
+            cb_state=jnp.zeros(elp if self._has_breaker else 1, jnp.int32),
+            cb_consec=jnp.zeros(elp if self._has_breaker else 1, jnp.int32),
+            cb_open_until=jnp.zeros(
+                elp if self._has_breaker else 1, jnp.float32,
+            ),
+            cb_probes_out=jnp.zeros(
+                elp if self._has_breaker else 1, jnp.int32,
+            ),
+            cb_probe_ok=jnp.zeros(elp if self._has_breaker else 1, jnp.int32),
             tl_ptr=jnp.int32(0),
             nxt_i=jnp.int32(0),
             nxt_t=jnp.float32(INF),  # empty pool
@@ -915,6 +1188,10 @@ class Engine:
         st = self._arrive_srv_branch(st, i, now, kit, ov, is_pool & (ev == EV_ARRIVE_SRV))
         st = self._resume_branch(st, i, now, kit, ov, is_pool & (ev == EV_RESUME))
         st = self._seg_end_branch(st, i, now, kit, ov, is_pool & (ev == EV_SEG_END))
+        if self._has_timeout:
+            st = self._abandon_branch(
+                st, i, now, kit, ov, is_pool & (ev == EV_ABANDON),
+            )
         return self._refresh_pool_min(st)
 
     def _run_one(self, key, ov: ScenarioOverrides) -> EngineState:

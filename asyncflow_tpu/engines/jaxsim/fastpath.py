@@ -233,6 +233,7 @@ class FastEngine:
         n_hist_bins: int = 1024,
         max_requests: int | None = None,
         relax_sweeps: int | None = None,
+        relax_damping: float = 0.0,
         gauge_series_stride: int = 0,
     ) -> None:
         """``gauge_series_stride``: with ``collect_gauges=False``, a stride
@@ -268,6 +269,11 @@ class FastEngine:
         self.gauge_series_stride = 0 if collect_gauges else gauge_series_stride
         self.n_hist_bins = n_hist_bins
         self.relax_sweeps = relax_sweeps
+        self.relax_damping = relax_damping
+        #: "zero" (default) or "visit1": start the multi-burst relaxation
+        #: from the exact waits of a first-visits-only queue instead of 0
+        #: (envelope experiments, docs/internals/fastpath.md §5)
+        self.relax_init = "zero"
         self.n = max_requests or plan.max_requests
         self.n_windows = int(np.ceil(plan.horizon / plan.user_window))
         self.n_thr = int(np.ceil(plan.horizon)) or 1
@@ -733,13 +739,32 @@ class FastEngine:
                 # converge by ~2*kb+2; at convergence the result is within
                 # the oracle's own ensemble noise (+/-2-3% p95 at rho 0.6).
                 W = jnp.zeros((n, kb), jnp.float32)
+                if self.relax_init == "visit1":
+                    # exact waits of the first-visit-only queue: a lower
+                    # bound in truth's neighborhood (experimental)
+                    first = validb & (ks[None, :] == 0)
+                    e1 = jnp.where(first, t[:, None] + pre_cum, INF).reshape(-1)
+                    d1 = jnp.where(first, dur, 0.0).reshape(-1)
+                    v1 = first.reshape(-1)
+                    o1 = jnp.argsort(e1)
+                    if n_cores == 1:
+                        w1 = _lindley_waits(e1[o1], d1[o1], v1[o1])
+                    else:
+                        w1 = _kw_waits(e1[o1], d1[o1], v1[o1], n_cores)
+                    W = jnp.zeros(n * kb).at[o1].set(w1).reshape(n, kb)
+                    W = jnp.where(first & (dur > 0), W, 0.0)
                 n_sweeps = (
                     self.relax_sweeps
                     if self.relax_sweeps is not None
                     else (1 if kb == 1 else 2 * kb + 2)
                 )
+                alpha = self.relax_damping
                 for _ in range(n_sweeps):
-                    W = queue_waits(W)
+                    W = (
+                        queue_waits(W)
+                        if alpha == 0.0
+                        else (1.0 - alpha) * queue_waits(W) + alpha * W
+                    )
 
                 # enqueue times consistent with the final waits (gauges)
                 busy_prev = jnp.cumsum(W + dur, axis=1) - (W + dur)

@@ -105,8 +105,17 @@ def _threefry2x32(k0, k1, x0, x1):
 
 
 def _uniform_from_bits(bits):
-    """uint32 -> f32 uniform in [0, 1) with 24-bit resolution."""
-    return (bits >> np.uint32(8)).astype(jnp.float32) * np.float32(2.0**-24)
+    """uint32 -> f32 uniform in [0, 1) with 24-bit resolution.
+
+    Routed through int32: the shifted value is < 2**24 so the reinterpret
+    is exact, and Mosaic's TPU lowering has no uint32->f32 cast rule (the
+    direct cast raises ``NotImplementedError: Unsupported cast`` at
+    lowering — found by scripts/pallas_keepcut.py's cross-lowering probe).
+    """
+    return (
+        (bits >> np.uint32(8)).astype(jnp.int32).astype(jnp.float32)
+        * np.float32(2.0**-24)
+    )
 
 
 class _Rng:
@@ -303,14 +312,19 @@ class PallasEngine:
             or plan.has_stochastic_cache
             or plan.has_queue_cap
             or plan.has_conn_cap
+            or plan.has_rate_limit
+            or plan.has_queue_timeout
+            or plan.breaker_threshold > 0
         ):
             # the VMEM kernel has no DB-pool FIFO machinery, no cache
-            # mixture draws, and no shed/refusal paths; the compiler routes
-            # such plans to the general event engine
+            # mixture draws, and no shed/refusal/limiter/deadline/breaker
+            # paths; the compiler routes such plans to the general event
+            # engine
             msg = (
                 "the Pallas kernel does not model binding DB connection "
                 "pools, stochastic cache steps, or reachable overload "
-                "policies; use the event engine"
+                "policies (caps, capacities, rate limits, deadlines, "
+                "circuit breakers); use the event engine"
             )
             raise ValueError(msg)
         self.plan = plan
@@ -1053,8 +1067,56 @@ class PallasEngine:
         keys: jnp.ndarray,
         overrides: ScenarioOverrides | None = None,
     ) -> PallasState:
-        from jax.experimental import pallas as pl
+        args, sig, s = self._prepare(keys, overrides)
+        call = self._get_call(sig)
+        try:
+            hist, thr, momf, momi, trunc = call(*args)
+        finally:
+            # _kernel binds the traced table refs to self._tk for its
+            # helpers; drop them even when tracing/compilation fails so no
+            # tracer outlives its trace
+            self._tk = {}
+        hist = np.asarray(hist[:s])
+        thr = np.asarray(thr[:s])
+        momf = np.asarray(momf[:s])
+        momi = np.asarray(momi[:s])
+        trunc = np.asarray(trunc[:s, 0]).astype(bool)
+        return PallasState(
+            hist=hist,
+            lat_count=momi[:, 0],
+            lat_sum=momf[:, 0],
+            lat_sumsq=momf[:, 1],
+            lat_min=momf[:, 2],
+            lat_max=momf[:, 3],
+            thr=thr,
+            clock=np.zeros((1, 2), np.float32),
+            clock_n=momi[:, 0],
+            n_generated=momi[:, 1],
+            n_dropped=momi[:, 2],
+            n_overflow=momi[:, 3],
+            truncated=trunc,
+        )
 
+    def lower_tpu(self, keys: jnp.ndarray):
+        """Cross-platform-lower the compiled-mode kernel for the TPU target
+        (works from the CPU backend — Mosaic IR is embedded at lowering).
+        Returns the ``Lowered`` object; used by scripts/pallas_keepcut.py
+        to bound the Mosaic half of the compile risk without hardware."""
+        args, sig, _ = self._prepare(keys, None, force_interpret=False)
+        call = self._get_call(sig)
+        try:
+            return call.trace(*args).lower(lowering_platforms=("tpu",))
+        finally:
+            self._tk = {}
+
+    def _prepare(
+        self,
+        keys: jnp.ndarray,
+        overrides: ScenarioOverrides | None = None,
+        *,
+        force_interpret: bool | None = None,
+    ):
+        """(call args, program signature, requested batch size)."""
         ov = overrides if overrides is not None else base_overrides(self.plan)
         s = keys.shape[0]
         ne = self.plan.n_edges
@@ -1087,13 +1149,35 @@ class PallasEngine:
         ed = expand(ov.edge_dropout)
 
         interpret = (
-            self.interpret
-            if self.interpret is not None
-            else jax.default_backend() != "tpu"
+            force_interpret
+            if force_interpret is not None
+            else (
+                self.interpret
+                if self.interpret is not None
+                else jax.default_backend() != "tpu"
+            )
         )
         rows = sp // n_dev  # per-device rows (== sp when unsharded)
         nblk = rows // blk
         sig = (blk, nblk, interpret, n_dev)
+        args = (
+            k0,
+            k1,
+            lam,
+            em,
+            evr,
+            ed,
+            *[jnp.asarray(arr) for _, arr in self._tables],
+        )
+        return args, sig, s
+
+    def _get_call(self, sig):
+        """Build (once) and return the jitted pallas_call for ``sig``."""
+        from jax.experimental import pallas as pl
+
+        blk, nblk, interpret, n_dev = sig
+        ne = self.plan.n_edges
+        rows = blk * nblk
         if sig not in self._compiled:
             grid = (nblk,)
 
@@ -1147,39 +1231,4 @@ class PallasEngine:
                     check_vma=False,
                 )
             self._compiled[sig] = jax.jit(call)
-
-        try:
-            hist, thr, momf, momi, trunc = self._compiled[sig](
-                k0,
-                k1,
-                lam,
-                em,
-                evr,
-                ed,
-                *[jnp.asarray(arr) for _, arr in self._tables],
-            )
-        finally:
-            # _kernel binds the traced table refs to self._tk for its
-            # helpers; drop them even when tracing/compilation fails so no
-            # tracer outlives its trace
-            self._tk = {}
-        hist = np.asarray(hist[:s])
-        thr = np.asarray(thr[:s])
-        momf = np.asarray(momf[:s])
-        momi = np.asarray(momi[:s])
-        trunc = np.asarray(trunc[:s, 0]).astype(bool)
-        return PallasState(
-            hist=hist,
-            lat_count=momi[:, 0],
-            lat_sum=momf[:, 0],
-            lat_sumsq=momf[:, 1],
-            lat_min=momf[:, 2],
-            lat_max=momf[:, 3],
-            thr=thr,
-            clock=np.zeros((1, 2), np.float32),
-            clock_n=momi[:, 0],
-            n_generated=momi[:, 1],
-            n_dropped=momi[:, 2],
-            n_overflow=momi[:, 3],
-            truncated=trunc,
-        )
+        return self._compiled[sig]

@@ -23,6 +23,7 @@ EV_RESUME = 4  # RAM granted; start endpoint segments at time t
 EV_WAIT_CPU = 5
 EV_WAIT_RAM = 6
 EV_WAIT_DB = 7  # parked in the server's DB connection-pool FIFO
+EV_ABANDON = 8  # granted the core past its dequeue deadline: abandon now
 
 
 class PlanParams(NamedTuple):
@@ -36,6 +37,9 @@ class PlanParams(NamedTuple):
     server_ram: jnp.ndarray
     server_queue_cap: jnp.ndarray  # (NS,) i32 ready-queue cap (-1 unbounded)
     server_conn_cap: jnp.ndarray  # (NS,) i32 socket capacity (-1 unbounded)
+    server_rate_limit: jnp.ndarray  # (NS,) f32 token refill rps (-1 none)
+    server_rate_burst: jnp.ndarray  # (NS,) i32 token-bucket capacity
+    server_queue_timeout: jnp.ndarray  # (NS,) f32 dequeue deadline (-1 none)
     n_endpoints: jnp.ndarray
     seg_kind: jnp.ndarray
     seg_dur: jnp.ndarray
@@ -69,6 +73,9 @@ def params_from_plan(plan: StaticPlan) -> PlanParams:
         # size-0 arrays are normalized to (-1,)*NS by StaticPlan.__post_init__
         server_queue_cap=jnp.asarray(plan.server_queue_cap),
         server_conn_cap=jnp.asarray(plan.server_conn_cap),
+        server_rate_limit=jnp.asarray(plan.server_rate_limit),
+        server_rate_burst=jnp.asarray(plan.server_rate_burst),
+        server_queue_timeout=jnp.asarray(plan.server_queue_timeout),
         n_endpoints=jnp.asarray(plan.n_endpoints),
         seg_kind=jnp.asarray(plan.seg_kind),
         seg_dur=jnp.asarray(plan.seg_dur),
@@ -124,6 +131,17 @@ class EngineState(NamedTuple):
     smp_window_end: jnp.ndarray
     smp_lam: jnp.ndarray
     next_arrival: jnp.ndarray  # scalar f32 (simulation clock)
+    # milestone-5 overload controls (size (1,) when the plan has none)
+    req_wait_t: jnp.ndarray  # (P,) f32: ready-queue park time (deadlines)
+    req_cbslot: jnp.ndarray  # (P,) i32: breaker slot awaiting a report
+    req_probe: jnp.ndarray  # (P,) i32: 1 while a half-open breaker probe
+    rl_tokens: jnp.ndarray  # (NS,) f32: token-bucket fill
+    rl_last: jnp.ndarray  # (NS,) f32: last refill timestamp
+    cb_state: jnp.ndarray  # (EL,) i32: 0 closed / 1 open / 2 half-open
+    cb_consec: jnp.ndarray  # (EL,) i32: consecutive failures (closed)
+    cb_open_until: jnp.ndarray  # (EL,) f32: cooldown end (open)
+    cb_probes_out: jnp.ndarray  # (EL,) i32: outstanding half-open probes
+    cb_probe_ok: jnp.ndarray  # (EL,) i32: successful probes this round
     # outage timeline cursor
     tl_ptr: jnp.ndarray  # scalar i32
     # cached pool argmin (computed once at the end of each loop body so the

@@ -70,6 +70,11 @@ class Request:
     initial_time: float
     finish_time: float | None = None
     history: list[Hop] = field(default_factory=list)
+    #: LB out-edge that routed this request; cleared after the first
+    #: server reports success/failure to the circuit breaker
+    lb_edge_id: str | None = None
+    #: True while this request is a half-open breaker probe
+    probe: bool = False
 
     def record_hop(self, kind: str, component_id: str, now: float) -> None:
         self.history.append(Hop(kind, component_id, now))
@@ -95,6 +100,10 @@ class _EdgeRuntime:
                 engine.sim.now,
             )
             engine.total_dropped += 1
+            if req.lb_edge_id == self.cfg.id:
+                # a dropped send on the routing edge is a connection
+                # failure to the breaker
+                engine.breaker_failure(req)
             return
 
         self.concurrent += 1
@@ -137,6 +146,23 @@ class _ServerRuntime:
         self.conn_cap = (
             cfg.overload.max_connections if cfg.overload is not None else None
         )
+        # token-bucket rate limiter: refuse arrivals that find no whole
+        # token (reference roadmap milestone 5); runs before the socket
+        # capacity check
+        self.rate_limit = (
+            cfg.overload.rate_limit_rps if cfg.overload is not None else None
+        )
+        self.rl_burst = (
+            float(cfg.overload.effective_burst)
+            if cfg.overload is not None and cfg.overload.effective_burst
+            else 0.0
+        )
+        self.rl_tokens = self.rl_burst
+        self.rl_last = 0.0
+        # dequeue deadline on the ready-queue wait (milestone 5)
+        self.queue_timeout = (
+            cfg.overload.queue_timeout_s if cfg.overload is not None else None
+        )
         self.residents = 0
         self.ready_queue_len = 0
         self.io_queue_len = 0
@@ -149,18 +175,37 @@ class _ServerRuntime:
         }
 
     def receive(self, req: Request) -> None:
+        engine = self.engine
+        if self.rate_limit is not None:
+            now = engine.sim.now
+            self.rl_tokens = min(
+                self.rl_burst,
+                self.rl_tokens + (now - self.rl_last) * self.rate_limit,
+            )
+            self.rl_last = now
+            if self.rl_tokens < 1.0:
+                # rate limited: no whole token in the bucket
+                req.finish_time = now
+                req.record_hop(
+                    SystemNodes.SERVER, f"{self.cfg.id}-rate-limited", now,
+                )
+                engine.total_rejected += 1
+                engine.breaker_failure(req)
+                return
+            self.rl_tokens -= 1.0
         if self.conn_cap is not None and self.residents >= self.conn_cap:
             # connection refused: the server is at socket capacity
-            req.finish_time = self.engine.sim.now
+            req.finish_time = engine.sim.now
             req.record_hop(
                 SystemNodes.SERVER,
                 f"{self.cfg.id}-refused",
-                self.engine.sim.now,
+                engine.sim.now,
             )
-            self.engine.total_rejected += 1
+            engine.total_rejected += 1
+            engine.breaker_failure(req)
             return
         self.residents += 1
-        self.engine.sim.process(self._handle(req))
+        engine.sim.process(self._handle(req))
 
     def _handle(self, req: Request):
         try:
@@ -207,13 +252,35 @@ class _ServerRuntime:
                                 engine.sim.now,
                             )
                             engine.total_rejected += 1
+                            engine.breaker_failure(req)
                             return
                         waiting_cpu = True
                         self.ready_queue_len += 1
+                    wait_started = engine.sim.now
                     yield AcquireToken(self.cpu)
                     if waiting_cpu:
                         waiting_cpu = False
                         self.ready_queue_len -= 1
+                        if (
+                            self.queue_timeout is not None
+                            and engine.sim.now - wait_started > self.queue_timeout
+                        ):
+                            # dequeue deadline exceeded: abandon, consuming
+                            # zero service (the core passes straight to the
+                            # next FIFO waiter)
+                            self.cpu.release()
+                            if total_ram:
+                                self.ram_in_use -= total_ram
+                                self.ram.release(total_ram)
+                            req.finish_time = engine.sim.now
+                            req.record_hop(
+                                SystemNodes.SERVER,
+                                f"{self.cfg.id}-timed-out",
+                                engine.sim.now,
+                            )
+                            engine.total_rejected += 1
+                            engine.breaker_failure(req)
+                            return
                     core_locked = True
                 yield Timeout(step.quantity)
             elif step.is_io:
@@ -250,6 +317,7 @@ class _ServerRuntime:
             self.ram_in_use -= total_ram
             self.ram.release(total_ram)
 
+        engine.breaker_success(req)
         assert self.out_edge is not None
         self.out_edge.transport(req)
 
@@ -287,6 +355,11 @@ class OracleEngine:
         self.lb = graph.nodes.load_balancer
         # rotation order of LB out-edges; mutated by routing and outages
         self.lb_out_edges: OrderedDict[str, _EdgeRuntime] = OrderedDict()
+        # circuit breaker (reference roadmap milestone 5): independent
+        # consecutive-failure breaker per LB out-edge; lazy OPEN ->
+        # HALF_OPEN transition at routing time (schemas.nodes.CircuitBreaker)
+        self.breaker = self.lb.circuit_breaker if self.lb is not None else None
+        self.breaker_state: dict[str, dict] = {}
         self.generator_out: _EdgeRuntime | None = None
 
         self._wire()
@@ -368,18 +441,106 @@ class OracleEngine:
             self.total_dropped += 1
             return
         out = self._pick_lb_edge()
+        if out is None:
+            # every rotation member's breaker is open (or saturated with
+            # probes): the LB refuses the request — an overload
+            # protection, counted rejected like the server-side policies
+            req.finish_time = self.sim.now
+            req.record_hop(
+                SystemNodes.LOAD_BALANCER,
+                f"{self.lb.id}-rejected",
+                self.sim.now,
+            )
+            self.total_rejected += 1
+            return
+        if self.breaker is not None:
+            st = self._breaker_st(out.cfg.id)
+            req.lb_edge_id = out.cfg.id
+            if st["state"] == 2:  # half-open: this request is a probe
+                req.probe = True
+                st["probes_out"] += 1
         out.transport(req)
 
-    def _pick_lb_edge(self) -> _EdgeRuntime:
+    def _breaker_st(self, edge_id: str) -> dict:
+        return self.breaker_state.setdefault(
+            edge_id,
+            {"state": 0, "consec": 0, "open_until": 0.0,
+             "probes_out": 0, "probe_ok": 0},
+        )
+
+    def _breaker_admits(self, edge_id: str) -> bool:
+        """Lazy state advance + routing eligibility of one rotation slot."""
+        if self.breaker is None:
+            return True
+        st = self._breaker_st(edge_id)
+        now = self.sim.now
+        if st["state"] == 1:
+            if now < st["open_until"]:
+                return False
+            # cooldown elapsed: half-open with fresh probe slots
+            st["state"] = 2
+            st["probes_out"] = 0
+            st["probe_ok"] = 0
+        if st["state"] == 2:
+            return st["probes_out"] < self.breaker.half_open_probes
+        return True
+
+    def _pick_lb_edge(self) -> _EdgeRuntime | None:
         assert self.lb is not None
         edges = self.lb_out_edges
         if self.lb.algorithms == LbAlgorithmsName.LEAST_CONNECTIONS:
-            best_id = min(edges, key=lambda eid: edges[eid].concurrent)
+            eligible = [eid for eid in edges if self._breaker_admits(eid)]
+            if not eligible:
+                return None
+            best_id = min(eligible, key=lambda eid: edges[eid].concurrent)
             return edges[best_id]
-        # round robin: take the head, rotate it to the tail
-        head_id, head = next(iter(edges.items()))
-        edges.move_to_end(head_id)
-        return head
+        # round robin: first ADMITTING edge in rotation order; only the
+        # picked edge rotates to the tail (ineligible edges keep their
+        # position — the breaker skips, it does not reorder)
+        for eid in list(edges):
+            if self._breaker_admits(eid):
+                edges.move_to_end(eid)
+                return edges[eid]
+        return None
+
+    # breaker feedback (called by edges and servers; no-ops once the
+    # request's routing slot has reported)
+
+    def breaker_failure(self, req: Request) -> None:
+        if self.breaker is None or req.lb_edge_id is None:
+            return
+        st = self._breaker_st(req.lb_edge_id)
+        req.lb_edge_id = None
+        now = self.sim.now
+        if req.probe:
+            req.probe = False
+            st["probes_out"] = max(0, st["probes_out"] - 1)
+            # a probe failure re-opens immediately
+            st["state"] = 1
+            st["open_until"] = now + self.breaker.cooldown_s
+            return
+        if st["state"] == 0:
+            st["consec"] += 1
+            if st["consec"] >= self.breaker.failure_threshold:
+                st["state"] = 1
+                st["open_until"] = now + self.breaker.cooldown_s
+                st["consec"] = 0
+
+    def breaker_success(self, req: Request) -> None:
+        if self.breaker is None or req.lb_edge_id is None:
+            return
+        st = self._breaker_st(req.lb_edge_id)
+        req.lb_edge_id = None
+        if req.probe:
+            req.probe = False
+            st["probes_out"] = max(0, st["probes_out"] - 1)
+            st["probe_ok"] += 1
+            if st["state"] == 2 and st["probe_ok"] >= self.breaker.half_open_probes:
+                st["state"] = 0
+                st["consec"] = 0
+            return
+        if st["state"] == 0:
+            st["consec"] = 0
 
     # ------------------------------------------------------------------
     # event injection

@@ -44,6 +44,9 @@ class _PlanC(ctypes.Structure):
         ("server_db_pool", _i32p),
         ("server_queue_cap", _i32p),
         ("server_conn_cap", _i32p),
+        ("server_rate_limit", _f32p),
+        ("server_rate_burst", _i32p),
+        ("server_queue_timeout", _f32p),
         ("n_endpoints", _i32p),
         ("seg_kind", _i32p),
         ("seg_dur", _f32p),
@@ -57,6 +60,9 @@ class _PlanC(ctypes.Structure):
         ("n_lb_edges", ctypes.c_int32),
         ("lb_edge_index", _i32p),
         ("lb_target", _i32p),
+        ("breaker_threshold", ctypes.c_int32),
+        ("breaker_probes", ctypes.c_int32),
+        ("breaker_cooldown", ctypes.c_double),
         ("n_spike_times", ctypes.c_int32),
         ("spike_times", _f32p),
         ("spike_values", _f32p),
@@ -192,6 +198,9 @@ def run_native(
         server_db_pool=i32(plan.server_db_pool),
         server_queue_cap=i32(plan.server_queue_cap),
         server_conn_cap=i32(plan.server_conn_cap),
+        server_rate_limit=f32(plan.server_rate_limit),
+        server_rate_burst=i32(plan.server_rate_burst),
+        server_queue_timeout=f32(plan.server_queue_timeout),
         n_endpoints=i32(plan.n_endpoints),
         seg_kind=i32(plan.seg_kind),
         seg_dur=f32(plan.seg_dur),
@@ -205,6 +214,9 @@ def run_native(
         n_lb_edges=plan.n_lb_edges,
         lb_edge_index=i32(plan.lb_edge_index),
         lb_target=i32(plan.lb_target),
+        breaker_threshold=plan.breaker_threshold,
+        breaker_probes=plan.breaker_probes,
+        breaker_cooldown=plan.breaker_cooldown,
         n_spike_times=len(plan.spike_times),
         spike_times=f32(plan.spike_times),
         spike_values=f32(plan.spike_values),

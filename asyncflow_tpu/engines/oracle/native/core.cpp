@@ -58,6 +58,9 @@ struct PlanC {
     const int32_t* server_db_pool;  // -1 = unlimited / not modeled
     const int32_t* server_queue_cap;  // -1 = unbounded ready queue
     const int32_t* server_conn_cap;   // -1 = unbounded socket capacity
+    const float* server_rate_limit;   // token refill rps, -1 = no limiter
+    const int32_t* server_rate_burst; // token-bucket capacity
+    const float* server_queue_timeout; // dequeue deadline s, -1 = none
     const int32_t* n_endpoints;
     const int32_t* seg_kind;  // [NS][NEP][NSEG+1]
     const float* seg_dur;
@@ -72,6 +75,10 @@ struct PlanC {
     int32_t n_lb_edges;
     const int32_t* lb_edge_index;
     const int32_t* lb_target;
+    // circuit breaker (0 threshold = not modeled)
+    int32_t breaker_threshold;
+    int32_t breaker_probes;
+    double breaker_cooldown;
     // spikes (piecewise-constant cumulative spike per edge)
     int32_t n_spike_times;
     const float* spike_times;
@@ -96,16 +103,21 @@ struct PlanC {
 struct Request {
     double start = 0.0;
     double ram = 0.0;
+    double wait_start = 0.0;  // ready-queue park time (dequeue deadlines)
     int32_t srv = -1;
     int32_t ep = 0;
     int32_t seg = 0;   // segment index; hop index during the entry chain
     int32_t lbslot = -1;
+    int32_t cbslot = -1;  // breaker slot awaiting this request's report
+    bool probe = false;   // half-open breaker probe
 };
 
 struct Server {
     int32_t cores_free = 1;
     double ram_free = 0.0;
     double ram_in_use = 0.0;
+    double rl_tokens = 0.0;  // token bucket (rate limiter)
+    double rl_last = 0.0;
     int32_t ready_len = 0;
     int32_t io_len = 0;
     int32_t db_free = -1;  // -1 = unlimited (pool not modeled)
@@ -150,6 +162,15 @@ struct Sim {
     std::vector<Server> servers;
     std::vector<int32_t> lb_rotation;  // slot ids in rotation order
     std::vector<int32_t> lb_conn;
+    // per-slot circuit breaker (consecutive-failure; half-open probes)
+    struct BState {
+        int32_t state = 0;  // 0 closed / 1 open / 2 half-open
+        int32_t consec = 0;
+        int32_t probes_out = 0;
+        int32_t probe_ok = 0;
+        double open_until = 0.0;
+    };
+    std::vector<BState> cb;
     std::vector<int32_t> edge_conn;    // in-flight messages per edge
 
     // arrival sampler state (sampler clock drifts from sim clock by design)
@@ -171,7 +192,10 @@ struct Sim {
             servers[s].cores_free = p.server_cores[s];
             servers[s].ram_free = p.server_ram[s];
             servers[s].db_free = p.server_db_pool ? p.server_db_pool[s] : -1;
+            if (p.server_rate_burst)
+                servers[s].rl_tokens = (double)p.server_rate_burst[s];
         }
+        cb.resize(p.n_lb_edges);
         lb_rotation.resize(p.n_lb_edges);
         for (int i = 0; i < p.n_lb_edges; ++i) lb_rotation[i] = i;
         lb_conn.assign(p.n_lb_edges, 0);
@@ -211,6 +235,53 @@ struct Sim {
                       - times) - 1;
         if (idx < 0) idx = 0;
         return p.spike_values[(int64_t)idx * p.n_edges + e];
+    }
+
+    // ---- circuit breaker (schemas.nodes.CircuitBreaker semantics) ------
+    bool cb_enabled() const { return p.breaker_threshold > 0; }
+    bool cb_admits(int slot) {
+        BState& b = cb[slot];
+        if (b.state == 1) {
+            if (now < b.open_until) return false;
+            b.state = 2;  // cooldown elapsed: half-open, fresh probe round
+            b.probes_out = 0;
+            b.probe_ok = 0;
+        }
+        if (b.state == 2) return b.probes_out < p.breaker_probes;
+        return true;
+    }
+    void cb_fail(int slot, bool probe) {
+        BState& b = cb[slot];
+        if (probe) {
+            if (b.probes_out > 0) --b.probes_out;
+            b.state = 1;  // a probe failure re-opens immediately
+            b.open_until = now + p.breaker_cooldown;
+            return;
+        }
+        if (b.state == 0 && ++b.consec >= p.breaker_threshold) {
+            b.state = 1;
+            b.open_until = now + p.breaker_cooldown;
+            b.consec = 0;
+        }
+    }
+    void cb_ok(int slot, bool probe) {
+        BState& b = cb[slot];
+        if (probe) {
+            if (b.probes_out > 0) --b.probes_out;
+            if (b.state == 2 && ++b.probe_ok >= p.breaker_probes) {
+                b.state = 0;
+                b.consec = 0;
+            }
+            return;
+        }
+        if (b.state == 0) b.consec = 0;
+    }
+    void cb_report(Request& r, bool failed) {
+        if (!cb_enabled() || r.cbslot < 0) return;
+        if (failed) cb_fail(r.cbslot, r.probe);
+        else cb_ok(r.cbslot, r.probe);
+        r.cbslot = -1;
+        r.probe = false;
     }
 
     // ---- arrival process (window-jump semantics) ----------------------
@@ -313,8 +384,10 @@ struct Sim {
                 }
                 ++rejected;
                 --sv.residents;
+                cb_report(r, true);
                 release(i);
             } else {
+                r.wait_start = now;
                 sv.cpu_wait.push_back(i);
                 ++sv.ready_len;
             }
@@ -345,12 +418,29 @@ struct Sim {
 
     void grant_cores(int s) {
         Server& sv = servers[s];
+        double dl = p.server_queue_timeout ? p.server_queue_timeout[s] : -1.0;
         while (sv.cores_free > 0 && !sv.cpu_wait.empty()) {
             int32_t j = sv.cpu_wait.front();
             sv.cpu_wait.pop_front();
             --sv.ready_len;
+            Request& rj = reqs[j];
+            if (dl >= 0.0 && now - rj.wait_start > dl) {
+                // dequeue deadline exceeded: abandon with zero service —
+                // the core passes straight to the next FIFO waiter
+                if (rj.ram > 0.0) {
+                    sv.ram_free += rj.ram;
+                    sv.ram_in_use -= rj.ram;
+                    rj.ram = 0.0;
+                    grant_ram(s);
+                }
+                --sv.residents;
+                ++rejected;
+                cb_report(rj, true);
+                release(j);
+                continue;
+            }
             --sv.cores_free;
-            double dur = durs(reqs[j].srv, reqs[j].ep)[reqs[j].seg];
+            double dur = durs(rj.srv, rj.ep)[rj.seg];
             push(now + dur, EV_SEG_END, j);
         }
     }
@@ -371,6 +461,7 @@ struct Sim {
         Request& r = reqs[i];
         int s = r.srv;
         Server& sv = servers[s];
+        cb_report(r, false);  // departing the routed target = success
         --sv.residents;
         if (r.ram > 0.0) {
             sv.ram_free += r.ram;
@@ -418,8 +509,39 @@ struct Sim {
 
     void on_arrive_lb(int32_t i) {
         if (lb_rotation.empty()) { ++dropped; release(i); return; }
-        int slot;
-        if (p.lb_algo == 0) {  // round robin: head out, rotate to tail
+        int slot = -1;
+        bool probe = false;
+        if (cb_enabled()) {
+            // skip-in-place: non-admitting slots keep their rotation
+            // positions; only the picked slot rotates to the tail (rr)
+            if (p.lb_algo == 0) {
+                for (size_t pos = 0; pos < lb_rotation.size(); ++pos) {
+                    int c = lb_rotation[pos];
+                    if (cb_admits(c)) {
+                        slot = c;
+                        lb_rotation.erase(lb_rotation.begin() + pos);
+                        lb_rotation.push_back(slot);
+                        break;
+                    }
+                }
+            } else {
+                for (int c : lb_rotation)
+                    if (cb_admits(c) && (slot < 0 || lb_conn[c] < lb_conn[slot]))
+                        slot = c;
+            }
+            if (slot < 0) {
+                // every rotation member open / probe-saturated: the LB
+                // refuses the request (overload protection, rejected)
+                ++rejected;
+                release(i);
+                return;
+            }
+            BState& b = cb[slot];
+            probe = b.state == 2;
+            if (probe) ++b.probes_out;
+            reqs[i].cbslot = slot;
+            reqs[i].probe = probe;
+        } else if (p.lb_algo == 0) {  // round robin: head out, to tail
             slot = lb_rotation.front();
             lb_rotation.erase(lb_rotation.begin());
             lb_rotation.push_back(slot);
@@ -433,17 +555,39 @@ struct Sim {
         reqs[i].lbslot = slot;
         // dropout is rolled before the connection count, like the Python
         // oracle's transport(): dropped messages never count
-        if (send(p.lb_edge_index[slot], EV_ARRIVE_SRV, i)) ++lb_conn[slot];
+        if (send(p.lb_edge_index[slot], EV_ARRIVE_SRV, i)) {
+            ++lb_conn[slot];
+        } else if (cb_enabled()) {
+            // the dropped send is a connection failure to the breaker
+            // (the request slot is already released by send())
+            cb_fail(slot, probe);
+        }
     }
 
     void on_arrive_srv(int32_t i) {
         Request& r = reqs[i];
         if (r.lbslot >= 0) { --lb_conn[r.lbslot]; r.lbslot = -1; }
         Server& sv = servers[r.srv];
+        if (p.server_rate_limit && p.server_rate_limit[r.srv] >= 0.0f) {
+            // token bucket: lazy refill at arrival; refuse without a
+            // whole token (runs before the socket-capacity check)
+            double rps = p.server_rate_limit[r.srv];
+            double cap = (double)p.server_rate_burst[r.srv];
+            sv.rl_tokens = std::min(cap, sv.rl_tokens + (now - sv.rl_last) * rps);
+            sv.rl_last = now;
+            if (sv.rl_tokens < 1.0) {
+                ++rejected;
+                cb_report(r, true);
+                release(i);
+                return;
+            }
+            sv.rl_tokens -= 1.0;
+        }
         if (p.server_conn_cap && p.server_conn_cap[r.srv] >= 0
             && sv.residents >= p.server_conn_cap[r.srv]) {
             // connection refused: the server is at socket capacity
             ++rejected;
+            cb_report(r, true);
             release(i);
             return;
         }

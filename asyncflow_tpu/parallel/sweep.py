@@ -380,11 +380,15 @@ class SweepRunner:
             engine == "auto"
             and jax.default_backend() == "tpu"
             # the VMEM kernel models neither pool FIFOs, cache mixtures,
-            # nor overload policies (shedding / refusal)
+            # nor overload policies (shedding / refusal / rate limits /
+            # deadlines / circuit breakers)
             and not self.plan.has_db_pool
             and not self.plan.has_stochastic_cache
             and not self.plan.has_queue_cap
             and not self.plan.has_conn_cap
+            and not self.plan.has_rate_limit
+            and not self.plan.has_queue_timeout
+            and self.plan.breaker_threshold == 0
         ):
             from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
 
@@ -872,6 +876,22 @@ def _guard_overrides_against_plan(
     rate-safe: saturation is simulated, not assumed away."""
     if overrides is None:
         return
+    if plan.breaker_lowered:
+        # the breaker was lowered away because NO failure channel exists;
+        # raising LB-edge dropout would create one the simulation ignores
+        ov_drop = np.asarray(overrides.edge_dropout)
+        base_drop = np.asarray(plan.edge_dropout)
+        for e in plan.lb_edge_index.tolist():
+            col = ov_drop[..., e] if ov_drop.ndim else ov_drop
+            if float(np.max(col)) > float(base_drop[e]) + 1e-12:
+                msg = (
+                    "overrides raise dropout on a load-balancer edge, but "
+                    "the configured circuit breaker was lowered away as "
+                    "trip-proof at zero dropout; use "
+                    "SweepRunner(..., engine='event') or set the base "
+                    "dropout to the swept maximum"
+                )
+                raise _FastpathOverrideError(msg)
     tier1 = len(plan.ram_slots) and bool(np.any(plan.ram_slots == -1))
     if not tier1 and plan.lc_ring == 0 and plan.relax_rho == 0.0:
         return

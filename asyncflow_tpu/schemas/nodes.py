@@ -11,7 +11,15 @@ from __future__ import annotations
 
 from collections import Counter
 
-from pydantic import BaseModel, ConfigDict, Field, PositiveInt, field_validator, model_validator
+from pydantic import (
+    BaseModel,
+    ConfigDict,
+    Field,
+    PositiveFloat,
+    PositiveInt,
+    field_validator,
+    model_validator,
+)
 
 from asyncflow_tpu.config.constants import (
     LbAlgorithmsName,
@@ -78,12 +86,74 @@ class OverloadPolicy(BaseModel):
     through every queue and sleep).  An arrival at a full server is
     refused (same rejected accounting).  The connection-capacity half of
     the reference roadmap's network-baseline milestone.
+
+    ``rate_limit_rps`` (+ optional ``rate_limit_burst``): token-bucket
+    admission control at arrival.  The bucket holds up to
+    ``rate_limit_burst`` tokens (default: one second's worth,
+    ``ceil(rate_limit_rps)``) and refills at ``rate_limit_rps`` tokens/s;
+    an arrival that finds no whole token is refused (same rejected
+    accounting).  Runs BEFORE the socket-capacity check.
+
+    ``queue_timeout_s``: deadline on the CPU ready-queue wait — checked
+    when the request is DEQUEUED (reaches the head and would take the
+    core): if it waited longer than the deadline it abandons, consuming
+    zero service (RAM released, counted rejected).  These are
+    dequeue-time deadlines (the semantics of an executor that checks a
+    task's deadline when popping it), not mid-queue reneging: expired
+    waiters still occupy ready-queue slots until popped.
     """
 
     model_config = ConfigDict(extra="forbid")
 
     max_ready_queue: PositiveInt | None = None
     max_connections: PositiveInt | None = None
+    rate_limit_rps: PositiveFloat | None = None
+    rate_limit_burst: PositiveInt | None = None
+    queue_timeout_s: PositiveFloat | None = None
+
+    @model_validator(mode="after")
+    def _burst_needs_rate(self) -> OverloadPolicy:
+        if self.rate_limit_burst is not None and self.rate_limit_rps is None:
+            msg = "rate_limit_burst requires rate_limit_rps"
+            raise ValueError(msg)
+        return self
+
+    @property
+    def effective_burst(self) -> int | None:
+        """Token-bucket capacity: explicit burst, else one second's worth."""
+        if self.rate_limit_rps is None:
+            return None
+        if self.rate_limit_burst is not None:
+            return self.rate_limit_burst
+        import math
+
+        return max(1, math.ceil(self.rate_limit_rps))
+
+
+class CircuitBreaker(BaseModel):
+    """Per-target circuit breaker on the load balancer (reference roadmap
+    milestone 5).  Each LB out-edge carries an independent breaker:
+
+    - **failure** = a request routed through the edge is dropped by that
+      edge or rejected by the target server (socket refusal, rate-limit
+      refusal, queue shed, or queue-timeout abandon), counted at the
+      rejection time;
+    - **success** = the request departs the target server, resetting the
+      consecutive-failure count;
+    - ``failure_threshold`` consecutive failures **open** the breaker: the
+      edge leaves the rotation (the event engines' outage pop discipline);
+    - after ``cooldown_s`` the breaker goes **half-open**: up to
+      ``half_open_probes`` in-flight requests may probe the target (the
+      edge is skipped while all probe slots are outstanding).  A probe
+      failure re-opens the breaker for another cooldown; ``half_open_probes``
+      consecutive probe successes close it.
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    failure_threshold: PositiveInt
+    cooldown_s: PositiveFloat
+    half_open_probes: PositiveInt = 1
 
 
 class Server(BaseModel):
@@ -106,6 +176,8 @@ class LoadBalancer(BaseModel):
     type: SystemNodes = SystemNodes.LOAD_BALANCER
     algorithms: LbAlgorithmsName = LbAlgorithmsName.ROUND_ROBIN
     server_covered: set[str] = Field(default_factory=set)
+    #: optional per-target circuit breaker (reference roadmap milestone 5)
+    circuit_breaker: CircuitBreaker | None = None
 
     _check_type = field_validator("type", mode="after")(
         _fixed_type(SystemNodes.LOAD_BALANCER),

@@ -1,0 +1,117 @@
+"""Experiment harness for pushing the multi-burst relaxation past rho 0.70.
+
+Bypasses the compiler's RELAX_RHO_MAX fence (monkeypatched) and compares
+relaxation variants (sweep counts, damping) against the native oracle at
+near-saturation utilizations, with an oracle-vs-oracle disjoint ensemble
+as the noise floor.  Results feed docs/internals/fastpath.md §5 and the
+production RELAX_RHO_MAX.
+
+Usage: JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python scripts/envelope_experiments.py
+Env: EXP_SEEDS (default 8), EXP_HORIZON (300), EXP_USERS, EXP_VARIANTS
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import asyncflow_tpu.compiler.plan as planmod
+
+planmod.RELAX_RHO_MAX = 100.0  # fence off: this harness measures past it
+
+from relaxation_envelope import (  # noqa: E402
+    CPU_TOTAL,
+    HORIZON,
+    payload_at,
+)
+
+from asyncflow_tpu.compiler import compile_payload  # noqa: E402
+from asyncflow_tpu.engines.jaxsim.engine import scenario_keys  # noqa: E402
+from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine  # noqa: E402
+from asyncflow_tpu.engines.oracle.native import (  # noqa: E402
+    native_available,
+    run_native,
+)
+
+SEEDS = int(os.environ.get("EXP_SEEDS", "8"))
+USERS = tuple(int(u) for u in os.environ.get("EXP_USERS", "75,85,94").split(","))
+# variant = (label, relax_sweeps, damping[, init])
+_DEFAULT_VARIANTS = "base:6:0.0,damp5:8:0.5,damp7:12:0.7"
+VARIANTS = [
+    (parts[0], int(parts[1]), float(parts[2]), parts[3] if len(parts) > 3 else "zero")
+    for parts in (
+        v.split(":") for v in os.environ.get("EXP_VARIANTS", _DEFAULT_VARIANTS).split(",")
+    )
+]
+
+
+def fast_latencies(payload, seed0, n, sweeps, damping, init="zero"):
+    plan = compile_payload(payload)
+    assert plan.fastpath_ok, plan.fastpath_reason
+    engine = FastEngine(
+        plan, collect_clocks=True, relax_sweeps=sweeps, relax_damping=damping,
+    )
+    engine.relax_init = init
+    final = engine.run_batch(scenario_keys(seed0, n))
+    clock = np.asarray(final.clock)
+    counts = np.asarray(final.clock_n)
+    return np.concatenate(
+        [clock[i, : counts[i], 1] - clock[i, : counts[i], 0] for i in range(n)],
+    )
+
+
+def oracle_latencies(payload, seed0, n):
+    plan = compile_payload(payload)
+    return np.concatenate(
+        [
+            run_native(plan, seed=seed0 + s, collect_gauges=False).latencies
+            for s in range(n)
+        ],
+    )
+
+
+def devs(a, b):
+    out = {}
+    for q in (50, 95):
+        out[f"p{q}"] = (np.percentile(a, q) - np.percentile(b, q)) / np.percentile(b, q)
+    out["mean"] = (a.mean() - b.mean()) / b.mean()
+    return out
+
+
+def main() -> None:
+    assert native_available()
+    print(f"seeds={SEEDS} horizon={HORIZON}")
+    for users in USERS:
+        rho = users * 20.0 / 60.0 * CPU_TOTAL
+        p = payload_at(users)
+        ora = oracle_latencies(p, 0, SEEDS)
+        ora2 = oracle_latencies(p, 1000, SEEDS)
+        oo = devs(ora2, ora)
+        print(
+            f"-- users={users} rho={rho:.3f} | noise floor p50 {oo['p50']:+.3f} "
+            f"p95 {oo['p95']:+.3f} mean {oo['mean']:+.3f}",
+            flush=True,
+        )
+        for label, sweeps, damping, init in VARIANTS:
+            fast = fast_latencies(p, 11, SEEDS, sweeps, damping, init)
+            fo = devs(fast, ora)
+            print(
+                f"   {label:>8} (sweeps={sweeps:2d} damp={damping:.1f} "
+                f"init={init}): p50 {fo['p50']:+.3f} p95 {fo['p95']:+.3f} "
+                f"mean {fo['mean']:+.3f}",
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""CPU-side keep/cut evidence for the Pallas VMEM event kernel (VERDICT r3
+#2): with the TPU worker unavailable, measure what CAN be measured off-chip:
+
+1. **Cross-platform Mosaic lowering** of the full kernel for the TPU
+   target (`.lower(lowering_platforms=("tpu",))` from the CPU backend):
+   wall time + StableHLO size.  A pathological kernel would already blow
+   up here; a flat, second-scale lowering bounds the Mosaic half of the
+   compile risk (the XLA-side compile of one custom call is shape-tiny
+   compared to the 21k-op fast-path program).
+2. **Interpret-mode execution scaling** vs block size on a short horizon
+   (the interpreter is ~1000x the compiled kernel but exposes relative
+   per-block iteration costs and validates the batched state machine).
+
+Results land in docs/internals/pallas-engine.md §keep/cut.
+
+Usage: JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python scripts/pallas_keepcut.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from _common import load_example_payload, log  # noqa: E402
+
+from asyncflow_tpu.compiler import compile_payload  # noqa: E402
+from asyncflow_tpu.engines.jaxsim.engine import scenario_keys  # noqa: E402
+from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine  # noqa: E402
+
+
+def lowering_probe(horizon: int, block: int) -> None:
+    payload = load_example_payload(horizon)
+    plan = compile_payload(payload)
+    eng = PallasEngine(plan, interpret=False)
+    keys = scenario_keys(3, block)
+    t0 = time.time()
+    # trace + lower the exact TPU program from the CPU backend
+    lowered = eng.lower_tpu(keys)
+    txt = lowered.as_text()
+    log(
+        f"horizon={horizon} block={block}: TPU lowering "
+        f"{time.time() - t0:.1f}s, stablehlo_lines={txt.count(chr(10))}, "
+        f"mosaic={'tpu_custom_call' in txt or 'mosaic' in txt.lower()}",
+    )
+
+
+def interpret_probe(horizon: int, blocks: tuple[int, ...]) -> None:
+    payload = load_example_payload(horizon)
+    plan = compile_payload(payload)
+    for blk in blocks:
+        eng = PallasEngine(plan, interpret=True)
+        keys = scenario_keys(5, blk)
+        t0 = time.time()
+        out = eng.run_batch(keys)
+        jax.block_until_ready(out)
+        wall = time.time() - t0
+        t0 = time.time()
+        out = eng.run_batch(scenario_keys(6, blk))
+        jax.block_until_ready(out)
+        warm = time.time() - t0
+        log(
+            f"interpret horizon={horizon} block={blk}: cold {wall:.1f}s "
+            f"warm {warm:.1f}s ({blk / warm:.2f} scen/s interpreted)",
+        )
+
+
+def main() -> None:
+    for horizon, block in ((60, 16), (600, 16), (600, 128)):
+        lowering_probe(horizon, block)
+    interpret_probe(20, (4, 8))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""Capture a jax.profiler trace of warm fast-path chunks (VERDICT r3 #5:
+profile, don't estimate).
+
+Compiles (or loads from cache) the scanned bench executable, runs one warm
+chunk under ``jax.profiler.trace``, and prints where the trace landed plus
+a coarse wall/device summary.  Works on TPU through the tunnel or on CPU
+(set JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS=).
+
+Usage: SHOT_CHUNK=512 SHOT_INNER=16 python scripts/tpu_profile.py
+Output: PROF_DIR (default ./prof_trace) with the .trace/.pb artifacts —
+inspect with tensorboard or xprof; the driver-facing summary goes to
+stdout.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import time
+
+from _common import load_example_payload, log
+
+
+def main() -> None:
+    chunk = int(os.environ.get("SHOT_CHUNK", "512"))
+    inner = int(os.environ.get("SHOT_INNER", "16"))
+    horizon = int(os.environ.get("SHOT_HORIZON", "600"))
+    prof_dir = os.environ.get("PROF_DIR", "prof_trace")
+
+    import jax
+
+    from asyncflow_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    log(f"backend: {jax.default_backend()}; chunk={chunk} inner={inner}")
+
+    from asyncflow_tpu.parallel.sweep import SweepRunner
+
+    payload = load_example_payload(horizon)
+    runner = SweepRunner(payload, scan_inner=inner, use_mesh=False)
+    log(f"engine={runner.engine_kind}; warm-up run (compile or cache load)")
+    t0 = time.time()
+    runner.run(chunk, seed=5, chunk_size=chunk)
+    log(f"warm-up done in {time.time() - t0:.1f}s; tracing one warm chunk")
+
+    with jax.profiler.trace(prof_dir):
+        t0 = time.time()
+        runner.run(chunk, seed=6, chunk_size=chunk)
+        wall = time.time() - t0
+    log(f"traced chunk: {wall:.2f}s wall ({chunk / wall:.1f} scen/s)")
+
+    files = sorted(
+        glob.glob(os.path.join(prof_dir, "**", "*"), recursive=True),
+    )
+    total = sum(os.path.getsize(f) for f in files if os.path.isfile(f))
+    log(f"trace artifacts: {len(files)} files, {total / 1e6:.1f} MB in {prof_dir}")
+
+
+if __name__ == "__main__":
+    main()

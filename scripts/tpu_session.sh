@@ -10,7 +10,7 @@
 #   - The persistent compile cache (.jax_cache) makes every successful
 #     compile a one-time cost.
 #
-# Round-3 ladder: secure a TPU bench number FIRST (scanned shape, then the
+# Round-4 ladder: secure a TPU bench number FIRST (scanned shape, then the
 # plain S=16 fallback that is known compile-safe), then escalate scan
 # length, then Pallas keep/cut evidence, then the event engine datum.
 #
@@ -76,6 +76,11 @@ if step scanned-1024 900 env SHOT_CHUNK=1024 SHOT_INNER=16 SHOT_REPEAT=2 \
     python scripts/tpu_shot.py; then
     step bench-1024 2700 env BENCH_CHUNK=1024 python bench.py
 fi
+
+# 3b. Profiler trace of a warm chunk (VERDICT r4 #5: measured device time,
+#     not estimated) — reuses the cached executable, cheap.
+step profile 600 env SHOT_CHUNK=512 SHOT_INNER=16 PROF_DIR=prof_trace_tpu \
+    python scripts/tpu_profile.py
 
 # 4. Pallas kernel: short horizon first (Mosaic compile sanity), then the
 #    flagship horizon.  Keep/cut evidence for VERDICT #4.

@@ -314,3 +314,15 @@ def test_sweep_runner_pallas_engine() -> None:
     assert s["completed_total"] > 100
     assert s["overflow_total"] == 0
     assert np.isfinite(s["latency_p95_s"])
+
+
+def test_kernel_lowers_for_tpu_from_cpu() -> None:
+    """Cross-platform Mosaic lowering gate (found round 4: the kernel's
+    uint32->f32 RNG cast had NO Mosaic lowering rule, so the engine could
+    never have compiled on hardware).  Lowering the full kernel for the
+    TPU target runs every Mosaic MLIR conversion pass on CPU — any op
+    without a TPU lowering rule fails HERE, in CI, not on a live worker."""
+    plan = compile_payload(SimulationPayload.model_validate(_lb_payload()))
+    eng = PallasEngine(plan, interpret=False)
+    lowered = eng.lower_tpu(scenario_keys(3, 4))
+    assert "tpu_custom_call" in lowered.as_text()
