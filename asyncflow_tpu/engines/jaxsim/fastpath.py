@@ -129,26 +129,29 @@ def _kw_waits(
 ) -> jnp.ndarray:
     """FIFO G/G/c waiting times via the Kiefer-Wolfowitz workload vector.
 
-    Carry the sorted per-core residual-work vector ``w``; for each customer:
-    age it by the inter-arrival gap, wait on the least-loaded core, add the
-    service there, re-sort.  Sequential in the number of requests (a
-    ``lax.scan``) but the carried state is just ``cores`` floats per lane.
+    Carries the sorted vector of ABSOLUTE next-free core times; per
+    customer: wait on the earliest-free core, add the service, re-sort.
+    Sequential in the number of requests (a ``lax.scan``) but the carried
+    state is just ``cores`` floats per lane.  Invalid (padding) entries
+    compose as the identity and may appear ANYWHERE in the stream — the
+    step only reads its own (arrival, service) — so callers may feed a
+    shared sorted order whose other lanes are masked out.
     """
-    inter = jnp.diff(arrivals, prepend=arrivals[:1])
-    inter = jnp.where(jnp.isfinite(inter), inter, 0.0)
 
-    def step(w, x):
-        gap, svc, ok = x
-        w = jnp.maximum(w - gap, 0.0)
-        wait = w[0]
-        busy = jnp.sort(w.at[0].add(svc))
-        w = jnp.where(ok, busy, w)
-        return w, jnp.where(ok, wait, 0.0)
+    def step(f, x):
+        a, svc, ok = x
+        wait = jnp.maximum(f[0] - a, 0.0)
+        busy = jnp.sort(f.at[0].set(jnp.maximum(f[0], a) + svc))
+        return jnp.where(ok, busy, f), jnp.where(ok, wait, 0.0)
 
     _, waits = jax.lax.scan(
         step,
         jnp.zeros(cores, jnp.float32),
-        (inter, jnp.where(valid, service, 0.0), valid),
+        (
+            jnp.where(valid, arrivals, 0.0),
+            jnp.where(valid, service, 0.0),
+            valid,
+        ),
     )
     return waits
 
@@ -201,24 +204,25 @@ def _ram_core_scan(
 def _lindley_waits(arrivals: jnp.ndarray, service: jnp.ndarray, valid) -> jnp.ndarray:
     """FIFO G/G/1 waiting times for time-sorted ``arrivals`` via max-plus scan.
 
-    Invalid (padding) entries must carry ``arrivals=+inf, service=0``; they
-    compose as the identity and produce waits that are never used.
+    Works on the service-COMPLETION recursion ``C_k = max(A_k, C_{k-1})
+    + S_k`` — element k is ``f_k(x) = max(A_k + S_k, x + S_k)``, built
+    only from k's OWN arrival and service, so invalid (padding) entries
+    compose as the identity and may appear ANYWHERE in the stream (a
+    shared sorted order with other lanes masked out is fine).  The wait is
+    ``C_k - S_k - A_k``.
     """
-    inter = jnp.diff(arrivals, prepend=arrivals[:1])
-    d = jnp.concatenate([jnp.array([-INF]), service[:-1] - inter[1:]])
-    # element k is f_k(x) = max(b_k, x + a_k); W_k = F_k(0).
-    # Padding sorts to the end (arrivals=inf), so d is only consumed where
-    # valid; invalid entries compose as the identity.
-    a = jnp.where(valid, d, 0.0)
-    b = jnp.where(valid, 0.0, -INF)
+    svc = jnp.where(valid, service, 0.0)
+    arr = jnp.where(valid, arrivals, 0.0)
+    a = svc
+    b = jnp.where(valid, arr + svc, -INF)
 
     def compose(left, right):
         a1, b1 = left
         a2, b2 = right
         return a1 + a2, jnp.maximum(b2, b1 + a2)
 
-    ca, cb = jax.lax.associative_scan(compose, (a, b))
-    return jnp.maximum(0.0, jnp.maximum(cb, ca))
+    _, cb = jax.lax.associative_scan(compose, (a, b))
+    return jnp.maximum(0.0, cb - svc - arr)
 
 
 class FastEngine:
@@ -282,6 +286,37 @@ class FastEngine:
         self._spike_times = jnp.asarray(plan.spike_times)
         self._spike_values = jnp.asarray(plan.spike_values)
         self._compiled: dict = {}
+
+    def _shares_entry_sort(self, s: int) -> bool:
+        """Can server ``s`` reuse the shared entry-tier arrival sort?
+
+        True when its core-queue order provably equals arrival order at
+        plan-compile time: the server is entry-tier (nothing exits into
+        it, so every request's ``t`` is final from routing), runs exactly
+        one CPU burst with a uniform enqueue offset across endpoints, has
+        no modeled RAM admission, and no stochastic pre-burst extras that
+        would perturb the enqueue order.
+        """
+        plan = self.plan
+        if s in {
+            int(x)
+            for x, k in zip(plan.exit_target, plan.exit_kind)
+            if k == TARGET_SERVER
+        }:
+            return False
+        nep = int(plan.n_endpoints[s])
+        kb = int(plan.n_bursts[s, :nep].max()) if nep else 0
+        ram_k = int(plan.ram_slots[s]) if len(plan.ram_slots) else 0
+        if kb != 1 or ram_k > 0:
+            return False
+        if nep > 1:
+            nb = plan.n_bursts[s, :nep]
+            pre0 = plan.burst_pre_io[s, :nep, 0]
+            if not (np.all(nb == nb[0]) and np.all(pre0 == pre0[0])):
+                return False
+        return not (
+            plan.fp_cache_slot.size and np.any(plan.fp_cache_slot[s] >= 0)
+        )
 
     # ------------------------------------------------------------------
     # draw helpers
@@ -357,11 +392,25 @@ class FastEngine:
         valid = slot < total
         win = jnp.searchsorted(offsets, slot, side="right").astype(jnp.int32)
         win = jnp.clip(win, 0, nw - 1)
-        u = jax.random.uniform(jax.random.fold_in(key, 3), (n,))
+        # SORTED uniforms per window without a sort (the profiler showed the
+        # fast path is sort-dominated): K sorted uniforms are the normalized
+        # partial sums of K+1 exponential gaps (the Poisson-process order
+        # statistics construction).  One global cumsum + per-window boundary
+        # gathers replace the 88k-key sort: S_i within window w is
+        # cum[i] - cum[start_w - 1], and the denominator adds one extra gap
+        # per window.  Distributionally identical to sorting iid uniforms.
+        gaps = -jnp.log1p(-jax.random.uniform(jax.random.fold_in(key, 3), (n,)))
+        cum = jnp.cumsum(gaps)
+        prefix = jnp.concatenate([jnp.zeros(1, cum.dtype), cum])  # (n+1,)
+        begin = jnp.concatenate([jnp.zeros(1, jnp.int32), offsets[:-1]])
+        base = prefix[jnp.clip(begin, 0, n)]  # (nw,) cum before each window
+        wsum = prefix[jnp.clip(offsets, 0, n)] - base
+        extra = -jnp.log1p(
+            -jax.random.uniform(jax.random.fold_in(key, 4), (nw,)),
+        )
+        denom = jnp.maximum(wsum + extra, _TINY)
+        u = jnp.clip((cum - base[win]) / denom[win], 0.0, 1.0)
         sampler_t = jnp.where(valid, starts[win] + u * lens[win], INF)
-        # windows occupy disjoint time ranges and slots are blocked by window,
-        # so the global sort preserves each sorted position's window index
-        sampler_t = jnp.sort(sampler_t)
 
         # residual dropped from the sim clock per window: boundary - last
         # arrival (full window length when empty)
@@ -617,6 +666,19 @@ class FastEngine:
         burst_dur_t = jnp.asarray(plan.burst_dur)
         burst_pre_t = jnp.asarray(plan.burst_pre_io)
         post_io_t = jnp.asarray(plan.endpoint_post_io)
+
+        # ONE shared arrival-order sort for every entry-tier server whose
+        # core-queue order provably equals arrival order (profiling showed
+        # the fast path is sort-dominated: this folds an LB fan-out's
+        # per-server argsorts into a single one).  Valid because each
+        # request's t is final from routing until its own server processes
+        # it, so the permutation's restriction to any one entry-tier
+        # server's requests is its arrival order.
+        shared_order = (
+            jnp.argsort(jnp.where(alive, t, INF))
+            if any(self._shares_entry_sort(s) for s in plan.server_topo_order)
+            else None
+        )
         for s in plan.server_topo_order:
             mine = alive & (srv == s) & (t < plan.horizon)
             nep = int(plan.n_endpoints[s])
@@ -712,6 +774,8 @@ class FastEngine:
                     pre = pre + jnp.where(validb, pre_extra, 0.0)
                 pre_cum = jnp.cumsum(pre, axis=1)
 
+                use_shared = shared_order is not None and self._shares_entry_sort(s)
+
                 def queue_waits(waits):
                     """One relaxation sweep of the core queue: enqueue times
                     from the current waits, then FIFO waits of the merged
@@ -721,7 +785,10 @@ class FastEngine:
                     flat_e = jnp.where(validb, enq, INF).reshape(-1)
                     flat_d = dur.reshape(-1)
                     flat_v = validb.reshape(-1)
-                    order = jnp.argsort(flat_e)
+                    # entry-tier single-burst servers reuse the shared
+                    # arrival sort (kb == 1, so the flat stream IS the
+                    # request axis); masked lanes interleave harmlessly
+                    order = shared_order if use_shared else jnp.argsort(flat_e)
                     if n_cores == 1:
                         w_s = _lindley_waits(
                             flat_e[order], flat_d[order], flat_v[order],
