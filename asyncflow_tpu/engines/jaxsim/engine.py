@@ -105,13 +105,27 @@ class Engine:
         *,
         collect_gauges: bool = False,
         collect_clocks: bool = False,
+        collect_traces: bool = False,
         n_hist_bins: int = 1024,
         pool_size: int | None = None,
         max_requests: int | None = None,
     ) -> None:
+        if collect_traces and not collect_clocks:
+            msg = "collect_traces requires collect_clocks (traces index rows)"
+            raise ValueError(msg)
         self.plan = plan
         self.collect_gauges = collect_gauges
         self.collect_clocks = collect_clocks
+        self.collect_traces = collect_traces
+        # Hop ring capacity: gen + (edge + client) per entry hop + per
+        # server visit (LB + edge + server + exit edge) + final client.
+        # Acyclic exit DAGs visit each server once; exit-to-LB topologies
+        # CAN cycle (the event engine allows them), so the ring records
+        # the FIRST `cap` hops and stops — tr_n saturates at cap, which
+        # readers can treat as a truncation marker.
+        self._hop_cap = (
+            1 + 2 * len(plan.entry_edges) + 4 * max(plan.n_servers, 1) + 2
+        )
         self.n_hist_bins = n_hist_bins
         self.pool = pool_size or plan.pool_size
         self.max_requests = max_requests or plan.max_requests
@@ -132,6 +146,30 @@ class Engine:
         self._has_timeout = plan.has_queue_timeout
         self._has_breaker = plan.breaker_threshold > 0
         self._compiled: dict = {}
+
+    # hop codes (decoded by run_single against the payload's ids)
+    HOP_GEN = 0
+    HOP_EDGE = 1000  # + edge index
+    HOP_SERVER = 2000  # + server index
+    HOP_LB = 3000
+    HOP_CLIENT = 4000
+
+    def _hop(self, st: EngineState, i, code, t, pred) -> EngineState:
+        """Append one hop to slot ``i``'s ring (no-op unless tracing)."""
+        if not self.collect_traces:
+            return st
+        # once full, stop recording (keep the FIRST cap hops; see __init__)
+        pred = pred & (st.req_hop_n[i] < self._hop_cap)
+        j = jnp.minimum(st.req_hop_n[i], self._hop_cap - 1)
+        return st._replace(
+            req_hops=st.req_hops.at[i, j].set(
+                jnp.where(pred, code, st.req_hops[i, j]),
+            ),
+            req_hop_t=st.req_hop_t.at[i, j].set(
+                jnp.where(pred, t, st.req_hop_t[i, j]),
+            ),
+            req_hop_n=st.req_hop_n.at[i].add(jnp.where(pred, 1, 0)),
+        )
 
     # ==================================================================
     # small helpers
@@ -379,6 +417,7 @@ class Engine:
 
         alive = pred
         t_cur = now
+        hop_times = []  # per-entry-edge delivery times (traces)
         for j, eidx in enumerate(plan.entry_edges.tolist()):
             e = jnp.int32(eidx)
             dropped, delay = self._sample_edge(
@@ -394,6 +433,7 @@ class Engine:
             )
             t_cur = jnp.where(survives, t_cur + delay, t_cur)
             alive = survives
+            hop_times.append(t_cur)
 
         free_mask = st.req_ev == EV_IDLE
         slot = jnp.argmax(free_mask).astype(jnp.int32)
@@ -415,6 +455,22 @@ class Engine:
             req_ticket=st.req_ticket.at[idx].set(NO_TICKET, mode="drop"),
             n_overflow=st.n_overflow + jnp.where(overflow, 1, 0),
         )
+        if self.collect_traces:
+            # fresh ring: generator hop, then one NETWORK + CLIENT pair per
+            # entry edge (the chain's intermediate targets are clients; the
+            # LAST target is the LB/server, recorded by its own branch)
+            st = st._replace(
+                req_hop_n=st.req_hop_n.at[idx].set(0, mode="drop"),
+            )
+            st = self._hop(st, idx, self.HOP_GEN, now, place)
+            for j, eidx in enumerate(plan.entry_edges.tolist()):
+                st = self._hop(
+                    st, idx, self.HOP_EDGE + eidx, hop_times[j], place,
+                )
+                if j < len(plan.entry_edges) - 1:
+                    st = self._hop(
+                        st, idx, self.HOP_CLIENT, hop_times[j], place,
+                    )
         return self._advance_arrival(st, key, ov, pred)
 
     def _seg_start(self, st, i, s, ep, seg, now, key, ov, pred) -> EngineState:
@@ -618,11 +674,26 @@ class Engine:
         drop_here = pred & dropped
 
         st = self._edge_interval(st, e, now, arrive, pred & ~dropped)
+        done = to_client & (arrive < plan.horizon)
+        if self.collect_traces:
+            st = self._hop(st, i, self.HOP_EDGE + e, arrive, pred & ~dropped)
+            st = self._hop(st, i, self.HOP_CLIENT, arrive, done)
+            # flush the completed ring to the trace store, aligned with the
+            # clock row _complete is about to claim
+            idx = jnp.where(done, st.clock_n, jnp.int32(st.tr_code.shape[0]))
+            st = st._replace(
+                tr_code=st.tr_code.at[idx].set(st.req_hops[i], mode="drop"),
+                tr_t=st.tr_t.at[idx].set(st.req_hop_t[i], mode="drop"),
+                tr_n=st.tr_n.at[idx].set(
+                    jnp.minimum(st.req_hop_n[i], self._hop_cap),
+                    mode="drop",
+                ),
+            )
         st = self._complete(
             st,
             st.req_start[i],
             arrive,
-            to_client & (arrive < plan.horizon),
+            done,
         )
 
         free = drop_here | to_client
@@ -798,6 +869,8 @@ class Engine:
                 st, i, now, jnp.bool_(True), drop_edge,
             )
 
+        st = self._hop(st, i, self.HOP_LB, now, pred)
+        st = self._hop(st, i, self.HOP_EDGE + p.lb_edge_index[slot], arrive, ok)
         st = self._edge_interval(st, e, now, arrive, ok)
         free = drop_empty | drop_edge
         st = st._replace(
@@ -892,6 +965,7 @@ class Engine:
                 srv_conn=st.srv_conn.at[s].add(jnp.where(pred, 1, 0)),
             )
 
+        st = self._hop(st, i, self.HOP_SERVER + s, now, pred)
         u = jax.random.uniform(jax.random.fold_in(key, 16))
         ep = jnp.minimum(
             (u * p.n_endpoints[s]).astype(jnp.int32),
@@ -1112,6 +1186,28 @@ class Engine:
                 elp if self._has_breaker else 1, jnp.int32,
             ),
             cb_probe_ok=jnp.zeros(elp if self._has_breaker else 1, jnp.int32),
+            req_hops=(
+                jnp.full((pool, self._hop_cap), -1, jnp.int32)
+                if self.collect_traces
+                else jnp.zeros((1, 1), jnp.int32)
+            ),
+            req_hop_t=(
+                jnp.zeros((pool, self._hop_cap), jnp.float32)
+                if self.collect_traces
+                else jnp.zeros((1, 1), jnp.float32)
+            ),
+            req_hop_n=jnp.zeros(pool if self.collect_traces else 1, jnp.int32),
+            tr_code=(
+                jnp.full((maxn, self._hop_cap), -1, jnp.int32)
+                if self.collect_traces
+                else jnp.zeros((1, 1), jnp.int32)
+            ),
+            tr_t=(
+                jnp.zeros((maxn, self._hop_cap), jnp.float32)
+                if self.collect_traces
+                else jnp.zeros((1, 1), jnp.float32)
+            ),
+            tr_n=jnp.zeros(maxn if self.collect_traces else 1, jnp.int32),
             tl_ptr=jnp.int32(0),
             nxt_i=jnp.int32(0),
             nxt_t=jnp.float32(INF),  # empty pool
@@ -1275,6 +1371,12 @@ def run_single(
         msg = f"engine must be 'auto', 'fast' or 'event', got {engine!r}"
         raise ValueError(msg)
     plan = compile_payload(payload)
+    # per-hop traces ride the event engine's request rings (the fast path
+    # computes trajectories in closed form, no per-hop state to record)
+    tracing = bool(engine_kw.pop("collect_traces", False))
+    if tracing and engine == "fast":
+        msg = "collect_traces needs the event engine (engine='event'/'auto')"
+        raise ValueError(msg)
     # Gauge recording is gated on the settings like the oracle's collector —
     # unless the caller explicitly forced it, in which case everything
     # recorded is also returned.
@@ -1288,7 +1390,7 @@ def run_single(
     # engine rather than silently discarding the tuning on the fast path
     pool_tuned = "pool_size" in engine_kw
     use_fast = engine == "fast" or (
-        engine == "auto" and plan.fastpath_ok and not pool_tuned
+        engine == "auto" and plan.fastpath_ok and not pool_tuned and not tracing
     )
     if use_fast:
         from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
@@ -1298,7 +1400,7 @@ def run_single(
             raise ValueError(msg)
         sim_engine: Engine | FastEngine = FastEngine(plan, **engine_kw)
     else:
-        sim_engine = Engine(plan, **engine_kw)
+        sim_engine = Engine(plan, collect_traces=tracing, **engine_kw)
     final = sim_engine.run_batch(scenario_keys(seed, 1))
     state = jax.tree.map(lambda x: np.asarray(x[0]), final)
 
@@ -1375,6 +1477,34 @@ def run_single(
             if server_metrics <= enabled:
                 keep |= {m.value for m in server_metrics}
             sampled = {k: v for k, v in sampled.items() if k in keep}
+    traces = None
+    if tracing:
+        from asyncflow_tpu.config.constants import SystemEdges, SystemNodes
+
+        nodes = payload.topology_graph.nodes
+        lb_id = nodes.load_balancer.id if nodes.load_balancer else ""
+
+        def decode(code: int) -> tuple[str, str]:
+            kind, idx = divmod(int(code), 1000)
+            if kind == 0:
+                return SystemNodes.GENERATOR, payload.rqs_input.id
+            if kind == 1:
+                return SystemEdges.NETWORK_CONNECTION, plan.edge_ids[idx]
+            if kind == 2:
+                return SystemNodes.SERVER, plan.server_ids[idx]
+            if kind == 3:
+                return SystemNodes.LOAD_BALANCER, lb_id
+            return SystemNodes.CLIENT, nodes.client.id
+
+        n_tr = min(int(state.clock_n), state.tr_code.shape[0])
+        traces = {}
+        for k in range(n_tr):
+            cnt = int(state.tr_n[k])
+            traces[k] = [
+                (*decode(state.tr_code[k, j]), float(state.tr_t[k, j]))
+                for j in range(cnt)
+            ]
+
     return SimulationResults(
         settings=payload.sim_settings,
         rqs_clock=clock,
@@ -1385,6 +1515,7 @@ def run_single(
         total_rejected=int(getattr(state, "n_rejected", 0)),
         server_ids=plan.server_ids,
         edge_ids=plan.edge_ids,
+        traces=traces,
     )
 
 

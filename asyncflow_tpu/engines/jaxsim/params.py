@@ -142,6 +142,15 @@ class EngineState(NamedTuple):
     cb_open_until: jnp.ndarray  # (EL,) f32: cooldown end (open)
     cb_probes_out: jnp.ndarray  # (EL,) i32: outstanding half-open probes
     cb_probe_ok: jnp.ndarray  # (EL,) i32: successful probes this round
+    # per-request hop rings + completed-trace store (round 4, VERDICT #8;
+    # size (1, 1) unless collect_traces — mirrors the reference's
+    # rqs_state.Hop records, flushed at completion like the oracle)
+    req_hops: jnp.ndarray  # (P, H) i32 hop codes
+    req_hop_t: jnp.ndarray  # (P, H) f32 hop timestamps
+    req_hop_n: jnp.ndarray  # (P,) i32 hops recorded
+    tr_code: jnp.ndarray  # (maxN, H) i32 completed traces
+    tr_t: jnp.ndarray  # (maxN, H) f32
+    tr_n: jnp.ndarray  # (maxN,) i32
     # outage timeline cursor
     tl_ptr: jnp.ndarray  # scalar i32
     # cached pool argmin (computed once at the end of each loop body so the

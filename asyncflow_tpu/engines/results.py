@@ -35,7 +35,10 @@ class SimulationResults:
     server_ids: list[str] = field(default_factory=list)
     #: edge ids in topology order.
     edge_ids: list[str] = field(default_factory=list)
-    #: optional per-request traces (oracle engine with collect_traces=True):
+    #: optional per-request traces (oracle or jax event engine with
+    #: collect_traces=True; keys are oracle request ids / event-engine
+    #: completed-clock row indices respectively — match traces to clocks
+    #: WITHIN one engine run, never across engines):
     #: request id -> list of (component_kind, component_id, timestamp) hops,
     #: the OpenTelemetry-style span record of the reference's RequestState
     #: history (`/root/reference/src/asyncflow/runtime/rqs_state.py:12-41`).

@@ -156,7 +156,9 @@ class ResultsAnalyzer:
 
     def get_traces(self) -> dict[int, list[tuple[str, str, float]]]:
         """Per-request hop traces (requires an engine run with tracing on,
-        e.g. ``engine_options={"collect_traces": True}`` on the oracle)."""
+        ``engine_options={"collect_traces": True}`` — oracle or jax event
+        backend; keys are oracle request ids / completed-clock row indices
+        respectively)."""
         return self._results.traces or {}
 
     def get_metric_map(
