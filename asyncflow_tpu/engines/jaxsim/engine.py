@@ -1497,13 +1497,16 @@ def run_single(
             return SystemNodes.CLIENT, nodes.client.id
 
         n_tr = min(int(state.clock_n), state.tr_code.shape[0])
-        traces = {}
-        for k in range(n_tr):
-            cnt = int(state.tr_n[k])
-            traces[k] = [
-                (*decode(state.tr_code[k, j]), float(state.tr_t[k, j]))
-                for j in range(cnt)
+        codes = state.tr_code[:n_tr].tolist()
+        times = state.tr_t[:n_tr].tolist()
+        counts = state.tr_n[:n_tr].tolist()
+        traces = {
+            k: [
+                (*decode(codes[k][j]), times[k][j])
+                for j in range(counts[k])
             ]
+            for k in range(n_tr)
+        }
 
     return SimulationResults(
         settings=payload.sim_settings,

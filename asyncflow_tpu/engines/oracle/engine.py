@@ -87,6 +87,7 @@ class _EdgeRuntime:
         self.engine = engine
         self.cfg = cfg
         self.concurrent = 0
+        self.total_sent = 0  # cumulative non-dropped sends
         self.series: list[float] = []
         self.deliver_to = None  # set during wiring: callable(Request)
 
@@ -107,6 +108,7 @@ class _EdgeRuntime:
             return
 
         self.concurrent += 1
+        self.total_sent += 1
         transit = sample_rv(self.cfg.latency, engine.rng)
         transit += engine.edge_spike.get(self.cfg.id, 0.0)
 
@@ -360,6 +362,11 @@ class OracleEngine:
         # HALF_OPEN transition at routing time (schemas.nodes.CircuitBreaker)
         self.breaker = self.lb.circuit_breaker if self.lb is not None else None
         self.breaker_state: dict[str, dict] = {}
+        # optional routing-weight override (the RL playground's action
+        # channel, asyncflow_tpu.rl): edge id -> nonnegative weight; None
+        # keeps the configured algorithm.  Breaker eligibility still
+        # applies; an all-zero weight vector falls back to uniform.
+        self.lb_weights: dict[str, float] | None = None
         self.generator_out: _EdgeRuntime | None = None
 
         self._wire()
@@ -488,6 +495,15 @@ class OracleEngine:
     def _pick_lb_edge(self) -> _EdgeRuntime | None:
         assert self.lb is not None
         edges = self.lb_out_edges
+        if self.lb_weights is not None:
+            eligible = [eid for eid in edges if self._breaker_admits(eid)]
+            if not eligible:
+                return None
+            w = np.array([self.lb_weights.get(eid, 0.0) for eid in eligible])
+            if w.sum() <= 0:
+                w = np.ones(len(eligible))
+            pick = eligible[int(self.rng.choice(len(eligible), p=w / w.sum()))]
+            return edges[pick]
         if self.lb.algorithms == LbAlgorithmsName.LEAST_CONNECTIONS:
             eligible = [eid for eid in edges if self._breaker_admits(eid)]
             if not eligible:
@@ -637,11 +653,17 @@ class OracleEngine:
     # run
     # ------------------------------------------------------------------
 
-    def run(self) -> SimulationResults:
-        """Execute the scenario and reduce to :class:`SimulationResults`."""
+    def start(self) -> None:
+        """Schedule the scenario's processes without running it — the
+        setup shared by :meth:`run` and incremental drivers (the RL
+        playground steps the clock with ``sim.run(until=...)``)."""
         self._schedule_events()
         self.sim.process(self._generator_process())
         self._schedule_collector()
+
+    def run(self) -> SimulationResults:
+        """Execute the scenario and reduce to :class:`SimulationResults`."""
+        self.start()
         self.sim.run(until=float(self.settings.total_simulation_time))
 
         sampled: dict[str, dict[str, np.ndarray]] = {}

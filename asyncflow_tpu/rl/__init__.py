@@ -1,0 +1,6 @@
+"""RL playground (reference roadmap milestone 6): Gym-style environments
+over the simulator."""
+
+from asyncflow_tpu.rl.env import LoadBalancerEnv
+
+__all__ = ["LoadBalancerEnv"]

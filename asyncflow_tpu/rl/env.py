@@ -1,0 +1,186 @@
+"""RL playground: a Gym-style environment over the simulator.
+
+The reference roadmap's final milestone
+(`/root/reference/ROADMAP.md` §6) plans "a research-oriented playground
+where AsyncFlow serves as a training and evaluation environment for
+intelligent load-balancing and autoscaling strategies.  With a Gym-like
+interface, researchers can train RL agents and benchmark them against
+established baselines."  This module delivers that interface without a
+gym/gymnasium dependency (the API is call-compatible: ``reset() -> (obs,
+info)``, ``step(a) -> (obs, reward, terminated, truncated, info)``), on
+the sequential oracle engine so every actor semantic is the reference's.
+
+- **Action**: nonnegative routing weights over the load balancer's
+  out-edges (order = :attr:`LoadBalancerEnv.target_ids`).  Weights are
+  normalized per decision; an all-zero action falls back to uniform.
+  Circuit-breaker eligibility still applies on top.
+- **Observation** (float32 vector): per server ``[ready_queue_len,
+  io_queue_len, ram_in_use / ram_total, residents]``, per LB edge
+  ``[in-flight]``, then ``[completions, mean latency, arrivals]`` of the
+  last decision window.
+- **Reward**: ``"neg_mean_latency"`` (default), ``"throughput"``, or any
+  ``callable(info) -> float``.  ``info`` carries the window's raw
+  counters so custom shaping needs no engine knowledge.
+
+Baselines to benchmark agents against are the configured algorithms
+themselves: run the same payload through :class:`SimulationRunner`
+(round robin / least connections) and compare latency stats.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from asyncflow_tpu.config.constants import SampledMetricName
+from asyncflow_tpu.engines.oracle.engine import OracleEngine
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+
+class LoadBalancerEnv:
+    """Sequential (single-scenario) routing environment.
+
+    One ``step`` applies the action's routing weights, advances the
+    simulation ``decision_period_s`` seconds, and returns the new
+    observation.  Episodes end at the payload's
+    ``total_simulation_time`` (``terminated=True``).
+    """
+
+    def __init__(
+        self,
+        payload: SimulationPayload,
+        *,
+        decision_period_s: float = 1.0,
+        reward: str | Callable[[dict], float] = "neg_mean_latency",
+        seed: int | None = None,
+    ) -> None:
+        if payload.topology_graph.nodes.load_balancer is None:
+            msg = "LoadBalancerEnv needs a load-balancer topology"
+            raise ValueError(msg)
+        if decision_period_s <= 0:
+            msg = f"decision_period_s must be > 0, got {decision_period_s}"
+            raise ValueError(msg)
+        if isinstance(reward, str) and reward not in (
+            "neg_mean_latency",
+            "throughput",
+        ):
+            msg = (
+                "reward must be 'neg_mean_latency', 'throughput', or a "
+                f"callable, got {reward!r}"
+            )
+            raise ValueError(msg)
+        self.payload = payload
+        self.decision_period_s = float(decision_period_s)
+        self.reward = reward
+        self._seed = seed
+        self.horizon = float(payload.sim_settings.total_simulation_time)
+        self._engine: OracleEngine | None = None
+        self._now = 0.0
+        self._seen_completions = 0
+        self._seen_generated = 0
+
+        lb = payload.topology_graph.nodes.load_balancer
+        lb_id = lb.id
+        #: LB out-edge ids in topology order — the action vector's order
+        self.edge_ids: list[str] = [
+            e.id for e in payload.topology_graph.edges if e.source == lb_id
+        ]
+        #: target server id per action component
+        self.target_ids: list[str] = [
+            e.target for e in payload.topology_graph.edges if e.source == lb_id
+        ]
+        self.server_ids: list[str] = [
+            s.id for s in payload.topology_graph.nodes.servers
+        ]
+        self.action_dim = len(self.edge_ids)
+        self.observation_dim = 4 * len(self.server_ids) + self.action_dim + 3
+
+    # ------------------------------------------------------------------
+
+    def reset(self, *, seed: int | None = None) -> tuple[np.ndarray, dict]:
+        """Fresh episode; returns ``(observation, info)``."""
+        if seed is not None:
+            self._seed = seed
+        self._engine = OracleEngine(self.payload, seed=self._seed)
+        self._engine.start()
+        self._now = 0.0
+        self._seen_completions = 0
+        self._seen_generated = 0
+        return self._observe(0, 0.0, 0), {"t": 0.0}
+
+    def step(
+        self,
+        action,
+    ) -> tuple[np.ndarray, float, bool, bool, dict]:
+        """Apply routing weights, simulate one decision window."""
+        if self._engine is None:
+            msg = "call reset() before step()"
+            raise RuntimeError(msg)
+        action = np.asarray(action, dtype=np.float64).reshape(-1)
+        if action.shape[0] != self.action_dim:
+            msg = f"action must have shape ({self.action_dim},)"
+            raise ValueError(msg)
+        if np.any(action < 0) or not np.all(np.isfinite(action)):
+            msg = "action weights must be finite and nonnegative"
+            raise ValueError(msg)
+        eng = self._engine
+        eng.lb_weights = dict(zip(self.edge_ids, action.tolist()))
+
+        self._now = min(self._now + self.decision_period_s, self.horizon)
+        eng.sim.run(until=self._now)
+
+        # window deltas (consumed AFTER the observation is built from them)
+        clock = eng.rqs_clock
+        done_n = len(clock) - self._seen_completions
+        lats = [fin - start for start, fin in clock[self._seen_completions :]]
+        self._seen_completions = len(clock)
+        gen_n = eng.total_generated - self._seen_generated
+        self._seen_generated = eng.total_generated
+        mean_lat = float(np.mean(lats)) if lats else 0.0
+
+        info = {
+            "t": self._now,
+            "window_completions": done_n,
+            "window_arrivals": gen_n,
+            "window_latencies": np.asarray(lats, dtype=np.float64),
+            "total_rejected": eng.total_rejected,
+            "total_dropped": eng.total_dropped,
+        }
+        if callable(self.reward):
+            r = float(self.reward(info))
+        elif self.reward == "throughput":
+            r = done_n / self.decision_period_s
+        else:  # neg_mean_latency; no completions = no evidence, 0 reward
+            r = -float(np.mean(lats)) if lats else 0.0
+        terminated = self._now >= self.horizon
+        return self._observe(done_n, mean_lat, gen_n), r, terminated, False, info
+
+    # ------------------------------------------------------------------
+
+    def _observe(self, done_n: int, mean_lat: float, gen_n: int) -> np.ndarray:
+        """Instantaneous state + the LAST decision window's counters."""
+        eng = self._engine
+        assert eng is not None
+        feats: list[float] = []
+        for sid in self.server_ids:
+            srv = eng.servers[sid]
+            ram_total = float(srv.cfg.server_resources.ram_mb)
+            feats += [
+                float(srv.ready_queue_len),
+                float(srv.io_queue_len),
+                srv.ram_in_use / ram_total if ram_total else 0.0,
+                float(srv.residents),
+            ]
+        for eid in self.edge_ids:
+            feats.append(float(eng.edges[eid].concurrent))
+        feats += [float(done_n), mean_lat, float(gen_n)]
+        return np.asarray(feats, dtype=np.float32)
+
+
+# the sampled-metric names an observation row exposes, for documentation
+OBSERVED_SERVER_METRICS = (
+    SampledMetricName.READY_QUEUE_LEN,
+    SampledMetricName.EVENT_LOOP_IO_SLEEP,
+    SampledMetricName.RAM_IN_USE,
+)
