@@ -58,6 +58,12 @@ SEG_DB = 3
 # path as per-request duration extras on the visit tables
 # (fp_cache_slot/fp_cache_miss_prob/fp_cache_extra).
 SEG_CACHE = 4
+# an io_llm step with call dynamics (the reference's reserved io_llm kind
+# + llm_cost/llm_stats metrics, activated): per request, output tokens ~
+# Poisson(tokens_mean); the sleep is base + tokens * time_per_token and
+# the request accrues tokens * cost_per_token.  Modeled by the oracle,
+# native, and event engines; the fast path declines with a named reason.
+SEG_LLM = 5
 
 # Multi-burst relaxation envelope: nominal per-server core utilization above
 # which the fast path's fixed-point relaxation is measurably biased vs the
@@ -101,6 +107,7 @@ def _compile_endpoint(
     """
     segments: list[tuple[int, float]] = []
     cache: list[tuple[float, float] | None] = []
+    llm: list[tuple[float, float, float] | None] = []
     total_ram = 0.0
     for step in endpoint.steps:
         if step.is_ram:
@@ -110,6 +117,8 @@ def _compile_endpoint(
             kind = SEG_CPU
         elif step.is_stochastic_cache:
             kind = SEG_CACHE
+        elif step.is_llm:
+            kind = SEG_LLM
         elif db_pooled and step.kind == EndpointStepIO.DB:
             kind = SEG_DB
         else:
@@ -117,7 +126,7 @@ def _compile_endpoint(
         if (
             segments
             and segments[-1][0] == kind
-            and kind not in (SEG_DB, SEG_CACHE)
+            and kind not in (SEG_DB, SEG_CACHE, SEG_LLM)
         ):
             segments[-1] = (kind, segments[-1][1] + step.quantity)
         else:
@@ -127,7 +136,16 @@ def _compile_endpoint(
                 if kind == SEG_CACHE
                 else None,
             )
-    return segments, total_ram, cache
+            llm.append(
+                (
+                    float(step.llm_tokens_mean),
+                    float(step.llm_time_per_token),
+                    float(step.llm_cost_per_token),
+                )
+                if kind == SEG_LLM
+                else None,
+            )
+    return segments, total_ram, cache, llm
 
 
 # fastpath cache-placement sentinels (fp_cache_slot values < 0):
@@ -422,6 +440,23 @@ class StaticPlan:
         default_factory=lambda: np.empty((0, 0, 0), np.float32),
     )
 
+    #: (NS, NEP, NSEG+1) f32 SEG_LLM call dynamics: Poisson output-token
+    #: mean, decode seconds per token, and cost units per token.
+    seg_llm_tokens: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0, 0), np.float32),
+    )
+    seg_llm_tpt: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0, 0), np.float32),
+    )
+    seg_llm_cost: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0, 0), np.float32),
+    )
+
+    @property
+    def has_llm(self) -> bool:
+        """True when any segment carries LLM call dynamics."""
+        return bool(self.seg_llm_tokens.size and np.any(self.seg_llm_tokens > 0))
+
     @property
     def has_stochastic_cache(self) -> bool:
         """True when any segment is a cache hit/miss mixture."""
@@ -582,16 +617,20 @@ def _estimate_capacity(payload: SimulationPayload) -> tuple[int, int]:
         io_req = 0.0
         ram_req = 0.0
         for endpoint in server.endpoints:
-            segs, ram, cache = _compile_endpoint(endpoint)
-            # capacity bounds use the worst-case (miss) duration of
-            # stochastic cache segments — relabeled SEG_IO so they enter
-            # the io/residence sums below (SEG_CACHE is an IO sleep)
-            segs = [
-                (SEG_IO, max(d, cache[i][1]))
-                if cache[i] is not None
-                else (k, d)
-                for i, (k, d) in enumerate(segs)
-            ]
+            segs, ram, cache, llm = _compile_endpoint(endpoint)
+            # capacity bounds use the worst-case duration of stochastic
+            # segments — cache: the miss latency; llm: a 6-sigma token
+            # draw — relabeled SEG_IO so they enter the io/residence sums
+            # below (both are IO sleeps)
+            def _worst_seg(i: int, k: int, d: float) -> tuple[int, float]:
+                if cache[i] is not None:
+                    return (SEG_IO, max(d, cache[i][1]))
+                if llm[i] is not None:
+                    m, tpt, _ = llm[i]
+                    return (SEG_IO, d + (m + 6.0 * math.sqrt(max(m, 1.0))) * tpt)
+                return (k, d)
+
+            segs = [_worst_seg(i, k, d) for i, (k, d) in enumerate(segs)]
             cpu_req = max(
                 cpu_req,
                 sum(dur for kind, dur in segs if kind == SEG_CPU),
@@ -805,9 +844,14 @@ def compile_payload(
 
         def _worst(step) -> float:
             # worst-case duration: stochastic cache steps may sleep the
-            # miss latency
+            # miss latency; llm steps a 6-sigma token draw
             if step.is_stochastic_cache:
                 return max(float(step.quantity), float(step.cache_miss_time))
+            if step.is_llm:
+                m = float(step.llm_tokens_mean)
+                return float(step.quantity) + (
+                    m + 6.0 * math.sqrt(max(m, 1.0))
+                ) * float(step.llm_time_per_token)
             return float(step.quantity)
 
         residence = max(
@@ -974,6 +1018,16 @@ def compile_payload(
     seg_miss_dur = np.zeros(
         (n_servers, max_endpoints, max_segments + 1), dtype=np.float32,
     )
+    # SEG_LLM call dynamics: Poisson token mean, seconds and cost per token
+    seg_llm_tokens = np.zeros(
+        (n_servers, max_endpoints, max_segments + 1), dtype=np.float32,
+    )
+    seg_llm_tpt = np.zeros(
+        (n_servers, max_endpoints, max_segments + 1), dtype=np.float32,
+    )
+    seg_llm_cost = np.zeros(
+        (n_servers, max_endpoints, max_segments + 1), dtype=np.float32,
+    )
     endpoint_ram = np.zeros((n_servers, max_endpoints), dtype=np.float32)
     n_endpoints = np.zeros(n_servers, dtype=np.int32)
     bursts = [
@@ -991,7 +1045,7 @@ def compile_payload(
     endpoint_post_io = np.zeros((n_servers, max_endpoints), dtype=np.float32)
     for s, per_server in enumerate(compiled):
         n_endpoints[s] = len(per_server)
-        for e, (segs, ram, cache) in enumerate(per_server):
+        for e, (segs, ram, cache, llm) in enumerate(per_server):
             endpoint_ram[s, e] = ram
             for k, (seg_k, dur) in enumerate(segs):
                 seg_kind[s, e, k] = seg_k
@@ -999,6 +1053,10 @@ def compile_payload(
                 if cache[k] is not None:
                     seg_hit_prob[s, e, k] = cache[k][0]
                     seg_miss_dur[s, e, k] = cache[k][1]
+                if llm[k] is not None:
+                    seg_llm_tokens[s, e, k] = llm[k][0]
+                    seg_llm_tpt[s, e, k] = llm[k][1]
+                    seg_llm_cost[s, e, k] = llm[k][2]
             dur_list, pre_list, post = bursts[s][e]
             n_bursts[s, e] = len(dur_list)
             burst_dur[s, e, : len(dur_list)] = dur_list
@@ -1009,7 +1067,7 @@ def compile_payload(
     # + cache-mixture placements (zero-filled where the endpoint has none;
     # _fastpath_analysis declines the shapes _fastpath_lowering rejects)
     fp_lowered = [
-        [_fastpath_lowering(segs, cache) for segs, _, cache in per_server]
+        [_fastpath_lowering(segs, cache) for segs, _, cache, _ in per_server]
         for per_server in compiled
     ]
     cmax = max(
@@ -1242,6 +1300,9 @@ def compile_payload(
         breaker_lowered=breaker_lowered,
         seg_hit_prob=seg_hit_prob,
         seg_miss_dur=seg_miss_dur,
+        seg_llm_tokens=seg_llm_tokens,
+        seg_llm_tpt=seg_llm_tpt,
+        seg_llm_cost=seg_llm_cost,
         fp_db_pre=fp_db_pre,
         fp_db_dur=fp_db_dur,
         fp_db_post=fp_db_post,
@@ -1421,6 +1482,16 @@ def _fastpath_analysis(
         # the lowering model (_fastpath_lowering): at most one DB query,
         # positioned after the last CPU burst so its FIFO wait never feeds
         # back into the core-queue enqueue times.
+        if any(k == SEG_LLM for segs, *_ in compiled[s] for k, _ in segs):
+            return (
+                False,
+                f"server {server.id}: LLM call dynamics (token draws and "
+                "cost accounting modeled on the event engines)",
+                [],
+                no_slots,
+                0,
+                0.0,
+            )
         if fp_lowered is not None:
             for e, (_, _, reason) in enumerate(fp_lowered[s]):
                 if reason:
@@ -1449,7 +1520,7 @@ def _fastpath_analysis(
         db_dur_max = 0.0
         visits = 1
         needs: set[float] = set()
-        for segs, ram, cache in compiled[s]:
+        for segs, ram, cache, _llm in compiled[s]:
             max_ram = max(max_ram, ram)
             if ram > 0:
                 needs.add(ram)
@@ -1534,7 +1605,7 @@ def _fastpath_analysis(
                 0,
                 0.0,
             )
-        if len(needs) == 1 and min(ram for _, ram, _ in compiled[s]) > 0:
+        if len(needs) == 1 and min(ram for _, ram, *_ in compiled[s]) > 0:
             if visits > 1:
                 return (
                     False,

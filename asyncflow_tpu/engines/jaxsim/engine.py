@@ -40,6 +40,7 @@ from asyncflow_tpu.compiler.plan import (
     SEG_CACHE,
     SEG_CPU,
     SEG_DB,
+    SEG_LLM,
     SEG_END,
     SEG_IO,
     TARGET_CLIENT,
@@ -142,6 +143,7 @@ class Engine:
         self._has_cache = bool(np.any(plan.seg_kind == SEG_CACHE))
         self._has_shed = plan.has_queue_cap
         self._has_conn = plan.has_conn_cap
+        self._has_llm = plan.has_llm
         self._has_rl = plan.has_rate_limit
         self._has_timeout = plan.has_queue_timeout
         self._has_breaker = plan.breaker_threshold > 0
@@ -455,6 +457,10 @@ class Engine:
             req_ticket=st.req_ticket.at[idx].set(NO_TICKET, mode="drop"),
             n_overflow=st.n_overflow + jnp.where(overflow, 1, 0),
         )
+        if self._has_llm:
+            st = st._replace(
+                req_llm=st.req_llm.at[idx].set(0.0, mode="drop"),
+            )
         if self.collect_traces:
             # fresh ring: generator hop, then one NETWORK + CLIENT pair per
             # entry edge (the chain's intermediate targets are clients; the
@@ -494,6 +500,21 @@ class Engine:
                 dur,
             )
             is_io = is_io | is_cache
+        if self._has_llm:
+            # SEG_LLM: output tokens ~ Poisson(mean); the sleep stretches
+            # by tokens * s/token and the request accrues tokens * cost
+            is_llm = pred & (kind == SEG_LLM)
+            lam = p.seg_llm_tokens[s, ep, seg]
+            tokens = jax.random.poisson(
+                jax.random.fold_in(key, 25), jnp.maximum(lam, 1e-6),
+            ).astype(jnp.float32)
+            dur = jnp.where(is_llm, dur + tokens * p.seg_llm_tpt[s, ep, seg], dur)
+            st = st._replace(
+                req_llm=st.req_llm.at[i].add(
+                    jnp.where(is_llm, tokens * p.seg_llm_cost[s, ep, seg], 0.0),
+                ),
+            )
+            is_io = is_io | is_llm
 
         has_waiters = st.cpu_wait_n[s] > 0
         can_take = (st.cores_free[s] > 0) & ~has_waiters
@@ -675,6 +696,19 @@ class Engine:
 
         st = self._edge_interval(st, e, now, arrive, pred & ~dropped)
         done = to_client & (arrive < plan.horizon)
+        if self._has_llm:
+            cost = st.req_llm[i]
+            st = st._replace(
+                llm_sum=st.llm_sum + jnp.where(done, cost, 0.0),
+                llm_sumsq=st.llm_sumsq + jnp.where(done, cost * cost, 0.0),
+            )
+            if self.collect_clocks:
+                lidx = jnp.where(
+                    done, st.clock_n, jnp.int32(st.llm_store.shape[0]),
+                )
+                st = st._replace(
+                    llm_store=st.llm_store.at[lidx].set(cost, mode="drop"),
+                )
         if self.collect_traces:
             st = self._hop(st, i, self.HOP_EDGE + e, arrive, pred & ~dropped)
             st = self._hop(st, i, self.HOP_CLIENT, arrive, done)
@@ -1208,6 +1242,13 @@ class Engine:
                 else jnp.zeros((1, 1), jnp.float32)
             ),
             tr_n=jnp.zeros(maxn if self.collect_traces else 1, jnp.int32),
+            req_llm=jnp.zeros(pool if self._has_llm else 1, jnp.float32),
+            llm_sum=jnp.float32(0.0),
+            llm_sumsq=jnp.float32(0.0),
+            llm_store=jnp.zeros(
+                maxn if (self._has_llm and self.collect_clocks) else 1,
+                jnp.float32,
+            ),
             tl_ptr=jnp.int32(0),
             nxt_i=jnp.int32(0),
             nxt_t=jnp.float32(INF),  # empty pool
@@ -1508,6 +1549,10 @@ def run_single(
             for k in range(n_tr)
         }
 
+    llm_cost = None
+    if plan.has_llm and sim_engine.collect_clocks and hasattr(state, "llm_store"):
+        llm_cost = state.llm_store[: int(state.clock_n)].astype(np.float64)
+
     return SimulationResults(
         settings=payload.sim_settings,
         rqs_clock=clock,
@@ -1519,6 +1564,7 @@ def run_single(
         server_ids=plan.server_ids,
         edge_ids=plan.edge_ids,
         traces=traces,
+        llm_cost=llm_cost,
     )
 
 
@@ -1563,6 +1609,16 @@ def sweep_results(
         throughput=np.asarray(final.thr),
         total_generated=np.asarray(final.n_generated),
         total_dropped=np.asarray(final.n_dropped),
+        llm_cost_sum=(
+            np.asarray(final.llm_sum)
+            if engine.plan.has_llm and hasattr(final, "llm_sum")
+            else None
+        ),
+        llm_cost_sumsq=(
+            np.asarray(final.llm_sumsq)
+            if engine.plan.has_llm and hasattr(final, "llm_sumsq")
+            else None
+        ),
         overflow_dropped=np.asarray(final.n_overflow),
         total_rejected=(
             np.asarray(final.n_rejected)

@@ -315,6 +315,7 @@ class PallasEngine:
             or plan.has_rate_limit
             or plan.has_queue_timeout
             or plan.breaker_threshold > 0
+            or plan.has_llm
         ):
             # the VMEM kernel has no DB-pool FIFO machinery, no cache
             # mixture draws, and no shed/refusal/limiter/deadline/breaker
@@ -322,9 +323,9 @@ class PallasEngine:
             # engine
             msg = (
                 "the Pallas kernel does not model binding DB connection "
-                "pools, stochastic cache steps, or reachable overload "
-                "policies (caps, capacities, rate limits, deadlines, "
-                "circuit breakers); use the event engine"
+                "pools, stochastic cache steps, LLM call dynamics, or "
+                "reachable overload policies (caps, capacities, rate "
+                "limits, deadlines, circuit breakers); use the event engine"
             )
             raise ValueError(msg)
         self.plan = plan

@@ -45,6 +45,9 @@ class PlanParams(NamedTuple):
     seg_dur: jnp.ndarray
     seg_hit_prob: jnp.ndarray  # SEG_CACHE mixtures (0 = deterministic)
     seg_miss_dur: jnp.ndarray
+    seg_llm_tokens: jnp.ndarray  # SEG_LLM Poisson token mean (0 = none)
+    seg_llm_tpt: jnp.ndarray  # SEG_LLM decode seconds per token
+    seg_llm_cost: jnp.ndarray  # SEG_LLM cost units per token
     endpoint_ram: jnp.ndarray
     exit_edge: jnp.ndarray
     exit_kind: jnp.ndarray
@@ -81,6 +84,9 @@ def params_from_plan(plan: StaticPlan) -> PlanParams:
         seg_dur=jnp.asarray(plan.seg_dur),
         seg_hit_prob=jnp.asarray(plan.seg_hit_prob),
         seg_miss_dur=jnp.asarray(plan.seg_miss_dur),
+        seg_llm_tokens=jnp.asarray(plan.seg_llm_tokens),
+        seg_llm_tpt=jnp.asarray(plan.seg_llm_tpt),
+        seg_llm_cost=jnp.asarray(plan.seg_llm_cost),
         endpoint_ram=jnp.asarray(plan.endpoint_ram),
         exit_edge=jnp.asarray(plan.exit_edge),
         exit_kind=jnp.asarray(plan.exit_kind),
@@ -151,6 +157,11 @@ class EngineState(NamedTuple):
     tr_code: jnp.ndarray  # (maxN, H) i32 completed traces
     tr_t: jnp.ndarray  # (maxN, H) f32
     tr_n: jnp.ndarray  # (maxN,) i32
+    # LLM call dynamics (size (1,) unless the plan has SEG_LLM segments)
+    req_llm: jnp.ndarray  # (P,) f32 accumulated cost of the in-flight request
+    llm_sum: jnp.ndarray  # scalar f32: total cost of completed requests
+    llm_sumsq: jnp.ndarray  # scalar f32
+    llm_store: jnp.ndarray  # (maxN,) f32 per-completion cost (clock-aligned)
     # outage timeline cursor
     tl_ptr: jnp.ndarray  # scalar i32
     # cached pool argmin (computed once at the end of each loop body so the

@@ -75,6 +75,8 @@ class Request:
     lb_edge_id: str | None = None
     #: True while this request is a half-open breaker probe
     probe: bool = False
+    #: accumulated LLM cost units (io_llm steps with call dynamics)
+    llm_cost: float = 0.0
 
     def record_hop(self, kind: str, component_id: str, now: float) -> None:
         self.history.append(Hop(kind, component_id, now))
@@ -308,6 +310,15 @@ class _ServerRuntime:
                     yield Timeout(
                         step.quantity if hit else step.cache_miss_time,
                     )
+                elif step.is_llm:
+                    # reserved io_llm kind, activated: output tokens ~
+                    # Poisson(mean); sleep = base + tokens * s/token and
+                    # the request accrues tokens * cost/token
+                    tokens = float(engine.rng.poisson(step.llm_tokens_mean))
+                    req.llm_cost += tokens * step.llm_cost_per_token
+                    yield Timeout(
+                        step.quantity + tokens * step.llm_time_per_token,
+                    )
                 else:
                     yield Timeout(step.quantity)
 
@@ -345,6 +356,17 @@ class OracleEngine:
         self.total_dropped = 0
         self.total_rejected = 0
         self.rqs_clock: list[tuple[float, float]] = []
+        self.llm_costs: list[float] = []  # aligned with rqs_clock
+        # gate the llm_cost OUTPUT on llm presence in the payload (not on
+        # observed nonzero costs: cost_per_token=0 is a legal latency-only
+        # model and must still report a zeros array, matching the jax
+        # engine's plan-gated output)
+        self._has_llm = any(
+            step.is_llm
+            for server in payload.topology_graph.nodes.servers
+            for ep in server.endpoints
+            for step in ep.steps
+        )
         self.edge_spike: dict[str, float] = {}
 
         graph = payload.topology_graph
@@ -429,6 +451,7 @@ class OracleEngine:
         if len(req.history) > 3:
             req.finish_time = self.sim.now
             self.rqs_clock.append((req.initial_time, req.finish_time))
+            self.llm_costs.append(req.llm_cost)
             if self.collect_traces:
                 self.traces[req.id] = [
                     (hop.component_type, hop.component_id, hop.timestamp)
@@ -699,4 +722,9 @@ class OracleEngine:
             server_ids=list(self.servers),
             edge_ids=list(self.edges),
             traces=self.traces if self.collect_traces else None,
+            llm_cost=(
+                np.asarray(self.llm_costs, dtype=np.float64)
+                if self._has_llm
+                else None
+            ),
         )

@@ -52,6 +52,9 @@ class _PlanC(ctypes.Structure):
         ("seg_dur", _f32p),
         ("seg_hit_prob", _f32p),
         ("seg_miss_dur", _f32p),
+        ("seg_llm_tokens", _f32p),
+        ("seg_llm_tpt", _f32p),
+        ("seg_llm_cost", _f32p),
         ("endpoint_ram", _f32p),
         ("exit_edge", _i32p),
         ("exit_kind", _i32p),
@@ -133,6 +136,7 @@ def load_library() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_double),
             _f32p,
             ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double),
         ]
         _lib = lib
     except (OSError, subprocess.CalledProcessError) as exc:
@@ -206,6 +210,9 @@ def run_native(
         seg_dur=f32(plan.seg_dur),
         seg_hit_prob=f32(plan.seg_hit_prob),
         seg_miss_dur=f32(plan.seg_miss_dur),
+        seg_llm_tokens=f32(plan.seg_llm_tokens),
+        seg_llm_tpt=f32(plan.seg_llm_tpt),
+        seg_llm_cost=f32(plan.seg_llm_cost),
         endpoint_ram=f32(plan.endpoint_ram),
         exit_edge=i32(plan.exit_edge),
         exit_kind=i32(plan.exit_kind),
@@ -242,12 +249,22 @@ def run_native(
     )
     counters = np.zeros(5, dtype=np.int64)
 
+    llm = (
+        np.zeros(plan.max_requests, dtype=np.float64)
+        if plan.has_llm
+        else None
+    )
     lib.afnative_run(
         ctypes.byref(c),
         ctypes.c_uint64(seed),
         clock.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         gauges.ctypes.data_as(_f32p) if gauges is not None else _f32p(),
         counters.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        (
+            llm.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+            if llm is not None
+            else ctypes.POINTER(ctypes.c_double)()
+        ),
     )
     generated, dropped, clock_n, clock_overflow, rejected = (
         int(x) for x in counters
@@ -297,4 +314,5 @@ def run_native(
         overflow_dropped=clock_overflow,
         server_ids=plan.server_ids,
         edge_ids=plan.edge_ids,
+        llm_cost=llm[:clock_n] if llm is not None else None,
     )

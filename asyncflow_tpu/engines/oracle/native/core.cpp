@@ -25,6 +25,7 @@ constexpr int SEG_CPU = 1;
 constexpr int SEG_IO = 2;
 constexpr int SEG_DB = 3;  // io_db holding one of K FIFO pool connections
 constexpr int SEG_CACHE = 4;  // io_cache hit/miss mixture sleep
+constexpr int SEG_LLM = 5;    // io_llm call dynamics (tokens, time, cost)
 
 // hop targets (compiler order)
 constexpr int TARGET_SERVER = 1;
@@ -66,6 +67,9 @@ struct PlanC {
     const float* seg_dur;
     const float* seg_hit_prob;  // SEG_CACHE: hit probability (0 = deterministic)
     const float* seg_miss_dur;  // SEG_CACHE: miss latency
+    const float* seg_llm_tokens;  // SEG_LLM: Poisson token mean
+    const float* seg_llm_tpt;     // SEG_LLM: seconds per token
+    const float* seg_llm_cost;    // SEG_LLM: cost units per token
     const float* endpoint_ram;  // [NS][NEP]
     const int32_t* exit_edge;
     const int32_t* exit_kind;
@@ -104,6 +108,7 @@ struct Request {
     double start = 0.0;
     double ram = 0.0;
     double wait_start = 0.0;  // ready-queue park time (dequeue deadlines)
+    double llm_cost = 0.0;    // accumulated io_llm cost units
     int32_t srv = -1;
     int32_t ep = 0;
     int32_t seg = 0;   // segment index; hop index during the entry chain
@@ -181,6 +186,7 @@ struct Sim {
 
     // outputs
     double* out_clock = nullptr;  // [max_requests][2]
+    double* out_llm = nullptr;    // [max_requests] per-completion cost
     int64_t clock_n = 0;
     int64_t clock_overflow = 0;  // completions past the clock capacity
     float* out_gauges = nullptr;  // [n_samples][NG] or nullptr
@@ -401,6 +407,15 @@ struct Sim {
             int64_t off = seg_off(r.srv, r.ep, r.seg);
             if (uniform() >= p.seg_hit_prob[off]) dur = p.seg_miss_dur[off];
             push(now + dur, EV_SEG_END, i);
+        } else if (kind == SEG_LLM) {
+            // io_llm call dynamics: tokens ~ Poisson(mean); the sleep
+            // stretches by tokens * s/token, cost accrues per token
+            ++sv.io_len;
+            int64_t off = seg_off(r.srv, r.ep, r.seg);
+            double tokens = (double)std::poisson_distribution<long>(
+                p.seg_llm_tokens[off])(rng);
+            r.llm_cost += tokens * p.seg_llm_cost[off];
+            push(now + dur + tokens * p.seg_llm_tpt[off], EV_SEG_END, i);
         } else if (kind == SEG_DB) {
             // hold one of K FIFO connections for the query; the wait (if
             // any) parks in the event loop and counts as io sleep
@@ -636,6 +651,7 @@ struct Sim {
         if (clock_n < p.max_requests) {
             out_clock[2 * clock_n] = r.start;
             out_clock[2 * clock_n + 1] = now;
+            if (out_llm) out_llm[clock_n] = r.llm_cost;
             ++clock_n;
         } else {
             ++clock_overflow;  // saturated run: surface, don't silently drop
@@ -709,10 +725,12 @@ int64_t afnative_run(
     uint64_t seed,
     double* out_clock,
     float* out_gauges,  // may be null
-    int64_t* out_counters
-    /* [generated, dropped, clock_n, clock_overflow, rejected] */) {
+    int64_t* out_counters,
+    /* [generated, dropped, clock_n, clock_overflow, rejected] */
+    double* out_llm  /* may be null: [max_requests] per-completion cost */) {
     Sim sim(*plan, seed);
     sim.out_clock = out_clock;
+    sim.out_llm = out_llm;
     sim.out_gauges = out_gauges;
     sim.run();
     out_counters[0] = sim.generated;

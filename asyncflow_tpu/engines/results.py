@@ -43,6 +43,10 @@ class SimulationResults:
     #: the OpenTelemetry-style span record of the reference's RequestState
     #: history (`/root/reference/src/asyncflow/runtime/rqs_state.py:12-41`).
     traces: dict[int, list[tuple[str, str, float]]] | None = None
+    #: optional (n_completed,) per-request LLM cost units aligned with
+    #: ``rqs_clock`` rows (io_llm steps with call dynamics; the
+    #: reference's reserved ``llm_cost`` event metric, activated).
+    llm_cost: np.ndarray | None = None
 
     @property
     def latencies(self) -> np.ndarray:
@@ -82,6 +86,11 @@ class SweepResults:
     #: horizon, so this scenario's results cover only part of the run (event
     #: engine only; always False on the fast path).
     truncated: np.ndarray | None = None
+    #: (S,) per-scenario totals of completed requests' LLM cost units (and
+    #: squared costs, for CIs) — io_llm call dynamics; None when the plan
+    #: has none.
+    llm_cost_sum: np.ndarray | None = None
+    llm_cost_sumsq: np.ndarray | None = None
     #: (S, T_g, k) per-scenario streaming gauge time series on the coarse
     #: resample grid (fast-path sweeps with a gauge_series spec; None
     #: otherwise).  Column j is the j-th selected gauge; the value at row i
@@ -121,6 +130,14 @@ class SweepResults:
             total_rejected=(
                 self.total_rejected[idx]
                 if self.total_rejected is not None
+                else None
+            ),
+            llm_cost_sum=(
+                self.llm_cost_sum[idx] if self.llm_cost_sum is not None else None
+            ),
+            llm_cost_sumsq=(
+                self.llm_cost_sumsq[idx]
+                if self.llm_cost_sumsq is not None
                 else None
             ),
         )

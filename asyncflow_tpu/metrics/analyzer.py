@@ -154,6 +154,23 @@ class ResultsAnalyzer:
         """All sampled time series: metric -> component id -> values."""
         return self._results.sampled
 
+    def get_llm_stats(self) -> dict[str, float] | None:
+        """Aggregated LLM cost statistics (the reference's reserved
+        ``llm_stats`` metric, activated): total / mean / p95 / max cost
+        per completed request and cost per simulated second.  None when
+        the scenario has no io_llm call dynamics."""
+        cost = self._results.llm_cost
+        if cost is None or cost.size == 0:
+            return None
+        horizon = float(self._results.settings.total_simulation_time)
+        return {
+            "total_cost": float(cost.sum()),
+            "mean_cost_per_request": float(cost.mean()),
+            "p95_cost_per_request": float(np.percentile(cost, 95)),
+            "max_cost_per_request": float(cost.max()),
+            "cost_per_second": float(cost.sum() / max(horizon, 1e-9)),
+        }
+
     def get_traces(self) -> dict[int, list[tuple[str, str, float]]]:
         """Per-request hop traces (requires an engine run with tracing on,
         ``engine_options={"collect_traces": True}`` — oracle or jax event

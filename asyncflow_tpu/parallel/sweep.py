@@ -262,6 +262,16 @@ class SweepReport:
                 int(res.truncated.sum()) if res.truncated is not None else 0
             ),
             "latency_mean_s": float(mean),
+            "llm_cost_total": (
+                float(res.llm_cost_sum.sum())
+                if res.llm_cost_sum is not None
+                else None
+            ),
+            "llm_cost_mean_per_request": (
+                float(res.llm_cost_sum.sum() / max(completed, 1))
+                if res.llm_cost_sum is not None
+                else None
+            ),
             "latency_p50_s": self.aggregate_percentile(50),
             "latency_p95_s": self.aggregate_percentile(95),
             "latency_p99_s": self.aggregate_percentile(99),
@@ -389,6 +399,7 @@ class SweepRunner:
             and not self.plan.has_rate_limit
             and not self.plan.has_queue_timeout
             and self.plan.breaker_threshold == 0
+            and not self.plan.has_llm
         ):
             from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
 
@@ -777,6 +788,9 @@ class _SweepCheckpoint:
             payload["gauge_series_period"] = np.float64(part.gauge_series_period)
         if part.total_rejected is not None:
             payload["total_rejected"] = part.total_rejected
+        if part.llm_cost_sum is not None:
+            payload["llm_cost_sum"] = part.llm_cost_sum
+            payload["llm_cost_sumsq"] = part.llm_cost_sumsq
         if part.truncated is not None:
             payload["truncated"] = part.truncated
         # atomic write so an interrupt never leaves a half-written chunk
@@ -803,6 +817,12 @@ class _SweepCheckpoint:
                 ),
                 total_rejected=(
                     data["total_rejected"] if "total_rejected" in data else None
+                ),
+                llm_cost_sum=(
+                    data["llm_cost_sum"] if "llm_cost_sum" in data else None
+                ),
+                llm_cost_sumsq=(
+                    data["llm_cost_sumsq"] if "llm_cost_sumsq" in data else None
                 ),
                 truncated=data["truncated"] if "truncated" in data else None,
                 **{name: data[name] for name in self._ARRAY_FIELDS},
@@ -998,6 +1018,16 @@ def _concat_sweeps(parts: list[SweepResults]) -> SweepResults:
             total_rejected=(
                 np.concatenate([p.total_rejected for p in parts])
                 if all(p.total_rejected is not None for p in parts)
+                else None
+            ),
+            llm_cost_sum=(
+                np.concatenate([p.llm_cost_sum for p in parts])
+                if all(p.llm_cost_sum is not None for p in parts)
+                else None
+            ),
+            llm_cost_sumsq=(
+                np.concatenate([p.llm_cost_sumsq for p in parts])
+                if all(p.llm_cost_sumsq is not None for p in parts)
                 else None
             ),
         )

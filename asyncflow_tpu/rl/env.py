@@ -127,7 +127,9 @@ class LoadBalancerEnv:
         eng = self._engine
         eng.lb_weights = dict(zip(self.edge_ids, action.tolist()))
 
+        prev_now = self._now
         self._now = min(self._now + self.decision_period_s, self.horizon)
+        window_s = self._now - prev_now
         eng.sim.run(until=self._now)
 
         # window deltas (consumed AFTER the observation is built from them)
@@ -150,7 +152,9 @@ class LoadBalancerEnv:
         if callable(self.reward):
             r = float(self.reward(info))
         elif self.reward == "throughput":
-            r = done_n / self.decision_period_s
+            # divide by the ACTUAL simulated window (the final one may be
+            # clamped short by the horizon)
+            r = done_n / max(window_s, 1e-9)
         else:  # neg_mean_latency; no completions = no evidence, 0 reward
             r = -float(np.mean(lats)) if lats else 0.0
         terminated = self._now >= self.horizon

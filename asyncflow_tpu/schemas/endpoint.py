@@ -43,6 +43,15 @@ class Step(BaseModel):
     step_operation: dict[StepOperation, PositiveFloat | PositiveInt]
     cache_hit_probability: float | None = None
     cache_miss_time: PositiveFloat | None = None
+    #: LLM call dynamics (activates the reference's reserved ``io_llm``
+    #: kind + ``llm_cost``/``llm_stats`` metrics): per request, output
+    #: tokens ~ Poisson(llm_tokens_mean); the sleep becomes
+    #: ``io_waiting_time`` (prefill/base) + tokens * llm_time_per_token
+    #: (decode), and the request accrues tokens * llm_cost_per_token in
+    #: cost units.  All three must be given together, only on io_llm.
+    llm_tokens_mean: PositiveFloat | None = None
+    llm_time_per_token: float | None = None
+    llm_cost_per_token: float | None = None
 
     @field_validator("step_operation", mode="before")
     @classmethod
@@ -90,7 +99,34 @@ class Step(BaseModel):
             raise ValueError(msg)
         return self
 
+    @model_validator(mode="after")
+    def _llm_fields_coherent(self) -> Step:
+        given = [
+            self.llm_tokens_mean,
+            self.llm_time_per_token,
+            self.llm_cost_per_token,
+        ]
+        if all(v is None for v in given):
+            return self
+        if any(v is None for v in given):
+            msg = (
+                "llm_tokens_mean, llm_time_per_token and llm_cost_per_token "
+                "must be given together"
+            )
+            raise ValueError(msg)
+        if self.kind != EndpointStepIO.LLM:
+            msg = "LLM dynamics are only valid on io_llm steps"
+            raise ValueError(msg)
+        if self.llm_time_per_token < 0 or self.llm_cost_per_token < 0:
+            msg = "llm_time_per_token and llm_cost_per_token must be >= 0"
+            raise ValueError(msg)
+        return self
+
     # -- typed accessors used by the compiler / engines --------------------
+
+    @property
+    def is_llm(self) -> bool:
+        return self.llm_tokens_mean is not None
 
     @property
     def is_stochastic_cache(self) -> bool:

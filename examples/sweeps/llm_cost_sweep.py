@@ -1,0 +1,74 @@
+"""LLM serving cost vs load (activates the reference's reserved io_llm
+kind and llm_cost/llm_stats metrics).
+
+An API tier fronting an LLM backend: each request's io_llm step draws
+Poisson output tokens (decode time + per-token cost).  One sweep maps the
+load axis to BOTH the latency curve and the spend rate — the
+capacity-AND-budget question LLM serving teams actually ask.
+
+Run:  python examples/sweeps/llm_cost_sweep.py [n_loads]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+import yaml
+
+from asyncflow_tpu.parallel import SweepRunner, make_overrides
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+MAX_USERS = 60.0
+HORIZON_S = 60
+BASE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "..", "yaml_input", "data", "single_server.yml",
+)
+
+
+def build_payload() -> SimulationPayload:
+    data = yaml.safe_load(open(BASE).read())
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    srv["server_resources"]["cpu_cores"] = 4
+    srv["endpoints"][0]["steps"] = [
+        {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.003}},
+        {
+            "kind": "io_llm",
+            "step_operation": {"io_waiting_time": 0.080},  # prefill/base
+            "llm_tokens_mean": 250,
+            "llm_time_per_token": 0.0008,  # decode
+            "llm_cost_per_token": 2e-05,   # cost units per output token
+        },
+    ]
+    data["rqs_input"]["avg_active_users"]["mean"] = MAX_USERS
+    data["sim_settings"]["total_simulation_time"] = HORIZON_S
+    return SimulationPayload.model_validate(data)
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    runner = SweepRunner(build_payload(), use_mesh=False)
+    scales = np.linspace(0.25, 1.0, n)
+    overrides = make_overrides(
+        runner.plan, n, user_mean=(MAX_USERS * scales).astype(np.float32),
+    )
+    rep = runner.run(n, seed=3, overrides=overrides)
+    res = rep.results
+    p95 = res.percentile(95) * 1e3
+    cost_rate = res.llm_cost_sum / HORIZON_S
+    cost_per_req = res.llm_cost_sum / np.maximum(res.completed, 1)
+    print(f"engine: {runner.engine_kind}")
+    for i, sc in enumerate(scales):
+        print(
+            f"load {sc * 100:5.1f}%: p95 {p95[i]:7.1f} ms   "
+            f"spend {cost_rate[i]:8.4f} cost/s   "
+            f"({cost_per_req[i]:.5f}/request)",
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,178 @@
+"""LLM call dynamics (the reference's reserved ``io_llm`` step kind and
+``llm_cost``/``llm_stats`` metric enums, activated).
+
+Semantics under test: an ``io_llm`` step with call dynamics draws output
+tokens ~ Poisson(llm_tokens_mean) per request, sleeps ``io_waiting_time``
++ tokens * llm_time_per_token, and accrues tokens * llm_cost_per_token in
+cost units.  Modeled by the oracle, native, and event engines; the fast
+path declines with a named reason.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import yaml
+from pydantic import ValidationError
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.compiler.plan import SEG_LLM
+from asyncflow_tpu.engines.jaxsim.engine import run_single
+from asyncflow_tpu.engines.oracle.engine import OracleEngine
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+pytestmark = pytest.mark.integration
+
+BASE = "tests/integration/data/single_server.yml"
+TOKENS, TPT, CPT, BASE_S = 200.0, 0.0005, 0.0001, 0.05
+SEEDS = 8
+
+
+def _payload(horizon: int = 60) -> SimulationPayload:
+    data = yaml.safe_load(open(BASE).read())
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    srv["endpoints"][0]["steps"] = [
+        {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.002}},
+        {
+            "kind": "io_llm",
+            "step_operation": {"io_waiting_time": BASE_S},
+            "llm_tokens_mean": TOKENS,
+            "llm_time_per_token": TPT,
+            "llm_cost_per_token": CPT,
+        },
+    ]
+    data["sim_settings"]["total_simulation_time"] = horizon
+    return SimulationPayload.model_validate(data)
+
+
+class TestSchema:
+    def test_fields_must_come_together(self) -> None:
+        data = yaml.safe_load(open(BASE).read())
+        data["topology_graph"]["nodes"]["servers"][0]["endpoints"][0][
+            "steps"
+        ].append(
+            {
+                "kind": "io_llm",
+                "step_operation": {"io_waiting_time": 0.01},
+                "llm_tokens_mean": 10,
+            },
+        )
+        with pytest.raises(ValidationError, match="together"):
+            SimulationPayload.model_validate(data)
+
+    def test_only_on_io_llm(self) -> None:
+        data = yaml.safe_load(open(BASE).read())
+        data["topology_graph"]["nodes"]["servers"][0]["endpoints"][0][
+            "steps"
+        ].append(
+            {
+                "kind": "io_wait",
+                "step_operation": {"io_waiting_time": 0.01},
+                "llm_tokens_mean": 10,
+                "llm_time_per_token": 0.001,
+                "llm_cost_per_token": 0.001,
+            },
+        )
+        with pytest.raises(ValidationError, match="io_llm"):
+            SimulationPayload.model_validate(data)
+
+    def test_plain_io_llm_unchanged(self) -> None:
+        data = yaml.safe_load(open(BASE).read())
+        data["topology_graph"]["nodes"]["servers"][0]["endpoints"][0][
+            "steps"
+        ].append(
+            {"kind": "io_llm", "step_operation": {"io_waiting_time": 0.005}},
+        )
+        plan = compile_payload(SimulationPayload.model_validate(data))
+        assert not plan.has_llm
+        assert plan.fastpath_ok, plan.fastpath_reason  # merges into IO
+
+
+def test_compiler_lowering_and_fallback() -> None:
+    plan = compile_payload(_payload())
+    assert plan.has_llm
+    k = int(np.argmax(plan.seg_kind[0, 0] == SEG_LLM))
+    assert plan.seg_llm_tokens[0, 0, k] == pytest.approx(TOKENS)
+    assert plan.seg_llm_tpt[0, 0, k] == pytest.approx(TPT)
+    assert plan.seg_llm_cost[0, 0, k] == pytest.approx(CPT)
+    assert not plan.fastpath_ok
+    assert "LLM" in plan.fastpath_reason
+
+    from asyncflow_tpu.parallel import SweepRunner
+
+    assert SweepRunner(_payload(), use_mesh=False).engine_kind == "event"
+
+
+def test_three_engine_parity_and_cost_calibration() -> None:
+    """Cost per request must calibrate to tokens_mean * cost_per_token on
+    every engine (a per-request Poisson mean), latency to base + mean
+    decode time; cross-engine means within ensemble noise."""
+    payload = _payload()
+    plan = compile_payload(payload)
+    expected_cost = TOKENS * CPT
+
+    def stats(costs, lats):
+        return float(np.mean(costs)), float(np.mean(lats))
+
+    co, lo = [], []
+    for s in range(SEEDS):
+        r = OracleEngine(payload, seed=s).run()
+        co.append(r.llm_cost)
+        lo.append(r.latencies)
+    cost_o, lat_o = stats(np.concatenate(co), np.concatenate(lo))
+    assert cost_o == pytest.approx(expected_cost, rel=0.02)
+
+    ce, le = [], []
+    for s in range(SEEDS):
+        r = run_single(payload, seed=s, engine="event")
+        ce.append(r.llm_cost)
+        le.append(r.latencies)
+    cost_e, lat_e = stats(np.concatenate(ce), np.concatenate(le))
+    assert cost_e == pytest.approx(expected_cost, rel=0.02)
+    assert lat_e == pytest.approx(lat_o, rel=0.03)
+
+    from asyncflow_tpu.engines.oracle.native import native_available, run_native
+
+    if native_available():
+        cn, ln = [], []
+        for s in range(SEEDS):
+            r = run_native(plan, seed=s, collect_gauges=False)
+            cn.append(r.llm_cost)
+            ln.append(r.latencies)
+        cost_n, lat_n = stats(np.concatenate(cn), np.concatenate(ln))
+        assert cost_n == pytest.approx(expected_cost, rel=0.02)
+        assert lat_n == pytest.approx(lat_o, rel=0.03)
+
+
+def test_llm_stats_accessor_and_sweep_summary() -> None:
+    from asyncflow_tpu.metrics.analyzer import ResultsAnalyzer
+    from asyncflow_tpu.parallel import SweepRunner
+
+    res = OracleEngine(_payload(), seed=2).run()
+    stats = ResultsAnalyzer(res).get_llm_stats()
+    assert stats is not None
+    assert stats["mean_cost_per_request"] == pytest.approx(
+        TOKENS * CPT, rel=0.05,
+    )
+    assert stats["total_cost"] > 0
+    # scenarios without llm dynamics report None, not zeros
+    plain = yaml.safe_load(open(BASE).read())
+    res2 = OracleEngine(
+        SimulationPayload.model_validate(plain), seed=2,
+    ).run()
+    assert ResultsAnalyzer(res2).get_llm_stats() is None
+
+    runner = SweepRunner(_payload(), use_mesh=False)
+    rep = runner.run(4, seed=5, chunk_size=4)
+    s = rep.summary()
+    assert s["llm_cost_total"] > 0
+    assert s["llm_cost_mean_per_request"] == pytest.approx(
+        TOKENS * CPT, rel=0.05,
+    )
+
+
+def test_pallas_declines_llm_plans() -> None:
+    from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
+
+    with pytest.raises(ValueError, match="LLM"):
+        PallasEngine(compile_payload(_payload()))
