@@ -372,6 +372,15 @@ class StaticPlan:
                 setattr(self, name, np.full(self.n_servers, -1.0, np.float32))
         if not self.server_rate_burst.size:
             self.server_rate_burst = np.zeros(self.n_servers, np.int32)
+        if not self.endpoint_cum.size and self.n_endpoints.size:
+            # uniform selection table for hand-built plans, at the SAME
+            # row stride as every other per-endpoint array (the native
+            # core indexes rows by max_endpoints)
+            cum = np.ones((self.n_servers, max(self.max_endpoints, 1)), np.float32)
+            for s in range(self.n_servers):
+                k = max(int(self.n_endpoints[s]), 1)
+                cum[s, :k] = (np.arange(1, k + 1) / k).astype(np.float32)
+            self.endpoint_cum = cum
 
     @property
     def has_queue_cap(self) -> bool:
@@ -440,6 +449,11 @@ class StaticPlan:
         default_factory=lambda: np.empty((0, 0, 0), np.float32),
     )
 
+    #: (NS, NEP) f32 cumulative endpoint-selection probabilities (uniform
+    #: when every selection_weight is the default; padded columns = 1).
+    endpoint_cum: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0), np.float32),
+    )
     #: (NS, NEP, NSEG+1) f32 SEG_LLM call dynamics: Poisson output-token
     #: mean, decode seconds per token, and cost units per token.
     seg_llm_tokens: np.ndarray = field(
@@ -451,6 +465,19 @@ class StaticPlan:
     seg_llm_cost: np.ndarray = field(
         default_factory=lambda: np.empty((0, 0, 0), np.float32),
     )
+
+    @property
+    def has_weighted_endpoints(self) -> bool:
+        """True when any server's selection weights deviate from uniform."""
+        if not self.endpoint_cum.size:
+            return False
+        for s in range(self.n_servers):
+            k = int(self.n_endpoints[s])
+            if k > 1:
+                uniform = np.arange(1, k + 1, dtype=np.float64) / k
+                if not np.allclose(self.endpoint_cum[s, :k], uniform, atol=1e-6):
+                    return True
+        return False
 
     @property
     def has_llm(self) -> bool:
@@ -1029,6 +1056,17 @@ def compile_payload(
         (n_servers, max_endpoints, max_segments + 1), dtype=np.float32,
     )
     endpoint_ram = np.zeros((n_servers, max_endpoints), dtype=np.float32)
+    # cumulative endpoint-selection probabilities (selection_weight; the
+    # uniform default lowers to the same evenly-spaced table the
+    # reference's uniform pick implies).  Padded columns carry 1.0 so a
+    # searchsorted draw never lands on them.
+    endpoint_cum = np.ones((n_servers, max_endpoints), dtype=np.float32)
+    for s_i, server in enumerate(servers):
+        w = np.array(
+            [float(ep.selection_weight) for ep in server.endpoints],
+            dtype=np.float64,
+        )
+        endpoint_cum[s_i, : len(w)] = np.cumsum(w / w.sum())
     n_endpoints = np.zeros(n_servers, dtype=np.int32)
     bursts = [
         [_burst_decomposition(segs) for segs, *_ in per_server]
@@ -1246,6 +1284,7 @@ def compile_payload(
         seg_kind=seg_kind,
         seg_dur=seg_dur,
         endpoint_ram=endpoint_ram,
+        endpoint_cum=endpoint_cum,
         max_bursts=max_bursts,
         n_bursts=n_bursts,
         burst_dur=burst_dur,

@@ -1001,8 +1001,12 @@ class Engine:
 
         st = self._hop(st, i, self.HOP_SERVER + s, now, pred)
         u = jax.random.uniform(jax.random.fold_in(key, 16))
+        # weighted endpoint pick (uniform weights lower to the evenly
+        # spaced cumulative table, preserving the reference's behavior)
         ep = jnp.minimum(
-            (u * p.n_endpoints[s]).astype(jnp.int32),
+            jnp.searchsorted(p.endpoint_cum[s], u, side="right").astype(
+                jnp.int32,
+            ),
             p.n_endpoints[s] - 1,
         )
         st = st._replace(

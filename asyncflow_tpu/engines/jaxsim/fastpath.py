@@ -666,6 +666,7 @@ class FastEngine:
         burst_dur_t = jnp.asarray(plan.burst_dur)
         burst_pre_t = jnp.asarray(plan.burst_pre_io)
         post_io_t = jnp.asarray(plan.endpoint_post_io)
+        endpoint_cum_t = jnp.asarray(plan.endpoint_cum)
 
         # ONE shared arrival-order sort for every entry-tier server whose
         # core-queue order provably equals arrival order (profiling showed
@@ -683,7 +684,12 @@ class FastEngine:
             mine = alive & (srv == s) & (t < plan.horizon)
             nep = int(plan.n_endpoints[s])
             u = jax.random.uniform(jax.random.fold_in(key, 64 + s), (n,))
-            ep = jnp.minimum((u * nep).astype(jnp.int32), nep - 1)
+            ep = jnp.minimum(
+                jnp.searchsorted(endpoint_cum_t[s], u, side="right").astype(
+                    jnp.int32,
+                ),
+                nep - 1,
+            )
             ram = jnp.asarray(plan.endpoint_ram)[s, ep]
             post = post_io_t[s, ep]
             n_cores = int(plan.server_cores[s])

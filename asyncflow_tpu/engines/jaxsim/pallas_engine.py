@@ -316,6 +316,7 @@ class PallasEngine:
             or plan.has_queue_timeout
             or plan.breaker_threshold > 0
             or plan.has_llm
+            or plan.has_weighted_endpoints
         ):
             # the VMEM kernel has no DB-pool FIFO machinery, no cache
             # mixture draws, and no shed/refusal/limiter/deadline/breaker
@@ -323,7 +324,8 @@ class PallasEngine:
             # engine
             msg = (
                 "the Pallas kernel does not model binding DB connection "
-                "pools, stochastic cache steps, LLM call dynamics, or "
+                "pools, stochastic cache steps, LLM call dynamics, "
+                "weighted endpoint selection, or "
                 "reachable overload policies (caps, capacities, rate "
                 "limits, deadlines, circuit breakers); use the event engine"
             )

@@ -49,6 +49,7 @@ class PlanParams(NamedTuple):
     seg_llm_tpt: jnp.ndarray  # SEG_LLM decode seconds per token
     seg_llm_cost: jnp.ndarray  # SEG_LLM cost units per token
     endpoint_ram: jnp.ndarray
+    endpoint_cum: jnp.ndarray  # (NS, NEP) cumulative selection probs
     exit_edge: jnp.ndarray
     exit_kind: jnp.ndarray
     exit_target: jnp.ndarray
@@ -88,6 +89,7 @@ def params_from_plan(plan: StaticPlan) -> PlanParams:
         seg_llm_tpt=jnp.asarray(plan.seg_llm_tpt),
         seg_llm_cost=jnp.asarray(plan.seg_llm_cost),
         endpoint_ram=jnp.asarray(plan.endpoint_ram),
+        endpoint_cum=jnp.asarray(plan.endpoint_cum),
         exit_edge=jnp.asarray(plan.exit_edge),
         exit_kind=jnp.asarray(plan.exit_kind),
         exit_target=jnp.asarray(plan.exit_target),

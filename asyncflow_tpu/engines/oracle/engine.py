@@ -171,6 +171,9 @@ class _ServerRuntime:
         self.ready_queue_len = 0
         self.io_queue_len = 0
         self.ram_in_use = 0.0
+        # cumulative endpoint-selection probabilities (selection_weight)
+        w = np.array([float(ep.selection_weight) for ep in cfg.endpoints])
+        self.endpoint_cum = np.cumsum(w / w.sum())
         self.out_edge: _EdgeRuntime | None = None
         self.series: dict[SampledMetricName, list[float]] = {
             SampledMetricName.READY_QUEUE_LEN: [],
@@ -222,7 +225,12 @@ class _ServerRuntime:
         req.record_hop(SystemNodes.SERVER, self.cfg.id, engine.sim.now)
 
         endpoints = self.cfg.endpoints
-        endpoint = endpoints[int(engine.rng.integers(0, len(endpoints)))]
+        endpoint = endpoints[
+            min(
+                int(np.searchsorted(self.endpoint_cum, engine.rng.uniform())),
+                len(endpoints) - 1,
+            )
+        ]
         total_ram = sum(step.quantity for step in endpoint.steps if step.is_ram)
 
         if total_ram:

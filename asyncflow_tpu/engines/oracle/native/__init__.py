@@ -56,6 +56,7 @@ class _PlanC(ctypes.Structure):
         ("seg_llm_tpt", _f32p),
         ("seg_llm_cost", _f32p),
         ("endpoint_ram", _f32p),
+        ("endpoint_cum", _f32p),
         ("exit_edge", _i32p),
         ("exit_kind", _i32p),
         ("exit_target", _i32p),
@@ -214,6 +215,7 @@ def run_native(
         seg_llm_tpt=f32(plan.seg_llm_tpt),
         seg_llm_cost=f32(plan.seg_llm_cost),
         endpoint_ram=f32(plan.endpoint_ram),
+        endpoint_cum=f32(plan.endpoint_cum),
         exit_edge=i32(plan.exit_edge),
         exit_kind=i32(plan.exit_kind),
         exit_target=i32(plan.exit_target),

@@ -71,6 +71,7 @@ struct PlanC {
     const float* seg_llm_tpt;     // SEG_LLM: seconds per token
     const float* seg_llm_cost;    // SEG_LLM: cost units per token
     const float* endpoint_ram;  // [NS][NEP]
+    const float* endpoint_cum;  // [NS][NEP] cumulative selection probs
     const int32_t* exit_edge;
     const int32_t* exit_kind;
     const int32_t* exit_target;
@@ -608,7 +609,15 @@ struct Sim {
         }
         ++sv.residents;
         int nep = p.n_endpoints[r.srv];
-        r.ep = (int32_t)std::min<long>((long)(uniform() * nep), nep - 1);
+        {
+            // weighted endpoint pick (uniform weights -> even table)
+            double u = uniform();
+            const float* cum = p.endpoint_cum
+                + (int64_t)r.srv * p.max_endpoints;
+            int e = 0;
+            while (e < nep - 1 && u >= cum[e]) ++e;
+            r.ep = e;
+        }
         r.seg = 0;
         double need = p.endpoint_ram[(int64_t)r.srv * p.max_endpoints + r.ep];
         r.ram = need;

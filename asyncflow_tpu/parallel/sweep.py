@@ -400,6 +400,7 @@ class SweepRunner:
             and not self.plan.has_queue_timeout
             and self.plan.breaker_threshold == 0
             and not self.plan.has_llm
+            and not self.plan.has_weighted_endpoints
         ):
             from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
 

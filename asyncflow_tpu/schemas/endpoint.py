@@ -151,10 +151,18 @@ class Step(BaseModel):
 
 
 class Endpoint(BaseModel):
-    """A named sequence of steps exposed by a server."""
+    """A named sequence of steps exposed by a server.
+
+    ``selection_weight`` (beyond the reference, whose servers pick
+    endpoints uniformly): relative probability of a request hitting this
+    endpoint — traffic splits proportionally to the weights within a
+    server.  The default (1.0 everywhere) reproduces the reference's
+    uniform pick exactly.
+    """
 
     endpoint_name: str
     steps: list[Step]
+    selection_weight: PositiveFloat = 1.0
 
     @field_validator("endpoint_name", mode="before")
     @classmethod

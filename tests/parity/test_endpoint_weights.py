@@ -1,0 +1,101 @@
+"""Per-endpoint selection weights (beyond the reference, whose servers
+pick endpoints uniformly): traffic splits proportionally to
+``Endpoint.selection_weight`` on every engine; the default reproduces
+the uniform pick.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import yaml
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.engines.jaxsim.engine import run_single
+from asyncflow_tpu.engines.oracle.engine import OracleEngine
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+pytestmark = pytest.mark.integration
+
+BASE = "tests/integration/data/single_server.yml"
+
+
+def _payload(weights=(3.0, 1.0), horizon: int = 60) -> SimulationPayload:
+    data = yaml.safe_load(open(BASE).read())
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    # two endpoints with distinguishable latencies: fast (5 ms io) and
+    # slow (50 ms io); the observed latency mixture reveals the split
+    srv["endpoints"] = [
+        {
+            "endpoint_name": "/fast",
+            "selection_weight": weights[0],
+            "steps": [
+                {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.005}},
+            ],
+        },
+        {
+            "endpoint_name": "/slow",
+            "selection_weight": weights[1],
+            "steps": [
+                {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.050}},
+            ],
+        },
+    ]
+    data["sim_settings"]["total_simulation_time"] = horizon
+    return SimulationPayload.model_validate(data)
+
+
+def _slow_fraction(lat: np.ndarray) -> float:
+    return float(np.mean(lat > 0.030))
+
+
+def test_compiler_table_and_default_uniform() -> None:
+    plan = compile_payload(_payload((3.0, 1.0)))
+    assert plan.has_weighted_endpoints
+    assert plan.endpoint_cum[0, 0] == pytest.approx(0.75)
+    assert plan.endpoint_cum[0, 1] == pytest.approx(1.0)
+    # fast path keeps weighted plans (the pick is one searchsorted draw)
+    assert plan.fastpath_ok, plan.fastpath_reason
+
+    uniform = compile_payload(_payload((1.0, 1.0)))
+    assert not uniform.has_weighted_endpoints
+
+
+def test_split_on_every_engine() -> None:
+    payload = _payload((3.0, 1.0))
+    plan = compile_payload(payload)
+    n = 6
+    expected = 0.25  # slow endpoint weight share
+
+    lat_o = np.concatenate(
+        [OracleEngine(payload, seed=s).run().latencies for s in range(n)],
+    )
+    assert _slow_fraction(lat_o) == pytest.approx(expected, abs=0.02)
+
+    lat_e = np.concatenate(
+        [run_single(payload, seed=s, engine="event").latencies for s in range(n)],
+    )
+    assert _slow_fraction(lat_e) == pytest.approx(expected, abs=0.02)
+
+    lat_f = np.concatenate(
+        [run_single(payload, seed=s, engine="fast").latencies for s in range(n)],
+    )
+    assert _slow_fraction(lat_f) == pytest.approx(expected, abs=0.02)
+
+    from asyncflow_tpu.engines.oracle.native import native_available, run_native
+
+    if native_available():
+        lat_n = np.concatenate(
+            [
+                run_native(plan, seed=s, collect_gauges=False).latencies
+                for s in range(n)
+            ],
+        )
+        assert _slow_fraction(lat_n) == pytest.approx(expected, abs=0.02)
+
+
+def test_pallas_declines_weighted_plans() -> None:
+    from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
+
+    with pytest.raises(ValueError, match="weighted endpoint"):
+        PallasEngine(compile_payload(_payload((3.0, 1.0))))
