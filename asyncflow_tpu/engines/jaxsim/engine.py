@@ -51,6 +51,7 @@ from asyncflow_tpu.compiler.plan import (
 )
 from asyncflow_tpu.config.constants import SampledMetricName
 from asyncflow_tpu.engines.jaxsim.sampling import (
+    as_threefry,
     D_EXPONENTIAL as _D_EXPONENTIAL,
     D_LOGNORMAL as _D_LOGNORMAL,
     D_NORMAL as _D_NORMAL,
@@ -222,7 +223,7 @@ class Engine:
                 delay = jnp.where(dist == _D_LOGNORMAL, lognormal(mean, var, z), delay)
         if _D_POISSON in self._dists_present:
             pois = jax.random.poisson(
-                jax.random.fold_in(key, 3),
+                as_threefry(jax.random.fold_in(key, 3)),
                 jnp.maximum(mean, _TINY),
             ).astype(jnp.float32)
             delay = jnp.where(dist == _D_POISSON, pois, delay)
@@ -298,7 +299,7 @@ class Engine:
             need_window = smp_now >= window_end
             if poisson_users:
                 users = jax.random.poisson(
-                    jax.random.fold_in(kd, 0),
+                    as_threefry(jax.random.fold_in(kd, 0)),
                     jnp.maximum(ov.user_mean, _TINY),
                 ).astype(jnp.float32)
             else:
@@ -506,7 +507,7 @@ class Engine:
             is_llm = pred & (kind == SEG_LLM)
             lam = p.seg_llm_tokens[s, ep, seg]
             tokens = jax.random.poisson(
-                jax.random.fold_in(key, 25), jnp.maximum(lam, 1e-6),
+                as_threefry(jax.random.fold_in(key, 25)), jnp.maximum(lam, 1e-6),
             ).astype(jnp.float32)
             dur = jnp.where(is_llm, dur + tokens * p.seg_llm_tpt[s, ep, seg], dur)
             st = st._replace(

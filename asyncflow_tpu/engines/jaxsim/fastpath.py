@@ -85,7 +85,9 @@ from asyncflow_tpu.engines.jaxsim.rotation import (
     rotation_insert,
     rotation_remove,
 )
+from asyncflow_tpu.engines.jaxsim.sortutil import time_rank
 from asyncflow_tpu.engines.jaxsim.sampling import (
+    as_threefry as _as_threefry,
     D_EXPONENTIAL as _D_EXPONENTIAL,
     D_LOGNORMAL as _D_LOGNORMAL,
     D_NORMAL as _D_NORMAL,
@@ -371,7 +373,7 @@ class FastEngine:
 
         if plan.user_var < 0:
             users = jax.random.poisson(
-                jax.random.fold_in(key, 1),
+                _as_threefry(jax.random.fold_in(key, 1)),
                 jnp.maximum(ov.user_mean, _TINY),
                 (nw,),
             ).astype(jnp.float32)
@@ -381,7 +383,7 @@ class FastEngine:
         lam = users * ov.req_rate
 
         counts = jax.random.poisson(
-            jax.random.fold_in(key, 2),
+            _as_threefry(jax.random.fold_in(key, 2)),
             jnp.maximum(lam * lens, _TINY),
         ).astype(jnp.int32)
         counts = jnp.where(lam > 0, counts, 0)
@@ -472,14 +474,18 @@ class FastEngine:
             rot = rotation_advance(rot, length, ok & ~empty, el)
             return (rot, length, ptr), picked
 
-        order = jnp.argsort(jnp.where(alive, t, INF))
+        n = t.shape[0]
+        rank = time_rank(t, alive)
         init = (jnp.arange(el, dtype=jnp.int32), jnp.int32(el), jnp.int32(0))
         _, picked_sorted = jax.lax.scan(
             step,
             init,
-            (jnp.where(alive, t, INF)[order], alive[order]),
+            (
+                jnp.full(n, INF).at[rank].set(jnp.where(alive, t, INF)),
+                jnp.zeros(n, bool).at[rank].set(alive),
+            ),
         )
-        picked = jnp.zeros(t.shape[0], jnp.int32).at[order].set(picked_sorted)
+        picked = picked_sorted[rank]
         return picked, picked >= 0
 
     def _routed_slots_lc(self, t, alive, drop_s, delay_s):
@@ -525,7 +531,8 @@ class FastEngine:
             rings = rings.at[row, j].set(new_val)
             return (rot, length, ptr, rings), picked
 
-        order = jnp.argsort(jnp.where(alive, t, INF))
+        n = t.shape[0]
+        rank = time_rank(t, alive)
         init = (
             jnp.arange(el, dtype=jnp.int32),
             jnp.int32(el),
@@ -536,13 +543,13 @@ class FastEngine:
             step,
             init,
             (
-                jnp.where(alive, t, INF)[order],
-                alive[order],
-                drop_s[order],
-                deliver[order],
+                jnp.full(n, INF).at[rank].set(jnp.where(alive, t, INF)),
+                jnp.zeros(n, bool).at[rank].set(alive),
+                jnp.zeros((n, el), bool).at[rank].set(drop_s),
+                jnp.full((n, el), -INF).at[rank].set(deliver),
             ),
         )
-        picked = jnp.zeros(t.shape[0], jnp.int32).at[order].set(picked_sorted)
+        picked = picked_sorted[rank]
         return picked, picked >= 0
 
     # ------------------------------------------------------------------
@@ -629,10 +636,10 @@ class FastEngine:
                 alive = alive & routed
                 slot = jnp.where(alive, slot, 0)
             elif len(plan.timeline_times) == 0:
-                # fixed membership: round robin is a pure function of rank
-                order = jnp.argsort(jnp.where(alive, t, INF))
-                rank_sorted = jnp.cumsum(alive[order].astype(jnp.int32)) - 1
-                rank = jnp.zeros(n, jnp.int32).at[order].set(rank_sorted)
+                # fixed membership: round robin is a pure function of rank.
+                # Dead lanes rank after every alive lane (sortutil), so the
+                # stable rank IS the rank-among-alive wherever alive.
+                rank = time_rank(t, alive)
                 slot = jnp.where(alive, rank % plan.n_lb_edges, 0)
             else:
                 # outages mutate the rotation: scan LB arrivals in time
@@ -675,8 +682,8 @@ class FastEngine:
         # request's t is final from routing until its own server processes
         # it, so the permutation's restriction to any one entry-tier
         # server's requests is its arrival order.
-        shared_order = (
-            jnp.argsort(jnp.where(alive, t, INF))
+        shared_rank = (
+            time_rank(t, alive)
             if any(self._shares_entry_sort(s) for s in plan.server_topo_order)
             else None
         )
@@ -741,19 +748,18 @@ class FastEngine:
                 pre0 = jnp.where(nb >= 1, burst_pre_t[s, ep][:, 0], 0.0)
                 dur0 = jnp.where(nb >= 1, burst_dur_t[s, ep][:, 0], 0.0)
                 arr = jnp.where(mine, t, INF)
-                order = jnp.argsort(arr)
+                rank_r = time_rank(arr, mine)
                 w_ram_s, w_cpu_s, _dep = _ram_core_scan(
-                    arr[order],
-                    pre0[order],
-                    jnp.where(mine, dur0, 0.0)[order],
-                    post[order],
-                    mine[order],
+                    jnp.full(n, INF).at[rank_r].set(arr),
+                    jnp.zeros(n).at[rank_r].set(pre0),
+                    jnp.zeros(n).at[rank_r].set(jnp.where(mine, dur0, 0.0)),
+                    jnp.zeros(n).at[rank_r].set(post),
+                    jnp.zeros(n, bool).at[rank_r].set(mine),
                     ram_k,
                     n_cores,
                 )
-                inv = jnp.zeros(n)
-                W_ram = inv.at[order].set(w_ram_s)
-                w_cpu = inv.at[order].set(w_cpu_s)
+                W_ram = w_ram_s[rank_r]
+                w_cpu = w_cpu_s[rank_r]
                 W_ram = jnp.where(mine, W_ram, 0.0)
                 w_cpu = jnp.where(mine & (dur0 > 0), w_cpu, 0.0)
                 E = (t + W_ram + pre0)[:, None]
@@ -780,7 +786,7 @@ class FastEngine:
                     pre = pre + jnp.where(validb, pre_extra, 0.0)
                 pre_cum = jnp.cumsum(pre, axis=1)
 
-                use_shared = shared_order is not None and self._shares_entry_sort(s)
+                use_shared = shared_rank is not None and self._shares_entry_sort(s)
 
                 def queue_waits(waits):
                     """One relaxation sweep of the core queue: enqueue times
@@ -792,18 +798,21 @@ class FastEngine:
                     flat_d = dur.reshape(-1)
                     flat_v = validb.reshape(-1)
                     # entry-tier single-burst servers reuse the shared
-                    # arrival sort (kb == 1, so the flat stream IS the
-                    # request axis); masked lanes interleave harmlessly
-                    order = shared_order if use_shared else jnp.argsort(flat_e)
+                    # arrival rank (kb == 1, so the flat stream IS the
+                    # request axis); masked lanes interleave harmlessly.
+                    # Sorting = scatter by rank, un-sorting = gather by rank
+                    # (sortutil.time_rank is the argsort's inverse).
+                    rank = (
+                        shared_rank if use_shared else time_rank(flat_e, flat_v)
+                    )
+                    e_s = jnp.full(n * kb, INF).at[rank].set(flat_e)
+                    d_s = jnp.zeros(n * kb).at[rank].set(flat_d)
+                    v_s = jnp.zeros(n * kb, bool).at[rank].set(flat_v)
                     if n_cores == 1:
-                        w_s = _lindley_waits(
-                            flat_e[order], flat_d[order], flat_v[order],
-                        )
+                        w_s = _lindley_waits(e_s, d_s, v_s)
                     else:
-                        w_s = _kw_waits(
-                            flat_e[order], flat_d[order], flat_v[order], n_cores,
-                        )
-                    new = jnp.zeros(n * kb).at[order].set(w_s).reshape(n, kb)
+                        w_s = _kw_waits(e_s, d_s, v_s, n_cores)
+                    new = w_s[rank].reshape(n, kb)
                     return jnp.where(validb & (dur > 0), new, 0.0)
 
                 # Visit k's enqueue time depends on earlier visits' waits, so
@@ -819,12 +828,15 @@ class FastEngine:
                     e1 = jnp.where(first, t[:, None] + pre_cum, INF).reshape(-1)
                     d1 = jnp.where(first, dur, 0.0).reshape(-1)
                     v1 = first.reshape(-1)
-                    o1 = jnp.argsort(e1)
+                    r1 = time_rank(e1, v1)
+                    e1_s = jnp.full(n * kb, INF).at[r1].set(e1)
+                    d1_s = jnp.zeros(n * kb).at[r1].set(d1)
+                    v1_s = jnp.zeros(n * kb, bool).at[r1].set(v1)
                     if n_cores == 1:
-                        w1 = _lindley_waits(e1[o1], d1[o1], v1[o1])
+                        w1 = _lindley_waits(e1_s, d1_s, v1_s)
                     else:
-                        w1 = _kw_waits(e1[o1], d1[o1], v1[o1], n_cores)
-                    W = jnp.zeros(n * kb).at[o1].set(w1).reshape(n, kb)
+                        w1 = _kw_waits(e1_s, d1_s, v1_s, n_cores)
+                    W = w1[r1].reshape(n, kb)
                     W = jnp.where(first & (dur > 0), W, 0.0)
                 n_sweeps = (
                     self.relax_sweeps
@@ -890,19 +902,15 @@ class FastEngine:
                 db_pre_r = jnp.asarray(plan.fp_db_pre)[s, ep] + trail_extra
                 use_db = mine & (db_dur_r > 0)
                 enq_db = jnp.where(use_db, trail_start + db_pre_r, INF)
-                order_db = jnp.argsort(enq_db)
+                rank_db = time_rank(enq_db, use_db)
+                e_db = jnp.full(n, INF).at[rank_db].set(enq_db)
+                d_db = jnp.zeros(n).at[rank_db].set(db_dur_r)
+                v_db = jnp.zeros(n, bool).at[rank_db].set(use_db)
                 if pool_k == 1:
-                    w_s = _lindley_waits(
-                        enq_db[order_db], db_dur_r[order_db], use_db[order_db],
-                    )
+                    w_s = _lindley_waits(e_db, d_db, v_db)
                 else:
-                    w_s = _kw_waits(
-                        enq_db[order_db],
-                        db_dur_r[order_db],
-                        use_db[order_db],
-                        pool_k,
-                    )
-                w_db = jnp.zeros(n).at[order_db].set(w_s)
+                    w_s = _kw_waits(e_db, d_db, v_db, pool_k)
+                w_db = w_s[rank_db]
                 dep = dep + jnp.where(use_db, w_db, 0.0)
 
             # trailing IO sleep (including any DB pool wait: the reference

@@ -1,0 +1,103 @@
+// XLA:CPU FFI kernel: stable argsort + rank of f32 keys, adaptive.
+//
+// The scan fast path needs, per scenario, the stable sort permutation of
+// ~88k arrival timestamps (see sortutil.py).  XLA's tuple-sort comparator
+// costs ~15 ms per lane on one CPU core; the timestamps are NEAR-SORTED
+// (sorted base + small iid edge-latency jitter), where an adaptive sort is
+// O(n + inversions) ~ 1 ms.  This kernel is the CPU escape hatch, plugged
+// in under jax.lax.platform_dependent (TPU keeps the pure-XLA path).
+//
+// Algorithm: binary-insertion-free plain insertion sort with a move
+// budget (stable, cost n + #inversions); on budget overrun (adversarial /
+// far-from-sorted input) falls back to std::stable_sort.  Equal keys keep
+// index order in both paths, matching jnp.argsort's stability; +inf
+// padding lanes therefore land at the tail in lane order.
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 -I<jax.ffi.include_dir()>.
+// Replaces the reference's per-event heap ordering
+// (/root/reference/src/asyncflow/runtime/simulation_runner.py:369) with a
+// whole-array pass.
+
+#include <algorithm>
+#include <cstdint>
+#include <limits>
+#include <numeric>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+namespace {
+
+// Stable adaptive argsort of the index prefix ob[0..m) (keys via kb).
+// Returns false when the move budget is exhausted (caller falls back to
+// std::stable_sort).
+bool InsertionArgsort(const float* kb, int32_t* ob, int64_t m,
+                      int64_t budget) {
+  int64_t moves = 0;
+  for (int64_t i = 1; i < m; ++i) {
+    const int32_t idx = ob[i];
+    const float kv = kb[idx];
+    int64_t j = i;
+    while (j > 0 && kb[ob[j - 1]] > kv) {
+      ob[j] = ob[j - 1];
+      --j;
+      if (++moves > budget) return false;
+    }
+    ob[j] = idx;
+  }
+  return true;
+}
+
+ffi::Error StableArgsortRankImpl(ffi::Buffer<ffi::F32> keys,
+                                 ffi::ResultBuffer<ffi::S32> order,
+                                 ffi::ResultBuffer<ffi::S32> rank) {
+  const auto dims = keys.dimensions();
+  if (dims.size() == 0) {
+    return ffi::Error::InvalidArgument("keys must have at least one dim");
+  }
+  const int64_t n = dims.back();
+  const int64_t batch = n == 0 ? 0 : keys.element_count() / n;
+  const float* k = keys.typed_data();
+  int32_t* o = order->typed_data();
+  int32_t* r = rank->typed_data();
+  const float kInf = std::numeric_limits<float>::infinity();
+  for (int64_t b = 0; b < batch; ++b) {
+    const float* kb = k + b * n;
+    int32_t* ob = o + b * n;
+    // Stable partition: finite keys first (the +inf padding/drop lanes
+    // would each travel to the tail and blow the insertion budget; a
+    // stable sort sends every +inf/NaN tie to the back in lane order, so
+    // emit that block directly).
+    int64_t m = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      if (kb[i] < kInf) ob[m++] = static_cast<int32_t>(i);
+    }
+    int64_t d = m;
+    for (int64_t i = 0; i < n; ++i) {
+      if (!(kb[i] < kInf)) ob[d++] = static_cast<int32_t>(i);
+    }
+    if (!InsertionArgsort(kb, ob, m, /*budget=*/8 * n)) {
+      // The bailed insertion pass left ob permuted; stability is relative
+      // to the array order, so restore lane order before the real sort.
+      int64_t w = 0;
+      for (int64_t i = 0; i < n; ++i) {
+        if (kb[i] < kInf) ob[w++] = static_cast<int32_t>(i);
+      }
+      std::stable_sort(ob, ob + m, [kb](int32_t a, int32_t c) {
+        return kb[a] < kb[c];
+      });
+    }
+    int32_t* rb = r + b * n;
+    for (int64_t j = 0; j < n; ++j) rb[ob[j]] = static_cast<int32_t>(j);
+  }
+  return ffi::Error::Success();
+}
+
+}  // namespace
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(AfStableArgsortRank, StableArgsortRankImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::S32>>()
+                                  .Ret<ffi::Buffer<ffi::S32>>());

@@ -74,6 +74,7 @@ from asyncflow_tpu.engines.jaxsim.sampling import (
     D_POISSON,
     D_UNIFORM,
     TINY,
+    as_threefry,
     hist_constants,
 )
 
@@ -1054,7 +1055,7 @@ class PallasEngine:
             kd = jax.random.fold_in(key, 0x77AB)
             if plan.user_var < 0:
                 users = jax.random.poisson(
-                    kd, jnp.maximum(um, TINY), (nw,),
+                    as_threefry(kd), jnp.maximum(um, TINY), (nw,),
                 ).astype(jnp.float32)
             else:
                 z = jax.random.normal(kd, (nw,))

@@ -55,3 +55,22 @@ def sample_bucket(t, period: float, n_samples: int):
     """Sample-tick bucket: a delta at ``t`` affects samples at ticks >= t."""
     b = jnp.ceil(t / period).astype(jnp.int32)
     return jnp.clip(b, 0, n_samples + 1)
+
+
+def as_threefry(key):
+    """A threefry-typed view of any PRNG key (raw or typed).
+
+    ``jax.random.poisson`` is only implemented for threefry; routing its
+    (tiny, per-window) draws through this shim lets the bulk per-request
+    draws run under a cheaper global impl (``rbg``) without losing the
+    counting-process sampler.  Takes the first 64 key bits.
+    """
+    import jax
+
+    if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+        data = jax.random.key_data(key)
+    else:
+        data = key
+    return jax.random.wrap_key_data(
+        data[..., :2].astype(jnp.uint32), impl="threefry2x32",
+    )

@@ -1,0 +1,175 @@
+"""Exact stable time-argsort without a tuple sort.
+
+Profiling the scan fast path (round 4/5, ``prof_trace``) showed the two
+surviving per-scenario argsorts — LB routing order and the shared
+entry-tier arrival order — are ~44% of device time.  XLA lowers
+``jnp.argsort`` to a *tuple* sort (key, iota) whose 4-parameter comparator
+region falls off the backend's specialized single-operand path; measured on
+XLA:CPU a plain ``u32`` sort of the same 87,840-key shape is ~7x faster
+(77 ms vs 565 ms per 16-lane block).
+
+``argsort_time`` reproduces ``jnp.argsort(where(alive, t, INF))`` —
+stable, bit-identical — as:
+
+1. map f32 times to their order-isomorphic ``u32`` bit pattern (the
+   classic sign-flip bijection: IEEE-754 totally ordered for finite
+   values), and give each dead lane the unique key ``0xFF000000 + lane``
+   (above every finite alive key when ``t < ~1.7e38``; unique, so the
+   whole padding block is tie-free and lands in lane order — exactly what
+   a stable sort of equal INF keys produces);
+2. ONE single-operand ``lax.sort`` of the keys (the fast comparator path);
+3. ranks via vectorized binary search of each key in the sorted array
+   (``searchsorted`` side='left');
+4. accidental f32 ties among alive lanes (dozens per 88k-arrival scenario:
+   ~1e7-8e7 representable values under the time range vs 88k^2/2 pairs)
+   share a 'left' rank; a short ``while_loop`` — scatter-min of lane index
+   onto contested slots, losers step one slot right — assigns the tied
+   block in ascending-lane order, i.e. the stable order.  Trip count =
+   largest tie group (2-3 in practice), checked each round.
+
+The result is a true permutation, equal to the stable argsort everywhere.
+Replaces the reference's per-event heap ordering
+(`/root/reference/src/asyncflow/runtime/simulation_runner.py:369`) at the
+whole-array level.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["argsort_time", "sortable_u32", "time_rank"]
+
+_DEAD_BASE = jnp.uint32(0xFF000000)
+
+# ---------------------------------------------------------------------------
+# CPU escape hatch: adaptive native stable argsort (ffisort.cpp).  The
+# arrival keys are near-sorted, where an insertion sort is O(n+inversions)
+# ~ 1 ms/lane vs ~15 ms for XLA:CPU's comparator-driven sort.  Built on
+# demand with the system g++ against jax.ffi's bundled XLA headers;
+# unavailable (no compiler) degrades to the pure-XLA path.
+# ---------------------------------------------------------------------------
+
+_FFI_TARGET = "af_stable_argsort_rank"
+_ffi_ready: bool | None = None
+
+
+def _ensure_ffi() -> bool:
+    global _ffi_ready
+    if _ffi_ready is not None:
+        return _ffi_ready
+    try:
+        src = Path(__file__).parent / "ffisort.cpp"
+        out_dir = Path(tempfile.gettempdir()) / f"asyncflow_tpu_ffi_{os.getuid()}"
+        out_dir.mkdir(exist_ok=True, mode=0o700)
+        if out_dir.stat().st_uid != os.getuid():
+            out_dir = Path(tempfile.mkdtemp(prefix="asyncflow_tpu_ffi_"))
+        # key the cache on the jax version too: a jax upgrade changes the
+        # bundled XLA FFI headers, and a stale binary would register fine
+        # but fail at call time instead of degrading to the XLA path
+        out = out_dir / f"_afffisort_jax{jax.__version__}.so"
+        if not (out.exists() and out.stat().st_mtime >= src.stat().st_mtime):
+            tmp = out_dir / f"{out.name}.{os.getpid()}.tmp"
+            subprocess.run(
+                [
+                    "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                    f"-I{jax.ffi.include_dir()}",
+                    str(src), "-o", str(tmp),
+                ],
+                check=True,
+                capture_output=True,
+            )
+            os.replace(tmp, out)
+        lib = ctypes.CDLL(str(out))
+        jax.ffi.register_ffi_target(
+            _FFI_TARGET,
+            jax.ffi.pycapsule(lib.AfStableArgsortRank),
+            platform="cpu",
+        )
+        _ffi_ready = True
+    except Exception:  # noqa: BLE001 — any failure means "no native sort"
+        _ffi_ready = False
+    return _ffi_ready
+
+
+def _ffi_rank(keys: jnp.ndarray) -> jnp.ndarray:
+    """Stable-sort rank of f32 keys via the native kernel (CPU only)."""
+    shape = jax.ShapeDtypeStruct(keys.shape, jnp.int32)
+    _, rank = jax.ffi.ffi_call(
+        _FFI_TARGET, (shape, shape), vmap_method="expand_dims",
+    )(keys)
+    return rank
+
+
+def sortable_u32(t: jnp.ndarray) -> jnp.ndarray:
+    """Order-isomorphic u32 image of finite f32 (sign-flip bijection)."""
+    b = jax.lax.bitcast_convert_type(t.astype(jnp.float32), jnp.uint32)
+    neg = (b >> 31) == 1
+    return jnp.where(neg, ~b, b | (jnp.uint32(1) << 31))
+
+
+def time_rank(t: jnp.ndarray, alive: jnp.ndarray) -> jnp.ndarray:
+    """Stable sort *rank* of each lane under ``where(alive, t, INF)``.
+
+    ``rank`` is the inverse of the stable argsort permutation:
+    ``argsort[rank[i]] == i``.  Consumers sort with a scatter
+    (``sorted = empty.at[rank].set(x)`` == ``x[argsort]``) and un-sort with
+    a gather (``x_lane = x_sorted[rank]`` == ``empty.at[argsort].set(x)``),
+    so most call sites never materialize the permutation itself.
+
+    ``t`` finite f32 (< ~1.7e38 where alive), shape (n,); ``alive`` bool.
+    Dead lanes rank last in lane order, tied alive lanes rank in lane
+    order — bit-identical to the stable tuple argsort's inverse.
+    """
+    if _ensure_ffi():
+        keys_f = jnp.where(alive, t.astype(jnp.float32), jnp.inf)
+        return jax.lax.platform_dependent(
+            keys_f, cpu=_ffi_rank, default=_time_rank_xla,
+        )
+    return _time_rank_xla(jnp.where(alive, t, jnp.inf))
+
+
+def _time_rank_xla(t: jnp.ndarray) -> jnp.ndarray:
+    """Pure-XLA stable rank of f32 keys (+inf = padding; see time_rank)."""
+    alive = t < jnp.inf
+    n = t.shape[0]
+    if n > 0x0100_0000:  # dead keys are _DEAD_BASE + lane: 24 bits of lane
+        msg = f"time_rank supports at most 2**24 lanes, got {n}"
+        raise ValueError(msg)
+    lane = jnp.arange(n, dtype=jnp.uint32)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    key = jnp.where(alive, sortable_u32(t), _DEAD_BASE + lane)
+    sk = jax.lax.sort(key, dimension=0)
+    rank = jnp.searchsorted(sk, key, side="left").astype(jnp.int32)
+
+    # Resolve shared 'left' ranks of tied alive keys: every round the
+    # lowest-lane contender keeps the slot, the rest step right.  Dead
+    # lanes are unique by construction and never enter the loop; alive tie
+    # groups are f32 collisions (dozens per 88k keys), so the trip count —
+    # the largest tie group — is 2-3.
+    big = jnp.int32(n)
+
+    def body(state):
+        pos, _ = state
+        winner = jnp.full((n,), big, jnp.int32).at[pos].min(iota)
+        lost = winner[pos] != iota
+        return pos + lost.astype(jnp.int32), jnp.any(lost)
+
+    def cond(state):
+        return state[1]
+
+    pos, _ = jax.lax.while_loop(cond, body, (rank, jnp.bool_(True)))
+    return pos
+
+
+def argsort_time(t: jnp.ndarray, alive: jnp.ndarray) -> jnp.ndarray:
+    """Stable ``argsort(where(alive, t, INF))`` (see :func:`time_rank`)."""
+    n = t.shape[0]
+    rank = time_rank(t, alive)
+    return jnp.zeros((n,), jnp.int32).at[rank].set(jnp.arange(n, dtype=jnp.int32))
