@@ -30,6 +30,9 @@ namespace ffi = xla::ffi;
 namespace {
 
 // Stable adaptive argsort of the index prefix ob[0..m) (keys via kb).
+// Plain IEEE '<' matches jnp.argsort's comparator: -0.0 and +0.0 compare
+// equal and stability keeps them in lane order (the pure-XLA path
+// canonicalizes -0.0 before its u32 bijection for the same reason).
 // Returns false when the move budget is exhausted (caller falls back to
 // std::stable_sort).
 bool InsertionArgsort(const float* kb, int32_t* ob, int64_t m,

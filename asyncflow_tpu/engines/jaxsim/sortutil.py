@@ -108,8 +108,15 @@ def _ffi_rank(keys: jnp.ndarray) -> jnp.ndarray:
 
 
 def sortable_u32(t: jnp.ndarray) -> jnp.ndarray:
-    """Order-isomorphic u32 image of finite f32 (sign-flip bijection)."""
-    b = jax.lax.bitcast_convert_type(t.astype(jnp.float32), jnp.uint32)
+    """Order-isomorphic u32 image of finite f32 (sign-flip bijection).
+
+    -0.0 is canonicalized to +0.0 first: jnp.argsort's comparator treats
+    the two as equal (ties broken by lane), while the raw bijection would
+    order -0.0 strictly first.
+    """
+    t = t.astype(jnp.float32)
+    t = jnp.where(t == 0.0, jnp.float32(0.0), t)
+    b = jax.lax.bitcast_convert_type(t, jnp.uint32)
     neg = (b >> 31) == 1
     return jnp.where(neg, ~b, b | (jnp.uint32(1) << 31))
 

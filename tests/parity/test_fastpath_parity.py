@@ -44,6 +44,74 @@ def _oracle_latencies(payload: SimulationPayload, n: int) -> np.ndarray:
     )
 
 
+# -- matched user draws -------------------------------------------------------
+#
+# At queueing configs the pooled tail is dominated by the per-window active-
+# user draw U (e.g. Poisson(110)): a 24-48 draw ensemble's top order
+# statistics carry the p95, so two engines sampling U from different RNG
+# streams show +/-4-8% pooled-p95 spread from ensemble noise alone (round-5
+# decomposition, docs/internals/fastpath.md §5: spread collapses to <1% when
+# U is matched, and engine disciplines are sample-path FIFO-exact).  These
+# helpers feed the SAME U sequence to both engines — the fast path via the
+# per-scenario override, the oracle via a per-seed pinned payload — leaving
+# only genuine model differences in the comparison.
+
+
+def _matched_user_draws(payload: SimulationPayload, n: int) -> np.ndarray:
+    from asyncflow_tpu.config.constants import Distribution
+
+    rv = payload.rqs_input.avg_active_users
+    rng = np.random.default_rng(999)
+    if rv.distribution == Distribution.NORMAL:
+        assert rv.variance is not None
+        return np.maximum(0.0, rng.normal(rv.mean, rv.variance, n))
+    return rng.poisson(rv.mean, n).astype(float)
+
+
+def _pin_users(payload: SimulationPayload, users: float) -> SimulationPayload:
+    data = payload.model_dump()
+    data["rqs_input"]["avg_active_users"] = {
+        "mean": float(users), "variance": 1e-9, "distribution": "normal",
+    }
+    return SimulationPayload.model_validate(data)
+
+
+def _fast_latencies_matched(
+    payload: SimulationPayload, n: int, users: np.ndarray,
+) -> np.ndarray:
+    import jax.numpy as jnp
+
+    from asyncflow_tpu.engines.jaxsim.params import base_overrides
+
+    # size capacity for the LARGEST pinned draw: the pinned payload has
+    # ~zero user variance, so _estimate_capacity keeps no draw slack and a
+    # plan compiled from a low draw would silently truncate high-U lanes
+    plan = compile_payload(_pin_users(payload, float(users.max())))
+    assert plan.fastpath_ok, plan.fastpath_reason
+    engine = FastEngine(plan, collect_clocks=True)
+    ov = base_overrides(plan)._replace(user_mean=jnp.asarray(users, jnp.float32))
+    final = engine.run_batch(scenario_keys(11, n), ov)
+    assert int(np.asarray(final.n_overflow).sum()) == 0, "arrival truncation"
+    clock = np.asarray(final.clock)
+    counts = np.asarray(final.clock_n)
+    return np.concatenate(
+        [clock[i, : counts[i], 1] - clock[i, : counts[i], 0] for i in range(n)],
+    )
+
+
+def _oracle_latencies_matched(
+    payload: SimulationPayload, n: int, users: np.ndarray,
+) -> np.ndarray:
+    return np.concatenate(
+        [
+            OracleEngine(_pin_users(payload, float(users[s])), seed=s)
+            .run()
+            .latencies
+            for s in range(n)
+        ],
+    )
+
+
 def _assert_parity(a: np.ndarray, b: np.ndarray, tol: float) -> None:
     assert a.size > 1000 and b.size > 1000
     for q in (50, 90, 95):
@@ -421,17 +489,20 @@ def test_fastpath_multicore_kw() -> None:
     payload = _payload(BASE, mutate)
     plan = compile_payload(payload)
     assert plan.fastpath_ok, plan.fastpath_reason
-    # Tolerance from round-4 measurement at this config: the KW recursion
-    # is sample-path exact (test_kw_waits_sample_path_exact), and the
-    # one-sided pooled-tail spread PRE-DATES the round-4 sort rewrite
-    # (measured on the round-3 engine: fast-vs-native p95 +1.6..+7.8%
-    # across disjoint seed sets; post-rewrite +4.3..+8.5%; the python
-    # oracle itself sits +1.6..+3.3% above native, native-vs-native
-    # +/-2%).  0.10 sits above every observed band so a reseed cannot
-    # flake, while still failing on a real (>2x) regression; the
-    # one-sided cross-engine tail spread at multi-core configs is
-    # recorded as an open question in docs/internals/fastpath.md §5.
-    _assert_parity(_fast_latencies(payload, SEEDS), _oracle_latencies(payload, SEEDS), 0.10)
+    # Matched user draws close the round-3/4 "one-sided tail spread" open
+    # question: the +4..8% pooled-p95 spread was ensemble noise of the
+    # per-window Poisson(110) user draw (top order statistics of 24-48
+    # draws carry the tail; the fast path's draw ensemble was keyed by the
+    # fixed scenario_keys base, so disjoint ORACLE seed sets still compared
+    # against the SAME fast ensemble — consistently one-sided).  With U
+    # matched the spread is <1% (round-5 decomposition, fastpath.md §5),
+    # so the gate tightens 0.10 -> 0.03.
+    users = _matched_user_draws(payload, SEEDS)
+    _assert_parity(
+        _fast_latencies_matched(payload, SEEDS, users),
+        _oracle_latencies_matched(payload, SEEDS, users),
+        0.03,
+    )
 
 
 def test_kw_waits_sample_path_exact() -> None:
@@ -676,13 +747,17 @@ def test_fastpath_ram_admission_queue() -> None:
     plan = compile_payload(payload)
     assert plan.fastpath_ok, plan.fastpath_reason
     assert plan.ram_slots[0] == 5
-    lat_fast = _fast_latencies(payload, SEEDS)
-    lat_oracle = _oracle_latencies(payload, SEEDS)
+    # Matched user draws (see _matched_user_draws): the former p95 +/-6.4%
+    # "noise floor" at this rho ~ 0.75 config was user-draw ensemble noise;
+    # with U matched the admission-queue comparison gates at 4%.
+    users = _matched_user_draws(payload, SEEDS)
+    lat_fast = _fast_latencies_matched(payload, SEEDS, users)
+    lat_oracle = _oracle_latencies_matched(payload, SEEDS, users)
     assert abs(lat_fast.mean() - lat_oracle.mean()) / lat_oracle.mean() < 0.04
     p50f, p50o = np.percentile(lat_fast, 50), np.percentile(lat_oracle, 50)
     assert abs(p50f - p50o) / p50o < 0.04
     p95f, p95o = np.percentile(lat_fast, 95), np.percentile(lat_oracle, 95)
-    assert abs(p95f - p95o) / p95o < 0.08
+    assert abs(p95f - p95o) / p95o < 0.04
 
 
 def test_fastpath_least_connections() -> None:
