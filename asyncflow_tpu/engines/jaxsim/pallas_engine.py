@@ -34,7 +34,12 @@ Feature coverage matches the event engine: multi-segment endpoints, lazy
 core handoff with FIFO tickets, RAM admission with strict-FIFO grant
 cascades, both LB algorithms, outage timelines, spike superposition, all
 five edge distributions (Poisson via an in-kernel exp-sum loop), dropout,
-server chains, overflow/truncation accounting.
+server chains, overflow/truncation accounting, weighted endpoint
+selection (cumulative-weight one-hot walk), stochastic cache mixtures,
+LLM call dynamics (in-kernel Poisson tokens; cost sum/sumsq outputs), and
+binding DB connection pools (a second strict-FIFO ticket queue whose
+holder sleeps instead of running).  Reachable overload policies stay on
+the event engine.
 """
 
 from __future__ import annotations
@@ -46,9 +51,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from asyncflow_tpu.compiler.plan import (
+    SEG_CACHE,
     SEG_CPU,
+    SEG_DB,
     SEG_END,
     SEG_IO,
+    SEG_LLM,
     TARGET_CLIENT,
     TARGET_LB,
     TARGET_SERVER,
@@ -61,6 +69,7 @@ from asyncflow_tpu.engines.jaxsim.params import (
     EV_RESUME,
     EV_SEG_END,
     EV_WAIT_CPU,
+    EV_WAIT_DB,
     EV_WAIT_RAM,
     INF,
     NO_TICKET,
@@ -282,6 +291,8 @@ class PallasState(NamedTuple):
     n_dropped: np.ndarray
     n_overflow: np.ndarray
     truncated: np.ndarray
+    llm_sum: np.ndarray
+    llm_sumsq: np.ndarray
 
 
 class PallasEngine:
@@ -309,26 +320,20 @@ class PallasEngine:
         program — GSPMD cannot partition a ``pallas_call``, so the sharding
         seam has to be explicit)."""
         if (
-            plan.has_db_pool
-            or plan.has_stochastic_cache
-            or plan.has_queue_cap
+            plan.has_queue_cap
             or plan.has_conn_cap
             or plan.has_rate_limit
             or plan.has_queue_timeout
             or plan.breaker_threshold > 0
-            or plan.has_llm
-            or plan.has_weighted_endpoints
         ):
-            # the VMEM kernel has no DB-pool FIFO machinery, no cache
-            # mixture draws, and no shed/refusal/limiter/deadline/breaker
+            # the VMEM kernel has no shed/refusal/limiter/deadline/breaker
             # paths; the compiler routes such plans to the general event
-            # engine
+            # engine.  DB pools, cache mixtures, LLM dynamics, and weighted
+            # endpoint selection are modeled (round 5).
             msg = (
-                "the Pallas kernel does not model binding DB connection "
-                "pools, stochastic cache steps, LLM call dynamics, "
-                "weighted endpoint selection, or "
-                "reachable overload policies (caps, capacities, rate "
-                "limits, deadlines, circuit breakers); use the event engine"
+                "the Pallas kernel does not model reachable overload "
+                "policies (caps, capacities, rate limits, deadlines, "
+                "circuit breakers); use the event engine"
             )
             raise ValueError(msg)
         self.plan = plan
@@ -342,6 +347,9 @@ class PallasEngine:
         self.n_windows = int(np.ceil(plan.horizon / plan.user_window)) + 1
         self._dists_present = sorted(set(plan.edge_dist.tolist()))
         self._has_ram = bool(np.max(plan.endpoint_ram) > 0)
+        self._has_cache = bool(np.any(plan.seg_kind == SEG_CACHE))
+        self._has_llm = bool(np.any(plan.seg_kind == SEG_LLM))
+        self._has_db = bool(np.any(plan.seg_kind == SEG_DB))
         self._has_tl = len(plan.timeline_times) > 0
         self._has_spikes = len(plan.spike_times) > 1
         self._nsegp = plan.seg_kind.shape[2]
@@ -353,6 +361,9 @@ class PallasEngine:
             ("seg_kind", plan.seg_kind.reshape(-1).astype(np.int32)),
             ("seg_dur", plan.seg_dur.reshape(-1).astype(np.float32)),
             ("ep_ram", plan.endpoint_ram.reshape(-1).astype(np.float32)),
+            # endpoint selection by cumulative weight (uniform plans carry
+            # the k/nep ladder, weighted plans their weights — one path)
+            ("ep_cum", plan.endpoint_cum.reshape(-1).astype(np.float32)),
             ("edge_dist", plan.edge_dist.astype(np.int32)),
             ("exit_edge", plan.exit_edge.astype(np.int32)),
             ("exit_kind", plan.exit_kind.astype(np.int32)),
@@ -361,6 +372,24 @@ class PallasEngine:
             ("server_cores", plan.server_cores.astype(np.int32)),
             ("server_ram", plan.server_ram.astype(np.float32)),
         ]
+        if self._has_cache:
+            tables += [
+                ("seg_hit_prob", plan.seg_hit_prob.reshape(-1).astype(np.float32)),
+                ("seg_miss_dur", plan.seg_miss_dur.reshape(-1).astype(np.float32)),
+            ]
+        if self._has_llm:
+            tables += [
+                ("seg_llm_tokens", plan.seg_llm_tokens.reshape(-1).astype(np.float32)),
+                ("seg_llm_tpt", plan.seg_llm_tpt.reshape(-1).astype(np.float32)),
+                ("seg_llm_cost", plan.seg_llm_cost.reshape(-1).astype(np.float32)),
+            ]
+        if self._has_db:
+            tables += [
+                # -1 (unlimited) becomes a huge pool so acquire never blocks
+                ("db_pool", np.where(
+                    plan.server_db_pool >= 0, plan.server_db_pool, 2**30,
+                ).astype(np.int32)),
+            ]
         if plan.n_lb_edges > 0:
             tables += [
                 ("lb_edge_index", plan.lb_edge_index.astype(np.int32)),
@@ -542,8 +571,12 @@ class PallasEngine:
         st["next_arrival"] = jnp.where(pred, nxt, st["next_arrival"])
         return st
 
-    def _complete(self, st, start, finish, pred):
+    def _complete(self, st, i, start, finish, pred):
         latency = finish - start
+        if self._has_llm:
+            cost = _sel_col(st["req_llm"], i)
+            st["llm_sum"] = st["llm_sum"] + jnp.where(pred, cost, 0.0)
+            st["llm_sumsq"] = st["llm_sumsq"] + jnp.where(pred, cost * cost, 0.0)
         # identical binning to sampling.latency_bin (shared hist contract)
         lbin = jnp.clip(
             (
@@ -598,29 +631,108 @@ class PallasEngine:
         is_io = pred & (kind == SEG_IO)
         is_end = pred & (kind == SEG_END)
 
+        if self._has_cache:
+            # SEG_CACHE: per-request hit/miss mixture (`engine.py:495-503`)
+            is_cache = pred & (kind == SEG_CACHE)
+            u_cache = rng.one(it, 24)
+            dur = jnp.where(
+                is_cache & (u_cache >= _tab(self._tk["seg_hit_prob"], sidx)),
+                _tab(self._tk["seg_miss_dur"], sidx),
+                dur,
+            )
+            is_io = is_io | is_cache
+        if self._has_llm:
+            # SEG_LLM: tokens ~ Poisson(mean) via the in-kernel exp-sum
+            # counting process; the sleep stretches by tokens * s/token and
+            # the request accrues tokens * cost (`engine.py:505-518`)
+            is_llm = pred & (kind == SEG_LLM)
+            lam_t = jnp.maximum(
+                _tab(self._tk["seg_llm_tokens"], sidx), np.float32(1e-6),
+            )
+
+            def lcond(c):
+                _acc, _k, live, _seq = c
+                return jnp.sum(live.astype(jnp.int32)) > 0
+
+            def lbody(c):
+                acc, k, live, seq = c
+                u_p = rng.one(it, 25, seq)
+                g = -jnp.log(jnp.maximum(1.0 - u_p, np.float32(TINY)))
+                acc2 = acc + g
+                over = acc2 > lam_t
+                k = jnp.where(live & ~over, k + 1, k)
+                return acc2, k, live & ~over, seq + 1
+
+            _, tok, _, _ = jax.lax.while_loop(
+                lcond,
+                lbody,
+                (
+                    jnp.zeros_like(dur),
+                    jnp.zeros_like(dur, jnp.int32),
+                    is_llm,
+                    jnp.int32(0),
+                ),
+            )
+            tokens = tok.astype(jnp.float32)
+            dur = jnp.where(
+                is_llm, dur + tokens * _tab(self._tk["seg_llm_tpt"], sidx), dur,
+            )
+            st["req_llm"] = _add_col(
+                st["req_llm"],
+                i,
+                jnp.where(is_llm, tokens * _tab(self._tk["seg_llm_cost"], sidx), 0.0),
+                is_llm,
+            )
+            is_io = is_io | is_llm
+
         has_waiters = _sel_col(st["cpu_wait_n"], s) > 0
         can_take = (_sel_col(st["cores_free"], s) > 0) & ~has_waiters
         cpu_run = is_cpu & can_take
         cpu_wait = is_cpu & ~can_take
         run_now = cpu_run | is_io
 
+        db_wait = jnp.zeros_like(is_cpu)
+        if self._has_db:
+            # DB connection acquire-or-wait: the core queue's strict-FIFO
+            # discipline, but the holder sleeps instead of running
+            # (`engine.py:536-552`)
+            is_db = pred & (kind == SEG_DB)
+            db_can = (_sel_col(st["db_free"], s) > 0) & ~(
+                _sel_col(st["db_wait_n"], s) > 0
+            )
+            db_run = is_db & db_can
+            db_wait = is_db & ~db_can
+            run_now = run_now | db_run
+            st["db_free"] = _add_col(st["db_free"], s, -1, db_run)
+            st["db_ticket"] = _add_col(st["db_ticket"], s, 1, db_wait)
+            st["db_wait_n"] = _add_col(st["db_wait_n"], s, 1, db_wait)
+
         st["cores_free"] = _add_col(st["cores_free"], s, -1, cpu_run)
         st["cpu_ticket"] = _add_col(st["cpu_ticket"], s, 1, cpu_wait)
         st["cpu_wait_n"] = _add_col(st["cpu_wait_n"], s, 1, cpu_wait)
-        new_ticket = _sel_col(st["cpu_ticket"], s)
         st["req_ev"] = _set_col(
             st["req_ev"],
             i,
-            jnp.where(run_now, EV_SEG_END, EV_WAIT_CPU),
-            run_now | cpu_wait,
+            jnp.where(
+                run_now,
+                EV_SEG_END,
+                jnp.where(cpu_wait, EV_WAIT_CPU, EV_WAIT_DB),
+            ),
+            run_now | cpu_wait | db_wait,
         )
         st["req_t"] = _set_col(
             st["req_t"],
             i,
             jnp.where(run_now, now + dur, np.float32(INF)),
-            run_now | cpu_wait,
+            run_now | cpu_wait | db_wait,
         )
-        st["req_ticket"] = _set_col(st["req_ticket"], i, new_ticket, cpu_wait)
+        st["req_ticket"] = _set_col(
+            st["req_ticket"], i, _sel_col(st["cpu_ticket"], s), cpu_wait,
+        )
+        if self._has_db:
+            st["req_ticket"] = _set_col(
+                st["req_ticket"], i, _sel_col(st["db_ticket"], s), db_wait,
+            )
         st["req_seg"] = _set_col(st["req_seg"], i, seg, pred)
         return self._exit_flow(st, i, s, now, rng, it, ov_tabs, is_end)
 
@@ -699,6 +811,7 @@ class PallasEngine:
 
         st = self._complete(
             st,
+            i,
             _sel_col(st["req_start"], i),
             arrive,
             to_client & (arrive < np.float32(self.plan.horizon)),
@@ -753,6 +866,8 @@ class PallasEngine:
         st["req_lbslot"] = _set_col(st["req_lbslot"], slot, -1, place)
         st["req_ram"] = _set_col(st["req_ram"], slot, 0.0, place)
         st["req_ticket"] = _set_col(st["req_ticket"], slot, NO_TICKET, place)
+        if self._has_llm:
+            st["req_llm"] = _set_col(st["req_llm"], slot, 0.0, place)
         st["n_overflow"] = st["n_overflow"] + jnp.where(overflow, 1, 0)
         return self._advance_arrival(st, rng, it, lam_tab, pred)
 
@@ -824,7 +939,15 @@ class PallasEngine:
 
         u = rng.one(it, 4)
         nep = _tab(self._tk["n_endpoints"], s)
-        ep = jnp.minimum((u * nep.astype(jnp.float32)).astype(jnp.int32), nep - 1)
+        # endpoint pick by cumulative weight: searchsorted(cum, u, 'right')
+        # as a sum of one-hot threshold tests over the (small, static)
+        # max-endpoint count — weighted and uniform plans share the path
+        # (`engine.py:1008`)
+        ep = jnp.zeros_like(s)
+        for k in range(self._nep):
+            ck = _tab(self._tk["ep_cum"], s * self._nep + k)
+            ep = ep + (ck <= u).astype(jnp.int32)
+        ep = jnp.minimum(ep, nep - 1)
         st["req_ep"] = _set_col(st["req_ep"], i, ep, pred)
 
         if not self._has_ram:
@@ -888,6 +1011,26 @@ class PallasEngine:
         st["req_ev"] = _set_col(st["req_ev"], j, EV_SEG_END, grant)
         st["req_t"] = _set_col(st["req_t"], j, now + jdur, grant)
         st["req_ticket"] = _set_col(st["req_ticket"], j, NO_TICKET, grant)
+
+        if self._has_db:
+            # DB connection handoff, mirroring the core queue's discipline
+            # (`engine.py:1129-1146`)
+            was_db = pred & (kind == SEG_DB)
+            dwaiting = (st["req_ev"] == EV_WAIT_DB) & (st["req_srv"] == srv_col)
+            dtick = jnp.where(dwaiting, st["req_ticket"], NO_TICKET)
+            dj, dtmin = _argmin_row(dtick)
+            dgrant = was_db & (dtmin < NO_TICKET)
+            drelease = was_db & ~dgrant
+            djs = _sel_col(st["req_srv"], dj)
+            djep = _sel_col(st["req_ep"], dj)
+            djseg = _sel_col(st["req_seg"], dj)
+            djdur = _tab(self._tk["seg_dur"], self._seg_idx(djs, djep, djseg))
+            st["db_free"] = _add_col(st["db_free"], s, 1, drelease)
+            st["db_wait_n"] = _add_col(st["db_wait_n"], s, -1, dgrant)
+            st["req_ev"] = _set_col(st["req_ev"], dj, EV_SEG_END, dgrant)
+            st["req_t"] = _set_col(st["req_t"], dj, now + djdur, dgrant)
+            st["req_ticket"] = _set_col(st["req_ticket"], dj, NO_TICKET, dgrant)
+
         return self._seg_start(st, i, s, ep, seg + 1, now, rng, it, ov_tabs, pred)
 
     # ------------------------------------------------------------------
@@ -952,7 +1095,15 @@ class PallasEngine:
             "n_generated": col(0, jnp.int32),
             "n_dropped": col(0, jnp.int32),
             "n_overflow": col(0, jnp.int32),
+            "llm_sum": col(0.0),
+            "llm_sumsq": col(0.0),
         }
+        if self._has_llm:
+            st["req_llm"] = jnp.zeros((sblk, pool), jnp.float32)
+        if self._has_db:
+            st["db_free"] = jnp.broadcast_to(self._tk["db_pool"], (sblk, ns))
+            st["db_ticket"] = jnp.zeros((sblk, ns), jnp.int32)
+            st["db_wait_n"] = jnp.zeros((sblk, ns), jnp.int32)
         st = self._advance_arrival(st, rng, jnp.int32(0), lam_tab, col(True, jnp.bool_))
         # cached pool argmin (the single pool scan per iteration, refreshed
         # at the end of each body after every branch — same discipline as
@@ -1027,6 +1178,8 @@ class PallasEngine:
                 sd["lat_sumsq"],
                 sd["lat_min"],
                 sd["lat_max"],
+                sd["llm_sum"],
+                sd["llm_sumsq"],
             ],
             axis=1,
         )
@@ -1099,6 +1252,8 @@ class PallasEngine:
             n_dropped=momi[:, 2],
             n_overflow=momi[:, 3],
             truncated=trunc,
+            llm_sum=momf[:, 4],
+            llm_sumsq=momf[:, 5],
         )
 
     def lower_tpu(self, keys: jnp.ndarray):
@@ -1206,14 +1361,14 @@ class PallasEngine:
                 out_specs=[
                     row_spec(self.n_hist_bins),
                     row_spec(self.n_thr),
-                    row_spec(4),
+                    row_spec(6),
                     row_spec(4),
                     row_spec(1),
                 ],
                 out_shape=[
                     jax.ShapeDtypeStruct((rows, self.n_hist_bins), jnp.int32),
                     jax.ShapeDtypeStruct((rows, self.n_thr), jnp.int32),
-                    jax.ShapeDtypeStruct((rows, 4), jnp.float32),
+                    jax.ShapeDtypeStruct((rows, 6), jnp.float32),
                     jax.ShapeDtypeStruct((rows, 4), jnp.int32),
                     jax.ShapeDtypeStruct((rows, 1), jnp.int32),
                 ],

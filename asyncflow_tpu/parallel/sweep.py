@@ -389,18 +389,15 @@ class SweepRunner:
         elif engine == "pallas" or (
             engine == "auto"
             and jax.default_backend() == "tpu"
-            # the VMEM kernel models neither pool FIFOs, cache mixtures,
-            # nor overload policies (shedding / refusal / rate limits /
-            # deadlines / circuit breakers)
-            and not self.plan.has_db_pool
-            and not self.plan.has_stochastic_cache
+            # the VMEM kernel models DB pools, cache mixtures, LLM
+            # dynamics, and weighted endpoints (round 5) but not overload
+            # policies (shedding / refusal / rate limits / deadlines /
+            # circuit breakers)
             and not self.plan.has_queue_cap
             and not self.plan.has_conn_cap
             and not self.plan.has_rate_limit
             and not self.plan.has_queue_timeout
             and self.plan.breaker_threshold == 0
-            and not self.plan.has_llm
-            and not self.plan.has_weighted_endpoints
         ):
             from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
 

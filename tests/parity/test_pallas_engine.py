@@ -326,3 +326,163 @@ def test_kernel_lowers_for_tpu_from_cpu() -> None:
     eng = PallasEngine(plan, interpret=False)
     lowered = eng.lower_tpu(scenario_keys(3, 4))
     assert "tpu_custom_call" in lowered.as_text()
+
+
+# -- round-5 feature coverage: weights, cache, LLM, DB pools ----------------
+
+
+def test_weighted_endpoints_parity() -> None:
+    """Endpoint.selection_weight: a 3:1 fast/slow mixture's latency shape
+    reveals the split — a wrong selection law shifts the pooled mean far
+    beyond TOL."""
+    data = _base(horizon=10.0)
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    srv["endpoints"] = [
+        {
+            "endpoint_name": "/fast",
+            "selection_weight": 3.0,
+            "steps": [
+                {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.005}},
+            ],
+        },
+        {
+            "endpoint_name": "/slow",
+            "selection_weight": 1.0,
+            "steps": [
+                {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.050}},
+            ],
+        },
+    ]
+    plan, ev, ps = _run_both(data)
+    assert plan.has_weighted_endpoints
+    _assert_parity(ev, ps)
+
+
+def test_cache_mixture_parity() -> None:
+    """io_cache hit/miss mixture: the bimodal sleep (2 ms hit / 50 ms miss
+    at p=0.8) must reproduce the event engine's latency mixture."""
+    data = _base(horizon=10.0)
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    srv["endpoints"][0]["steps"] = [
+        {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.002}},
+        {
+            "kind": "io_cache",
+            "step_operation": {"io_waiting_time": 0.002},
+            "cache_hit_probability": 0.8,
+            "cache_miss_time": 0.050,
+        },
+    ]
+    plan, ev, ps = _run_both(data)
+    assert plan.has_stochastic_cache
+    _assert_parity(ev, ps)
+
+
+def test_llm_dynamics_parity() -> None:
+    """io_llm: tokens ~ Poisson(mean) stretch the sleep and accrue cost;
+    the kernel's in-kernel counting process must match the event engine's
+    jax.random.poisson in both latency and cost moments."""
+    data = _base(horizon=10.0)
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    srv["endpoints"][0]["steps"] = [
+        {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.002}},
+        {
+            "kind": "io_llm",
+            "step_operation": {"io_waiting_time": 0.004},
+            "llm_tokens_mean": 40.0,
+            "llm_time_per_token": 0.0005,
+            "llm_cost_per_token": 0.01,
+        },
+    ]
+    plan, ev, ps = _run_both(data)
+    assert plan.has_llm
+    _assert_parity(ev, ps)
+    ec = int(np.asarray(ev.lat_count).sum())
+    pc = int(ps.lat_count.sum())
+    e_cost = float(np.asarray(ev.llm_sum).sum()) / ec
+    p_cost = float(ps.llm_sum.sum()) / pc
+    assert e_cost > 0
+    assert abs(e_cost - p_cost) / e_cost < TOL
+    e_sq = float(np.asarray(ev.llm_sumsq).sum()) / ec
+    p_sq = float(ps.llm_sumsq.sum()) / pc
+    assert abs(e_sq - p_sq) / e_sq < 2 * TOL
+
+
+def test_db_pool_parity() -> None:
+    """Binding DB connection pool: 2 connections against a 60 ms query at
+    high demand — pool waits dominate the tail, so any FIFO-discipline
+    divergence shows up in the pooled percentiles."""
+    data = _base(horizon=10.0)
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    srv["server_resources"]["db_connection_pool"] = 2
+    srv["endpoints"][0]["steps"] = [
+        {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.002}},
+        {"kind": "io_db", "step_operation": {"io_waiting_time": 0.060}},
+    ]
+    plan, ev, ps = _run_both(data)
+    assert plan.has_db_pool
+    _assert_parity(ev, ps)
+
+
+def test_db_pool_conservation() -> None:
+    """generated == completed + dropped + in-flight on the pool config
+    (no request may vanish inside the DB ticket queue)."""
+    data = _base(horizon=10.0)
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    srv["server_resources"]["db_connection_pool"] = 1
+    srv["endpoints"][0]["steps"] = [
+        {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.002}},
+        {"kind": "io_db", "step_operation": {"io_waiting_time": 0.030}},
+    ]
+    _plan, _ev, ps = _run_both(data)
+    gen = int(ps.n_generated.sum())
+    comp = int(ps.lat_count.sum())
+    drop = int(ps.n_dropped.sum())
+    over = int(ps.n_overflow.sum())
+    assert comp + drop + over <= gen
+    # in-flight at horizon is bounded by the pool backlog a 1-conn server
+    # can hold; the vast majority must complete
+    assert comp > 0.5 * gen
+
+
+def test_featured_kernel_lowers_for_tpu_from_cpu() -> None:
+    """The round-5 feature paths (cache mixture draw, in-kernel LLM token
+    process, DB ticket queue, weighted endpoint walk) must ALSO pass every
+    Mosaic conversion pass — same gate as the base kernel."""
+    data = _base(horizon=6.0)
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    srv["server_resources"]["db_connection_pool"] = 2
+    srv["endpoints"] = [
+        {
+            "endpoint_name": "/mixed",
+            "selection_weight": 3.0,
+            "steps": [
+                {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.002}},
+                {
+                    "kind": "io_cache",
+                    "step_operation": {"io_waiting_time": 0.002},
+                    "cache_hit_probability": 0.8,
+                    "cache_miss_time": 0.050,
+                },
+                {"kind": "io_db", "step_operation": {"io_waiting_time": 0.020}},
+            ],
+        },
+        {
+            "endpoint_name": "/llm",
+            "selection_weight": 1.0,
+            "steps": [
+                {
+                    "kind": "io_llm",
+                    "step_operation": {"io_waiting_time": 0.004},
+                    "llm_tokens_mean": 40.0,
+                    "llm_time_per_token": 0.0005,
+                    "llm_cost_per_token": 0.01,
+                },
+            ],
+        },
+    ]
+    plan = compile_payload(SimulationPayload.model_validate(data))
+    assert plan.has_db_pool and plan.has_stochastic_cache
+    assert plan.has_llm and plan.has_weighted_endpoints
+    eng = PallasEngine(plan, interpret=False)
+    lowered = eng.lower_tpu(scenario_keys(3, 4))
+    assert "tpu_custom_call" in lowered.as_text()
