@@ -1480,41 +1480,60 @@ def _fastpath_analysis(
                 0,
                 0.0,
             )
-        if server_queue_cap is not None and server_queue_cap[s] >= 0:
-            # a reachable ready-queue cap sheds requests mid-endpoint; the
-            # closed-form recursions have no rejection channel
-            return (
-                False,
-                f"server {server.id}: reachable ready-queue cap "
-                "(load shedding modeled on the event engines)",
-                [],
-                no_slots,
-                0,
-                0.0,
+        # Feedback-free overload controls (round 5).  A token-bucket rate
+        # limit is a pure function of the arrival sequence (arrival-order
+        # scan, any server shape).  A ready-queue cap / dequeue deadline is
+        # exact as a joint KW+ring arrival-order scan when the server has
+        # at most one CPU burst and no RAM admission tier (FIFO starts are
+        # monotone, so "cap-th most recent start still in the future" IS
+        # the shed test; abandons add zero service at their grant).  Other
+        # shapes keep the event-engine fence.
+        cap_reachable = server_queue_cap is not None and server_queue_cap[s] >= 0
+        to_reachable = (
+            server_queue_timeout is not None and server_queue_timeout[s] >= 0
+        )
+        if cap_reachable or to_reachable:
+            visits_s = max(
+                (
+                    sum(1 for k, _ in segs if k == SEG_CPU)
+                    for segs, *_ in compiled[s]
+                ),
+                default=0,
             )
-        if server_rate_limit is not None and server_rate_limit[s] >= 0:
-            # a reachable token-bucket limiter refuses arrivals; no
-            # refusal channel in the closed-form recursions
-            return (
-                False,
-                f"server {server.id}: reachable rate limit "
-                "(token bucket modeled on the event engines)",
-                [],
-                no_slots,
-                0,
-                0.0,
+            max_ram_s = max(
+                (ram for _, ram, *_ in compiled[s]), default=0.0,
             )
-        if server_queue_timeout is not None and server_queue_timeout[s] >= 0:
-            # a reachable dequeue deadline abandons requests mid-endpoint
-            return (
-                False,
-                f"server {server.id}: reachable queue deadline "
-                "(timeouts modeled on the event engines)",
-                [],
-                no_slots,
-                0,
-                0.0,
-            )
+            name = "ready-queue cap" if cap_reachable else "dequeue deadline"
+            if visits_s > 1:
+                return (
+                    False,
+                    f"server {server.id}: reachable {name} on a multi-burst "
+                    "endpoint (modeled on the event engines)",
+                    [],
+                    no_slots,
+                    0,
+                    0.0,
+                )
+            if max_ram_s > 0:
+                return (
+                    False,
+                    f"server {server.id}: reachable {name} with a RAM "
+                    "admission tier (modeled on the event engines)",
+                    [],
+                    no_slots,
+                    0,
+                    0.0,
+                )
+            if cap_reachable and server_queue_cap[s] > 128:
+                return (
+                    False,
+                    f"server {server.id}: ready-queue cap {server_queue_cap[s]} "
+                    "exceeds the scan ring bound (128)",
+                    [],
+                    no_slots,
+                    0,
+                    0.0,
+                )
         # Stochastic cache segments are per-request duration extras and DB
         # pools are one extra FIFO G/G/K station per server on the fast
         # path (round 4) — eligible as long as every endpoint's shape fits

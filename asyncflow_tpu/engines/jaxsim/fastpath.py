@@ -121,6 +121,9 @@ class FastState(NamedTuple):
     #: (n_gauges,) exact time-average of every gauge over the horizon —
     #: cheap per-scenario what-if statistics even in histogram-only sweeps
     gauge_means: jnp.ndarray
+    #: requests refused by overload controls (rate limit / queue cap /
+    #: dequeue deadline) — the event engines' n_rejected counterpart
+    n_rejected: jnp.ndarray
 
 
 def _kw_waits(
@@ -225,6 +228,78 @@ def _lindley_waits(arrivals: jnp.ndarray, service: jnp.ndarray, valid) -> jnp.nd
 
     _, cb = jax.lax.associative_scan(compose, (a, b))
     return jnp.maximum(0.0, cb - svc - arr)
+
+
+def _token_bucket_scan(t_sorted, valid_sorted, rate: float, burst: float):
+    """Accepted mask (sorted order) of the arrival token bucket.
+
+    Mirrors the oracle (`engines/oracle/engine.py:186-202`): the bucket
+    starts full, refills ``rate * dt`` capped at ``burst``, rejects below
+    one whole token, and its refill clock advances on every arrival —
+    rejected ones included.  Feed-forward (no queue feedback), so an
+    arrival-order scan is exact.
+    """
+
+    def step(carry, x):
+        tokens, last = carry
+        t_i, v = x
+        tok = jnp.minimum(jnp.float32(burst), tokens + (t_i - last) * rate)
+        acc = v & (tok >= 1.0)
+        tok = tok - jnp.where(acc, 1.0, 0.0)
+        return (
+            jnp.where(v, tok, tokens),
+            jnp.where(v, t_i, last),
+        ), acc
+
+    _, acc = jax.lax.scan(
+        step,
+        (jnp.float32(burst), jnp.float32(0.0)),
+        (t_sorted, valid_sorted),
+    )
+    return acc
+
+
+def _controlled_station_scan(
+    enq, dur, valid, n_cores: int, cap: int, timeout: float,
+):
+    """Exact FIFO G/G/c waits under a ready-queue cap and dequeue deadline.
+
+    One arrival-order pass per controlled server: the carry holds the
+    Kiefer-Wolfowitz vector of absolute core-free times plus a ring of the
+    last ``cap`` service-start times.  FIFO starts are monotone, so "the
+    cap-th most recent start is still in the future at my enqueue" is
+    exactly "cap requests are waiting" — the shed test
+    (`engines/oracle/engine.py:251-257`).  A request whose wait exceeds
+    the deadline abandons at its grant, consuming zero service
+    (`engine.py:276-295`): the core's free time becomes the grant instant.
+
+    Returns (wait, shed, abandoned) per sorted element.
+    """
+    r = max(cap, 1)
+
+    def step(carry, x):
+        w, ring = carry
+        e, s_dur, v = x
+        shed = v & jnp.bool_(cap >= 0) & (ring[0] > e)
+        g = jnp.maximum(e, w[0])
+        wait = g - e
+        live = v & ~shed
+        abandoned = live & jnp.bool_(timeout >= 0.0) & (wait > timeout)
+        w0 = g + jnp.where(abandoned, 0.0, s_dur)
+        w = jnp.where(live, jnp.sort(w.at[0].set(w0)), w)
+        ring = jnp.where(
+            live, jnp.concatenate([ring[1:], jnp.array([g])]), ring,
+        )
+        return (w, ring), (wait, shed, abandoned)
+
+    init = (
+        jnp.zeros(n_cores, jnp.float32),
+        jnp.full((r,), -INF, jnp.float32),
+    )
+    _, (wait, shed, abandoned) = jax.lax.scan(
+        step, init, (enq, dur, valid),
+    )
+    return wait, shed, abandoned
 
 
 class FastEngine:
@@ -584,6 +659,7 @@ class FastEngine:
         start = t
         n_generated = jnp.sum(alive)
         n_dropped = jnp.int32(0)
+        n_rejected = jnp.int32(0)
 
         # exact time-integrals of every gauge (divided by the horizon at the
         # end); an interval [a, b) contributes its horizon-clipped length
@@ -689,6 +765,29 @@ class FastEngine:
         )
         for s in plan.server_topo_order:
             mine = alive & (srv == s) & (t < plan.horizon)
+
+            # token-bucket rate limit at arrival (reference milestone 5):
+            # feed-forward, so one arrival-order scan settles it exactly
+            rate_s = (
+                float(plan.server_rate_limit[s])
+                if len(plan.server_rate_limit)
+                else -1.0
+            )
+            if rate_s >= 0:
+                rank_rl = time_rank(t, mine)
+                nn = t.shape[0]
+                acc_sorted = _token_bucket_scan(
+                    jnp.full(nn, INF).at[rank_rl].set(jnp.where(mine, t, INF)),
+                    jnp.zeros(nn, bool).at[rank_rl].set(mine),
+                    rate_s,
+                    float(plan.server_rate_burst[s]),
+                )
+                accepted = acc_sorted[rank_rl]
+                limited = mine & ~accepted
+                n_rejected = n_rejected + jnp.sum(limited)
+                alive = alive & ~limited
+                mine = mine & accepted
+
             nep = int(plan.n_endpoints[s])
             u = jax.random.uniform(jax.random.fold_in(key, 64 + s), (n,))
             ep = jnp.minimum(
@@ -737,9 +836,68 @@ class FastEngine:
             ram_k = int(plan.ram_slots[s]) if len(plan.ram_slots) else 0
             W_ram = jnp.zeros(n, jnp.float32)
 
+            cap_s = (
+                int(plan.server_queue_cap[s])
+                if len(plan.server_queue_cap)
+                else -1
+            )
+            qto_s = (
+                float(plan.server_queue_timeout[s])
+                if len(plan.server_queue_timeout)
+                else -1.0
+            )
+            controlled = cap_s >= 0 or qto_s >= 0
+
             if kb == 0 and ram_k <= 0:
                 # pure-IO server: no queues, departure is deterministic
                 dep = t + post
+            elif controlled:
+                # ready-queue cap / dequeue deadline: exact joint KW+ring
+                # arrival-order scan (compiler guarantees kb == 1, no RAM)
+                assert kb == 1 and ram_k <= 0
+                nb = n_bursts_t[s, ep]
+                pre0 = jnp.where(nb >= 1, burst_pre_t[s, ep][:, 0], 0.0)
+                if server_has_cache:
+                    # pre-burst stochastic cache extras shift this request's
+                    # enqueue time; the scan orders by enqueue, so adding
+                    # them here keeps the pass exact (same per-slot fold as
+                    # the relaxation branch's pre_extra)
+                    pre0 = pre0 + jnp.where(
+                        nb >= 1,
+                        jnp.sum(
+                            jnp.where(cache_slot_r == 0, cache_extra_r, 0.0),
+                            axis=1,
+                        ),
+                        0.0,
+                    )
+                dur0 = jnp.where(nb >= 1, burst_dur_t[s, ep][:, 0], 0.0)
+                part = mine & (nb >= 1)  # io-only endpoints skip the queue
+                e_c = jnp.where(part, t + pre0, INF)
+                rank_c = time_rank(e_c, part)
+                w_s_, shed_s, aband_s = _controlled_station_scan(
+                    jnp.full(n, INF).at[rank_c].set(e_c),
+                    jnp.zeros(n).at[rank_c].set(jnp.where(part, dur0, 0.0)),
+                    jnp.zeros(n, bool).at[rank_c].set(part),
+                    n_cores,
+                    cap_s,
+                    qto_s,
+                )
+                W_c = jnp.where(part, w_s_[rank_c], 0.0)
+                shed = part & shed_s[rank_c]
+                abandoned = part & aband_s[rank_c]
+                rejected = shed | abandoned
+                n_rejected = n_rejected + jnp.sum(rejected)
+                alive = alive & ~rejected
+                served = mine & ~rejected
+                # gauge shapes shared with the other branches: enqueue,
+                # wait, pre-IO per (single) visit; shed never enters the
+                # ready queue (W forced 0), abandons wait their full W
+                E = (t + pre0)[:, None]
+                W = jnp.where(shed, 0.0, W_c)[:, None]
+                pre = pre0[:, None]
+                validb = part[:, None]
+                dep = t + pre0 + W_c + dur0 + post
+                mine = served
             elif ram_k > 0:
                 # Binding RAM (eligibility guarantees at most one burst and a
                 # uniform need): admission + core settled jointly in one
@@ -999,6 +1157,7 @@ class FastEngine:
             n_dropped=n_dropped,
             n_overflow=overflow,
             gauge_means=gauge_means / horizon,
+            n_rejected=n_rejected,
         )
 
     def run_batch(

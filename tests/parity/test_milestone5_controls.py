@@ -168,13 +168,14 @@ class TestCompilerTiering:
         assert plan.fastpath_ok, plan.fastpath_reason
         assert plan.proof_rate_headroom < np.inf  # guard records the proof
 
-    def test_reachable_rate_limit_declines_fast_path(self) -> None:
+    def test_reachable_rate_limit_keeps_fast_path(self) -> None:
+        # round 5: the token bucket is feed-forward, so the fast path
+        # models it with an arrival-order scan instead of declining
         plan = compile_payload(_payload(_rate_limited))
         assert plan.has_rate_limit
         assert plan.server_rate_limit[0] == pytest.approx(6.0)
         assert plan.server_rate_burst[0] == 6
-        assert not plan.fastpath_ok
-        assert "rate limit" in plan.fastpath_reason
+        assert plan.fastpath_ok, plan.fastpath_reason
 
     def test_unreachable_deadline_lowers_away(self) -> None:
         def mut(data):
@@ -187,11 +188,30 @@ class TestCompilerTiering:
         assert not plan.has_queue_timeout
         assert plan.fastpath_ok, plan.fastpath_reason
 
-    def test_reachable_deadline_declines_fast_path(self) -> None:
+    def test_reachable_deadline_keeps_fast_path(self) -> None:
+        # round 5: single-burst, no-RAM servers settle the deadline in the
+        # exact KW+ring arrival-order scan
         plan = compile_payload(_payload(_deadlined))
         assert plan.has_queue_timeout
+        assert plan.fastpath_ok, plan.fastpath_reason
+
+    def test_deadline_on_multiburst_still_declines(self) -> None:
+        def mut(data):
+            _deadlined(data)
+            srv = data["topology_graph"]["nodes"]["servers"][0]
+            srv["endpoints"][0]["steps"] = [
+                {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.03}},
+                {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.01}},
+                {
+                    "kind": "cpu_bound_operation",
+                    "step_operation": {"cpu_time": 0.03},
+                },
+            ]
+
+        plan = compile_payload(_payload(mut))
+        assert plan.has_queue_timeout
         assert not plan.fastpath_ok
-        assert "deadline" in plan.fastpath_reason
+        assert "multi-burst" in plan.fastpath_reason
 
     def test_deadline_inert_without_cpu(self) -> None:
         def mut(data):
@@ -337,3 +357,121 @@ def test_pallas_declines_milestone5_controls() -> None:
             PallasEngine(compile_payload(_payload(mut)))
     with pytest.raises(ValueError, match="overload policies"):
         PallasEngine(compile_payload(_payload(_breakered, base=LB)))
+
+
+def _matched_users(p, n=SEEDS):
+    """One shared user-draw sequence for every engine (a token bucket's
+    refusal fraction is strongly load-dependent, so per-engine user
+    ensembles of ~16 window draws dominate the comparison otherwise —
+    same decomposition as docs/internals/fastpath.md §5)."""
+    rng = np.random.default_rng(321)
+    return rng.poisson(p.rqs_input.avg_active_users.mean, n).astype(float)
+
+
+def _pin_users(p, users: float) -> SimulationPayload:
+    data = p.model_dump()
+    data["rqs_input"]["avg_active_users"] = {
+        "mean": float(users), "variance": 1e-9, "distribution": "normal",
+    }
+    return SimulationPayload.model_validate(data)
+
+
+def _oracle_matched(p, users, n=SEEDS):
+    gen = rej = 0
+    lats = []
+    for s in range(n):
+        r = OracleEngine(_pin_users(p, users[s]), seed=s).run()
+        gen += r.total_generated
+        rej += r.total_rejected
+        lats.append(r.latencies)
+    return gen, rej, np.concatenate(lats)
+
+
+def _fast_matched(p, users, n=SEEDS):
+    import jax.numpy as jnp
+
+    from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+    from asyncflow_tpu.engines.jaxsim.params import base_overrides
+
+    plan = compile_payload(_pin_users(p, float(users.max())))
+    assert plan.fastpath_ok, plan.fastpath_reason
+    engine = FastEngine(plan, collect_clocks=True)
+    ov = base_overrides(plan)._replace(user_mean=jnp.asarray(users, jnp.float32))
+    fin = engine.run_batch(scenario_keys(11, n), ov)
+    assert int(np.asarray(fin.n_overflow).sum()) == 0
+    clock = np.asarray(fin.clock)
+    cnt = np.asarray(fin.clock_n)
+    lat = np.concatenate(
+        [clock[i, : cnt[i], 1] - clock[i, : cnt[i], 0] for i in range(n)],
+    )
+    return (
+        int(np.sum(np.asarray(fin.n_generated))),
+        int(np.sum(np.asarray(fin.n_rejected))),
+        lat,
+    )
+
+
+class TestFastPathControls:
+    """Round 5: feedback-free controls ride the fast path — token-bucket
+    scan for the rate limit, exact KW+ring scan for the dequeue deadline."""
+
+    def test_rate_limit_fast_parity(self) -> None:
+        p = _payload(_rate_limited)
+        assert compile_payload(p).fastpath_ok
+        users = _matched_users(p)
+        o = _oracle_matched(p, users)
+        assert o[1] / o[0] > 0.25  # the limiter genuinely binds
+        _check_parity("rl-fast", o, _fast_matched(p, users))
+
+    def test_queue_timeout_fast_parity(self) -> None:
+        p = _payload(_deadlined)
+        assert compile_payload(p).fastpath_ok
+        users = _matched_users(p)
+        o = _oracle_matched(p, users)
+        assert 0.03 < o[1] / o[0] < 0.4
+        _check_parity("to-fast", o, _fast_matched(p, users), lat_tol=0.06)
+
+    def test_combined_rate_limit_and_deadline(self) -> None:
+        def mut(data):
+            _deadlined(data)
+            data["topology_graph"]["nodes"]["servers"][0]["overload"] = {
+                "queue_timeout_s": 0.15,
+                "rate_limit_rps": 12.0,
+                "rate_limit_burst": 12,
+            }
+
+        p = _payload(mut)
+        assert compile_payload(p).fastpath_ok
+        users = _matched_users(p)
+        o = _oracle_matched(p, users)
+        assert o[1] > 0
+        _check_parity("rl+to-fast", o, _fast_matched(p, users), lat_tol=0.06)
+
+
+def test_deadline_with_preburst_cache_fast_parity() -> None:
+    """A stochastic cache segment BEFORE the burst shifts enqueue times;
+    the controlled scan must fold its per-request miss extras in (exactness
+    regression for the round-5 review finding)."""
+
+    def mut(data):
+        srv = data["topology_graph"]["nodes"]["servers"][0]
+        srv["endpoints"][0]["steps"] = [
+            {
+                "kind": "io_cache",
+                "step_operation": {"io_waiting_time": 0.002},
+                "cache_hit_probability": 0.5,
+                "cache_miss_time": 0.060,
+            },
+            {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.050}},
+        ]
+        data["rqs_input"]["avg_active_users"]["mean"] = 50
+        srv["overload"] = {"queue_timeout_s": 0.15}
+
+    p = _payload(mut)
+    plan = compile_payload(p)
+    assert plan.fastpath_ok, plan.fastpath_reason
+    assert plan.has_queue_timeout and plan.has_stochastic_cache
+    users = _matched_users(p)
+    o = _oracle_matched(p, users)
+    assert o[1] > 0  # deadlines fire
+    _check_parity("to+cache-fast", o, _fast_matched(p, users), lat_tol=0.06)

@@ -59,16 +59,25 @@ class TestCompilerTiering:
         assert not plan.has_queue_cap
         assert plan.fastpath_ok, plan.fastpath_reason
 
-    def test_reachable_cap_routes_to_event_engine(self) -> None:
+    def test_reachable_cap_keeps_fast_path(self) -> None:
+        # round 5: single-burst, no-RAM servers model the cap in the exact
+        # KW+ring arrival-order scan instead of declining
         plan = compile_payload(_payload(3))
         assert plan.has_queue_cap
         assert plan.server_queue_cap[0] == 3
-        assert not plan.fastpath_ok
-        assert "ready-queue cap" in plan.fastpath_reason
+        assert plan.fastpath_ok, plan.fastpath_reason
 
         from asyncflow_tpu.parallel import SweepRunner
 
-        assert SweepRunner(_payload(3), use_mesh=False).engine_kind == "event"
+        assert SweepRunner(_payload(3), use_mesh=False).engine_kind == "fast"
+
+    def test_cap_beyond_ring_bound_declines(self) -> None:
+        # a reachable cap above the 128-slot scan ring falls back to the
+        # event engine (mirrors the least-connections ring fence)
+        plan = compile_payload(_payload(400, users=90))
+        assert plan.has_queue_cap  # rho > 1: always reachable
+        assert not plan.fastpath_ok
+        assert "ring bound" in plan.fastpath_reason
 
     def test_saturated_server_always_models_the_cap(self) -> None:
         # rho_b ~ 1.1 at these settings: the queue grows without bound, so
@@ -283,3 +292,37 @@ class TestConnectionCapacity:
                 r.total_generated for r in res
             )
         assert fracs[2] > fracs[4] > fracs[None] == 0.0
+
+
+def test_fast_path_shed_parity() -> None:
+    """Round 5: the reachable cap keeps the fast path (exact KW+ring scan);
+    shed fraction and latency shape must match the oracle like the event
+    engine does."""
+    from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+
+    payload = _payload(3)
+    plan = compile_payload(payload)
+    assert plan.fastpath_ok, plan.fastpath_reason
+    n = 8
+
+    res_o = [OracleEngine(payload, seed=s).run() for s in range(n)]
+    rej_o = sum(r.total_rejected for r in res_o)
+    gen_o = sum(r.total_generated for r in res_o)
+    assert rej_o > 0.02 * gen_o
+
+    engine = FastEngine(plan, collect_clocks=True)
+    final = engine.run_batch(scenario_keys(11, n))
+    rej_f = int(np.sum(np.asarray(final.n_rejected)))
+    gen_f = int(np.sum(np.asarray(final.n_generated)))
+    assert abs(rej_f / gen_f - rej_o / gen_o) < 0.02
+
+    lat_o = np.concatenate([r.latencies for r in res_o])
+    clock = np.asarray(final.clock)
+    counts = np.asarray(final.clock_n)
+    lat_f = np.concatenate(
+        [clock[i, : counts[i], 1] - clock[i, : counts[i], 0] for i in range(n)],
+    )
+    assert abs(lat_f.mean() - lat_o.mean()) / lat_o.mean() < 0.05
+    for q in (50, 95):
+        po, pf = np.percentile(lat_o, q), np.percentile(lat_f, q)
+        assert abs(pf - po) / po < 0.06, (q, po, pf)
