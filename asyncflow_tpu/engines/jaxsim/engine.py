@@ -361,6 +361,32 @@ class Engine:
         best = jnp.argmin(order_key).astype(jnp.int32)
         return st.lb_order[best], st.lb_order
 
+    def _lb_pick_weighted(self, st: EngineState, weights, key, admits=None):
+        """(slot, none_eligible): sample a rotation member ~ its weight.
+
+        The RL playground's action channel (`rl/batched.py`), mirroring
+        the oracle's ``lb_weights`` hook
+        (`engines/oracle/engine.py:525-536`): weights index LB slots in
+        topology order, eligibility (rotation membership, breaker admits)
+        applies first, an all-zero eligible mass falls back to uniform,
+        and the rotation order is left untouched.
+        """
+        el = max(self.plan.n_lb_edges, 1)
+        pos = jnp.arange(el, dtype=jnp.int32)
+        valid = pos < st.lb_len
+        elig = valid if admits is None else valid & admits[st.lb_order]
+        w = jnp.where(elig, jnp.maximum(weights[st.lb_order], 0.0), 0.0)
+        total = jnp.sum(w)
+        w = jnp.where(total > 0, w, elig.astype(jnp.float32))
+        cum = jnp.cumsum(w)
+        u = jax.random.uniform(key) * cum[-1]
+        idx = jnp.sum((cum <= u).astype(jnp.int32))
+        # float rounding can put u exactly at cum[-1] (idx == el); clamp to
+        # the LAST ELIGIBLE slot, never a removed/ineligible position
+        last_elig = el - 1 - jnp.argmax(jnp.flip(elig).astype(jnp.int32))
+        idx = jnp.minimum(idx, last_elig)
+        return st.lb_order[idx], ~jnp.any(elig)
+
     def _lb_pick_breaker(self, st: EngineState, admits):
         """(slot, rotated order, none_admitting) honoring breaker state.
 
@@ -842,7 +868,7 @@ class Engine:
             ),
         )
 
-    def _arrive_lb_branch(self, st, i, now, key, ov, pred) -> EngineState:
+    def _arrive_lb_branch(self, st, i, now, key, ov, pred, weights=None) -> EngineState:
         """Route one request at the LB (empty rotation drops the request;
         with a circuit breaker, open slots are skipped in place and a fully
         open rotation REJECTS the request — an overload protection)."""
@@ -866,7 +892,13 @@ class Engine:
                 (st.cb_state == 2)
                 & (st.cb_probes_out < self.plan.breaker_probes)
             )
-            slot, rotated, none_open = self._lb_pick_breaker(st, admits)
+            if weights is not None:
+                slot, none_open = self._lb_pick_weighted(
+                    st, weights, jax.random.fold_in(key, 33), admits,
+                )
+                rotated = st.lb_order
+            else:
+                slot, rotated, none_open = self._lb_pick_breaker(st, admits)
             reject = route & none_open
             route = route & ~none_open
             st = st._replace(
@@ -891,7 +923,13 @@ class Engine:
                 ),
             )
         else:
-            slot, rotated = self._lb_pick(st)
+            if weights is not None:
+                slot, _none = self._lb_pick_weighted(
+                    st, weights, jax.random.fold_in(key, 33),
+                )
+                rotated = st.lb_order
+            else:
+                slot, rotated = self._lb_pick(st)
         order = jnp.where(route, rotated, st.lb_order)
         e = p.lb_edge_index[slot]
         dropped, delay = self._sample_edge(e, now, jax.random.fold_in(key, 32), ov)
@@ -1307,7 +1345,7 @@ class Engine:
         t_min = jnp.minimum(jnp.minimum(t_pool, t_arr), t_tl)
         return (t_min < self.plan.horizon) & (st.it < self.plan.max_iterations)
 
-    def _body(self, st: EngineState, ov) -> EngineState:
+    def _body(self, st: EngineState, ov, weights=None) -> EngineState:
         t_pool, t_arr, t_tl = self._next_times(st)
         now = jnp.minimum(jnp.minimum(t_pool, t_arr), t_tl)
         in_horizon = now < self.plan.horizon
@@ -1326,7 +1364,9 @@ class Engine:
         # `now`, so the cached index stays the pool minimum when is_pool
         i = st.nxt_i
         ev = st.req_ev[i]
-        st = self._arrive_lb_branch(st, i, now, kit, ov, is_pool & (ev == EV_ARRIVE_LB))
+        st = self._arrive_lb_branch(
+            st, i, now, kit, ov, is_pool & (ev == EV_ARRIVE_LB), weights,
+        )
         st = self._arrive_srv_branch(st, i, now, kit, ov, is_pool & (ev == EV_ARRIVE_SRV))
         st = self._resume_branch(st, i, now, kit, ov, is_pool & (ev == EV_RESUME))
         st = self._seg_end_branch(st, i, now, kit, ov, is_pool & (ev == EV_SEG_END))
@@ -1343,6 +1383,85 @@ class Engine:
     # ==================================================================
     # public entry points
     # ==================================================================
+
+    def init_batch(
+        self,
+        keys: jnp.ndarray,
+        overrides: ScenarioOverrides | None = None,
+    ) -> EngineState:
+        """Fresh (vmapped) pre-loop state for |keys| scenarios — the entry
+        point of the segmented stepping API (:meth:`run_until`)."""
+        ov = overrides if overrides is not None else base_overrides(self.plan)
+        axes = ScenarioOverrides(
+            *[0 if o.ndim > b.ndim else None
+              for o, b in zip(ov, base_overrides(self.plan))],
+        )
+        sig = ("init", tuple(axes))
+        if sig not in self._compiled:
+            self._compiled[sig] = jax.jit(
+                jax.vmap(self._init_state, in_axes=(0, axes)),
+            )
+        return self._compiled[sig](keys, ov)
+
+    def run_until(
+        self,
+        state: EngineState,
+        t_stop,
+        overrides: ScenarioOverrides | None = None,
+        weights=None,
+    ) -> EngineState:
+        """Advance every scenario until its next event is at or beyond
+        ``t_stop`` (clamped to the horizon) — ONE compiled call for the
+        whole batch.
+
+        The RL playground's batched rollout seam: ``state`` comes from
+        :meth:`init_batch` or a previous window; ``t_stop`` is a scalar or
+        (S,) per-scenario stop time; ``weights`` an optional (S, EL)
+        routing-weight action (see :meth:`_lb_pick_weighted`).  Stepping
+        to the horizon in windows is bit-identical to one
+        :meth:`run_batch` call — the loop body and the per-iteration RNG
+        derivation are the same; windows only pause it (events exactly at
+        ``t_stop`` run in the next window, matching the oracle kernel's
+        ``sim.run(until=...)``)."""
+        ov = overrides if overrides is not None else base_overrides(self.plan)
+        axes = ScenarioOverrides(
+            *[0 if o.ndim > b.ndim else None
+              for o, b in zip(ov, base_overrides(self.plan))],
+        )
+        t_stop = jnp.asarray(t_stop, jnp.float32)
+        batched_stop = t_stop.ndim > 0
+        has_w = weights is not None
+        sig = ("until", batched_stop, has_w, tuple(axes))
+        if sig not in self._compiled:
+
+            def one(st, stop, ov_, w):
+                limit = jnp.minimum(jnp.float32(self.plan.horizon), stop)
+
+                def cond(s):
+                    t_pool, t_arr, t_tl = self._next_times(s)
+                    t_min = jnp.minimum(jnp.minimum(t_pool, t_arr), t_tl)
+                    return (t_min < limit) & (
+                        s.it < self.plan.max_iterations
+                    )
+
+                return jax.lax.while_loop(
+                    cond, lambda s: self._body(s, ov_, w), st,
+                )
+
+            self._compiled[sig] = jax.jit(
+                jax.vmap(
+                    one,
+                    in_axes=(
+                        0,
+                        0 if batched_stop else None,
+                        axes,
+                        0 if has_w else None,
+                    ),
+                ),
+            )
+        if has_w:
+            weights = jnp.asarray(weights, jnp.float32)
+        return self._compiled[sig](state, t_stop, ov, weights)
 
     def run_batch(
         self,

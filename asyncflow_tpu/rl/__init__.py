@@ -1,6 +1,7 @@
 """RL playground (reference roadmap milestone 6): Gym-style environments
 over the simulator."""
 
+from asyncflow_tpu.rl.batched import BatchedLoadBalancerEnv
 from asyncflow_tpu.rl.env import LoadBalancerEnv
 
-__all__ = ["LoadBalancerEnv"]
+__all__ = ["BatchedLoadBalancerEnv", "LoadBalancerEnv"]

@@ -38,6 +38,36 @@ from asyncflow_tpu.engines.oracle.engine import OracleEngine
 from asyncflow_tpu.schemas.payload import SimulationPayload
 
 
+def bind_lb_topology(payload: SimulationPayload, decision_period_s: float, reward):
+    """Validate env construction inputs and derive the LB action/obs
+    binding shared by the sequential and batched envs: returns
+    ``(edge_ids, target_ids, server_ids, action_dim, observation_dim)``."""
+    if payload.topology_graph.nodes.load_balancer is None:
+        msg = "this environment needs a load-balancer topology"
+        raise ValueError(msg)
+    if decision_period_s <= 0:
+        msg = f"decision_period_s must be > 0, got {decision_period_s}"
+        raise ValueError(msg)
+    if isinstance(reward, str) and reward not in (
+        "neg_mean_latency",
+        "throughput",
+    ):
+        msg = (
+            "reward must be 'neg_mean_latency', 'throughput', or a "
+            f"callable, got {reward!r}"
+        )
+        raise ValueError(msg)
+    lb_id = payload.topology_graph.nodes.load_balancer.id
+    edge_ids = [e.id for e in payload.topology_graph.edges if e.source == lb_id]
+    target_ids = [
+        e.target for e in payload.topology_graph.edges if e.source == lb_id
+    ]
+    server_ids = [s.id for s in payload.topology_graph.nodes.servers]
+    action_dim = len(edge_ids)
+    observation_dim = 4 * len(server_ids) + action_dim + 3
+    return edge_ids, target_ids, server_ids, action_dim, observation_dim
+
+
 class LoadBalancerEnv:
     """Sequential (single-scenario) routing environment.
 
@@ -55,21 +85,13 @@ class LoadBalancerEnv:
         reward: str | Callable[[dict], float] = "neg_mean_latency",
         seed: int | None = None,
     ) -> None:
-        if payload.topology_graph.nodes.load_balancer is None:
-            msg = "LoadBalancerEnv needs a load-balancer topology"
-            raise ValueError(msg)
-        if decision_period_s <= 0:
-            msg = f"decision_period_s must be > 0, got {decision_period_s}"
-            raise ValueError(msg)
-        if isinstance(reward, str) and reward not in (
-            "neg_mean_latency",
-            "throughput",
-        ):
-            msg = (
-                "reward must be 'neg_mean_latency', 'throughput', or a "
-                f"callable, got {reward!r}"
-            )
-            raise ValueError(msg)
+        (
+            edge_ids,
+            target_ids,
+            server_ids,
+            action_dim,
+            observation_dim,
+        ) = bind_lb_topology(payload, decision_period_s, reward)
         self.payload = payload
         self.decision_period_s = float(decision_period_s)
         self.reward = reward
@@ -80,21 +102,13 @@ class LoadBalancerEnv:
         self._seen_completions = 0
         self._seen_generated = 0
 
-        lb = payload.topology_graph.nodes.load_balancer
-        lb_id = lb.id
         #: LB out-edge ids in topology order — the action vector's order
-        self.edge_ids: list[str] = [
-            e.id for e in payload.topology_graph.edges if e.source == lb_id
-        ]
+        self.edge_ids: list[str] = edge_ids
         #: target server id per action component
-        self.target_ids: list[str] = [
-            e.target for e in payload.topology_graph.edges if e.source == lb_id
-        ]
-        self.server_ids: list[str] = [
-            s.id for s in payload.topology_graph.nodes.servers
-        ]
-        self.action_dim = len(self.edge_ids)
-        self.observation_dim = 4 * len(self.server_ids) + self.action_dim + 3
+        self.target_ids: list[str] = target_ids
+        self.server_ids: list[str] = server_ids
+        self.action_dim = action_dim
+        self.observation_dim = observation_dim
 
     # ------------------------------------------------------------------
 

@@ -17,10 +17,12 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
+import time
+
 import numpy as np
 import yaml
 
-from asyncflow_tpu.rl import LoadBalancerEnv
+from asyncflow_tpu.rl import BatchedLoadBalancerEnv, LoadBalancerEnv
 from asyncflow_tpu.runtime.runner import SimulationRunner
 from asyncflow_tpu.schemas.payload import SimulationPayload
 
@@ -53,6 +55,21 @@ def episode_return(env: LoadBalancerEnv, weights: np.ndarray, seed: int) -> floa
             return total
 
 
+def batched_generation(
+    env: BatchedLoadBalancerEnv, cands: np.ndarray, seed: int,
+) -> np.ndarray:
+    """Evaluate a WHOLE candidate population in one batched episode:
+    env i applies candidate i's weights every decision — each window of
+    all environments advances in one compiled call."""
+    env.reset(seed=seed)
+    totals = np.zeros(len(cands))
+    while True:
+        _, r, term, _, _ = env.step(cands)
+        totals += r
+        if term.all():
+            return totals
+
+
 def main() -> None:
     generations = int(sys.argv[1]) if len(sys.argv) > 1 else 5
     payload = build_payload()
@@ -64,16 +81,17 @@ def main() -> None:
     rr_mean = rr.run().get_latency_stats()["mean"]
     print(f"round-robin baseline: mean latency {rr_mean * 1e3:.1f} ms")
 
-    # cross-entropy over the weight simplex
-    mu, sigma = np.full(env.action_dim, 0.5), np.full(env.action_dim, 0.3)
-    pop, elite = 8, 3
+    # cross-entropy over the weight simplex — every generation's
+    # population rolls out as ONE batched episode on the event engine
+    pop, elite = 16, 5
+    benv = BatchedLoadBalancerEnv(payload, pop, decision_period_s=1.0)
+    mu, sigma = np.full(benv.action_dim, 0.5), np.full(benv.action_dim, 0.3)
+    t0 = time.time()
     for gen in range(generations):
         cands = np.clip(
-            rng.normal(mu, sigma, size=(pop, env.action_dim)), 0.0, None,
+            rng.normal(mu, sigma, size=(pop, benv.action_dim)), 0.0, None,
         )
-        rets = np.array(
-            [episode_return(env, c, seed=100 + gen) for c in cands],
-        )
+        rets = batched_generation(benv, cands, seed=100 + gen)
         top = cands[np.argsort(rets)[-elite:]]
         mu, sigma = top.mean(0), top.std(0) + 0.02
         w = mu / max(mu.sum(), 1e-9)
@@ -81,6 +99,34 @@ def main() -> None:
             f"gen {gen}: best return {rets.max():7.2f}  "
             f"mean weights {np.array2string(w, precision=2)}",
         )
+    batched_s = time.time() - t0
+    print(
+        f"batched training: {generations} generations x {pop} candidates "
+        f"in {batched_s:.1f}s ({generations * pop} episodes, incl. compile)",
+    )
+
+    # Rollout throughput at scale: the batch axis is where the compiled
+    # engine wins (on TPU it is nearly free; on one CPU core the crossover
+    # vs the scalar oracle env sits around a hundred environments).
+    wide = 256
+    wenv = BatchedLoadBalancerEnv(payload, wide, decision_period_s=1.0)
+    wenv.reset()
+    acts = np.ones((wide, wenv.action_dim))
+    wenv.step(acts)  # compile
+    t0 = time.time()
+    for _ in range(5):
+        wenv.step(acts)
+    wide_rate = wide * 5 / (time.time() - t0)
+    env.reset(seed=0)
+    t0 = time.time()
+    for _ in range(10):
+        env.step(np.ones(env.action_dim))
+    seq_rate = 10 / (time.time() - t0)
+    print(
+        f"warm rollout throughput: batched x{wide} = {wide_rate:.0f} "
+        f"env-steps/s vs sequential oracle = {seq_rate:.0f} "
+        f"({wide_rate / seq_rate:.1f}x)",
+    )
 
     final = episode_return(env, mu, seed=999)
     uniform = episode_return(env, np.ones(env.action_dim), seed=999)

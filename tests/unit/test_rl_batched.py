@@ -1,0 +1,154 @@
+"""Batched RL rollouts: the segmented event-engine API and the vector env.
+
+The load-bearing invariant: stepping the engine to the horizon in windows
+(``Engine.init_batch`` + ``run_until``) is BIT-IDENTICAL to one
+``run_batch`` call — the rollout engine is the parity-tested event
+engine, windows only pause its loop.  On top of that, weighted routing
+(the action channel) must match the oracle's ``lb_weights`` hook
+distributionally, and the vector env's rewards must agree with the
+sequential oracle env under the same uniform policy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import yaml
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.engines.jaxsim.engine import Engine, scenario_keys
+from asyncflow_tpu.engines.oracle.engine import OracleEngine
+from asyncflow_tpu.rl import BatchedLoadBalancerEnv, LoadBalancerEnv
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+LB = "examples/yaml_input/data/two_servers_lb.yml"
+
+
+def _payload(horizon: float = 20.0) -> SimulationPayload:
+    data = yaml.safe_load(open(LB).read())
+    data["sim_settings"]["total_simulation_time"] = horizon
+    return SimulationPayload.model_validate(data)
+
+
+def test_windowed_run_until_is_bit_identical() -> None:
+    plan = compile_payload(_payload())
+    eng = Engine(plan)
+    keys = scenario_keys(7, 4)
+    full = eng.run_batch(keys)
+    st = eng.init_batch(keys)
+    for stop in np.arange(4.0, 21.0, 4.0):
+        st = eng.run_until(st, float(stop))
+    import jax.numpy as jnp
+
+    for f in full._fields:
+        assert bool(
+            jnp.all(jnp.asarray(getattr(full, f)) == jnp.asarray(getattr(st, f))),
+        ), f
+
+
+def test_weighted_routing_matches_oracle_split() -> None:
+    """80/20 routing weights: the per-server completion split must match
+    the oracle's lb_weights hook within binomial noise."""
+    p = _payload(horizon=30.0)
+    plan = compile_payload(p)
+    eng = Engine(plan)
+    n = 8
+    st = eng.init_batch(scenario_keys(3, n))
+    w = np.tile(np.asarray([[0.8, 0.2]]), (n, 1))
+    st = eng.run_until(st, 30.0, weights=w)
+    # srv-1's share of ARRIVALS: reconstruct via the edge gauges is heavy;
+    # use the oracle for the reference split instead
+    done_j = int(np.asarray(st.lat_count).sum())
+
+    def oracle_split(seed):
+        e = OracleEngine(p, seed=seed)
+        e.start()
+        e.lb_weights = {"lb-srv1": 0.8, "lb-srv2": 0.2}
+        e.sim.run(until=30.0)
+        s1 = e.edges["lb-srv1"].total_sent
+        s2 = e.edges["lb-srv2"].total_sent
+        return s1, s2, len(e.rqs_clock)
+
+    s1 = s2 = done_o = 0
+    for seed in range(n):
+        a, b, d = oracle_split(seed)
+        s1 += a
+        s2 += b
+        done_o += d
+    frac_o = s1 / (s1 + s2)
+    assert abs(frac_o - 0.8) < 0.02  # the hook itself honors the weights
+    assert abs(done_j - done_o) / done_o < 0.05  # comparable traffic
+
+    # jax engine split via latency asymmetry is indirect; check the direct
+    # counter instead: lb_conn in-flight cannot reveal totals, so assert
+    # via a one-sided experiment — all weight on slot 0 starves srv-2
+    st0 = eng.init_batch(scenario_keys(5, 2))
+    w0 = np.tile(np.asarray([[1.0, 0.0]]), (2, 1))
+    st0 = eng.run_until(st0, 30.0, weights=w0)
+    obs_env = BatchedLoadBalancerEnv(p, 2, seed=5)
+    obs_env._state = st0
+    core = np.asarray(obs_env._obs_fn(st0))
+    srv2_residents = core[:, 7]
+    assert np.all(srv2_residents == 0.0)
+
+
+def test_batched_env_matches_sequential_env() -> None:
+    """Uniform policy: batched rewards (event engine) agree with the
+    sequential oracle env's on the same scenario family."""
+    p = _payload(horizon=20.0)
+    n = 12
+    benv = BatchedLoadBalancerEnv(p, n, decision_period_s=1.0, seed=9)
+    obs, _ = benv.reset()
+    assert obs.shape == (n, benv.observation_dim)
+    total = np.zeros(n)
+    while True:
+        obs, r, term, trunc, info = benv.step(np.ones((n, benv.action_dim)))
+        assert obs.shape == (n, benv.observation_dim)
+        assert r.shape == (n,)
+        total += r
+        if term.all():
+            break
+    senv = LoadBalancerEnv(p, decision_period_s=1.0)
+    seq = []
+    for seed in range(6):
+        senv.reset(seed=seed)
+        tot = 0.0
+        while True:
+            _, r, done, _, _ = senv.step(np.ones(2))
+            tot += r
+            if done:
+                break
+        seq.append(tot)
+    assert abs(total.mean() - np.mean(seq)) / abs(np.mean(seq)) < 0.10
+
+
+def test_batched_env_validation() -> None:
+    p = _payload()
+    env = BatchedLoadBalancerEnv(p, 2, seed=0)
+    with pytest.raises(RuntimeError, match="reset"):
+        env.step(np.ones((2, 2)))
+    env.reset()
+    with pytest.raises(ValueError, match="shape"):
+        env.step(np.ones((3, 2)))
+    with pytest.raises(ValueError, match="nonnegative"):
+        env.step(np.full((2, 2), -1.0))
+    single = yaml.safe_load(open("examples/yaml_input/data/single_server.yml"))
+    with pytest.raises(ValueError, match="load-balancer"):
+        BatchedLoadBalancerEnv(
+            SimulationPayload.model_validate(single), 2,
+        )
+
+
+def test_reward_modes_batched() -> None:
+    p = _payload()
+    thr = BatchedLoadBalancerEnv(p, 2, reward="throughput", seed=0)
+    thr.reset()
+    _, r, _, _, info = thr.step(np.ones((2, 2)))
+    assert np.allclose(r, info["window_completions"] / 1.0)
+
+    custom = BatchedLoadBalancerEnv(
+        p, 2, reward=lambda info: -info["window_arrivals"].astype(float), seed=0,
+    )
+    custom.reset()
+    _, r2, _, _, info2 = custom.step(np.ones((2, 2)))
+    assert np.allclose(r2, -info2["window_arrivals"])
