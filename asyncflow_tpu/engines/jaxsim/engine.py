@@ -1644,34 +1644,10 @@ def run_single(
             sampled = {k: v for k, v in sampled.items() if k in keep}
     traces = None
     if tracing:
-        from asyncflow_tpu.config.constants import SystemEdges, SystemNodes
-
-        nodes = payload.topology_graph.nodes
-        lb_id = nodes.load_balancer.id if nodes.load_balancer else ""
-
-        def decode(code: int) -> tuple[str, str]:
-            kind, idx = divmod(int(code), 1000)
-            if kind == 0:
-                return SystemNodes.GENERATOR, payload.rqs_input.id
-            if kind == 1:
-                return SystemEdges.NETWORK_CONNECTION, plan.edge_ids[idx]
-            if kind == 2:
-                return SystemNodes.SERVER, plan.server_ids[idx]
-            if kind == 3:
-                return SystemNodes.LOAD_BALANCER, lb_id
-            return SystemNodes.CLIENT, nodes.client.id
-
         n_tr = min(int(state.clock_n), state.tr_code.shape[0])
-        codes = state.tr_code[:n_tr].tolist()
-        times = state.tr_t[:n_tr].tolist()
-        counts = state.tr_n[:n_tr].tolist()
-        traces = {
-            k: [
-                (*decode(codes[k][j]), times[k][j])
-                for j in range(counts[k])
-            ]
-            for k in range(n_tr)
-        }
+        traces = decode_hop_traces(
+            plan, payload, state.tr_code, state.tr_t, state.tr_n, n_tr,
+        )
 
     llm_cost = None
     if plan.has_llm and sim_engine.collect_clocks and hasattr(state, "llm_store"):
@@ -1690,6 +1666,43 @@ def run_single(
         traces=traces,
         llm_cost=llm_cost,
     )
+
+
+def decode_hop_traces(plan, payload, tr_code, tr_t, tr_n, n_tr):
+    """Hop-code rings -> the oracle's trace structure, keyed by completed
+    clock row: ``{row: [(component type, component id, timestamp), ...]}``.
+
+    Single decoder for every ring producer (jax event engine, native C++
+    core) of the Engine.HOP_* code map — 0 generator, 1000+e edge,
+    2000+s server, 3000 LB, 4000 client.
+    """
+    from asyncflow_tpu.config.constants import SystemEdges, SystemNodes
+
+    nodes = payload.topology_graph.nodes
+    lb_id = nodes.load_balancer.id if nodes.load_balancer else ""
+
+    def decode(code: int) -> tuple[str, str]:
+        kind, idx = divmod(int(code), 1000)
+        if kind == 0:
+            return SystemNodes.GENERATOR, payload.rqs_input.id
+        if kind == 1:
+            return SystemEdges.NETWORK_CONNECTION, plan.edge_ids[idx]
+        if kind == 2:
+            return SystemNodes.SERVER, plan.server_ids[idx]
+        if kind == 3:
+            return SystemNodes.LOAD_BALANCER, lb_id
+        return SystemNodes.CLIENT, nodes.client.id
+
+    codes = np.asarray(tr_code)[:n_tr].tolist()
+    times = np.asarray(tr_t)[:n_tr].tolist()
+    counts = np.asarray(tr_n)[:n_tr].tolist()
+    return {
+        k: [
+            (*decode(codes[k][j]), float(times[k][j]))
+            for j in range(counts[k])
+        ]
+        for k in range(n_tr)
+    }
 
 
 def sweep_results(

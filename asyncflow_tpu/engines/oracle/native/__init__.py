@@ -139,6 +139,14 @@ def load_library() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_double),
         ]
+        lib.afnative_run_traced.restype = ctypes.c_int64
+        lib.afnative_run_traced.argtypes = [
+            *lib.afnative_run.argtypes,
+            _i32p,
+            _f32p,
+            _i32p,
+            ctypes.c_int32,
+        ]
         _lib = lib
     except (OSError, subprocess.CalledProcessError) as exc:
         _lib_error = str(exc)
@@ -164,9 +172,20 @@ def run_native(
     *,
     seed: int = 0,
     collect_gauges: bool = True,
+    collect_traces: bool = False,
+    payload=None,
     settings=None,
 ) -> SimulationResults:
-    """Run one scenario on the native core -> :class:`SimulationResults`."""
+    """Run one scenario on the native core -> :class:`SimulationResults`.
+
+    ``collect_traces=True`` records per-request hop rings through the C
+    ABI (``afnative_run_traced``) with the oracle-identical structure
+    (component type, component id, timestamp); ``payload`` is then
+    required to decode generator/client/LB ids, which the compiled plan
+    does not carry."""
+    if collect_traces and payload is None:
+        msg = "collect_traces=True needs the payload to decode component ids"
+        raise ValueError(msg)
     lib = load_library()
     if lib is None:
         msg = f"native core unavailable: {_lib_error}"
@@ -256,18 +275,35 @@ def run_native(
         if plan.has_llm
         else None
     )
-    lib.afnative_run(
+    llm_ptr = (
+        llm.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+        if llm is not None
+        else ctypes.POINTER(ctypes.c_double)()
+    )
+    common = (
         ctypes.byref(c),
         ctypes.c_uint64(seed),
         clock.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         gauges.ctypes.data_as(_f32p) if gauges is not None else _f32p(),
         counters.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-        (
-            llm.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
-            if llm is not None
-            else ctypes.POINTER(ctypes.c_double)()
-        ),
+        llm_ptr,
     )
+    tr_code = tr_t = tr_n = None
+    if collect_traces:
+        # same ring capacity formula as the jax event engine
+        hop_cap = 1 + 2 * len(plan.entry_edges) + 4 * max(plan.n_servers, 1) + 2
+        tr_code = np.full((plan.max_requests, hop_cap), -1, dtype=np.int32)
+        tr_t = np.zeros((plan.max_requests, hop_cap), dtype=np.float32)
+        tr_n = np.zeros(plan.max_requests, dtype=np.int32)
+        lib.afnative_run_traced(
+            *common,
+            tr_code.ctypes.data_as(_i32p),
+            tr_t.ctypes.data_as(_f32p),
+            tr_n.ctypes.data_as(_i32p),
+            ctypes.c_int32(hop_cap),
+        )
+    else:
+        lib.afnative_run(*common)
     generated, dropped, clock_n, clock_overflow, rejected = (
         int(x) for x in counters
     )
@@ -316,5 +352,17 @@ def run_native(
         overflow_dropped=clock_overflow,
         server_ids=plan.server_ids,
         edge_ids=plan.edge_ids,
+        traces=(
+            _decode_traces(plan, payload, tr_code, tr_t, tr_n, clock_n)
+            if tr_code is not None
+            else None
+        ),
         llm_cost=llm[:clock_n] if llm is not None else None,
     )
+
+
+def _decode_traces(plan, payload, tr_code, tr_t, tr_n, clock_n):
+    """Shared decode with the jax event engine (same HOP_* code map)."""
+    from asyncflow_tpu.engines.jaxsim.engine import decode_hop_traces
+
+    return decode_hop_traces(plan, payload, tr_code, tr_t, tr_n, clock_n)

@@ -106,6 +106,10 @@ struct PlanC {
 };
 
 struct Request {
+    //: per-hop trace ring (only populated when the caller passes trace
+    //: buffers): (code, timestamp) with the jax event engine's code map —
+    //: 0 generator, 1000+e edge, 2000+s server, 3000 LB, 4000 client
+    std::vector<std::pair<int32_t, double>> hops;
     double start = 0.0;
     double ram = 0.0;
     double wait_start = 0.0;  // ready-queue park time (dequeue deadlines)
@@ -187,6 +191,10 @@ struct Sim {
 
     // outputs
     double* out_clock = nullptr;  // [max_requests][2]
+    int32_t* out_tr_code = nullptr;  // [max_requests x hop_cap]
+    float* out_tr_t = nullptr;
+    int32_t* out_tr_n = nullptr;
+    int32_t hop_cap = 0;  // per-request trace ring capacity
     double* out_llm = nullptr;    // [max_requests] per-completion cost
     int64_t clock_n = 0;
     int64_t clock_overflow = 0;  // completions past the clock capacity
@@ -340,6 +348,15 @@ struct Sim {
     }
     void release(int32_t i) { free_slots.push_back(i); }
 
+    // Append one hop (first hop_cap kept, like the event engine's rings;
+    // edge hops are recorded at SEND with their future delivery time, so
+    // ring order matches the oracle's record_hop order exactly).
+    void record_hop(int32_t i, int32_t code, double t) {
+        if (!out_tr_code) return;
+        auto& h = reqs[i].hops;
+        if ((int32_t)h.size() < hop_cap) h.emplace_back(code, t);
+    }
+
     // ---- edge traversal ------------------------------------------------
     // Rolls dropout + delay at `now`; on success increments the in-flight
     // counter and schedules `type` at the delivery time.  Returns false when
@@ -352,6 +369,7 @@ struct Sim {
         }
         double delay = sample_edge_delay(e) + spike_at(e, now);
         ++edge_conn[e];
+        if (req_idx >= 0) record_hop(req_idx, 1000 + e, now + delay);
         push(now + delay, type, req_idx, e);
         return true;
     }
@@ -504,6 +522,7 @@ struct Sim {
         int32_t i = alloc();
         reqs[i].start = now;
         reqs[i].seg = 0;  // entry-hop index
+        record_hop(i, 0, now);  // generator
         send(p.entry_edges[0], EV_ENTRY_HOP, i);
     }
 
@@ -511,6 +530,7 @@ struct Sim {
         Request& r = reqs[i];
         int hop = ++r.seg;  // this delivery completed hop (r.seg - 1)
         if (hop < p.n_entry) {
+            record_hop(i, 4000, now);  // intermediate client visit
             send(p.entry_edges[hop], EV_ENTRY_HOP, i);
             return;
         }
@@ -524,6 +544,7 @@ struct Sim {
     }
 
     void on_arrive_lb(int32_t i) {
+        record_hop(i, 3000, now);
         if (lb_rotation.empty()) { ++dropped; release(i); return; }
         int slot = -1;
         bool probe = false;
@@ -608,6 +629,7 @@ struct Sim {
             return;
         }
         ++sv.residents;
+        record_hop(i, 2000 + r.srv, now);
         int nep = p.n_endpoints[r.srv];
         {
             // weighted endpoint pick (uniform weights -> even table)
@@ -661,6 +683,17 @@ struct Sim {
             out_clock[2 * clock_n] = r.start;
             out_clock[2 * clock_n + 1] = now;
             if (out_llm) out_llm[clock_n] = r.llm_cost;
+            if (out_tr_code) {
+                record_hop(i, 4000, now);  // completing client visit
+                int32_t n = (int32_t)r.hops.size();
+                out_tr_n[clock_n] = n;
+                int32_t* row_c = out_tr_code + (int64_t)clock_n * hop_cap;
+                float* row_t = out_tr_t + (int64_t)clock_n * hop_cap;
+                for (int32_t j = 0; j < n; ++j) {
+                    row_c[j] = r.hops[j].first;
+                    row_t[j] = (float)r.hops[j].second;
+                }
+            }
             ++clock_n;
         } else {
             ++clock_overflow;  // saturated run: surface, don't silently drop
@@ -729,6 +762,18 @@ struct Sim {
 
 extern "C" {
 
+int64_t afnative_run_traced(
+    const PlanC* plan,
+    uint64_t seed,
+    double* out_clock,
+    float* out_gauges,
+    int64_t* out_counters,
+    double* out_llm,
+    int32_t* out_tr_code,
+    float* out_tr_t,
+    int32_t* out_tr_n,
+    int32_t hop_cap);
+
 int64_t afnative_run(
     const PlanC* plan,
     uint64_t seed,
@@ -737,10 +782,31 @@ int64_t afnative_run(
     int64_t* out_counters,
     /* [generated, dropped, clock_n, clock_overflow, rejected] */
     double* out_llm  /* may be null: [max_requests] per-completion cost */) {
+    // untraced entry = traced entry with null rings (record_hop no-ops)
+    return afnative_run_traced(
+        plan, seed, out_clock, out_gauges, out_counters, out_llm,
+        nullptr, nullptr, nullptr, 0);
+}
+
+int64_t afnative_run_traced(
+    const PlanC* plan,
+    uint64_t seed,
+    double* out_clock,
+    float* out_gauges,  // may be null
+    int64_t* out_counters,
+    double* out_llm,      // may be null
+    int32_t* out_tr_code, /* [max_requests x hop_cap] */
+    float* out_tr_t,      /* [max_requests x hop_cap] */
+    int32_t* out_tr_n,    /* [max_requests] */
+    int32_t hop_cap) {
     Sim sim(*plan, seed);
     sim.out_clock = out_clock;
     sim.out_llm = out_llm;
     sim.out_gauges = out_gauges;
+    sim.out_tr_code = out_tr_code;
+    sim.out_tr_t = out_tr_t;
+    sim.out_tr_n = out_tr_n;
+    sim.hop_cap = hop_cap;
     sim.run();
     out_counters[0] = sim.generated;
     out_counters[1] = sim.dropped;

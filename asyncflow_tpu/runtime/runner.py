@@ -51,11 +51,14 @@ class SimulationRunner:
         if backend == Backend.NATIVE:
             from asyncflow_tpu.engines.oracle.native import native_available
 
-            unsupported = set(self.engine_options) - {"collect_gauges"}
+            unsupported = set(self.engine_options) - {
+                "collect_gauges",
+                "collect_traces",
+            }
             if unsupported:
                 msg = (
                     f"engine_options {sorted(unsupported)} are not supported "
-                    "by the native backend (use backend='oracle' for tracing)"
+                    "by the native backend"
                 )
                 raise ValueError(msg)
 
@@ -63,11 +66,16 @@ class SimulationRunner:
                 from asyncflow_tpu.compiler import compile_payload
                 from asyncflow_tpu.engines.oracle.native import run_native
 
+                opts = dict(self.engine_options)
+                if opts.get("collect_traces"):
+                    # hop decoding needs the component ids the compiled
+                    # plan does not carry
+                    opts["payload"] = self.simulation_input
                 results = run_native(
                     compile_payload(self.simulation_input),
                     seed=self._effective_seed(),
                     settings=self.simulation_input.sim_settings,
-                    **self.engine_options,
+                    **opts,
                 )
                 return ResultsAnalyzer(results)
             import warnings

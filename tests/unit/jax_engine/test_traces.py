@@ -31,11 +31,21 @@ def _paths(res) -> set:
     }
 
 
-def test_event_traces_match_oracle_structure() -> None:
+@pytest.mark.parametrize("backend", ["jax", "native"])
+def test_traces_match_oracle_structure(backend: str) -> None:
+    """Both the batched event engine AND the C++ core (round 5: hop rings
+    through the C ABI) must reproduce the oracle's trace structure."""
+    if backend == "native":
+        from asyncflow_tpu.engines.oracle.native import native_available
+
+        if not native_available():
+            # without a compiler the runner would silently fall back to
+            # the oracle and this parametrization would pass vacuously
+            pytest.skip("no C++ toolchain")
     p = _payload()
-    ev = SimulationRunner(
+    res = SimulationRunner(
         simulation_input=p,
-        backend="jax",
+        backend=backend,
         seed=3,
         engine_options={"collect_traces": True},
     ).run()
@@ -45,7 +55,7 @@ def test_event_traces_match_oracle_structure() -> None:
         seed=3,
         engine_options={"collect_traces": True},
     ).run()
-    tr = ev.get_traces()
+    tr = res.get_traces()
     assert len(tr) > 1000
     for trace in tr.values():
         times = [t for _, _, t in trace]
@@ -53,7 +63,7 @@ def test_event_traces_match_oracle_structure() -> None:
         assert trace[0][0] == "generator"
         assert trace[-1][0] == "client"
     # both engines see exactly the two LB paths, hop for hop
-    assert _paths(ev) == _paths(orc)
+    assert _paths(res) == _paths(orc)
 
 
 def test_traces_need_event_engine_and_clocks() -> None:
