@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Smoke tier: the curated < 10-minute per-commit selection (every engine +
+# the load-bearing parity contracts).  Selection lives in tests/conftest.py
+# (_SMOKE_MODULES / _SMOKE_TESTS); the full ~45-min suite stays the merge
+# gate (scripts/run_tests.sh, ci-main).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -m smoke -q "$@"

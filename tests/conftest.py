@@ -115,3 +115,59 @@ def minimal_payload(
         topology_graph=minimal_topology,
         sim_settings=minimal_settings,
     )
+
+
+# ---------------------------------------------------------------------------
+# smoke tier (round 5): a < 10-minute per-commit selection covering every
+# engine and the load-bearing parity contracts.  One curated list here —
+# no marker churn in the test files; run with `pytest -m smoke` or
+# scripts/run_smoke.sh.  The full suite stays the merge gate (ci-main).
+# ---------------------------------------------------------------------------
+
+_SMOKE_MODULES = (
+    # contracts + fast pure-python tiers (whole modules)
+    "tests/unit/schemas",
+    "tests/unit/builder",
+    "tests/unit/compiler",
+    "tests/unit/public_api",
+    "tests/unit/jax_engine/test_sortutil.py",
+    "tests/unit/jax_engine/test_traces.py",
+    "tests/parity/test_native_parity.py",
+    "tests/parity/test_native_sweep.py",
+    "tests/parity/test_db_pool.py",
+    "tests/parity/test_cache_dynamics.py",
+)
+
+_SMOKE_TESTS = (
+    # one representative per engine/feature family from the slow modules
+    "tests/parity/test_backend_parity.py::test_parity_single_server_light_load",
+    "tests/parity/test_backend_parity.py::test_parity_lb_round_robin",
+    "tests/parity/test_fastpath_parity.py::test_fastpath_single_server",
+    "tests/parity/test_fastpath_parity.py::test_fastpath_lb_round_robin",
+    "tests/parity/test_pallas_engine.py::test_single_server_parity",
+    "tests/parity/test_pallas_engine.py::test_conservation_invariant",
+    "tests/parity/test_pallas_engine.py::test_cache_mixture_parity",
+    "tests/parity/test_pallas_engine.py::test_db_pool_parity",
+    "tests/parity/test_pallas_engine.py::test_llm_dynamics_parity",
+    "tests/parity/test_pallas_engine.py::test_weighted_endpoints_parity",
+    "tests/parity/test_milestone5_controls.py::TestFastPathControls::test_rate_limit_fast_parity",
+    "tests/parity/test_overload_policy.py::test_fast_path_shed_parity",
+    "tests/unit/test_rl_batched.py::test_windowed_run_until_is_bit_identical",
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        nodeid = item.nodeid
+        path = nodeid.split("::", 1)[0]
+        # boundary-safe matching: a listed module never captures a
+        # longer-named sibling, a listed test never captures
+        # test_foo_heavy — only itself and its parametrizations
+        in_module = any(
+            path == m or path.startswith(m + "/") for m in _SMOKE_MODULES
+        )
+        in_tests = any(
+            nodeid == t or nodeid.startswith(t + "[") for t in _SMOKE_TESTS
+        )
+        if in_module or in_tests:
+            item.add_marker(pytest.mark.smoke)

@@ -94,8 +94,10 @@ def test_split_on_every_engine() -> None:
         assert _slow_fraction(lat_n) == pytest.approx(expected, abs=0.02)
 
 
-def test_pallas_declines_weighted_plans() -> None:
+def test_pallas_models_weighted_plans() -> None:
+    # round 5: the VMEM kernel walks the cumulative-weight table (parity
+    # in test_pallas_engine.py::test_weighted_endpoints_parity)
     from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
 
-    with pytest.raises(ValueError, match="weighted endpoint"):
-        PallasEngine(compile_payload(_payload((3.0, 1.0))))
+    eng = PallasEngine(compile_payload(_payload((3.0, 1.0))))
+    assert eng.plan.has_weighted_endpoints
