@@ -4,6 +4,7 @@ from asyncflow_tpu.schemas.edges import Edge
 from asyncflow_tpu.schemas.endpoint import Endpoint, Step
 from asyncflow_tpu.schemas.events import EventInjection
 from asyncflow_tpu.schemas.nodes import (
+    CircuitBreaker,
     Client,
     LoadBalancer,
     OverloadPolicy,
@@ -12,6 +13,7 @@ from asyncflow_tpu.schemas.nodes import (
 )
 
 __all__ = [
+    "CircuitBreaker",
     "Client",
     "Edge",
     "Endpoint",

@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Post-clone developer setup (counterpart of the reference's
+# scripts/dev_setup.sh, which bootstraps Poetry): create an in-project
+# virtualenv with pip, install the dev extras, and run the quality gates
+# plus the smoke test tier.
+#
+# Usage:  bash scripts/dev_setup.sh
+# Needs:  python >= 3.12 on PATH (python3.12 or python3).
+
+set -Eeuo pipefail
+
+repo_root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$repo_root"
+
+err()  { echo "ERROR: $*" >&2; exit 1; }
+info() { echo "==> $*"; }
+
+[[ -f pyproject.toml ]] || err "pyproject.toml not found at $repo_root"
+
+py_bin=""
+for cand in python3.13 python3.12 python3; do
+    if command -v "$cand" >/dev/null 2>&1 \
+        && "$cand" -c 'import sys; sys.exit(0 if sys.version_info[:2] >= (3,12) else 1)'; then
+        py_bin="$cand"
+        break
+    fi
+done
+[[ -n "$py_bin" ]] || err "Python >= 3.12 not found"
+info "Using $("$py_bin" -V)"
+
+if [[ -d .venv ]] && ! .venv/bin/python -c \
+    'import sys; sys.exit(0 if sys.version_info[:2] >= (3,12) else 1)' \
+    2>/dev/null; then
+    info "Existing .venv has an unsupported interpreter; recreating"
+    rm -rf .venv
+fi
+if [[ ! -d .venv ]]; then
+    info "Creating .venv"
+    "$py_bin" -m venv .venv
+fi
+# shellcheck disable=SC1091
+source .venv/bin/activate
+
+export PIP_DISABLE_PIP_VERSION_CHECK=1
+export MPLBACKEND=Agg
+
+info "Installing project with dev extras"
+pip install -e ".[dev]"
+
+info "Quality gates (ruff + mypy)"
+bash scripts/quality_check.sh
+
+info "Smoke test tier (curated <10 min; full suite: scripts/run_tests.sh)"
+bash scripts/run_smoke.sh
+
+info "All checks completed"

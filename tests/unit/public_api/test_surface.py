@@ -19,6 +19,7 @@ def test_top_level_surface() -> None:
 
 def test_components_surface() -> None:
     assert set(components.__all__) == {
+        "CircuitBreaker",
         "Client",
         "Edge",
         "Endpoint",
