@@ -29,20 +29,26 @@ class AsyncFlow:
     """Accumulates scenario pieces and validates them into one payload."""
 
     def __init__(self) -> None:
-        self._generator: RqsGenerator | None = None
         self._client: Client | None = None
         self._servers: list[Server] = []
         self._edges: list[Edge] = []
         self._sim_settings: SimulationSettings | None = None
         self._load_balancer: LoadBalancer | None = None
         self._events: list[EventInjection] = []
+        self._generators: list[RqsGenerator] = []
 
     # -- nodes & wiring -----------------------------------------------------
 
     def add_generator(self, rqs_generator: RqsGenerator) -> Self:
-        """Set the stochastic request generator."""
+        """Add a stochastic request generator.
+
+        Called once for the reference's single-generator shape; repeated
+        calls ACCUMULATE generators (multi-generator workload
+        superposition — each needs its own entry edge).  The payload
+        keeps the reference's on-disk format for the single case.
+        """
         _require(rqs_generator, RqsGenerator, "the generator")
-        self._generator = rqs_generator
+        self._generators.append(rqs_generator)
         return self
 
     def add_client(self, client: Client) -> Self:
@@ -126,7 +132,7 @@ class AsyncFlow:
 
     def build_payload(self) -> SimulationPayload:
         """Validate the accumulated pieces into one :class:`SimulationPayload`."""
-        if self._generator is None:
+        if not self._generators:
             msg = "The generator input must be instantiated before the simulation"
             raise ValueError(msg)
         if self._client is None:
@@ -150,9 +156,14 @@ class AsyncFlow:
             ),
             edges=self._edges,
         )
+        rqs_input = (
+            self._generators[0]
+            if len(self._generators) == 1
+            else self._generators
+        )
         return SimulationPayload.model_validate(
             {
-                "rqs_input": self._generator,
+                "rqs_input": rqs_input,
                 "topology_graph": graph,
                 "sim_settings": self._sim_settings,
                 "events": self._events or None,

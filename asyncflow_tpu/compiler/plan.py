@@ -302,6 +302,7 @@ class StaticPlan:
     user_window: float
     req_per_user_per_sec: float
 
+
     # ---- run geometry ----
     horizon: float
     sample_period: float
@@ -383,6 +384,11 @@ class StaticPlan:
             self.endpoint_cum = cum
 
     @property
+    def n_generators(self) -> int:
+        """Workload sources; 0-size gen arrays mean a legacy single."""
+        return max(int(self.gen_user_mean.shape[0]), 1)
+
+    @property
     def has_queue_cap(self) -> bool:
         """True when any server's ready-queue cap is actually modeled."""
         return bool(np.any(self.server_queue_cap >= 0))
@@ -453,6 +459,35 @@ class StaticPlan:
     #: when every selection_weight is the default; padded columns = 1).
     endpoint_cum: np.ndarray = field(
         default_factory=lambda: np.empty((0, 0), np.float32),
+    )
+    #: (G,) per-generator workload params (multi-generator superposition;
+    #: G == 1 mirrors the scalar fields above).  Entry chains are
+    #: (G, L) edge indexes, -1-padded, with per-generator lengths and
+    #: entry targets; ``entry_edges``/``entry_target*`` stay generator 0's
+    #: chain for single-generator consumers.
+    gen_user_mean: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.float64),
+    )
+    gen_user_var: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.float64),
+    )
+    gen_window: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.float64),
+    )
+    gen_rate: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.float64),
+    )
+    gen_entry_edges: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0), np.int32),
+    )
+    gen_entry_len: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.int32),
+    )
+    gen_entry_target_kind: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.int32),
+    )
+    gen_entry_target: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.int32),
     )
     #: (NS, NEP, NSEG+1) f32 SEG_LLM call dynamics: Poisson output-token
     #: mean, decode seconds per token, and cost units per token.
@@ -538,29 +573,31 @@ def _server_entry_rates(payload: SimulationPayload) -> np.ndarray | None:
     servers = payload.topology_graph.nodes.servers
     server_index = {server.id: s for s, server in enumerate(servers)}
     lb = payload.topology_graph.nodes.load_balancer
-    workload = payload.rqs_input
-    rate = (
-        float(workload.avg_active_users.mean)
-        * float(workload.avg_request_per_minute_per_user.mean)
-        / 60.0
-    )
     out_edge = {e.source: e for e in payload.topology_graph.edges}
 
     srv_rate = np.zeros(len(servers))
-    node = workload.id
-    for _ in range(len(payload.topology_graph.edges) + 1):
-        e = out_edge.get(node)
-        if e is None:
-            break
-        if e.target in server_index:
-            srv_rate[server_index[e.target]] += rate
-            break
-        if lb is not None and e.target == lb.id:
-            covered = sorted(lb.server_covered)
-            for sid in covered:
-                srv_rate[server_index[sid]] += rate / len(covered)
-            break
-        node = e.target
+    # entry deposits: every generator's chain lands its rate on a server
+    # or spreads it over the LB cover (multi-generator workloads superpose)
+    for workload in payload.generators:
+        rate = (
+            float(workload.avg_active_users.mean)
+            * float(workload.avg_request_per_minute_per_user.mean)
+            / 60.0
+        )
+        node = workload.id
+        for _ in range(len(payload.topology_graph.edges) + 1):
+            e = out_edge.get(node)
+            if e is None:
+                break
+            if e.target in server_index:
+                srv_rate[server_index[e.target]] += rate
+                break
+            if lb is not None and e.target == lb.id:
+                covered = sorted(lb.server_covered)
+                for sid in covered:
+                    srv_rate[server_index[sid]] += rate / len(covered)
+                break
+            node = e.target
 
     # server -> server chain edges, propagated in topological order
     child = {}
@@ -584,6 +621,15 @@ def _server_entry_rates(payload: SimulationPayload) -> np.ndarray | None:
     if seen != len(servers):
         return None  # cycle: no well-defined rates
     return srv_rate
+
+
+def _pad_chains(chains: list[list[int]]) -> np.ndarray:
+    """(G, L) entry-edge chains, -1-padded to the longest."""
+    width = max(len(c) for c in chains)
+    out = np.full((len(chains), width), -1, np.int32)
+    for g, c in enumerate(chains):
+        out[g, : len(c)] = c
+    return out
 
 
 def _server_db_hold(server) -> float:
@@ -615,22 +661,33 @@ def _estimate_capacity(payload: SimulationPayload) -> tuple[int, int]:
     the engine counts and surfaces it (``overflow_dropped``) rather than
     silently skewing percentiles.
     """
-    workload = payload.rqs_input
     settings = payload.sim_settings
-    users = float(workload.avg_active_users.mean)
-    rate = users * float(workload.avg_request_per_minute_per_user.mean) / 60.0
     horizon = float(settings.total_simulation_time)
-    window = float(workload.user_sampling_window)
+    # aggregate over generators: counts of independent sources add, and so
+    # do their variances (multi-generator workloads superpose)
+    rate = 0.0
+    users = 0.0
+    count_var_draw = 0.0
+    max_window = 0.0
+    for workload in payload.generators:
+        g_users = float(workload.avg_active_users.mean)
+        rate_per_user = (
+            float(workload.avg_request_per_minute_per_user.mean) / 60.0
+        )
+        users += g_users
+        rate += g_users * rate_per_user
+        window = float(workload.user_sampling_window)
+        max_window = max(max_window, window)
+        users_var = (
+            float(workload.avg_active_users.variance) ** 2
+            if workload.avg_active_users.variance is not None
+            else g_users  # Poisson users
+        )
+        n_windows = max(1.0, horizon / window)
+        count_var_draw += n_windows * users_var * (rate_per_user * window) ** 2
     expected = rate * horizon
     # total-count variance = Poisson part + windowed user-draw part
-    users_var = (
-        float(workload.avg_active_users.variance) ** 2
-        if workload.avg_active_users.variance is not None
-        else users  # Poisson users
-    )
-    rate_per_user = float(workload.avg_request_per_minute_per_user.mean) / 60.0
-    n_windows = max(1.0, horizon / window)
-    count_var = expected + n_windows * users_var * (rate_per_user * window) ** 2
+    count_var = expected + count_var_draw
     max_requests = int(expected + 6.0 * math.sqrt(max(count_var, 1.0)) + 64)
 
     # ~3-sigma burst of the windowed user draw
@@ -685,7 +742,11 @@ def _estimate_capacity(payload: SimulationPayload) -> tuple[int, int]:
                 capacity = min(capacity, float(pool_k) / db_req)
         if capacity < math.inf:
             backlog += max(0.0, rate - capacity) * horizon
-            burst_backlog += max(0.0, burst_rate - capacity) * min(window, horizon)
+            # conservative across generators: the longest sampling window
+            # sustains a 3-sigma burst the longest
+            burst_backlog += max(0.0, burst_rate - capacity) * min(
+                max_window, horizon,
+            )
 
     # spikes park in-flight requests on an edge, and their release floods the
     # downstream queue: budget rate x (max concurrent spike) per edge, twice
@@ -747,24 +808,28 @@ def compile_payload(
         if edge.source != lb_id:
             out_edge_of[edge.source] = edge_index[edge.id]
 
-    # entry chain: generator -> (client ->)* first LB/server
-    entry_edges: list[int] = []
-    cursor = payload.rqs_input.id
-    kind, target = TARGET_CLIENT, -1
-    for _ in range(n_edges + 1):
-        if cursor not in out_edge_of:
-            msg = f"node {cursor!r} has no outgoing edge on the entry path"
-            raise ValueError(msg)
-        eidx = out_edge_of[cursor]
-        entry_edges.append(eidx)
-        next_id = edges[eidx].target
-        kind, target = _target_of(next_id)
-        if kind in (TARGET_LB, TARGET_SERVER):
-            break
-        cursor = next_id
-    else:  # pragma: no cover - graph validators prevent cycles here
+    # entry chains: generator -> (client ->)* first LB/server, one per
+    # generator; generator 0's chain doubles as the legacy scalar fields
+    def _entry_chain(gen_id: str) -> tuple[list[int], int, int]:
+        chain: list[int] = []
+        cursor = gen_id
+        for _ in range(n_edges + 1):
+            if cursor not in out_edge_of:
+                msg = f"node {cursor!r} has no outgoing edge on the entry path"
+                raise ValueError(msg)
+            eidx = out_edge_of[cursor]
+            chain.append(eidx)
+            next_id = edges[eidx].target
+            kind, target = _target_of(next_id)
+            if kind in (TARGET_LB, TARGET_SERVER):
+                return chain, kind, target
+            cursor = next_id
         msg = "entry path does not reach a server or load balancer"
         raise ValueError(msg)
+
+    generators = payload.generators
+    gen_chains = [_entry_chain(g.id) for g in generators]
+    entry_edges, kind, target = gen_chains[0]
 
     # ---- servers ----
     max_endpoints = max(len(server.endpoints) for server in servers)
@@ -780,7 +845,9 @@ def compile_payload(
     # segments: the event engines model the K-connection FIFO, and the
     # fast path declines the plan.
     srv_rates_est = _server_entry_rates(payload)
-    users_est = float(payload.rqs_input.avg_active_users.mean)
+    users_est = sum(
+        float(g.avg_active_users.mean) for g in payload.generators
+    )
     # one burst-inflation model for every non-binding proof tier (DB pools,
     # queue caps, and _fastpath_analysis's bounds use the same 3-sigma
     # user-draw inflation — keep them in lockstep)
@@ -1301,16 +1368,49 @@ def compile_payload(
         timeline_times=timeline_times,
         timeline_down=timeline_down,
         timeline_slot=timeline_slot,
-        user_mean=float(payload.rqs_input.avg_active_users.mean),
+        user_mean=float(generators[0].avg_active_users.mean),
         user_var=(
-            float(payload.rqs_input.avg_active_users.variance)
-            if payload.rqs_input.avg_active_users.distribution == Distribution.NORMAL
-            and payload.rqs_input.avg_active_users.variance is not None
+            float(generators[0].avg_active_users.variance)
+            if generators[0].avg_active_users.distribution == Distribution.NORMAL
+            and generators[0].avg_active_users.variance is not None
             else -1.0
         ),
-        user_window=float(payload.rqs_input.user_sampling_window),
+        user_window=float(generators[0].user_sampling_window),
         req_per_user_per_sec=(
-            float(payload.rqs_input.avg_request_per_minute_per_user.mean) / 60.0
+            float(generators[0].avg_request_per_minute_per_user.mean) / 60.0
+        ),
+        gen_user_mean=np.array(
+            [float(g.avg_active_users.mean) for g in generators], np.float64,
+        ),
+        gen_user_var=np.array(
+            [
+                float(g.avg_active_users.variance)
+                if g.avg_active_users.distribution == Distribution.NORMAL
+                and g.avg_active_users.variance is not None
+                else -1.0
+                for g in generators
+            ],
+            np.float64,
+        ),
+        gen_window=np.array(
+            [float(g.user_sampling_window) for g in generators], np.float64,
+        ),
+        gen_rate=np.array(
+            [
+                float(g.avg_request_per_minute_per_user.mean) / 60.0
+                for g in generators
+            ],
+            np.float64,
+        ),
+        gen_entry_edges=_pad_chains([c for c, _, _ in gen_chains]),
+        gen_entry_len=np.array(
+            [len(c) for c, _, _ in gen_chains], np.int32,
+        ),
+        gen_entry_target_kind=np.array(
+            [k for _, k, _ in gen_chains], np.int32,
+        ),
+        gen_entry_target=np.array(
+            [t for _, _, t in gen_chains], np.int32,
         ),
         horizon=horizon,
         sample_period=sample_period,
@@ -1412,7 +1512,18 @@ def _fastpath_analysis(
                 0.0,
             )
 
-    workload = payload.rqs_input
+    if len(payload.generators) > 1:
+        # the closed-form arrival construction is single-stream; multiple
+        # generators run on the event engines (superposition semantics)
+        return (
+            False,
+            "multiple generators (modeled on the event engines)",
+            [],
+            no_slots,
+            0,
+            0.0,
+        )
+    workload = payload.generators[0]
     users = float(workload.avg_active_users.mean)
     rate = users * float(workload.avg_request_per_minute_per_user.mean) / 60.0
     burst_rate = rate * (1.0 + 3.0 / math.sqrt(max(users, 1.0)))

@@ -125,9 +125,12 @@ class Engine:
         # CAN cycle (the event engine allows them), so the ring records
         # the FIRST `cap` hops and stops — tr_n saturates at cap, which
         # readers can treat as a truncation marker.
-        self._hop_cap = (
-            1 + 2 * len(plan.entry_edges) + 4 * max(plan.n_servers, 1) + 2
+        max_entry = (
+            int(plan.gen_entry_len.max())
+            if plan.gen_entry_len.size
+            else len(plan.entry_edges)
         )
+        self._hop_cap = 1 + 2 * max_entry + 4 * max(plan.n_servers, 1) + 2
         self.n_hist_bins = n_hist_bins
         self.pool = pool_size or plan.pool_size
         self.max_requests = max_requests or plan.max_requests
@@ -148,6 +151,7 @@ class Engine:
         self._has_rl = plan.has_rate_limit
         self._has_timeout = plan.has_queue_timeout
         self._has_breaker = plan.breaker_threshold > 0
+        self._n_gen = plan.n_generators
         self._compiled: dict = {}
 
     # hop codes (decoded by run_single against the payload's ids)
@@ -280,15 +284,30 @@ class Engine:
     # arrival sampler (window-jump semantics cloned from the reference)
     # ==================================================================
 
-    def _advance_arrival(self, st: EngineState, key, ov, pred) -> EngineState:
+    def _advance_arrival(
+        self, st: EngineState, key, ov, pred, gen: int | None = None,
+    ) -> EngineState:
         """Compute the next emitted gap; sim arrival time += gap (no jump time).
 
         `/root/reference/src/asyncflow/samplers/poisson_poisson.py:56-82`.
+        ``gen`` selects a generator's stream on multi-generator plans (a
+        STATIC index: callers loop generators at trace time); the arrival
+        state fields are (G,) vectors there, scalars on legacy plans.
+        Workload overrides apply to the single-generator path only (the
+        sweep layer refuses user_mean/req_rate overrides when G > 1).
         """
         plan = self.plan
         horizon = jnp.float32(plan.horizon)
-        window = jnp.float32(plan.user_window)
-        poisson_users = plan.user_var < 0
+        multi = gen is not None
+        if multi:
+            window = jnp.float32(plan.gen_window[gen])
+            poisson_users = plan.gen_user_var[gen] < 0
+            g_user_mean = jnp.float32(plan.gen_user_mean[gen])
+            g_user_var = jnp.float32(plan.gen_user_var[gen])
+            g_rate = jnp.float32(plan.gen_rate[gen])
+        else:
+            window = jnp.float32(plan.user_window)
+            poisson_users = plan.user_var < 0
 
         def cond(carry):
             return carry[4] == 0
@@ -297,16 +316,19 @@ class Engine:
             smp_now, window_end, lam, dctr, _status, gap = carry
             kd = jax.random.fold_in(key, 64 + dctr)
             need_window = smp_now >= window_end
+            u_mean = g_user_mean if multi else ov.user_mean
+            u_rate = g_rate if multi else ov.req_rate
+            u_var = g_user_var if multi else self.params.user_var
             if poisson_users:
                 users = jax.random.poisson(
                     as_threefry(jax.random.fold_in(kd, 0)),
-                    jnp.maximum(ov.user_mean, _TINY),
+                    jnp.maximum(u_mean, _TINY),
                 ).astype(jnp.float32)
             else:
                 z = jax.random.normal(jax.random.fold_in(kd, 1))
-                users = jnp.maximum(0.0, ov.user_mean + self.params.user_var * z)
+                users = jnp.maximum(0.0, u_mean + u_var * z)
             window_end = jnp.where(need_window, smp_now + window, window_end)
-            lam = jnp.where(need_window, users * ov.req_rate, lam)
+            lam = jnp.where(need_window, users * u_rate, lam)
 
             no_users = lam <= 0.0
             u = jnp.maximum(jax.random.uniform(jax.random.fold_in(kd, 2)), _TINY)
@@ -327,15 +349,32 @@ class Engine:
             return (smp_next, window_end, lam, dctr + 1, status, jnp.where(status == 1, g, gap))
 
         init = (
-            st.smp_now,
-            st.smp_window_end,
-            st.smp_lam,
+            st.smp_now[gen] if multi else st.smp_now,
+            st.smp_window_end[gen] if multi else st.smp_window_end,
+            st.smp_lam[gen] if multi else st.smp_lam,
             jnp.int32(0),
             jnp.where(pred, jnp.int32(0), jnp.int32(1)),  # inactive lanes: done
             jnp.float32(0.0),
         )
         smp_now, window_end, lam, _, status, gap = jax.lax.while_loop(cond, body, init)
         exhausted = status == 2
+        if multi:
+            next_t = jnp.where(exhausted, INF, st.next_arrival[gen] + gap)
+            upd = pred
+            return st._replace(
+                smp_now=st.smp_now.at[gen].set(
+                    jnp.where(upd, smp_now, st.smp_now[gen]),
+                ),
+                smp_window_end=st.smp_window_end.at[gen].set(
+                    jnp.where(upd, window_end, st.smp_window_end[gen]),
+                ),
+                smp_lam=st.smp_lam.at[gen].set(
+                    jnp.where(upd, lam, st.smp_lam[gen]),
+                ),
+                next_arrival=st.next_arrival.at[gen].set(
+                    jnp.where(upd, next_t, st.next_arrival[gen]),
+                ),
+            )
         next_t = jnp.where(exhausted, INF, st.next_arrival + gap)
         return st._replace(
             smp_now=jnp.where(pred, smp_now, st.smp_now),
@@ -444,40 +483,74 @@ class Engine:
         plan = self.plan
         st = st._replace(n_generated=st.n_generated + jnp.where(pred, 1, 0))
 
+        if self._n_gen > 1:
+            # multi-generator: the spawning stream is the earliest
+            # next_arrival; its (static) chain/target apply under a mask
+            g = jnp.argmin(st.next_arrival).astype(jnp.int32)
+            chains = [
+                plan.gen_entry_edges[gi, : plan.gen_entry_len[gi]].tolist()
+                for gi in range(self._n_gen)
+            ]
+        else:
+            g = jnp.int32(0)
+            chains = [plan.entry_edges.tolist()]
+
         alive = pred
         t_cur = now
-        hop_times = []  # per-entry-edge delivery times (traces)
-        for j, eidx in enumerate(plan.entry_edges.tolist()):
-            e = jnp.int32(eidx)
-            dropped, delay = self._sample_edge(
-                e,
-                t_cur,
-                jax.random.fold_in(key, 8 + j),
-                ov,
+        hop_chain = []  # (gi, eidx, delivery time) — for the trace rings
+        for gi, chain in enumerate(chains):
+            pred_gi = alive & (g == gi)
+            t_gi = now
+            # disjoint subkey range per generator: 100000+gi cannot
+            # collide with the arrival sampler's 64+dctr folds (dctr is
+            # bounded by windows-per-horizon, orders of magnitude smaller)
+            key_gi = (
+                jax.random.fold_in(key, 100000 + gi) if len(chains) > 1 else key
             )
-            survives = alive & ~dropped
-            st = self._edge_interval(st, e, t_cur, t_cur + delay, survives)
-            st = st._replace(
-                n_dropped=st.n_dropped + jnp.where(alive & dropped, 1, 0),
-            )
-            t_cur = jnp.where(survives, t_cur + delay, t_cur)
-            alive = survives
-            hop_times.append(t_cur)
+            for j, eidx in enumerate(chain):
+                e = jnp.int32(eidx)
+                dropped, delay = self._sample_edge(
+                    e,
+                    t_gi,
+                    jax.random.fold_in(key_gi, 8 + j),
+                    ov,
+                )
+                survives = pred_gi & ~dropped
+                st = self._edge_interval(st, e, t_gi, t_gi + delay, survives)
+                st = st._replace(
+                    n_dropped=st.n_dropped + jnp.where(pred_gi & dropped, 1, 0),
+                )
+                t_gi = jnp.where(survives, t_gi + delay, t_gi)
+                pred_gi = survives
+                hop_chain.append((gi, eidx, t_gi))
+            t_cur = jnp.where(g == gi, t_gi, t_cur)
+            alive = jnp.where(g == gi, pred_gi, alive)
 
         free_mask = st.req_ev == EV_IDLE
         slot = jnp.argmax(free_mask).astype(jnp.int32)
         has_free = free_mask[slot]
         overflow = alive & ~has_free
         place = alive & has_free
-        ev0 = EV_ARRIVE_LB if plan.entry_target_kind == TARGET_LB else EV_ARRIVE_SRV
+        if self._n_gen > 1:
+            kinds = jnp.asarray(plan.gen_entry_target_kind)
+            ev0 = jnp.where(
+                kinds[g] == TARGET_LB, EV_ARRIVE_LB, EV_ARRIVE_SRV,
+            ).astype(jnp.int32)
+            entry_target = jnp.maximum(
+                jnp.asarray(plan.gen_entry_target)[g], 0,
+            ).astype(jnp.int32)
+        else:
+            ev0 = (
+                EV_ARRIVE_LB
+                if plan.entry_target_kind == TARGET_LB
+                else EV_ARRIVE_SRV
+            )
+            entry_target = jnp.int32(max(plan.entry_target, 0))
         idx = jnp.where(place, slot, jnp.int32(self.pool))
         st = st._replace(
             req_ev=st.req_ev.at[idx].set(ev0, mode="drop"),
             req_t=st.req_t.at[idx].set(t_cur, mode="drop"),
-            req_srv=st.req_srv.at[idx].set(
-                jnp.int32(max(plan.entry_target, 0)),
-                mode="drop",
-            ),
+            req_srv=st.req_srv.at[idx].set(entry_target, mode="drop"),
             req_start=st.req_start.at[idx].set(now, mode="drop"),
             req_lbslot=st.req_lbslot.at[idx].set(-1, mode="drop"),
             req_ram=st.req_ram.at[idx].set(0.0, mode="drop"),
@@ -489,21 +562,31 @@ class Engine:
                 req_llm=st.req_llm.at[idx].set(0.0, mode="drop"),
             )
         if self.collect_traces:
-            # fresh ring: generator hop, then one NETWORK + CLIENT pair per
-            # entry edge (the chain's intermediate targets are clients; the
-            # LAST target is the LB/server, recorded by its own branch)
+            # fresh ring: generator hop (code = generator index), then one
+            # NETWORK + CLIENT pair per entry edge (the chain's
+            # intermediate targets are clients; the LAST target is the
+            # LB/server, recorded by its own branch)
             st = st._replace(
                 req_hop_n=st.req_hop_n.at[idx].set(0, mode="drop"),
             )
-            st = self._hop(st, idx, self.HOP_GEN, now, place)
-            for j, eidx in enumerate(plan.entry_edges.tolist()):
-                st = self._hop(
-                    st, idx, self.HOP_EDGE + eidx, hop_times[j], place,
-                )
-                if j < len(plan.entry_edges) - 1:
+            for gi, chain in enumerate(chains):
+                place_gi = place & (g == gi)
+                st = self._hop(st, idx, self.HOP_GEN + gi, now, place_gi)
+                gi_hops = [h for h in hop_chain if h[0] == gi]
+                for j, (_, eidx, t_hop) in enumerate(gi_hops):
                     st = self._hop(
-                        st, idx, self.HOP_CLIENT, hop_times[j], place,
+                        st, idx, self.HOP_EDGE + eidx, t_hop, place_gi,
                     )
+                    if j < len(chain) - 1:
+                        st = self._hop(
+                            st, idx, self.HOP_CLIENT, t_hop, place_gi,
+                        )
+        if self._n_gen > 1:
+            for gi in range(self._n_gen):
+                st = self._advance_arrival(
+                    st, key, ov, pred & (g == gi), gen=gi,
+                )
+            return st
         return self._advance_arrival(st, key, ov, pred)
 
     def _seg_start(self, st, i, s, ep, seg, now, key, ov, pred) -> EngineState:
@@ -1227,10 +1310,26 @@ class Engine:
             lb_order=jnp.arange(elp, dtype=jnp.int32),
             lb_len=jnp.int32(plan.n_lb_edges),
             lb_conn=jnp.zeros(elp, jnp.int32),
-            smp_now=jnp.float32(0.0),
-            smp_window_end=jnp.float32(0.0),
-            smp_lam=jnp.float32(0.0),
-            next_arrival=jnp.float32(0.0),
+            smp_now=(
+                jnp.zeros(self._n_gen, jnp.float32)
+                if self._n_gen > 1
+                else jnp.float32(0.0)
+            ),
+            smp_window_end=(
+                jnp.zeros(self._n_gen, jnp.float32)
+                if self._n_gen > 1
+                else jnp.float32(0.0)
+            ),
+            smp_lam=(
+                jnp.zeros(self._n_gen, jnp.float32)
+                if self._n_gen > 1
+                else jnp.float32(0.0)
+            ),
+            next_arrival=(
+                jnp.zeros(self._n_gen, jnp.float32)
+                if self._n_gen > 1
+                else jnp.float32(0.0)
+            ),
             req_wait_t=(
                 jnp.zeros(pool, jnp.float32)
                 if self._has_timeout
@@ -1312,7 +1411,17 @@ class Engine:
             n_dropped=jnp.int32(0),
             n_overflow=jnp.int32(0),
         )
-        # first arrival (gap from t=0)
+        # first arrival (gap from t=0), per generator stream
+        if self._n_gen > 1:
+            for gi in range(self._n_gen):
+                st = self._advance_arrival(
+                    st,
+                    jax.random.fold_in(key, 1000 + gi),
+                    ov,
+                    jnp.bool_(True),
+                    gen=gi,
+                )
+            return st
         return self._advance_arrival(
             st,
             jax.random.fold_in(key, 0),
@@ -1332,7 +1441,8 @@ class Engine:
             )
         else:
             t_tl = INF
-        return t_pool, st.next_arrival, t_tl
+        t_arr = jnp.min(st.next_arrival) if self._n_gen > 1 else st.next_arrival
+        return t_pool, t_arr, t_tl
 
     def _refresh_pool_min(self, st: EngineState) -> EngineState:
         """The single pool scan per iteration: cache argmin index + value so
@@ -1681,10 +1791,15 @@ def decode_hop_traces(plan, payload, tr_code, tr_t, tr_n, n_tr):
     nodes = payload.topology_graph.nodes
     lb_id = nodes.load_balancer.id if nodes.load_balancer else ""
 
+    generators = payload.generators
+
     def decode(code: int) -> tuple[str, str]:
         kind, idx = divmod(int(code), 1000)
         if kind == 0:
-            return SystemNodes.GENERATOR, payload.rqs_input.id
+            return (
+                SystemNodes.GENERATOR,
+                generators[min(idx, len(generators) - 1)].id,
+            )
         if kind == 1:
             return SystemEdges.NETWORK_CONNECTION, plan.edge_ids[idx]
         if kind == 2:

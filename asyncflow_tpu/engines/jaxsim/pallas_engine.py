@@ -325,6 +325,7 @@ class PallasEngine:
             or plan.has_rate_limit
             or plan.has_queue_timeout
             or plan.breaker_threshold > 0
+            or plan.n_generators > 1
         ):
             # the VMEM kernel has no shed/refusal/limiter/deadline/breaker
             # paths; the compiler routes such plans to the general event
@@ -333,7 +334,8 @@ class PallasEngine:
             msg = (
                 "the Pallas kernel does not model reachable overload "
                 "policies (caps, capacities, rate limits, deadlines, "
-                "circuit breakers); use the event engine"
+                "circuit breakers) or multi-generator workloads; use the "
+                "event engine"
             )
             raise ValueError(msg)
         self.plan = plan

@@ -397,7 +397,8 @@ class OracleEngine:
         # keeps the configured algorithm.  Breaker eligibility still
         # applies; an all-zero weight vector falls back to uniform.
         self.lb_weights: dict[str, float] | None = None
-        self.generator_out: _EdgeRuntime | None = None
+        self._gen_ids = {g.id for g in payload.generators}
+        self.generator_out_by_id: dict[str, _EdgeRuntime] = {}
 
         self._wire()
 
@@ -422,8 +423,8 @@ class OracleEngine:
                 msg = f"Unknown edge target {edge.target!r}"
                 raise ValueError(msg)
 
-            if edge.source == self.payload.rqs_input.id:
-                self.generator_out = runtime
+            if edge.source in self._gen_ids:
+                self.generator_out_by_id[edge.source] = runtime
             elif edge.source == self.client_id:
                 self.client_out = runtime
             elif edge.source == lb_id:
@@ -435,10 +436,12 @@ class OracleEngine:
     # actors
     # ------------------------------------------------------------------
 
-    def _generator_process(self):
-        assert self.generator_out is not None
+    def _generator_process(self, workload):
+        """One arrival process per generator; multi-generator payloads
+        superpose (each with its own workload params and entry edge)."""
+        out = self.generator_out_by_id[workload.id]
         for gap in arrival_gaps(
-            self.payload.rqs_input,
+            workload,
             self.settings,
             rng=self.rng,
         ):
@@ -447,10 +450,10 @@ class OracleEngine:
             req = Request(id=self.total_generated, initial_time=self.sim.now)
             req.record_hop(
                 SystemNodes.GENERATOR,
-                self.payload.rqs_input.id,
+                workload.id,
                 self.sim.now,
             )
-            self.generator_out.transport(req)
+            out.transport(req)
 
     def _client_receive(self, req: Request) -> None:
         req.record_hop(SystemNodes.CLIENT, self.client_id, self.sim.now)
@@ -689,7 +692,8 @@ class OracleEngine:
         setup shared by :meth:`run` and incremental drivers (the RL
         playground steps the clock with ``sim.run(until=...)``)."""
         self._schedule_events()
-        self.sim.process(self._generator_process())
+        for workload in self.payload.generators:
+            self.sim.process(self._generator_process(workload))
         self._schedule_collector()
 
     def run(self) -> SimulationResults:

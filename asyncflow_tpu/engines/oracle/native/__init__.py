@@ -78,6 +78,16 @@ class _PlanC(ctypes.Structure):
         ("user_var", ctypes.c_double),
         ("user_window", ctypes.c_double),
         ("req_rate", ctypes.c_double),
+        ("n_generators", ctypes.c_int32),
+        ("gen_entry_width", ctypes.c_int32),
+        ("gen_user_mean", ctypes.POINTER(ctypes.c_double)),
+        ("gen_user_var", ctypes.POINTER(ctypes.c_double)),
+        ("gen_window", ctypes.POINTER(ctypes.c_double)),
+        ("gen_rate", ctypes.POINTER(ctypes.c_double)),
+        ("gen_entry_edges", _i32p),
+        ("gen_entry_len", _i32p),
+        ("gen_entry_target_kind", _i32p),
+        ("gen_entry_target", _i32p),
         ("horizon", ctypes.c_double),
         ("sample_period", ctypes.c_double),
         ("n_samples", ctypes.c_int64),
@@ -196,12 +206,21 @@ def run_native(
     def i32(arr):
         a, ptr = _as_i32(arr)
         keep.append(a)
+        if a.size == 0:
+            return _i32p()  # null: the core falls back to legacy scalars
         return ptr
 
     def f32(arr):
         a, ptr = _as_f32(arr)
         keep.append(a)
         return ptr
+
+    def f64(arr):
+        a = np.ascontiguousarray(arr, dtype=np.float64)
+        keep.append(a)
+        if a.size == 0:
+            return ctypes.POINTER(ctypes.c_double)()
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
 
     c = _PlanC(
         n_edges=plan.n_edges,
@@ -256,6 +275,18 @@ def run_native(
         user_var=plan.user_var,
         user_window=plan.user_window,
         req_rate=plan.req_per_user_per_sec,
+        n_generators=plan.n_generators,
+        gen_entry_width=(
+            plan.gen_entry_edges.shape[1] if plan.gen_entry_edges.size else 0
+        ),
+        gen_user_mean=f64(plan.gen_user_mean),
+        gen_user_var=f64(plan.gen_user_var),
+        gen_window=f64(plan.gen_window),
+        gen_rate=f64(plan.gen_rate),
+        gen_entry_edges=i32(plan.gen_entry_edges),
+        gen_entry_len=i32(plan.gen_entry_len),
+        gen_entry_target_kind=i32(plan.gen_entry_target_kind),
+        gen_entry_target=i32(plan.gen_entry_target),
         horizon=plan.horizon,
         sample_period=plan.sample_period,
         n_samples=plan.n_samples,
@@ -290,8 +321,14 @@ def run_native(
     )
     tr_code = tr_t = tr_n = None
     if collect_traces:
-        # same ring capacity formula as the jax event engine
-        hop_cap = 1 + 2 * len(plan.entry_edges) + 4 * max(plan.n_servers, 1) + 2
+        # same ring capacity formula as the jax event engine: sized by the
+        # LONGEST generator entry chain
+        max_entry = (
+            int(plan.gen_entry_len.max())
+            if plan.gen_entry_len.size
+            else len(plan.entry_edges)
+        )
+        hop_cap = 1 + 2 * max_entry + 4 * max(plan.n_servers, 1) + 2
         tr_code = np.full((plan.max_requests, hop_cap), -1, dtype=np.int32)
         tr_t = np.zeros((plan.max_requests, hop_cap), dtype=np.float32)
         tr_n = np.zeros(plan.max_requests, dtype=np.int32)

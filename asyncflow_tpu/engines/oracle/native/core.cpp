@@ -98,6 +98,17 @@ struct PlanC {
     double user_var;  // < 0: Poisson users
     double user_window;
     double req_rate;  // requests / user / second
+    // multi-generator workloads (G >= 1; scalar fields above = generator 0)
+    int32_t n_generators;
+    int32_t gen_entry_width;            // padded chain length L
+    const double* gen_user_mean;        // [G]
+    const double* gen_user_var;         // [G]
+    const double* gen_window;           // [G]
+    const double* gen_rate;             // [G]
+    const int32_t* gen_entry_edges;     // [G][L], -1 padded
+    const int32_t* gen_entry_len;       // [G]
+    const int32_t* gen_entry_target_kind;  // [G]
+    const int32_t* gen_entry_target;    // [G]
     // geometry
     double horizon;
     double sample_period;
@@ -115,6 +126,7 @@ struct Request {
     double wait_start = 0.0;  // ready-queue park time (dequeue deadlines)
     double llm_cost = 0.0;    // accumulated io_llm cost units
     int32_t srv = -1;
+    int32_t gen = 0;  // originating generator (entry chain + trace code)
     int32_t ep = 0;
     int32_t seg = 0;   // segment index; hop index during the entry chain
     int32_t lbslot = -1;
@@ -184,7 +196,8 @@ struct Sim {
     std::vector<int32_t> edge_conn;    // in-flight messages per edge
 
     // arrival sampler state (sampler clock drifts from sim clock by design)
-    double smp_now = 0.0, smp_window_end = 0.0, smp_lam = 0.0;
+    // per-generator sampler state (index g; legacy single uses g = 0)
+    std::vector<double> smp_now, smp_window_end, smp_lam;
 
     int32_t tl_ptr = 0;
     int64_t sample_idx = 0;
@@ -303,36 +316,59 @@ struct Sim {
     // Next emitted gap, or negative when the stream is exhausted.  Window
     // boundary jumps advance the sampler clock only; simulated time advances
     // by emitted gaps, reproducing the reference generator's drift.
-    double next_gap() {
+    int n_gens() const {
+        return p.n_generators > 0 ? p.n_generators : 1;
+    }
+    double g_user_mean(int g) const {
+        return p.gen_user_mean ? p.gen_user_mean[g] : p.user_mean;
+    }
+    double g_user_var(int g) const {
+        return p.gen_user_var ? p.gen_user_var[g] : p.user_var;
+    }
+    double g_window(int g) const {
+        return p.gen_window ? p.gen_window[g] : p.user_window;
+    }
+    double g_rate(int g) const {
+        return p.gen_rate ? p.gen_rate[g] : p.req_rate;
+    }
+
+    double next_gap(int g) {
         while (true) {
-            if (smp_now >= p.horizon) return -1.0;
-            if (smp_now >= smp_window_end) {
-                smp_window_end = smp_now + p.user_window;
+            if (smp_now[g] >= p.horizon) return -1.0;
+            if (smp_now[g] >= smp_window_end[g]) {
+                smp_window_end[g] = smp_now[g] + g_window(g);
                 double users;
-                if (p.user_var < 0) {
+                if (g_user_var(g) < 0) {
                     users = (double)std::poisson_distribution<long>(
-                        p.user_mean)(rng);
+                        g_user_mean(g))(rng);
                 } else {
                     users = std::normal_distribution<double>(
-                        p.user_mean, p.user_var)(rng);
+                        g_user_mean(g), g_user_var(g))(rng);
                     if (users < 0.0) users = 0.0;
                 }
-                smp_lam = users * p.req_rate;
+                smp_lam[g] = users * g_rate(g);
             }
-            if (smp_lam <= 0.0) { smp_now = smp_window_end; continue; }
+            if (smp_lam[g] <= 0.0) { smp_now[g] = smp_window_end[g]; continue; }
             double u = uniform();
             if (u < 1e-15) u = 1e-15;
-            double gap = -std::log(1.0 - u) / smp_lam;
-            if (smp_now + gap > p.horizon) return -1.0;
-            if (smp_now + gap >= smp_window_end) { smp_now = smp_window_end; continue; }
-            smp_now += gap;
+            double gap = -std::log(1.0 - u) / smp_lam[g];
+            if (smp_now[g] + gap > p.horizon) return -1.0;
+            if (smp_now[g] + gap >= smp_window_end[g]) {
+                smp_now[g] = smp_window_end[g];
+                continue;
+            }
+            smp_now[g] += gap;
             return gap;
         }
     }
 
-    void schedule_next_arrival() {
-        double gap = next_gap();
-        if (gap >= 0.0) push(now + gap, EV_ARRIVAL, -1);
+    // one EV_ARRIVAL stream per generator; the event's `req` field carries
+    // the generator index (requests are allocated at arrival time).  Called
+    // from generator g's own arrival (or t=0 init), so `now` is its last
+    // emitted-arrival time and now+gap accumulates emitted gaps only.
+    void schedule_next_arrival(int g) {
+        double gap = next_gap(g);
+        if (gap >= 0.0) push(now + gap, EV_ARRIVAL, g);
     }
 
     // ---- request slots ------------------------------------------------
@@ -516,29 +552,45 @@ struct Sim {
     }
 
     // ---- event handlers ------------------------------------------------
-    void on_arrival() {
+    const int32_t* gen_chain(int g) const {
+        return p.gen_entry_edges
+            ? p.gen_entry_edges + (int64_t)g * p.gen_entry_width
+            : p.entry_edges;
+    }
+    int gen_chain_len(int g) const {
+        return p.gen_entry_len ? p.gen_entry_len[g] : p.n_entry;
+    }
+
+    void on_arrival(int g) {
         ++generated;
-        schedule_next_arrival();
+        schedule_next_arrival(g);
         int32_t i = alloc();
         reqs[i].start = now;
         reqs[i].seg = 0;  // entry-hop index
-        record_hop(i, 0, now);  // generator
-        send(p.entry_edges[0], EV_ENTRY_HOP, i);
+        reqs[i].gen = g;
+        record_hop(i, g, now);  // generator (code = generator index)
+        send(gen_chain(g)[0], EV_ENTRY_HOP, i);
     }
 
     void on_entry_hop(int32_t i) {
         Request& r = reqs[i];
+        int g = r.gen;
         int hop = ++r.seg;  // this delivery completed hop (r.seg - 1)
-        if (hop < p.n_entry) {
+        if (hop < gen_chain_len(g)) {
             record_hop(i, 4000, now);  // intermediate client visit
-            send(p.entry_edges[hop], EV_ENTRY_HOP, i);
+            send(gen_chain(g)[hop], EV_ENTRY_HOP, i);
             return;
         }
         r.seg = 0;
-        if (p.entry_target_kind == TARGET_LB) {
+        int kind = p.gen_entry_target_kind
+            ? p.gen_entry_target_kind[g]
+            : p.entry_target_kind;
+        if (kind == TARGET_LB) {
             on_arrive_lb(i);
         } else {
-            r.srv = p.entry_target;
+            r.srv = p.gen_entry_target
+                ? p.gen_entry_target[g]
+                : p.entry_target;
             on_arrive_srv(i);
         }
     }
@@ -736,7 +788,10 @@ struct Sim {
             push(p.timeline_times[i], EV_TIMELINE, -1);
         if (p.sample_period > 0.0 && p.n_samples > 0)
             push(p.sample_period, EV_SAMPLE, -1);
-        schedule_next_arrival();
+        smp_now.assign(n_gens(), 0.0);
+        smp_window_end.assign(n_gens(), 0.0);
+        smp_lam.assign(n_gens(), 0.0);
+        for (int g = 0; g < n_gens(); ++g) schedule_next_arrival(g);
 
         while (!heap.empty() && heap.top().t < p.horizon) {
             Ev ev = heap.top();
@@ -744,7 +799,7 @@ struct Sim {
             now = ev.t;
             if (ev.edge >= 0) --edge_conn[ev.edge];
             switch (ev.type) {
-                case EV_ARRIVAL: on_arrival(); break;
+                case EV_ARRIVAL: on_arrival(ev.req); break;
                 case EV_ENTRY_HOP: on_entry_hop(ev.req); break;
                 case EV_ARRIVE_LB: on_arrive_lb(ev.req); break;
                 case EV_ARRIVE_SRV: on_arrive_srv(ev.req); break;

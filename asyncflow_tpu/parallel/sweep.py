@@ -34,6 +34,17 @@ def make_overrides(
     req_per_minute: np.ndarray | None = None,
 ) -> ScenarioOverrides:
     """Per-scenario parameter overrides; every scale is (S,) or (S, NE)."""
+    if plan.n_generators > 1 and (
+        user_mean is not None or req_per_minute is not None
+    ):
+        # the override channel carries ONE workload scalar per scenario;
+        # per-generator overrides need a (S, G) design that does not exist
+        # yet — refuse loudly instead of silently scaling generator 0
+        msg = (
+            "user_mean/req_per_minute overrides are not supported on "
+            "multi-generator plans"
+        )
+        raise ValueError(msg)
     base = base_overrides(plan)
 
     def _edges(scale: np.ndarray | None, base_arr: jnp.ndarray) -> jnp.ndarray:

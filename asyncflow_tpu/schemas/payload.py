@@ -38,12 +38,69 @@ def _sweep_marks(
 
 
 class SimulationPayload(BaseModel):
-    """Everything needed to run one scenario."""
+    """Everything needed to run one scenario.
 
-    rqs_input: RqsGenerator
+    ``rqs_input`` accepts the reference's single generator (unchanged
+    on-disk format) or a LIST of generators — heterogeneous workload
+    sources superposed through the same front door, each with its own
+    entry edge to the client (reference roadmap "richer workload
+    models"; the reference itself is single-generator:
+    `/root/reference/src/asyncflow/schemas/payload.py:15`).  Engines
+    consume :attr:`generators`; ``rqs_input`` stays the on-disk field.
+    """
+
+    rqs_input: RqsGenerator | list[RqsGenerator]
     topology_graph: TopologyGraph
     sim_settings: SimulationSettings
     events: list[EventInjection] | None = None
+
+    @property
+    def generators(self) -> list[RqsGenerator]:
+        """The workload sources, always as a list."""
+        if isinstance(self.rqs_input, RqsGenerator):
+            return [self.rqs_input]
+        return self.rqs_input
+
+    @field_validator("rqs_input", mode="after")
+    @classmethod
+    def _generators_nonempty_unique(
+        cls,
+        value: RqsGenerator | list[RqsGenerator],
+    ) -> RqsGenerator | list[RqsGenerator]:
+        if isinstance(value, list):
+            if not value:
+                msg = "rqs_input must contain at least one generator"
+                raise ValueError(msg)
+            ids = [generator.id for generator in value]
+            if len(set(ids)) != len(ids):
+                dup = sorted({i for i in ids if ids.count(i) > 1})
+                msg = f"duplicate generator ids: {dup}"
+                raise ValueError(msg)
+        return value
+
+    @model_validator(mode="after")
+    def _generators_have_entry_edges(self) -> SimulationPayload:
+        """Every generator must source exactly one (entry) edge, and no
+        generator id may collide with a topology node id."""
+        node_ids = {s.id for s in self.topology_graph.nodes.servers}
+        node_ids.add(self.topology_graph.nodes.client.id)
+        if self.topology_graph.nodes.load_balancer is not None:
+            node_ids.add(self.topology_graph.nodes.load_balancer.id)
+        for generator in self.generators:
+            if generator.id in node_ids:
+                msg = f"generator id {generator.id!r} collides with a node id"
+                raise ValueError(msg)
+            outs = [
+                e for e in self.topology_graph.edges
+                if e.source == generator.id
+            ]
+            if len(outs) != 1:
+                msg = (
+                    f"generator {generator.id!r} must source exactly one "
+                    f"edge, found {len(outs)}"
+                )
+                raise ValueError(msg)
+        return self
 
     # ------------------------------------------------------------------
     # Event validators

@@ -1,0 +1,253 @@
+"""Multi-generator workloads (reference roadmap "richer workload models"):
+several independent arrival processes superposed through the same front
+door, each with its own workload params and entry edge.
+
+Semantics under test: the schema accepts a LIST in ``rqs_input`` (the
+reference's single-generator on-disk format is unchanged); each generator
+must source exactly one entry edge; the oracle, native, and jax event
+engines superpose the streams; the fast path and the Pallas kernel
+decline with a named reason; workload overrides are refused (one scalar
+per scenario cannot address G generators).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import yaml
+from pydantic import ValidationError
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.engines.jaxsim.engine import Engine, scenario_keys
+from asyncflow_tpu.engines.oracle.engine import OracleEngine
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+pytestmark = pytest.mark.integration
+
+LB = "examples/yaml_input/data/two_servers_lb.yml"
+SEEDS = 8
+
+
+def _payload(horizon: int = 60) -> SimulationPayload:
+    data = yaml.safe_load(open(LB).read())
+    data["sim_settings"]["total_simulation_time"] = horizon
+    data["rqs_input"] = [
+        {
+            "id": "rqs-1",
+            "avg_active_users": {"mean": 200},
+            "avg_request_per_minute_per_user": {"mean": 20},
+            "user_sampling_window": 60,
+        },
+        {
+            "id": "rqs-2",
+            "avg_active_users": {"mean": 100},
+            "avg_request_per_minute_per_user": {"mean": 40},
+            "user_sampling_window": 30,
+        },
+    ]
+    data["topology_graph"]["edges"].append(
+        {
+            "id": "gen2-client",
+            "source": "rqs-2",
+            "target": "client-1",
+            "latency": {"mean": 0.004, "distribution": "exponential"},
+        },
+    )
+    return SimulationPayload.model_validate(data)
+
+
+class TestSchema:
+    def test_single_generator_format_unchanged(self) -> None:
+        p = SimulationPayload.model_validate(yaml.safe_load(open(LB).read()))
+        assert len(p.generators) == 1
+        assert p.generators[0].id == "rqs-1"
+
+    def test_list_accepted_and_normalized(self) -> None:
+        p = _payload()
+        assert len(p.generators) == 2
+        assert [g.id for g in p.generators] == ["rqs-1", "rqs-2"]
+
+    def test_empty_list_rejected(self) -> None:
+        data = yaml.safe_load(open(LB).read())
+        data["rqs_input"] = []
+        with pytest.raises(ValidationError, match="at least one"):
+            SimulationPayload.model_validate(data)
+
+    def test_duplicate_generator_ids_rejected(self) -> None:
+        data = yaml.safe_load(open(LB).read())
+        gen = dict(data["rqs_input"])
+        data["rqs_input"] = [gen, dict(gen)]
+        with pytest.raises(ValidationError, match="duplicate generator"):
+            SimulationPayload.model_validate(data)
+
+    def test_generator_without_entry_edge_rejected(self) -> None:
+        data = yaml.safe_load(open(LB).read())
+        gen2 = dict(data["rqs_input"])
+        gen2 = {**gen2, "id": "rqs-2"}
+        data["rqs_input"] = [data["rqs_input"], gen2]  # no edge for rqs-2
+        with pytest.raises(ValidationError, match="exactly one"):
+            SimulationPayload.model_validate(data)
+
+
+class TestCompiler:
+    def test_plan_gen_arrays(self) -> None:
+        plan = compile_payload(_payload())
+        assert plan.n_generators == 2
+        assert plan.gen_user_mean.tolist() == [200.0, 100.0]
+        assert plan.gen_rate.tolist() == pytest.approx([20 / 60, 40 / 60])
+        assert plan.gen_entry_len.tolist() == [2, 2]
+
+    def test_fast_path_declines(self) -> None:
+        plan = compile_payload(_payload())
+        assert not plan.fastpath_ok
+        assert "multiple generators" in plan.fastpath_reason
+
+    def test_pallas_declines(self) -> None:
+        from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
+
+        with pytest.raises(ValueError, match="multi-generator"):
+            PallasEngine(compile_payload(_payload()))
+
+    def test_overrides_refused(self) -> None:
+        from asyncflow_tpu.parallel import make_overrides
+
+        plan = compile_payload(_payload())
+        with pytest.raises(ValueError, match="multi-generator"):
+            make_overrides(plan, 4, user_mean=np.full(4, 100.0))
+
+    def test_capacity_covers_both_streams(self) -> None:
+        # 200*20/60 + 100*40/60 = 133.3 rps x 60 s = 8000 expected; the
+        # request-pool estimate must exceed it with draw slack
+        plan = compile_payload(_payload())
+        assert plan.max_requests > 8000
+
+
+def test_three_engine_superposition_parity() -> None:
+    """Pooled rate and latency of the superposed streams agree across the
+    oracle, the native core, and the jax event engine."""
+    p = _payload()
+    plan = compile_payload(p)
+    expected = (200 * 20 / 60 + 100 * 40 / 60) * 60  # 8000
+
+    gen_o = 0
+    lat_o = []
+    for s in range(SEEDS):
+        r = OracleEngine(p, seed=s).run()
+        gen_o += r.total_generated
+        lat_o.append(r.latencies)
+    lat_o = np.concatenate(lat_o)
+    assert abs(gen_o / SEEDS - expected) / expected < 0.08
+
+    eng = Engine(plan, collect_clocks=True)
+    fin = eng.run_batch(scenario_keys(11, SEEDS))
+    gen_j = int(np.asarray(fin.n_generated).sum())
+    clock = np.asarray(fin.clock)
+    cnt = np.asarray(fin.clock_n)
+    lat_j = np.concatenate(
+        [clock[i, : cnt[i], 1] - clock[i, : cnt[i], 0] for i in range(SEEDS)],
+    )
+    assert abs(gen_j / SEEDS - expected) / expected < 0.08
+    assert abs(lat_j.mean() - lat_o.mean()) / lat_o.mean() < 0.05
+    for q in (50, 95):
+        po, pj = np.percentile(lat_o, q), np.percentile(lat_j, q)
+        assert abs(pj - po) / po < 0.06, (q, po, pj)
+
+    from asyncflow_tpu.engines.oracle.native import native_available, run_native
+
+    if native_available():
+        gen_n = 0
+        lat_n = []
+        for s in range(SEEDS):
+            r = run_native(
+                plan, seed=s, collect_gauges=False, settings=p.sim_settings,
+            )
+            gen_n += r.total_generated
+            lat_n.append(r.latencies)
+        lat_n = np.concatenate(lat_n)
+        assert abs(gen_n / SEEDS - expected) / expected < 0.08
+        assert abs(lat_n.mean() - lat_o.mean()) / lat_o.mean() < 0.05
+
+
+def test_traces_carry_generator_identity() -> None:
+    """Every engine's traces name the originating generator, and both
+    generators appear in proportion to their rates (equal here)."""
+    p = _payload(horizon=30)
+    plan = compile_payload(p)
+
+    def gen_share(traces):
+        ids = [trace[0][1] for trace in traces.values()]
+        assert set(ids) <= {"rqs-1", "rqs-2"}
+        return ids.count("rqs-2") / max(len(ids), 1)
+
+    e_o = OracleEngine(p, seed=0, collect_traces=True)
+    e_o.run()
+    traces_o = {
+        k: [(h[0], h[1], h[2]) for h in hops] for k, hops in e_o.traces.items()
+    }
+    share_o = gen_share(traces_o)
+    assert 0.4 < share_o < 0.6  # both streams at ~66.7 rps
+
+    from asyncflow_tpu.engines.jaxsim.engine import run_single
+
+    res_j = run_single(p, seed=0, engine="event", collect_traces=True)
+    share_j = gen_share(res_j.traces)
+    assert 0.4 < share_j < 0.6
+
+    from asyncflow_tpu.engines.oracle.native import native_available, run_native
+
+    if native_available():
+        res_n = run_native(
+            plan, seed=0, collect_gauges=False, collect_traces=True,
+            payload=p, settings=p.sim_settings,
+        )
+        assert 0.4 < gen_share(res_n.traces) < 0.6
+
+
+def test_builder_accumulates_generators() -> None:
+    from asyncflow_tpu import AsyncFlow
+    from asyncflow_tpu.components import (
+        Client, Edge, Endpoint, Server, ServerResources, Step,
+    )
+    from asyncflow_tpu.schemas.random_variables import RVConfig
+    from asyncflow_tpu.schemas.workload import RqsGenerator
+
+    ep = Endpoint(
+        endpoint_name="/e",
+        steps=[Step(kind="io_wait", step_operation={"io_waiting_time": 0.01})],
+    )
+    flow = (
+        AsyncFlow()
+        .add_generator(RqsGenerator(
+            id="g1",
+            avg_active_users=RVConfig(mean=20),
+            avg_request_per_minute_per_user=RVConfig(mean=30),
+        ))
+        .add_generator(RqsGenerator(
+            id="g2",
+            avg_active_users=RVConfig(mean=10),
+            avg_request_per_minute_per_user=RVConfig(mean=30),
+        ))
+        .add_client(Client(id="c"))
+        .add_servers(Server(
+            id="s",
+            server_resources=ServerResources(cpu_cores=1, ram_mb=1024),
+            endpoints=[ep],
+        ))
+        .add_edges(
+            Edge(id="g1-c", source="g1", target="c",
+                 latency=RVConfig(mean=0.003, distribution="exponential")),
+            Edge(id="g2-c", source="g2", target="c",
+                 latency=RVConfig(mean=0.003, distribution="exponential")),
+            Edge(id="c-s", source="c", target="s",
+                 latency=RVConfig(mean=0.002, distribution="exponential")),
+            Edge(id="s-c", source="s", target="c",
+                 latency=RVConfig(mean=0.003, distribution="exponential")),
+        )
+    )
+    from asyncflow_tpu.schemas.settings import SimulationSettings
+
+    flow.add_simulation_settings(SimulationSettings(total_simulation_time=20))
+    payload = flow.build_payload()
+    assert len(payload.generators) == 2
+    r = OracleEngine(payload, seed=1).run()
+    assert r.total_generated > 0
