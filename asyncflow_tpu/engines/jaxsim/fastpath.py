@@ -412,26 +412,86 @@ class FastEngine:
         msg = "poisson edge latency is not supported on the fast path"
         raise NotImplementedError(msg)
 
-    def _edge_hop(self, key, edge: int, t_send, ov: ScenarioOverrides):
-        """(dropped, delay+spike) vectors for one static edge index."""
+    @staticmethod
+    def _fused_drop_rescale(u, p):
+        """(dropped, survivor latency uniform): one uniform settles both —
+        u | u >= p is uniform on [p, 1), so the rescale is uniform [0, 1)
+        and the latency law is unchanged; dropped lanes never consume
+        their (negative) rescaled value."""
+        return u < p, (u - p) / jnp.maximum(1.0 - p, _TINY)
+
+    def _add_spike(self, delay, t_send, eidx):
+        """Active-spike superposition at send time (static or per-lane
+        edge index)."""
+        idx = (
+            jnp.searchsorted(self._spike_times, t_send, side="right").astype(
+                jnp.int32,
+            )
+            - 1
+        )
+        return delay + self._spike_values[idx, eidx]
+
+    def _edge_hop(self, key, edge: int, t_send, ov: ScenarioOverrides, u=None):
+        """(dropped, delay+spike) vectors for one static edge index.
+
+        ONE uniform settles both dropout and latency (profiling: threefry
+        draws dominate the post-sort chunk): ``u < p`` drops, and the
+        survivor's latency uniform is the exact conditional rescale
+        ``(u - p) / (1 - p)`` — u | u >= p is uniform on [p, 1), so the
+        rescale is uniform on [0, 1) and the latency law is unchanged.
+        Dropped lanes never consume their latency value.  ``u`` may be a
+        caller-shared stream (disjoint request sets draw disjoint lanes).
+        """
         dist_id = int(self.plan.edge_dist[edge])
-        u_drop = jax.random.uniform(jax.random.fold_in(key, 0), t_send.shape)
-        u = jax.random.uniform(jax.random.fold_in(key, 1), t_send.shape)
+        if u is None:
+            u = jax.random.uniform(jax.random.fold_in(key, 0), t_send.shape)
+        dropped, u_lat = self._fused_drop_rescale(u, ov.edge_dropout[edge])
         z = (
             jax.random.normal(jax.random.fold_in(key, 2), t_send.shape)
             if dist_id in (_D_NORMAL, _D_LOGNORMAL)
             else 0.0
         )
-        delay = self._delay(dist_id, ov.edge_mean[edge], ov.edge_var[edge], u, z)
+        delay = self._delay(
+            dist_id, ov.edge_mean[edge], ov.edge_var[edge], u_lat, z,
+        )
         if len(self.plan.spike_times) > 1:
-            idx = (
-                jnp.searchsorted(self._spike_times, t_send, side="right").astype(
-                    jnp.int32,
-                )
-                - 1
+            delay = self._add_spike(delay, t_send, edge)
+        return dropped, delay
+
+    def _edge_hop_dyn(self, key, eidx_arr, t_send, ov: ScenarioOverrides):
+        """(dropped, delay+spike) for a PER-LANE edge index (the routed LB
+        edge): one fused dropout+latency uniform, per-lane parameter
+        gathers, dist dispatch over the dists present among LB edges."""
+        plan = self.plan
+        mean = ov.edge_mean[eidx_arr]
+        var = ov.edge_var[eidx_arr]
+        u = jax.random.uniform(jax.random.fold_in(key, 0), t_send.shape)
+        dropped, u_lat = self._fused_drop_rescale(u, ov.edge_dropout[eidx_arr])
+        lb_dists = sorted(
+            {int(plan.edge_dist[e]) for e in plan.lb_edge_index.tolist()},
+        )
+        if len(lb_dists) == 1:
+            z = (
+                jax.random.normal(jax.random.fold_in(key, 2), t_send.shape)
+                if lb_dists[0] in (_D_NORMAL, _D_LOGNORMAL)
+                else 0.0
             )
-            delay = delay + self._spike_values[idx, edge]
-        return u_drop < ov.edge_dropout[edge], delay
+            delay = self._delay(lb_dists[0], mean, var, u_lat, z)
+        else:
+            dist = jnp.asarray(plan.edge_dist)[eidx_arr]
+            z = (
+                jax.random.normal(jax.random.fold_in(key, 2), t_send.shape)
+                if {_D_NORMAL, _D_LOGNORMAL} & set(lb_dists)
+                else 0.0
+            )
+            delay = jnp.zeros_like(t_send)
+            for d in lb_dists:
+                delay = jnp.where(
+                    dist == d, self._delay(d, mean, var, u_lat, z), delay,
+                )
+        if len(plan.spike_times) > 1:
+            delay = self._add_spike(delay, t_send, eidx_arr)
+        return dropped, delay
 
     # ------------------------------------------------------------------
     # arrivals
@@ -690,47 +750,52 @@ class FastEngine:
         alive = alive & (t < plan.horizon)
         srv = jnp.full(n, jnp.int32(max(plan.entry_target, 0)))
         if plan.n_lb_edges > 0:
-            # pre-draw every (request, slot) edge outcome; the routing rule
-            # then just selects a column (distributionally identical to the
-            # event engines' draw-after-pick)
-            drops = []
-            delays = []
-            for s_idx, eidx in enumerate(plan.lb_edge_index.tolist()):
-                dropped_c, delay_c = self._edge_hop(
-                    jax.random.fold_in(key, 32 + s_idx), eidx, t, ov,
-                )
-                drops.append(dropped_c)
-                delays.append(delay_c)
-            drop_s = jnp.stack(drops, axis=1)  # (n, EL)
-            delay_s = jnp.stack(delays, axis=1)
-
             if plan.lb_algo == 1:
-                # least connections: scan arrivals carrying per-slot rings of
-                # outstanding delivery times (live edge in-flight counts)
+                # least connections needs every slot's CANDIDATE delivery
+                # time for the in-flight rings, so outcomes are pre-drawn
+                # per (request, slot) — distributionally identical to the
+                # event engines' draw-after-pick
+                drops = []
+                delays = []
+                for s_idx, eidx in enumerate(plan.lb_edge_index.tolist()):
+                    dropped_c, delay_c = self._edge_hop(
+                        jax.random.fold_in(key, 32 + s_idx), eidx, t, ov,
+                    )
+                    drops.append(dropped_c)
+                    delays.append(delay_c)
+                drop_s = jnp.stack(drops, axis=1)  # (n, EL)
+                delay_s = jnp.stack(delays, axis=1)
                 slot, routed = self._routed_slots_lc(t, alive, drop_s, delay_s)
                 n_dropped = n_dropped + jnp.sum(alive & ~routed)
                 alive = alive & routed
                 slot = jnp.where(alive, slot, 0)
-            elif len(plan.timeline_times) == 0:
-                # fixed membership: round robin is a pure function of rank.
-                # Dead lanes rank after every alive lane (sortutil), so the
-                # stable rank IS the rank-among-alive wherever alive.
-                rank = time_rank(t, alive)
-                slot = jnp.where(alive, rank % plan.n_lb_edges, 0)
+                lanes = jnp.arange(n)
+                dropped = drop_s[lanes, slot]
+                delay = delay_s[lanes, slot]
+                eidx_arr = jnp.asarray(plan.lb_edge_index)[slot]
             else:
-                # outages mutate the rotation: scan LB arrivals in time
-                # order, interleaving the outage timeline (slot -1 = no
-                # healthy target, request dropped like the event engines)
-                slot, routed = self._routed_slots(t, alive)
-                n_dropped = n_dropped + jnp.sum(alive & ~routed)
-                alive = alive & routed
-                slot = jnp.where(alive, slot, 0)
+                # round robin picks its slot BEFORE any edge outcome is
+                # needed, so one dynamic-edge draw replaces the per-slot
+                # pre-draws (threefry streams dominate the post-sort chunk)
+                if len(plan.timeline_times) == 0:
+                    # fixed membership: round robin is a pure function of
+                    # rank; dead lanes rank after every alive lane
+                    # (sortutil), so the stable rank IS rank-among-alive
+                    rank = time_rank(t, alive)
+                    slot = jnp.where(alive, rank % plan.n_lb_edges, 0)
+                else:
+                    # outages mutate the rotation: scan LB arrivals in time
+                    # order, interleaving the outage timeline (slot -1 = no
+                    # healthy target, request dropped like the event engines)
+                    slot, routed = self._routed_slots(t, alive)
+                    n_dropped = n_dropped + jnp.sum(alive & ~routed)
+                    alive = alive & routed
+                    slot = jnp.where(alive, slot, 0)
+                eidx_arr = jnp.asarray(plan.lb_edge_index)[slot]
+                dropped, delay = self._edge_hop_dyn(
+                    jax.random.fold_in(key, 32), eidx_arr, t, ov,
+                )
             srv = jnp.asarray(plan.lb_target)[slot]
-
-            lanes = jnp.arange(n)
-            dropped = drop_s[lanes, slot]
-            delay = delay_s[lanes, slot]
-            eidx_arr = jnp.asarray(plan.lb_edge_index)[slot]
             ok = alive & ~dropped
             gauge = self._gauge_intervals(gauge, eidx_arr, t, t + delay, 1.0, ok)
             lo = jnp.minimum(t, horizon)
@@ -763,6 +828,22 @@ class FastEngine:
             if any(self._shares_entry_sort(s) for s in plan.server_topo_order)
             else None
         )
+        # one shared endpoint-pick stream and one shared exit-edge stream
+        # when no server chains exist: each request then visits exactly one
+        # server, so per-server masked consumers read DISJOINT lanes of the
+        # same uniforms — fewer threefry streams, independence intact.
+        # (Chained topologies revisit lanes and keep per-server draws.)
+        chained = any(int(k) == TARGET_SERVER for k in plan.exit_kind)
+        u_ep_shared = (
+            None
+            if chained
+            else jax.random.uniform(jax.random.fold_in(key, 6), (n,))
+        )
+        u_exit_shared = (
+            None
+            if chained
+            else jax.random.uniform(jax.random.fold_in(key, 7), (n,))
+        )
         for s in plan.server_topo_order:
             mine = alive & (srv == s) & (t < plan.horizon)
 
@@ -789,7 +870,11 @@ class FastEngine:
                 mine = mine & accepted
 
             nep = int(plan.n_endpoints[s])
-            u = jax.random.uniform(jax.random.fold_in(key, 64 + s), (n,))
+            u = (
+                u_ep_shared
+                if u_ep_shared is not None
+                else jax.random.uniform(jax.random.fold_in(key, 64 + s), (n,))
+            )
             ep = jnp.minimum(
                 jnp.searchsorted(endpoint_cum_t[s], u, side="right").astype(
                     jnp.int32,
@@ -1102,6 +1187,7 @@ class FastEngine:
             eidx = int(plan.exit_edge[s])
             dropped, delay = self._edge_hop(
                 jax.random.fold_in(key, 128 + s), eidx, dep, ov,
+                u=u_exit_shared,
             )
             ok = sendable & ~dropped
             gauge = self._gauge_intervals(gauge, eidx, dep, dep + delay, 1.0, ok)
