@@ -43,10 +43,14 @@ from pathlib import Path
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = ["argsort_time", "sortable_u32", "time_rank"]
 
-_DEAD_BASE = jnp.uint32(0xFF000000)
+# a numpy scalar, NOT jnp: a module-level jnp constant would initialize
+# the XLA backend at import time, breaking jax.distributed.initialize()
+# in multi-host workers (tests/system/test_sys_multihost.py)
+_DEAD_BASE = np.uint32(0xFF000000)
 
 # ---------------------------------------------------------------------------
 # CPU escape hatch: adaptive native stable argsort (ffisort.cpp).  The
