@@ -63,6 +63,7 @@ from asyncflow_tpu.compiler.plan import (
     StaticPlan,
 )
 from asyncflow_tpu.engines.jaxsim.params import (
+    EV_ABANDON,
     EV_ARRIVE_LB,
     EV_ARRIVE_SRV,
     EV_IDLE,
@@ -293,6 +294,7 @@ class PallasState(NamedTuple):
     truncated: np.ndarray
     llm_sum: np.ndarray
     llm_sumsq: np.ndarray
+    n_rejected: np.ndarray
 
 
 class PallasEngine:
@@ -319,23 +321,16 @@ class PallasEngine:
         kernel on its scenario shard (the kernel itself is a single-device
         program — GSPMD cannot partition a ``pallas_call``, so the sharding
         seam has to be explicit)."""
-        if (
-            plan.has_queue_cap
-            or plan.has_conn_cap
-            or plan.has_rate_limit
-            or plan.has_queue_timeout
-            or plan.breaker_threshold > 0
-            or plan.n_generators > 1
-        ):
-            # the VMEM kernel has no shed/refusal/limiter/deadline/breaker
-            # paths; the compiler routes such plans to the general event
-            # engine.  DB pools, cache mixtures, LLM dynamics, and weighted
-            # endpoint selection are modeled (round 5).
+        if plan.breaker_threshold > 0 or plan.n_generators > 1:
+            # the VMEM kernel has no breaker rotation-feedback channel and
+            # is single-stream; the compiler routes such plans to the
+            # general event engine.  Server-side overload policies (queue
+            # caps, socket capacities, rate limits, dequeue deadlines),
+            # DB pools, cache mixtures, LLM dynamics, and weighted
+            # endpoint selection are all modeled in-kernel (round 5).
             msg = (
-                "the Pallas kernel does not model reachable overload "
-                "policies (caps, capacities, rate limits, deadlines, "
-                "circuit breakers) or multi-generator workloads; use the "
-                "event engine"
+                "the Pallas kernel does not model LB circuit breakers or "
+                "multi-generator workloads; use the event engine"
             )
             raise ValueError(msg)
         self.plan = plan
@@ -350,6 +345,10 @@ class PallasEngine:
         self._dists_present = sorted(set(plan.edge_dist.tolist()))
         self._has_ram = bool(np.max(plan.endpoint_ram) > 0)
         self._has_cache = bool(np.any(plan.seg_kind == SEG_CACHE))
+        self._has_shed = plan.has_queue_cap
+        self._has_conn = plan.has_conn_cap
+        self._has_rl = plan.has_rate_limit
+        self._has_timeout = plan.has_queue_timeout
         self._has_llm = bool(np.any(plan.seg_kind == SEG_LLM))
         self._has_db = bool(np.any(plan.seg_kind == SEG_DB))
         self._has_tl = len(plan.timeline_times) > 0
@@ -384,6 +383,23 @@ class PallasEngine:
                 ("seg_llm_tokens", plan.seg_llm_tokens.reshape(-1).astype(np.float32)),
                 ("seg_llm_tpt", plan.seg_llm_tpt.reshape(-1).astype(np.float32)),
                 ("seg_llm_cost", plan.seg_llm_cost.reshape(-1).astype(np.float32)),
+            ]
+        if self._has_shed:
+            tables += [
+                ("queue_cap", plan.server_queue_cap.astype(np.int32)),
+            ]
+        if self._has_conn:
+            tables += [
+                ("conn_cap", plan.server_conn_cap.astype(np.int32)),
+            ]
+        if self._has_rl:
+            tables += [
+                ("rate_limit", plan.server_rate_limit.astype(np.float32)),
+                ("rate_burst", plan.server_rate_burst.astype(np.float32)),
+            ]
+        if self._has_timeout:
+            tables += [
+                ("queue_timeout", plan.server_queue_timeout.astype(np.float32)),
             ]
         if self._has_db:
             tables += [
@@ -691,6 +707,17 @@ class PallasEngine:
         can_take = (_sel_col(st["cores_free"], s) > 0) & ~has_waiters
         cpu_run = is_cpu & can_take
         cpu_wait = is_cpu & ~can_take
+        shed = jnp.zeros_like(is_cpu)
+        if self._has_shed:
+            # overload policy: joining a FULL ready queue sheds the
+            # request (`engine.py:523-531`)
+            cap = _tab(self._tk["queue_cap"], s)
+            shed = (
+                cpu_wait
+                & (cap >= 0)
+                & (_sel_col(st["cpu_wait_n"], s) >= cap)
+            )
+            cpu_wait = cpu_wait & ~shed
         run_now = cpu_run | is_io
 
         db_wait = jnp.zeros_like(is_cpu)
@@ -735,71 +762,93 @@ class PallasEngine:
             st["req_ticket"] = _set_col(
                 st["req_ticket"], i, _sel_col(st["db_ticket"], s), db_wait,
             )
+        if self._has_timeout:
+            st["req_wait_t"] = _set_col(st["req_wait_t"], i, now, cpu_wait)
+        if self._has_shed:
+            # shed: release RAM (grant cascade), free the socket slot,
+            # leave the system, count rejected (`engine.py:596-616`)
+            st = self._release_ram(st, i, s, now, shed)
+            if self._has_conn:
+                st["srv_conn"] = _add_col(st["srv_conn"], s, -1, shed)
+            st["req_ev"] = _set_col(st["req_ev"], i, EV_IDLE, shed)
+            st["req_t"] = _set_col(st["req_t"], i, np.float32(INF), shed)
+            st["n_rejected"] = st["n_rejected"] + jnp.where(shed, 1, 0)
         st["req_seg"] = _set_col(st["req_seg"], i, seg, pred)
         return self._exit_flow(st, i, s, now, rng, it, ov_tabs, is_end)
 
-    def _exit_flow(self, st, i, s, now, rng, it, ov_tabs, pred):
-        """`engine.py:421-529`: release RAM w/ FIFO grants, route exit edge."""
-        plan = self.plan
+    def _release_ram(self, st, i, s, now, pred):
+        """Release slot ``i``'s RAM on server ``s`` and run the strict-FIFO
+        grant cascade (`engine.py`'s ``_release_ram``); shared by the exit
+        flow, queue-cap shedding, and deadline abandons."""
+        if not self._has_ram:
+            return st
+        ram_amt = _sel_col(st["req_ram"], i)
+        st["ram_free"] = _add_col(
+            st["ram_free"], s, jnp.where(pred, ram_amt, 0.0), pred,
+        )
+        st["req_ram"] = _set_col(st["req_ram"], i, 0.0, pred)
 
-        if self._has_ram:
-            ram_amt = _sel_col(st["req_ram"], i)
-            st["ram_free"] = _add_col(
-                st["ram_free"], s, jnp.where(pred, ram_amt, 0.0), pred,
+        # strict-FIFO grant cascade: grant heads while they fit
+        srv_col = jnp.where(pred, s, -1)
+
+        def gcond(c):
+            req_ev, _t, req_tk, ram_free, wait_n, go = c
+            waiting = (req_ev == EV_WAIT_RAM) & (st["req_srv"] == srv_col)
+            tick = jnp.where(waiting, req_tk, NO_TICKET)
+            head, tmin = _argmin_row(tick)
+            fits = (tmin < NO_TICKET) & (
+                _sel_col(st["req_ram"], head) <= _sel_col(ram_free, srv_col)
             )
+            return jnp.sum((go & fits).astype(jnp.int32)) > 0
 
-            # strict-FIFO grant cascade: grant heads while they fit
-            srv_col = jnp.where(pred, s, -1)
+        def gbody(c):
+            req_ev, req_t, req_tk, ram_free, wait_n, go = c
+            waiting = (req_ev == EV_WAIT_RAM) & (st["req_srv"] == srv_col)
+            tick = jnp.where(waiting, req_tk, NO_TICKET)
+            head, tmin = _argmin_row(tick)
+            fits = go & (tmin < NO_TICKET) & (
+                _sel_col(st["req_ram"], head) <= _sel_col(ram_free, srv_col)
+            )
+            req_ev = _set_col(req_ev, head, EV_RESUME, fits)
+            req_t = _set_col(req_t, head, now, fits)
+            req_tk = _set_col(req_tk, head, NO_TICKET, fits)
+            ram_free = _add_col(
+                ram_free,
+                srv_col,
+                -jnp.where(fits, _sel_col(st["req_ram"], head), 0.0),
+                fits,
+            )
+            wait_n = _add_col(wait_n, srv_col, -1, fits)
+            return req_ev, req_t, req_tk, ram_free, wait_n, go
 
-            def gcond(c):
-                req_ev, _t, req_tk, ram_free, wait_n, go = c
-                waiting = (req_ev == EV_WAIT_RAM) & (st["req_srv"] == srv_col)
-                tick = jnp.where(waiting, req_tk, NO_TICKET)
-                head, tmin = _argmin_row(tick)
-                fits = (tmin < NO_TICKET) & (
-                    _sel_col(st["req_ram"], head) <= _sel_col(ram_free, srv_col)
-                )
-                return jnp.sum((go & fits).astype(jnp.int32)) > 0
-
-            def gbody(c):
-                req_ev, req_t, req_tk, ram_free, wait_n, go = c
-                waiting = (req_ev == EV_WAIT_RAM) & (st["req_srv"] == srv_col)
-                tick = jnp.where(waiting, req_tk, NO_TICKET)
-                head, tmin = _argmin_row(tick)
-                fits = go & (tmin < NO_TICKET) & (
-                    _sel_col(st["req_ram"], head) <= _sel_col(ram_free, srv_col)
-                )
-                req_ev = _set_col(req_ev, head, EV_RESUME, fits)
-                req_t = _set_col(req_t, head, now, fits)
-                req_tk = _set_col(req_tk, head, NO_TICKET, fits)
-                ram_free = _add_col(
-                    ram_free,
-                    srv_col,
-                    -jnp.where(fits, _sel_col(st["req_ram"], head), 0.0),
-                    fits,
-                )
-                wait_n = _add_col(wait_n, srv_col, -1, fits)
-                return req_ev, req_t, req_tk, ram_free, wait_n, go
-
+        (
+            st["req_ev"],
+            st["req_t"],
+            st["req_ticket"],
+            st["ram_free"],
+            st["ram_wait_n"],
+            _,
+        ) = jax.lax.while_loop(
+            gcond,
+            gbody,
             (
                 st["req_ev"],
                 st["req_t"],
                 st["req_ticket"],
                 st["ram_free"],
                 st["ram_wait_n"],
-                _,
-            ) = jax.lax.while_loop(
-                gcond,
-                gbody,
-                (
-                    st["req_ev"],
-                    st["req_t"],
-                    st["req_ticket"],
-                    st["ram_free"],
-                    st["ram_wait_n"],
-                    pred,
-                ),
-            )
+                pred,
+            ),
+        )
+        return st
+
+    def _exit_flow(self, st, i, s, now, rng, it, ov_tabs, pred):
+        """`engine.py:421-529`: release RAM w/ FIFO grants, route exit edge."""
+        plan = self.plan
+        st = self._release_ram(st, i, s, now, pred)
+        if self._has_conn:
+            # departing the server releases its socket slot
+            st["srv_conn"] = _add_col(st["srv_conn"], s, -1, pred)
 
         e = _tab(self._tk["exit_edge"], s)
         kind = _tab(self._tk["exit_kind"], s)
@@ -837,7 +886,6 @@ class PallasEngine:
         )
         st["req_srv"] = _set_col(st["req_srv"], i, target, to_server)
         st["req_lbslot"] = _set_col(st["req_lbslot"], i, -1, pred)
-        st["req_ram"] = _set_col(st["req_ram"], i, 0.0, pred)
         st["n_dropped"] = st["n_dropped"] + jnp.where(drop_here, 1, 0)
         return st
 
@@ -939,6 +987,37 @@ class PallasEngine:
             )
             st["req_lbslot"] = _set_col(st["req_lbslot"], i, -1, pred)
 
+        if self._has_rl:
+            # token-bucket rate limiter: lazy refill at arrival, refuse
+            # without a whole token (`engine.py:1069-1101`)
+            rps = _tab(self._tk["rate_limit"], s)
+            has_rl = pred & (rps >= 0)
+            tokens = jnp.minimum(
+                _tab(self._tk["rate_burst"], s),
+                _sel_col(st["rl_tokens"], s)
+                + (now - _sel_col(st["rl_last"], s)) * jnp.maximum(rps, 0.0),
+            )
+            limited = has_rl & (tokens < 1.0)
+            st["rl_tokens"] = _set_col(
+                st["rl_tokens"], s,
+                tokens - jnp.where(limited, 0.0, 1.0),
+                has_rl,
+            )
+            st["rl_last"] = _set_col(st["rl_last"], s, now, has_rl)
+            st["req_ev"] = _set_col(st["req_ev"], i, EV_IDLE, limited)
+            st["req_t"] = _set_col(st["req_t"], i, np.float32(INF), limited)
+            st["n_rejected"] = st["n_rejected"] + jnp.where(limited, 1, 0)
+            pred = pred & ~limited
+        if self._has_conn:
+            # socket capacity: refuse when the server is at residents cap
+            cap = _tab(self._tk["conn_cap"], s)
+            refuse = pred & (cap >= 0) & (_sel_col(st["srv_conn"], s) >= cap)
+            st["req_ev"] = _set_col(st["req_ev"], i, EV_IDLE, refuse)
+            st["req_t"] = _set_col(st["req_t"], i, np.float32(INF), refuse)
+            st["n_rejected"] = st["n_rejected"] + jnp.where(refuse, 1, 0)
+            pred = pred & ~refuse
+            st["srv_conn"] = _add_col(st["srv_conn"], s, 1, pred)
+
         u = rng.one(it, 4)
         nep = _tab(self._tk["n_endpoints"], s)
         # endpoint pick by cumulative weight: searchsorted(cum, u, 'right')
@@ -990,15 +1069,12 @@ class PallasEngine:
             st, i, s, ep, jnp.zeros_like(ep), now, rng, it, ov_tabs, pred,
         )
 
-    def _seg_end_branch(self, st, i, now, rng, it, ov_tabs, pred):
-        """`engine.py:638-669`: core handoff to longest-waiting, next seg."""
-        s = _sel_col(st["req_srv"], i)
-        ep = _sel_col(st["req_ep"], i)
-        seg = _sel_col(st["req_seg"], i)
-        kind = _tab(self._tk["seg_kind"], self._seg_idx(s, ep, seg))
-        was_cpu = pred & (kind == SEG_CPU)
-
-        srv_col = jnp.where(pred, s, -1)
+    def _cpu_handoff(self, st, s, now, was_cpu):
+        """Release one core of server ``s`` or grant it to the head FIFO
+        waiter; with dequeue deadlines, an expired grantee takes the core
+        for ZERO service as an immediate EV_ABANDON (`engine.py:1180-1212`).
+        """
+        srv_col = jnp.where(was_cpu, s, -1)
         waiting = (st["req_ev"] == EV_WAIT_CPU) & (st["req_srv"] == srv_col)
         tick = jnp.where(waiting, st["req_ticket"], NO_TICKET)
         j, tmin = _argmin_row(tick)
@@ -1008,16 +1084,55 @@ class PallasEngine:
         jep = _sel_col(st["req_ep"], j)
         jseg = _sel_col(st["req_seg"], j)
         jdur = _tab(self._tk["seg_dur"], self._seg_idx(js, jep, jseg))
+        ev_next = jnp.full_like(js, EV_SEG_END)
+        t_next = now + jdur
+        if self._has_timeout:
+            deadline = _tab(self._tk["queue_timeout"], s)
+            expired = (
+                grant
+                & (deadline >= 0)
+                & (now - _sel_col(st["req_wait_t"], j) > deadline)
+            )
+            ev_next = jnp.where(expired, EV_ABANDON, ev_next)
+            t_next = jnp.where(expired, now, t_next)
         st["cores_free"] = _add_col(st["cores_free"], s, 1, release)
         st["cpu_wait_n"] = _add_col(st["cpu_wait_n"], s, -1, grant)
-        st["req_ev"] = _set_col(st["req_ev"], j, EV_SEG_END, grant)
-        st["req_t"] = _set_col(st["req_t"], j, now + jdur, grant)
+        st["req_ev"] = _set_col(st["req_ev"], j, ev_next, grant)
+        st["req_t"] = _set_col(st["req_t"], j, t_next, grant)
         st["req_ticket"] = _set_col(st["req_ticket"], j, NO_TICKET, grant)
+        return st
+
+    def _abandon_branch(self, st, i, now, rng, it, ov_tabs, pred):
+        """Dequeue deadline exceeded: hold the core for zero service, hand
+        it onward, release RAM/socket, count rejected (`engine.py:1214-1233`).
+        """
+        if not self._has_timeout:
+            return st
+        s = _sel_col(st["req_srv"], i)
+        st = self._cpu_handoff(st, s, now, pred)
+        st = self._release_ram(st, i, s, now, pred)
+        if self._has_conn:
+            st["srv_conn"] = _add_col(st["srv_conn"], s, -1, pred)
+        st["req_ev"] = _set_col(st["req_ev"], i, EV_IDLE, pred)
+        st["req_t"] = _set_col(st["req_t"], i, np.float32(INF), pred)
+        st["n_rejected"] = st["n_rejected"] + jnp.where(pred, 1, 0)
+        return st
+
+    def _seg_end_branch(self, st, i, now, rng, it, ov_tabs, pred):
+        """`engine.py:638-669`: core handoff to longest-waiting, next seg."""
+        s = _sel_col(st["req_srv"], i)
+        ep = _sel_col(st["req_ep"], i)
+        seg = _sel_col(st["req_seg"], i)
+        kind = _tab(self._tk["seg_kind"], self._seg_idx(s, ep, seg))
+        was_cpu = pred & (kind == SEG_CPU)
+
+        st = self._cpu_handoff(st, s, now, was_cpu)
 
         if self._has_db:
             # DB connection handoff, mirroring the core queue's discipline
             # (`engine.py:1129-1146`)
             was_db = pred & (kind == SEG_DB)
+            srv_col = jnp.where(pred, s, -1)
             dwaiting = (st["req_ev"] == EV_WAIT_DB) & (st["req_srv"] == srv_col)
             dtick = jnp.where(dwaiting, st["req_ticket"], NO_TICKET)
             dj, dtmin = _argmin_row(dtick)
@@ -1099,7 +1214,17 @@ class PallasEngine:
             "n_overflow": col(0, jnp.int32),
             "llm_sum": col(0.0),
             "llm_sumsq": col(0.0),
+            "n_rejected": col(0, jnp.int32),
         }
+        if self._has_conn:
+            st["srv_conn"] = jnp.zeros((sblk, ns), jnp.int32)
+        if self._has_rl:
+            st["rl_tokens"] = jnp.broadcast_to(
+                self._tk["rate_burst"], (sblk, ns),
+            ).astype(jnp.float32)
+            st["rl_last"] = jnp.zeros((sblk, ns), jnp.float32)
+        if self._has_timeout:
+            st["req_wait_t"] = jnp.zeros((sblk, pool), jnp.float32)
         if self._has_llm:
             st["req_llm"] = jnp.zeros((sblk, pool), jnp.float32)
         if self._has_db:
@@ -1161,6 +1286,10 @@ class PallasEngine:
             sd = self._seg_end_branch(
                 sd, i, now, rng, it, ov_tabs, is_pool & (ev == EV_SEG_END),
             )
+            if self._has_timeout:
+                sd = self._abandon_branch(
+                    sd, i, now, rng, it, ov_tabs, is_pool & (ev == EV_ABANDON),
+                )
             sd["nxt_i"], sd["nxt_t"] = _argmin_row(sd["req_t"])
             return (it + 1, *[sd[k] for k in keys])
 
@@ -1191,6 +1320,7 @@ class PallasEngine:
                 sd["n_generated"],
                 sd["n_dropped"],
                 sd["n_overflow"],
+                sd["n_rejected"],
             ],
             axis=1,
         )
@@ -1256,6 +1386,7 @@ class PallasEngine:
             truncated=trunc,
             llm_sum=momf[:, 4],
             llm_sumsq=momf[:, 5],
+            n_rejected=momi[:, 4],
         )
 
     def lower_tpu(self, keys: jnp.ndarray):
@@ -1364,14 +1495,14 @@ class PallasEngine:
                     row_spec(self.n_hist_bins),
                     row_spec(self.n_thr),
                     row_spec(6),
-                    row_spec(4),
+                    row_spec(5),
                     row_spec(1),
                 ],
                 out_shape=[
                     jax.ShapeDtypeStruct((rows, self.n_hist_bins), jnp.int32),
                     jax.ShapeDtypeStruct((rows, self.n_thr), jnp.int32),
                     jax.ShapeDtypeStruct((rows, 6), jnp.float32),
-                    jax.ShapeDtypeStruct((rows, 4), jnp.int32),
+                    jax.ShapeDtypeStruct((rows, 5), jnp.int32),
                     jax.ShapeDtypeStruct((rows, 1), jnp.int32),
                 ],
                 interpret=interpret,

@@ -400,15 +400,12 @@ class SweepRunner:
         elif engine == "pallas" or (
             engine == "auto"
             and jax.default_backend() == "tpu"
-            # the VMEM kernel models DB pools, cache mixtures, LLM
-            # dynamics, and weighted endpoints (round 5) but not overload
-            # policies (shedding / refusal / rate limits / deadlines /
-            # circuit breakers)
-            and not self.plan.has_queue_cap
-            and not self.plan.has_conn_cap
-            and not self.plan.has_rate_limit
-            and not self.plan.has_queue_timeout
+            # the VMEM kernel models server-side overload policies, DB
+            # pools, cache mixtures, LLM dynamics, and weighted endpoints
+            # (round 5); only LB circuit breakers and multi-generator
+            # workloads still route to the general event engine
             and self.plan.breaker_threshold == 0
+            and self.plan.n_generators == 1
         ):
             from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
 

@@ -346,16 +346,17 @@ def test_timeout_caps_queue_wait_contribution() -> None:
     assert np.percentile(lat_to, 99) < np.percentile(lat_free, 99)
 
 
-def test_pallas_declines_milestone5_controls() -> None:
-    """The VMEM kernel models none of the new controls: its constructor
-    must refuse such plans (and SweepRunner's TPU auto-route excludes
-    them), or the sweep would silently ignore the configured policy."""
+def test_pallas_models_server_controls_declines_breakers() -> None:
+    """Round 5: the VMEM kernel models server-side controls (rate limits,
+    deadlines, caps, capacities) in-kernel; only LB circuit breakers —
+    rotation feedback — still refuse with a named reason."""
     from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
 
-    for mut in (_rate_limited, _deadlined):
-        with pytest.raises(ValueError, match="overload policies"):
-            PallasEngine(compile_payload(_payload(mut)))
-    with pytest.raises(ValueError, match="overload policies"):
+    eng_rl = PallasEngine(compile_payload(_payload(_rate_limited)))
+    assert eng_rl._has_rl
+    eng_to = PallasEngine(compile_payload(_payload(_deadlined)))
+    assert eng_to._has_timeout
+    with pytest.raises(ValueError, match="circuit breaker"):
         PallasEngine(compile_payload(_payload(_breakered, base=LB)))
 
 

@@ -486,3 +486,118 @@ def test_featured_kernel_lowers_for_tpu_from_cpu() -> None:
     eng = PallasEngine(plan, interpret=False)
     lowered = eng.lower_tpu(scenario_keys(3, 4))
     assert "tpu_custom_call" in lowered.as_text()
+
+
+# -- round-5b: server-side overload policies in-kernel ----------------------
+
+
+def _controlled(overload: dict, *, users: int = 40, horizon: float = 10.0,
+                cpu: float = 0.040) -> dict:
+    data = _base(horizon=horizon)
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    srv["endpoints"][0]["steps"] = [
+        {"kind": "initial_parsing", "step_operation": {"cpu_time": cpu}},
+        {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.010}},
+    ]
+    data["rqs_input"]["avg_active_users"]["mean"] = users
+    srv["overload"] = overload
+    return data
+
+
+def _run_both_rejecting(data: dict):
+    payload = SimulationPayload.model_validate(data)
+    plan = compile_payload(payload)
+    keys = scenario_keys(17, S)
+    ev = Engine(plan).run_batch(keys)
+    ps = PallasEngine(plan, block=32).run_batch(keys)
+    gen_e = int(np.asarray(ev.n_generated).sum())
+    rej_e = int(np.asarray(ev.n_rejected).sum())
+    gen_p = int(ps.n_generated.sum())
+    rej_p = int(ps.n_rejected.sum())
+    assert rej_e > 0, "the control never fired on the event engine"
+    assert abs(rej_p / gen_p - rej_e / gen_e) < 0.03, (
+        rej_e / gen_e, rej_p / gen_p,
+    )
+    _assert_parity(ev, ps)
+    return plan
+
+
+def test_queue_cap_shed_parity() -> None:
+    """Ready-queue cap: shed fraction and surviving latency shape match
+    the event engine (rho ~ 0.85, cap 3)."""
+    plan = _run_both_rejecting(_controlled({"max_ready_queue": 3}))
+    assert plan.has_queue_cap
+
+
+def test_conn_cap_refusal_parity() -> None:
+    """Socket capacity: refusal fraction matches at a binding residents
+    cap (long io holds residents up)."""
+    data = _controlled(
+        {"max_connections": 4}, users=40, cpu=0.002,
+    )
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    srv["endpoints"][0]["steps"][1]["step_operation"]["io_waiting_time"] = 0.200
+    plan = _run_both_rejecting(data)
+    assert plan.has_conn_cap
+
+
+def test_rate_limit_parity() -> None:
+    """Token bucket: ~10 rps offered against 6 rps refill."""
+    plan = _run_both_rejecting(
+        _controlled(
+            {"rate_limit_rps": 6.0, "rate_limit_burst": 6},
+            users=30, cpu=0.002,
+        ),
+    )
+    assert plan.has_rate_limit
+
+
+def test_queue_timeout_parity() -> None:
+    """Dequeue deadline: expired grants abandon with zero service."""
+    plan = _run_both_rejecting(
+        _controlled({"queue_timeout_s": 0.120}, users=45, cpu=0.045),
+    )
+    assert plan.has_queue_timeout
+
+
+def test_controls_conservation() -> None:
+    """generated == completed + dropped + rejected + in-flight under every
+    server-side control at once."""
+    data = _controlled(
+        {
+            "max_ready_queue": 4,
+            "max_connections": 64,
+            "rate_limit_rps": 60.0,
+            "rate_limit_burst": 30,
+            "queue_timeout_s": 0.2,
+        },
+        users=60,
+    )
+    payload = SimulationPayload.model_validate(data)
+    plan = compile_payload(payload)
+    ps = PallasEngine(plan, block=32).run_batch(scenario_keys(5, 16))
+    gen = int(ps.n_generated.sum())
+    done = int(ps.lat_count.sum())
+    drop = int(ps.n_dropped.sum())
+    rej = int(ps.n_rejected.sum())
+    assert rej > 0
+    in_flight = gen - done - drop - rej
+    assert 0 <= in_flight < 16 * 64, (gen, done, drop, rej)
+
+
+def test_controlled_kernel_lowers_for_tpu() -> None:
+    """The overload-control paths must pass every Mosaic conversion pass."""
+    data = _controlled(
+        {
+            "max_ready_queue": 4,
+            "max_connections": 64,
+            "rate_limit_rps": 60.0,
+            "rate_limit_burst": 30,
+            "queue_timeout_s": 0.2,
+        },
+        users=60, horizon=6.0,
+    )
+    plan = compile_payload(SimulationPayload.model_validate(data))
+    eng = PallasEngine(plan, interpret=False)
+    lowered = eng.lower_tpu(scenario_keys(3, 4))
+    assert "tpu_custom_call" in lowered.as_text()
