@@ -292,9 +292,9 @@ class Engine:
         `/root/reference/src/asyncflow/samplers/poisson_poisson.py:56-82`.
         ``gen`` selects a generator's stream on multi-generator plans (a
         STATIC index: callers loop generators at trace time); the arrival
-        state fields are (G,) vectors there, scalars on legacy plans.
-        Workload overrides apply to the single-generator path only (the
-        sweep layer refuses user_mean/req_rate overrides when G > 1).
+        state fields are (G,) vectors there, scalars on legacy plans, and
+        the workload override fields are (G,) vectors indexed per stream
+        (the sweep layer validates the (S, G) shape).
         """
         plan = self.plan
         horizon = jnp.float32(plan.horizon)
@@ -302,9 +302,11 @@ class Engine:
         if multi:
             window = jnp.float32(plan.gen_window[gen])
             poisson_users = plan.gen_user_var[gen] < 0
-            g_user_mean = jnp.float32(plan.gen_user_mean[gen])
+            # workload overrides carry (G,) / (S, G) fields on multi-
+            # generator plans (base_overrides): index this stream's slot
+            g_user_mean = ov.user_mean[gen]
             g_user_var = jnp.float32(plan.gen_user_var[gen])
-            g_rate = jnp.float32(plan.gen_rate[gen])
+            g_rate = ov.req_rate[gen]
         else:
             window = jnp.float32(plan.user_window)
             poisson_users = plan.user_var < 0
@@ -315,6 +317,11 @@ class Engine:
         def body(carry):
             smp_now, window_end, lam, dctr, _status, gap = carry
             kd = jax.random.fold_in(key, 64 + dctr)
+            # sampler clock past the horizon: exhausted (the oracle's
+            # `if smp_now >= horizon: return -1`) — without this, a
+            # zero-rate stream (user_mean override 0) would walk windows
+            # forever
+            at_end = smp_now >= horizon
             need_window = smp_now >= window_end
             u_mean = g_user_mean if multi else ov.user_mean
             u_rate = g_rate if multi else ov.req_rate
@@ -346,6 +353,8 @@ class Engine:
                 0,
                 jnp.where(beyond, 2, jnp.where(crosses, 0, 1)),
             ).astype(jnp.int32)
+            status = jnp.where(at_end, 2, status)
+            smp_next = jnp.where(at_end, smp_now, smp_next)
             return (smp_next, window_end, lam, dctr + 1, status, jnp.where(status == 1, g, gap))
 
         init = (

@@ -205,13 +205,24 @@ class ScenarioOverrides(NamedTuple):
 
 
 def base_overrides(plan: StaticPlan) -> ScenarioOverrides:
-    """Overrides equal to the base plan (no sweep variation)."""
+    """Overrides equal to the base plan (no sweep variation).
+
+    On multi-generator plans the workload fields are (G,) vectors — one
+    mean/rate per generator — and per-scenario overrides carry (S, G);
+    single-generator plans keep the scalar shape.
+    """
+    if plan.n_generators > 1:
+        user_mean = jnp.asarray(plan.gen_user_mean, jnp.float32)
+        req_rate = jnp.asarray(plan.gen_rate, jnp.float32)
+    else:
+        user_mean = jnp.float32(plan.user_mean)
+        req_rate = jnp.float32(plan.req_per_user_per_sec)
     return ScenarioOverrides(
         edge_mean=jnp.asarray(plan.edge_mean),
         edge_var=jnp.asarray(plan.edge_var),
         edge_dropout=jnp.asarray(plan.edge_dropout),
-        user_mean=jnp.float32(plan.user_mean),
-        req_rate=jnp.float32(plan.req_per_user_per_sec),
+        user_mean=user_mean,
+        req_rate=req_rate,
     )
 
 

@@ -33,19 +33,21 @@ def make_overrides(
     user_mean: np.ndarray | None = None,
     req_per_minute: np.ndarray | None = None,
 ) -> ScenarioOverrides:
-    """Per-scenario parameter overrides; every scale is (S,) or (S, NE)."""
-    if plan.n_generators > 1 and (
-        user_mean is not None or req_per_minute is not None
-    ):
-        # the override channel carries ONE workload scalar per scenario;
-        # per-generator overrides need a (S, G) design that does not exist
-        # yet — refuse loudly instead of silently scaling generator 0
-        msg = (
-            "user_mean/req_per_minute overrides are not supported on "
-            "multi-generator plans"
-        )
-        raise ValueError(msg)
+    """Per-scenario parameter overrides; every scale is (S,) or (S, NE).
+
+    On multi-generator plans, ``user_mean`` / ``req_per_minute`` must be
+    (S, G) — one value per scenario per generator stream."""
     base = base_overrides(plan)
+    g = plan.n_generators
+    if g > 1:
+        for name, arr in (("user_mean", user_mean),
+                          ("req_per_minute", req_per_minute)):
+            if arr is not None and np.asarray(arr).shape != (n_scenarios, g):
+                msg = (
+                    f"{name} on a {g}-generator plan must have shape "
+                    f"({n_scenarios}, {g}), got {np.asarray(arr).shape}"
+                )
+                raise ValueError(msg)
 
     def _edges(scale: np.ndarray | None, base_arr: jnp.ndarray) -> jnp.ndarray:
         if scale is None:
@@ -655,6 +657,19 @@ class _NativeSweepEngine:
             arr = np.asarray(field)
             return arr[row] if arr.ndim > base_ndim else arr
 
+        if self.plan.n_generators > 1:
+            um = np.asarray(pick(ov.user_mean, 1), np.float64)
+            rr = np.asarray(pick(ov.req_rate, 1), np.float64)
+            return dataclasses.replace(
+                self.plan,
+                edge_mean=np.asarray(pick(ov.edge_mean, 1), np.float32),
+                edge_var=np.asarray(pick(ov.edge_var, 1), np.float32),
+                edge_dropout=np.asarray(pick(ov.edge_dropout, 1), np.float32),
+                gen_user_mean=um,
+                gen_rate=rr,
+                user_mean=float(um[0]),
+                req_per_user_per_sec=float(rr[0]),
+            )
         return dataclasses.replace(
             self.plan,
             edge_mean=np.asarray(pick(ov.edge_mean, 1), np.float32),
@@ -862,9 +877,32 @@ class _FastpathOverrideError(ValueError):
 
 def _override_rate_scale(plan, overrides: ScenarioOverrides) -> float:
     """Worst-case workload-rate scale an override set applies vs the base
-    plan (shared by every proof-headroom guard)."""
+    plan (shared by every proof-headroom guard).
+
+    Multi-generator plans bound the PER-GENERATOR ratio (max over
+    scenarios and streams of um[s,g]*rr[s,g] / base_g): the proofs this
+    guard protects are per-server, and generators target fixed entry
+    chains, so a load-shifting override that keeps the total constant
+    can still push one server past its proof — a total-rate comparison
+    would miss that."""
     base = base_overrides(plan)
-    base_rate = float(base.user_mean) * float(base.req_rate)
+    um_b = np.asarray(base.user_mean, np.float64)
+    rr_b = np.asarray(base.req_rate, np.float64)
+    if um_b.ndim > 0:  # (G,) multi-generator base
+        base_g = um_b * rr_b
+        um = np.asarray(overrides.user_mean, np.float64)
+        rr = np.asarray(overrides.req_rate, np.float64)
+        um2, rr2 = np.broadcast_arrays(um, rr)
+        rates = um2 * rr2  # (..., G)
+        ratios = np.where(
+            base_g > 0,
+            rates / np.maximum(base_g, 1e-300),
+            # a stream that is OFF in the base plan contributed nothing
+            # to any proof: any positive rate on it is unbounded growth
+            np.where(rates > 0, np.inf, 1.0),
+        )
+        return float(np.max(ratios))
+    base_rate = float(um_b) * float(rr_b)
     if base_rate <= 0:
         return 1.0
     max_rate = _sweep_max(overrides.user_mean) * _sweep_max(overrides.req_rate)

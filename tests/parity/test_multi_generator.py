@@ -108,11 +108,13 @@ class TestCompiler:
         with pytest.raises(ValueError, match="multi-generator"):
             PallasEngine(compile_payload(_payload()))
 
-    def test_overrides_refused(self) -> None:
+    def test_scalar_override_shape_refused(self) -> None:
+        # (S,) workload overrides are ambiguous on a G-stream plan; the
+        # (S, G) form is accepted (TestPerGeneratorOverrides)
         from asyncflow_tpu.parallel import make_overrides
 
         plan = compile_payload(_payload())
-        with pytest.raises(ValueError, match="multi-generator"):
+        with pytest.raises(ValueError, match=r"\(4, 2\)"):
             make_overrides(plan, 4, user_mean=np.full(4, 100.0))
 
     def test_capacity_covers_both_streams(self) -> None:
@@ -251,3 +253,81 @@ def test_builder_accumulates_generators() -> None:
     assert len(payload.generators) == 2
     r = OracleEngine(payload, seed=1).run()
     assert r.total_generated > 0
+
+
+class TestPerGeneratorOverrides:
+    """(S, G) workload overrides: one value per scenario per stream."""
+
+    def test_event_sweep_responds_per_stream(self) -> None:
+        from asyncflow_tpu.parallel import SweepRunner, make_overrides
+
+        p = _payload(horizon=10)
+        sr = SweepRunner(p, use_mesh=False)
+        assert sr.engine_kind == "event"
+        n = 4
+        um = np.stack(
+            [np.full(n, 200.0), np.linspace(100.0, 0.0, n)], axis=1,
+        )
+        ov = make_overrides(sr.plan, n, user_mean=um)
+        rep = sr.run(n, seed=2, overrides=ov, chunk_size=n)
+        c = rep.results.completed
+        # stream 2 swept to zero: completions fall toward stream 1's rate
+        assert c[0] > c[-1] * 1.2, c.tolist()
+        # the zero-rate tail still completes stream 1's ~667 requests
+        assert c[-1] > 400
+
+    def test_native_sweep_responds_per_stream(self) -> None:
+        from asyncflow_tpu.engines.oracle.native import native_available
+        from asyncflow_tpu.parallel import SweepRunner, make_overrides
+
+        if not native_available():
+            pytest.skip("no C++ toolchain")
+        p = _payload(horizon=10)
+        sr = SweepRunner(p, use_mesh=False, engine="native")
+        n = 4
+        um = np.stack(
+            [np.full(n, 200.0), np.linspace(100.0, 0.0, n)], axis=1,
+        )
+        ov = make_overrides(sr.plan, n, user_mean=um)
+        rep = sr.run(n, seed=2, overrides=ov, chunk_size=n)
+        c = rep.results.completed
+        assert c[0] > c[-1] * 1.2, c.tolist()
+        assert c[-1] > 400
+
+    def test_rate_guard_bounds_per_stream(self) -> None:
+        """The non-binding-proof guard bounds the PER-GENERATOR ratio:
+        shifting load between streams while keeping the total constant
+        must still register as growth on the raised stream (the proofs
+        are per-server)."""
+        from asyncflow_tpu.engines.jaxsim.params import base_overrides
+        from asyncflow_tpu.parallel.sweep import _override_rate_scale
+
+        plan = compile_payload(_payload())
+        base = base_overrides(plan)
+        doubled = base._replace(
+            user_mean=np.asarray(base.user_mean)[None, :] * 2.0,
+        )
+        assert _override_rate_scale(plan, doubled) == pytest.approx(2.0)
+        # load shift: stream 1 x2, stream 2 off — total rate unchanged
+        # (200*2*20 + 0 == 200*20 + 100*40 per minute) but the guard must
+        # report 2x, not 1x
+        um = np.asarray(base.user_mean)[None, :] * np.asarray([[2.0, 0.0]])
+        shifted = base._replace(user_mean=um)
+        assert _override_rate_scale(plan, shifted) == pytest.approx(2.0)
+
+
+def test_zero_rate_override_terminates() -> None:
+    """Regression: a user_mean override of 0 walked sampler windows
+    forever (no horizon exit on the zero-rate branch) — single-generator
+    plans too."""
+    from asyncflow_tpu.parallel import SweepRunner, make_overrides
+
+    data = yaml.safe_load(open(LB).read())
+    data["sim_settings"]["total_simulation_time"] = 10
+    p = SimulationPayload.model_validate(data)
+    sr = SweepRunner(p, use_mesh=False, engine="event")
+    ov = make_overrides(sr.plan, 2, user_mean=np.array([50.0, 0.0]))
+    rep = sr.run(2, seed=1, overrides=ov, chunk_size=2)
+    c = rep.results.completed
+    assert c[1] == 0
+    assert c[0] > 0
