@@ -203,6 +203,16 @@ def _tab(table, idx):
     )
 
 
+def _gather_by_order(order, values):
+    """Per-position gather over the (tiny, static) LB slot axis:
+    ``out[:, pos] = values[:, order[:, pos]]`` as a one-hot loop."""
+    el = values.shape[1]
+    out = jnp.zeros(order.shape, values.dtype)
+    for j in range(el):
+        out = jnp.where(order == j, values[:, j : j + 1], out)
+    return out
+
+
 def _argmin_row(values):
     """Per-row argmin over lanes -> ((S,1) index, (S,1) value).
 
@@ -321,16 +331,15 @@ class PallasEngine:
         kernel on its scenario shard (the kernel itself is a single-device
         program — GSPMD cannot partition a ``pallas_call``, so the sharding
         seam has to be explicit)."""
-        if plan.breaker_threshold > 0 or plan.n_generators > 1:
-            # the VMEM kernel has no breaker rotation-feedback channel and
-            # is single-stream; the compiler routes such plans to the
-            # general event engine.  Server-side overload policies (queue
-            # caps, socket capacities, rate limits, dequeue deadlines),
-            # DB pools, cache mixtures, LLM dynamics, and weighted
-            # endpoint selection are all modeled in-kernel (round 5).
+        if plan.n_generators > 1:
+            # the kernel's arrival sampler is single-stream; multi-
+            # generator plans run on the general event engine.  Everything
+            # else — overload policies, circuit breakers, DB pools, cache
+            # mixtures, LLM dynamics, weighted endpoints — is modeled
+            # in-kernel (round 5).
             msg = (
-                "the Pallas kernel does not model LB circuit breakers or "
-                "multi-generator workloads; use the event engine"
+                "the Pallas kernel does not model multi-generator "
+                "workloads; use the event engine"
             )
             raise ValueError(msg)
         self.plan = plan
@@ -349,6 +358,7 @@ class PallasEngine:
         self._has_conn = plan.has_conn_cap
         self._has_rl = plan.has_rate_limit
         self._has_timeout = plan.has_queue_timeout
+        self._has_breaker = plan.breaker_threshold > 0
         self._has_llm = bool(np.any(plan.seg_kind == SEG_LLM))
         self._has_db = bool(np.any(plan.seg_kind == SEG_DB))
         self._has_tl = len(plan.timeline_times) > 0
@@ -629,12 +639,7 @@ class PallasEngine:
             return slot, _rot_advance(st["lb_order"], st["lb_len"], True)
         lane = jax.lax.broadcasted_iota(jnp.int32, st["lb_order"].shape, 1)
         valid = lane < st["lb_len"]
-        # conn[rot]: one-hot over the (static, tiny) slot count
-        conn_rot = jnp.zeros_like(st["lb_conn"])
-        for j in range(el):
-            conn_rot = jnp.where(
-                st["lb_order"] == j, st["lb_conn"][:, j : j + 1], conn_rot,
-            )
+        conn_rot = _gather_by_order(st["lb_order"], st["lb_conn"])
         order_key = jnp.where(valid, conn_rot * el + lane, jnp.int32(2**30))
         best, _ = _argmin_row(order_key)
         return _sel_col(st["lb_order"], best), st["lb_order"]
@@ -773,6 +778,9 @@ class PallasEngine:
             st["req_ev"] = _set_col(st["req_ev"], i, EV_IDLE, shed)
             st["req_t"] = _set_col(st["req_t"], i, np.float32(INF), shed)
             st["n_rejected"] = st["n_rejected"] + jnp.where(shed, 1, 0)
+            st = self._breaker_server_report(
+                st, i, now, jnp.full_like(shed, True), shed,
+            )
         st["req_seg"] = _set_col(st["req_seg"], i, seg, pred)
         return self._exit_flow(st, i, s, now, rng, it, ov_tabs, is_end)
 
@@ -849,6 +857,10 @@ class PallasEngine:
         if self._has_conn:
             # departing the server releases its socket slot
             st["srv_conn"] = _add_col(st["srv_conn"], s, -1, pred)
+        # departing the routed target is the breaker's success signal
+        st = self._breaker_server_report(
+            st, i, now, jnp.full_like(pred, False), pred,
+        )
 
         e = _tab(self._tk["exit_edge"], s)
         kind = _tab(self._tk["exit_kind"], s)
@@ -937,6 +949,80 @@ class PallasEngine:
         st["tl_ptr"] = st["tl_ptr"] + jnp.where(pred, 1, 0)
         return st
 
+    def _breaker_report(self, st, slot, is_probe, failed, now, pred):
+        """One success/failure report to breaker slot ``slot`` (per-row):
+        the event engine's state machine batched (`engine.py:883-942`)."""
+        plan = self.plan
+        probe = pred & is_probe
+        plain = pred & ~is_probe
+        stt = _sel_col(st["cb_state"], slot)
+        st["cb_probes_out"] = jnp.maximum(
+            _add_col(st["cb_probes_out"], slot, -1, probe), 0,
+        )
+        p_fail = probe & failed
+        c_fail = plain & failed & (stt == 0)
+        consec = _sel_col(st["cb_consec"], slot) + jnp.where(c_fail, 1, 0)
+        trips = c_fail & (consec >= plan.breaker_threshold)
+        opens = p_fail | trips
+        st["cb_consec"] = _set_col(
+            st["cb_consec"],
+            slot,
+            jnp.where(trips | (plain & ~failed & (stt == 0)), 0, consec),
+            pred,
+        )
+        st["cb_state"] = _set_col(st["cb_state"], slot, 1, opens)
+        st["cb_open_until"] = _set_col(
+            st["cb_open_until"],
+            slot,
+            now + np.float32(plan.breaker_cooldown),
+            opens,
+        )
+        p_ok = probe & ~failed
+        probe_ok = _sel_col(st["cb_probe_ok"], slot) + jnp.where(p_ok, 1, 0)
+        closes = p_ok & (stt == 2) & (probe_ok >= plan.breaker_probes)
+        st["cb_probe_ok"] = _set_col(st["cb_probe_ok"], slot, probe_ok, probe)
+        st["cb_state"] = _set_col(st["cb_state"], slot, 0, closes)
+        st["cb_consec"] = _set_col(st["cb_consec"], slot, 0, closes)
+        return st
+
+    def _breaker_server_report(self, st, i, now, failed, pred):
+        """Report slot ``i``'s routing outcome once (no-op after clearing;
+        `engine.py:944-961`)."""
+        if not self._has_breaker:
+            return st
+        slot = _sel_col(st["req_cbslot"], i)
+        act = pred & (slot >= 0)
+        slot_c = jnp.maximum(slot, 0)
+        st = self._breaker_report(
+            st, slot_c, _sel_col(st["req_probe"], i) > 0, failed, now, act,
+        )
+        st["req_cbslot"] = _set_col(st["req_cbslot"], i, -1, act)
+        st["req_probe"] = _set_col(st["req_probe"], i, 0, act)
+        return st
+
+    def _lb_pick_breaker(self, st, admits):
+        """(slot, rotated order, none_admitting): RR picks the FIRST
+        admitting rotation member and moves only it to the tail (skip in
+        place); LC takes the masked first-min (`engine.py:377-401`)."""
+        el = max(self.plan.n_lb_edges, 1)
+        lane = jax.lax.broadcasted_iota(jnp.int32, st["lb_order"].shape, 1)
+        valid = lane < st["lb_len"]
+        # admits[order]: one-hot over the tiny slot count
+        elig = valid & _gather_by_order(st["lb_order"], admits)
+        any_elig = jnp.sum(elig.astype(jnp.int32), 1, keepdims=True) > 0
+        if self.plan.lb_algo == 0:
+            pos, _has = _argmax_bool_row(elig)
+            slot = _sel_col(st["lb_order"], pos)
+            order, length = _rot_remove(
+                st["lb_order"], st["lb_len"], slot, any_elig,
+            )
+            order, _ = _rot_insert(order, length, slot, any_elig)
+            return slot, order, ~any_elig
+        conn_rot = _gather_by_order(st["lb_order"], st["lb_conn"])
+        order_key = jnp.where(elig, conn_rot * el + lane, jnp.int32(2**30))
+        best, _ = _argmin_row(order_key)
+        return _sel_col(st["lb_order"], best), st["lb_order"], ~any_elig
+
     def _arrive_lb_branch(self, st, i, now, rng, it, ov_tabs, pred):
         """`engine.py:531-567`."""
         if self.plan.n_lb_edges == 0:
@@ -945,7 +1031,31 @@ class PallasEngine:
         drop_empty = pred & empty
         route = pred & ~empty
 
-        slot, rotated = self._lb_pick(st)
+        if self._has_breaker:
+            # lazy cooldown expiry: open slots whose cooldown elapsed
+            # become half-open with fresh probe slots (`engine.py:879-887`)
+            wake = route & (st["cb_state"] == 1) & (now >= st["cb_open_until"])
+            st["cb_state"] = jnp.where(wake, 2, st["cb_state"])
+            st["cb_probes_out"] = jnp.where(wake, 0, st["cb_probes_out"])
+            st["cb_probe_ok"] = jnp.where(wake, 0, st["cb_probe_ok"])
+            admits = (st["cb_state"] == 0) | (
+                (st["cb_state"] == 2)
+                & (st["cb_probes_out"] < self.plan.breaker_probes)
+            )
+            slot, rotated, none_open = self._lb_pick_breaker(st, admits)
+            reject = route & none_open
+            route = route & ~none_open
+            st["n_rejected"] = st["n_rejected"] + jnp.where(reject, 1, 0)
+            st["req_ev"] = _set_col(st["req_ev"], i, EV_IDLE, reject)
+            st["req_t"] = _set_col(st["req_t"], i, np.float32(INF), reject)
+            probe = route & (_sel_col(st["cb_state"], slot) == 2)
+            st["cb_probes_out"] = _add_col(st["cb_probes_out"], slot, 1, probe)
+            st["req_cbslot"] = _set_col(st["req_cbslot"], i, slot, route)
+            st["req_probe"] = _set_col(
+                st["req_probe"], i, jnp.where(probe, 1, 0), route,
+            )
+        else:
+            slot, rotated = self._lb_pick(st)
         st["lb_order"] = jnp.where(route, rotated, st["lb_order"])
         e = _tab(self._tk["lb_edge_index"], slot)
         dropped, delay = self._edge_draw(rng, it, 32, e, now, ov_tabs)
@@ -953,6 +1063,11 @@ class PallasEngine:
         ok = route & ~dropped
         drop_edge = route & dropped
         free = drop_empty | drop_edge
+        if self._has_breaker:
+            # a dropped send on the routing edge is a connection failure
+            st = self._breaker_server_report(
+                st, i, now, jnp.full_like(drop_edge, True), drop_edge,
+            )
 
         st["lb_conn"] = _add_col(st["lb_conn"], slot, 1, ok)
         st["req_ev"] = _set_col(
@@ -1007,6 +1122,9 @@ class PallasEngine:
             st["req_ev"] = _set_col(st["req_ev"], i, EV_IDLE, limited)
             st["req_t"] = _set_col(st["req_t"], i, np.float32(INF), limited)
             st["n_rejected"] = st["n_rejected"] + jnp.where(limited, 1, 0)
+            st = self._breaker_server_report(
+                st, i, now, jnp.full_like(limited, True), limited,
+            )
             pred = pred & ~limited
         if self._has_conn:
             # socket capacity: refuse when the server is at residents cap
@@ -1015,6 +1133,9 @@ class PallasEngine:
             st["req_ev"] = _set_col(st["req_ev"], i, EV_IDLE, refuse)
             st["req_t"] = _set_col(st["req_t"], i, np.float32(INF), refuse)
             st["n_rejected"] = st["n_rejected"] + jnp.where(refuse, 1, 0)
+            st = self._breaker_server_report(
+                st, i, now, jnp.full_like(refuse, True), refuse,
+            )
             pred = pred & ~refuse
             st["srv_conn"] = _add_col(st["srv_conn"], s, 1, pred)
 
@@ -1116,7 +1237,9 @@ class PallasEngine:
         st["req_ev"] = _set_col(st["req_ev"], i, EV_IDLE, pred)
         st["req_t"] = _set_col(st["req_t"], i, np.float32(INF), pred)
         st["n_rejected"] = st["n_rejected"] + jnp.where(pred, 1, 0)
-        return st
+        return self._breaker_server_report(
+            st, i, now, jnp.full_like(pred, True), pred,
+        )
 
     def _seg_end_branch(self, st, i, now, rng, it, ov_tabs, pred):
         """`engine.py:638-669`: core handoff to longest-waiting, next seg."""
@@ -1225,6 +1348,14 @@ class PallasEngine:
             st["rl_last"] = jnp.zeros((sblk, ns), jnp.float32)
         if self._has_timeout:
             st["req_wait_t"] = jnp.zeros((sblk, pool), jnp.float32)
+        if self._has_breaker:
+            st["cb_state"] = jnp.zeros((sblk, el), jnp.int32)
+            st["cb_open_until"] = jnp.zeros((sblk, el), jnp.float32)
+            st["cb_consec"] = jnp.zeros((sblk, el), jnp.int32)
+            st["cb_probes_out"] = jnp.zeros((sblk, el), jnp.int32)
+            st["cb_probe_ok"] = jnp.zeros((sblk, el), jnp.int32)
+            st["req_cbslot"] = jnp.full((sblk, pool), -1, jnp.int32)
+            st["req_probe"] = jnp.zeros((sblk, pool), jnp.int32)
         if self._has_llm:
             st["req_llm"] = jnp.zeros((sblk, pool), jnp.float32)
         if self._has_db:

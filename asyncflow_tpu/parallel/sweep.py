@@ -402,11 +402,10 @@ class SweepRunner:
         elif engine == "pallas" or (
             engine == "auto"
             and jax.default_backend() == "tpu"
-            # the VMEM kernel models server-side overload policies, DB
-            # pools, cache mixtures, LLM dynamics, and weighted endpoints
-            # (round 5); only LB circuit breakers and multi-generator
-            # workloads still route to the general event engine
-            and self.plan.breaker_threshold == 0
+            # the VMEM kernel models overload policies, circuit breakers,
+            # DB pools, cache mixtures, LLM dynamics, and weighted
+            # endpoints (round 5); only multi-generator workloads still
+            # route to the general event engine
             and self.plan.n_generators == 1
         ):
             from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine

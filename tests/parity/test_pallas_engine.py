@@ -601,3 +601,59 @@ def test_controlled_kernel_lowers_for_tpu() -> None:
     eng = PallasEngine(plan, interpret=False)
     lowered = eng.lower_tpu(scenario_keys(3, 4))
     assert "tpu_custom_call" in lowered.as_text()
+
+
+def test_circuit_breaker_parity() -> None:
+    """LB circuit breaker in-kernel: a rate-limited backend in rotation
+    trips the breaker; rejection fraction and latency shape must match
+    the event engine, and the breaker must CUT rejections vs no breaker."""
+    data = _lb_payload()
+    data["rqs_input"]["avg_active_users"]["mean"] = 60
+    for srv in data["topology_graph"]["nodes"]["servers"]:
+        if srv["id"] == "s2":
+            srv["overload"] = {"rate_limit_rps": 4.0, "rate_limit_burst": 4}
+    data["topology_graph"]["nodes"]["load_balancer"]["circuit_breaker"] = {
+        "failure_threshold": 5,
+        "cooldown_s": 2.0,
+        "half_open_probes": 2,
+    }
+    payload = SimulationPayload.model_validate(data)
+    plan = compile_payload(payload)
+    assert plan.breaker_threshold == 5
+    keys = scenario_keys(17, S)
+    ev = Engine(plan).run_batch(keys)
+    ps = PallasEngine(plan, block=32).run_batch(keys)
+    gen_e = int(np.asarray(ev.n_generated).sum())
+    rej_e = int(np.asarray(ev.n_rejected).sum())
+    gen_p = int(ps.n_generated.sum())
+    rej_p = int(ps.n_rejected.sum())
+    assert rej_e > 0
+    assert abs(rej_p / gen_p - rej_e / gen_e) < 0.03, (
+        rej_e / gen_e, rej_p / gen_p,
+    )
+    _assert_parity(ev, ps)
+
+    # the breaker's purpose: without it, rejections are much higher
+    no_b = copy.deepcopy(data)
+    del no_b["topology_graph"]["nodes"]["load_balancer"]["circuit_breaker"]
+    plan_nb = compile_payload(SimulationPayload.model_validate(no_b))
+    ps_nb = PallasEngine(plan_nb, block=32).run_batch(keys)
+    frac_b = rej_p / gen_p
+    frac_nb = int(ps_nb.n_rejected.sum()) / int(ps_nb.n_generated.sum())
+    assert frac_b < 0.6 * frac_nb, (frac_b, frac_nb)
+
+
+def test_breaker_kernel_lowers_for_tpu() -> None:
+    data = _lb_payload()
+    for srv in data["topology_graph"]["nodes"]["servers"]:
+        if srv["id"] == "s2":
+            srv["overload"] = {"rate_limit_rps": 4.0, "rate_limit_burst": 4}
+    data["topology_graph"]["nodes"]["load_balancer"]["circuit_breaker"] = {
+        "failure_threshold": 5,
+        "cooldown_s": 2.0,
+        "half_open_probes": 2,
+    }
+    plan = compile_payload(SimulationPayload.model_validate(data))
+    eng = PallasEngine(plan, interpret=False)
+    lowered = eng.lower_tpu(scenario_keys(3, 4))
+    assert "tpu_custom_call" in lowered.as_text()
