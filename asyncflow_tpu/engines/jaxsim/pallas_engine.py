@@ -331,17 +331,6 @@ class PallasEngine:
         kernel on its scenario shard (the kernel itself is a single-device
         program — GSPMD cannot partition a ``pallas_call``, so the sharding
         seam has to be explicit)."""
-        if plan.n_generators > 1:
-            # the kernel's arrival sampler is single-stream; multi-
-            # generator plans run on the general event engine.  Everything
-            # else — overload policies, circuit breakers, DB pools, cache
-            # mixtures, LLM dynamics, weighted endpoints — is modeled
-            # in-kernel (round 5).
-            msg = (
-                "the Pallas kernel does not model multi-generator "
-                "workloads; use the event engine"
-            )
-            raise ValueError(msg)
         self.plan = plan
         self.mesh = mesh
         self.n_hist_bins = n_hist_bins
@@ -350,7 +339,17 @@ class PallasEngine:
         self.interpret = interpret
         self.hist_lo, self.hist_scale = hist_constants(n_hist_bins)
         self.n_thr = int(np.ceil(plan.horizon)) or 1
-        self.n_windows = int(np.ceil(plan.horizon / plan.user_window)) + 1
+        # per-generator lam-table layout: gen gi's windows occupy columns
+        # [off_gi, off_gi + nw_gi) of the concatenated (S, sum nw) table
+        self._n_gen = plan.n_generators
+        if self._n_gen > 1:
+            self._gen_nw = [
+                int(np.ceil(plan.horizon / w)) + 1 for w in plan.gen_window
+            ]
+        else:
+            self._gen_nw = [int(np.ceil(plan.horizon / plan.user_window)) + 1]
+        self._gen_lam_off = list(np.cumsum([0] + self._gen_nw[:-1]))
+        self.n_windows = int(sum(self._gen_nw))
         self._dists_present = sorted(set(plan.edge_dist.tolist()))
         self._has_ram = bool(np.max(plan.endpoint_ram) > 0)
         self._has_cache = bool(np.any(plan.seg_kind == SEG_CACHE))
@@ -526,12 +525,23 @@ class PallasEngine:
     # kernel body pieces (each takes/returns the state dict)
     # ------------------------------------------------------------------
 
-    def _advance_arrival(self, st, rng, it, lam_tab, pred):
-        """Batched window-jump gap sampler (`engine.py:229-291`)."""
+    def _advance_arrival(self, st, rng, it, lam_tab, pred, gen: int = 0):
+        """Batched window-jump gap sampler (`engine.py:229-291`).
+
+        ``gen`` is a STATIC stream index on multi-generator plans: the
+        arrival-state fields are (S, G) columns and each stream reads its
+        own lam-table block and window length.
+        """
         plan = self.plan
         horizon = np.float32(plan.horizon)
-        window = np.float32(plan.user_window)
-        nw = lam_tab.shape[1]
+        if self._n_gen > 1:
+            window = np.float32(plan.gen_window[gen])
+        else:
+            window = np.float32(plan.user_window)
+        off = self._gen_lam_off[gen]
+        nw = self._gen_nw[gen]
+        lam_tab = lam_tab[:, off : off + nw]
+        gcol = slice(gen, gen + 1)
 
         def cond(c):
             _smp, _we, _widx, _lam, status, _gap, _d = c
@@ -552,7 +562,7 @@ class PallasEngine:
             window_end = jnp.where(need_window, smp_now + window, window_end)
 
             no_users = lam <= 0.0
-            u = jnp.maximum(rng.one(it, 200, dctr), np.float32(TINY))
+            u = jnp.maximum(rng.one(it, 200 + gen, dctr), np.float32(TINY))
             g = -jnp.log(jnp.maximum(1.0 - u, np.float32(TINY))) / jnp.maximum(
                 lam, np.float32(TINY),
             )
@@ -579,24 +589,33 @@ class PallasEngine:
             return smp_now, window_end, widx, lam, status, gap, dctr + 1
 
         init = (
-            st["smp_now"],
-            st["smp_window_end"],
-            st["widx"],
-            st["smp_lam"],
+            st["smp_now"][:, gcol],
+            st["smp_window_end"][:, gcol],
+            st["widx"][:, gcol],
+            st["smp_lam"][:, gcol],
             jnp.where(pred, 0, 1).astype(jnp.int32),
-            jnp.zeros_like(st["smp_now"]),
+            jnp.zeros_like(st["smp_now"][:, gcol]),
             jnp.int32(0),
         )
         smp_now, window_end, widx, lam, status, gap, _ = jax.lax.while_loop(
             cond, body, init,
         )
         exhausted = status == 2
-        nxt = jnp.where(exhausted, np.float32(INF), st["next_arrival"] + gap)
-        st["smp_now"] = jnp.where(pred, smp_now, st["smp_now"])
-        st["smp_window_end"] = jnp.where(pred, window_end, st["smp_window_end"])
-        st["widx"] = jnp.where(pred, widx, st["widx"])
-        st["smp_lam"] = jnp.where(pred, lam, st["smp_lam"])
-        st["next_arrival"] = jnp.where(pred, nxt, st["next_arrival"])
+        prev = st["next_arrival"][:, gcol]
+        nxt = jnp.where(exhausted, np.float32(INF), prev + gap)
+
+        def upd(field, new):
+            # one-hot column write (a concat of zero-width slices at the
+            # edges has no Mosaic lowering)
+            merged = jnp.where(pred, new, field[:, gcol])
+            lane = jax.lax.broadcasted_iota(jnp.int32, field.shape, 1)
+            return jnp.where(lane == gen, merged, field)
+
+        st["smp_now"] = upd(st["smp_now"], smp_now)
+        st["smp_window_end"] = upd(st["smp_window_end"], window_end)
+        st["widx"] = upd(st["widx"], widx)
+        st["smp_lam"] = upd(st["smp_lam"], lam)
+        st["next_arrival"] = upd(st["next_arrival"], nxt)
         return st
 
     def _complete(self, st, i, start, finish, pred):
@@ -905,25 +924,77 @@ class PallasEngine:
         """`engine.py:336-380`: entry chain, pool slot, next arrival."""
         plan = self.plan
         st["n_generated"] = st["n_generated"] + jnp.where(pred, 1, 0)
+
+        if self._n_gen > 1:
+            g_idx, _ = _argmin_row(st["next_arrival"])
+            chains = [
+                plan.gen_entry_edges[gi, : plan.gen_entry_len[gi]].tolist()
+                for gi in range(self._n_gen)
+            ]
+        else:
+            g_idx = jnp.zeros_like(st["lb_len"])
+            chains = [plan.entry_edges.tolist()]
+
+        sblk = st["req_ev"].shape[0]
         alive = pred
         t_cur = now
-        for j, eidx in enumerate(plan.entry_edges.tolist()):
-            e = jnp.full_like(st["widx"], np.int32(eidx))
-            dropped, delay = self._edge_draw(rng, it, 64 + 4 * j, e, t_cur, ov_tabs)
-            survives = alive & ~dropped
-            st["n_dropped"] = st["n_dropped"] + jnp.where(alive & dropped, 1, 0)
-            t_cur = jnp.where(survives, t_cur + delay, t_cur)
-            alive = survives
+        # _edge_draw consumes sites site..site+2 (Box-Muller pair, Poisson
+        # loop), so edges need a stride of 4 and streams a block sized to
+        # the longest chain; the single-stream range (64 + 4j) is
+        # preserved for G == 1
+        max_chain = max(len(c) for c in chains)
+        for gi, chain in enumerate(chains):
+            pred_gi = alive & (g_idx == gi)
+            t_gi = now
+            for j, eidx in enumerate(chain):
+                e = jnp.full((sblk, 1), np.int32(eidx))
+                site = (
+                    64 + 4 * j
+                    if len(chains) == 1
+                    else 600 + gi * 4 * max_chain + 4 * j
+                )
+                dropped, delay = self._edge_draw(
+                    rng, it, site, e, t_gi, ov_tabs,
+                )
+                survives = pred_gi & ~dropped
+                st["n_dropped"] = st["n_dropped"] + jnp.where(
+                    pred_gi & dropped, 1, 0,
+                )
+                t_gi = jnp.where(survives, t_gi + delay, t_gi)
+                pred_gi = survives
+            t_cur = jnp.where(g_idx == gi, t_gi, t_cur)
+            alive = jnp.where(g_idx == gi, pred_gi, alive)
 
         slot, has_free = _argmax_bool_row(st["req_ev"] == EV_IDLE)
         overflow = alive & ~has_free
         place = alive & has_free
-        ev0 = EV_ARRIVE_LB if plan.entry_target_kind == TARGET_LB else EV_ARRIVE_SRV
+        if self._n_gen > 1:
+            # static per-stream select (no dynamic gather: Mosaic-safe)
+            ev0 = jnp.full((sblk, 1), EV_ARRIVE_SRV, jnp.int32)
+            entry_target = jnp.zeros((sblk, 1), jnp.int32)
+            for gi in range(self._n_gen):
+                gmask = g_idx == gi
+                ev_gi = (
+                    EV_ARRIVE_LB
+                    if int(plan.gen_entry_target_kind[gi]) == TARGET_LB
+                    else EV_ARRIVE_SRV
+                )
+                ev0 = jnp.where(gmask, ev_gi, ev0)
+                entry_target = jnp.where(
+                    gmask,
+                    np.int32(max(int(plan.gen_entry_target[gi]), 0)),
+                    entry_target,
+                )
+        else:
+            ev0 = (
+                EV_ARRIVE_LB
+                if plan.entry_target_kind == TARGET_LB
+                else EV_ARRIVE_SRV
+            )
+            entry_target = np.int32(max(plan.entry_target, 0))
         st["req_ev"] = _set_col(st["req_ev"], slot, ev0, place)
         st["req_t"] = _set_col(st["req_t"], slot, t_cur, place)
-        st["req_srv"] = _set_col(
-            st["req_srv"], slot, np.int32(max(plan.entry_target, 0)), place,
-        )
+        st["req_srv"] = _set_col(st["req_srv"], slot, entry_target, place)
         st["req_start"] = _set_col(st["req_start"], slot, now, place)
         st["req_lbslot"] = _set_col(st["req_lbslot"], slot, -1, place)
         st["req_ram"] = _set_col(st["req_ram"], slot, 0.0, place)
@@ -931,6 +1002,12 @@ class PallasEngine:
         if self._has_llm:
             st["req_llm"] = _set_col(st["req_llm"], slot, 0.0, place)
         st["n_overflow"] = st["n_overflow"] + jnp.where(overflow, 1, 0)
+        if self._n_gen > 1:
+            for gi in range(self._n_gen):
+                st = self._advance_arrival(
+                    st, rng, it, lam_tab, pred & (g_idx == gi), gen=gi,
+                )
+            return st
         return self._advance_arrival(st, rng, it, lam_tab, pred)
 
     def _timeline_branch(self, st, pred):
@@ -1319,11 +1396,11 @@ class PallasEngine:
             "lb_order": jax.lax.broadcasted_iota(jnp.int32, (sblk, el), 1),
             "lb_len": col(plan.n_lb_edges, jnp.int32),
             "lb_conn": jnp.zeros((sblk, el), jnp.int32),
-            "smp_now": col(0.0),
-            "smp_window_end": col(0.0),
-            "widx": col(-1, jnp.int32),
-            "smp_lam": col(0.0),
-            "next_arrival": col(0.0),
+            "smp_now": jnp.zeros((sblk, self._n_gen), jnp.float32),
+            "smp_window_end": jnp.zeros((sblk, self._n_gen), jnp.float32),
+            "widx": jnp.full((sblk, self._n_gen), -1, jnp.int32),
+            "smp_lam": jnp.zeros((sblk, self._n_gen), jnp.float32),
+            "next_arrival": jnp.zeros((sblk, self._n_gen), jnp.float32),
             "tl_ptr": col(0, jnp.int32),
             "hist": jnp.zeros((sblk, self.n_hist_bins), jnp.int32),
             "thr": jnp.zeros((sblk, self.n_thr), jnp.int32),
@@ -1362,7 +1439,10 @@ class PallasEngine:
             st["db_free"] = jnp.broadcast_to(self._tk["db_pool"], (sblk, ns))
             st["db_ticket"] = jnp.zeros((sblk, ns), jnp.int32)
             st["db_wait_n"] = jnp.zeros((sblk, ns), jnp.int32)
-        st = self._advance_arrival(st, rng, jnp.int32(0), lam_tab, col(True, jnp.bool_))
+        for gi in range(self._n_gen):
+            st = self._advance_arrival(
+                st, rng, jnp.int32(0), lam_tab, col(True, jnp.bool_), gen=gi,
+            )
         # cached pool argmin (the single pool scan per iteration, refreshed
         # at the end of each body after every branch — same discipline as
         # engine.py's _refresh_pool_min)
@@ -1381,7 +1461,8 @@ class PallasEngine:
                 )
             else:
                 t_tl = jnp.full_like(sd["nxt_t"], np.float32(INF))
-            return sd["nxt_i"], sd["nxt_t"], sd["next_arrival"], t_tl
+            t_arr = jnp.min(sd["next_arrival"], 1, keepdims=True)
+            return sd["nxt_i"], sd["nxt_t"], t_arr, t_tl
 
         def cond(carry):
             it = carry[0]
@@ -1463,24 +1544,44 @@ class PallasEngine:
 
     def _lam_table(self, keys, user_mean, req_rate):
         """Per-(scenario, window) arrival rates, drawn with jax.random outside
-        the kernel (identical distribution to `engine.py:246-255`)."""
+        the kernel (identical distribution to `engine.py:246-255`).
+
+        Multi-generator plans concatenate one block per stream along the
+        window axis (`self._gen_lam_off` / `self._gen_nw`); the workload
+        fields are then (G,) or (S, G)."""
         plan = self.plan
-        nw = self.n_windows
+        s = keys.shape[0]
 
-        def one(key, um, rr):
-            kd = jax.random.fold_in(key, 0x77AB)
-            if plan.user_var < 0:
-                users = jax.random.poisson(
-                    as_threefry(kd), jnp.maximum(um, TINY), (nw,),
-                ).astype(jnp.float32)
-            else:
-                z = jax.random.normal(kd, (nw,))
-                users = jnp.maximum(0.0, um + plan.user_var * z)
-            return users * rr
+        def block(gen, nw, user_var):
+            def one(key, um, rr):
+                kd = jax.random.fold_in(key, 0x77AB + gen)
+                if user_var < 0:
+                    users = jax.random.poisson(
+                        as_threefry(kd), jnp.maximum(um, TINY), (nw,),
+                    ).astype(jnp.float32)
+                else:
+                    z = jax.random.normal(kd, (nw,))
+                    users = jnp.maximum(0.0, um + user_var * z)
+                return users * rr
 
-        um = jnp.broadcast_to(jnp.asarray(user_mean, jnp.float32), (keys.shape[0],))
-        rr = jnp.broadcast_to(jnp.asarray(req_rate, jnp.float32), (keys.shape[0],))
-        return jax.vmap(one)(keys, um, rr)
+            return one
+
+        if self._n_gen > 1:
+            um_all = jnp.asarray(user_mean, jnp.float32)
+            rr_all = jnp.asarray(req_rate, jnp.float32)
+            blocks = []
+            for gi in range(self._n_gen):
+                um = jnp.broadcast_to(um_all[..., gi], (s,))
+                rr = jnp.broadcast_to(rr_all[..., gi], (s,))
+                blocks.append(
+                    jax.vmap(
+                        block(gi, self._gen_nw[gi], float(plan.gen_user_var[gi])),
+                    )(keys, um, rr),
+                )
+            return jnp.concatenate(blocks, axis=1)
+        um = jnp.broadcast_to(jnp.asarray(user_mean, jnp.float32), (s,))
+        rr = jnp.broadcast_to(jnp.asarray(req_rate, jnp.float32), (s,))
+        return jax.vmap(block(0, self.n_windows, plan.user_var))(keys, um, rr)
 
     def run_batch(
         self,

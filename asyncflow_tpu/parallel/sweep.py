@@ -402,11 +402,10 @@ class SweepRunner:
         elif engine == "pallas" or (
             engine == "auto"
             and jax.default_backend() == "tpu"
-            # the VMEM kernel models overload policies, circuit breakers,
-            # DB pools, cache mixtures, LLM dynamics, and weighted
-            # endpoints (round 5); only multi-generator workloads still
-            # route to the general event engine
-            and self.plan.n_generators == 1
+            # the VMEM kernel models the full event-engine feature set
+            # (round 5): overload policies, circuit breakers, DB pools,
+            # cache mixtures, LLM dynamics, weighted endpoints, and
+            # multi-generator workloads
         ):
             from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
 

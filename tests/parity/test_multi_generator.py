@@ -102,11 +102,13 @@ class TestCompiler:
         assert not plan.fastpath_ok
         assert "multiple generators" in plan.fastpath_reason
 
-    def test_pallas_declines(self) -> None:
+    def test_pallas_models_multi_generator(self) -> None:
+        # round 5 (late): per-stream lam tables + (S, G) arrival state
+        # in-kernel; parity in test_pallas_engine.py
         from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
 
-        with pytest.raises(ValueError, match="multi-generator"):
-            PallasEngine(compile_payload(_payload()))
+        eng = PallasEngine(compile_payload(_payload()))
+        assert eng._n_gen == 2
 
     def test_scalar_override_shape_refused(self) -> None:
         # (S,) workload overrides are ambiguous on a G-stream plan; the

@@ -657,3 +657,61 @@ def test_breaker_kernel_lowers_for_tpu() -> None:
     eng = PallasEngine(plan, interpret=False)
     lowered = eng.lower_tpu(scenario_keys(3, 4))
     assert "tpu_custom_call" in lowered.as_text()
+
+
+def _two_gen_payload(horizon: float = 8.0) -> dict:
+    """The LB payload with a second, faster-windowed workload stream."""
+    data = _lb_payload()
+    data["sim_settings"]["total_simulation_time"] = horizon
+    data["rqs_input"] = [
+        dict(data["rqs_input"]),
+        {
+            "id": "g2",
+            "avg_active_users": {"mean": 10},
+            "avg_request_per_minute_per_user": {"mean": 60},
+            "user_sampling_window": 4,
+        },
+    ]
+    data["topology_graph"]["edges"].append(
+        {
+            "id": "g2-c",
+            "source": "g2",
+            "target": "c",
+            "latency": {"mean": 0.004, "distribution": "exponential"},
+        },
+    )
+    return data
+
+
+def test_multi_generator_parity() -> None:
+    """Round 5: superposed workload streams in-kernel — pooled rate and
+    latency match the event engine on a two-stream payload."""
+    payload = SimulationPayload.model_validate(_two_gen_payload())
+    plan = compile_payload(payload)
+    assert plan.n_generators == 2
+    keys = scenario_keys(17, S)
+    ev = Engine(plan).run_batch(keys)
+    ps = PallasEngine(plan, block=32).run_batch(keys)
+    _assert_parity(ev, ps)
+
+
+def test_multi_generator_normal_edge_parity() -> None:
+    """A normal-latency edge on a two-stream payload: exercises the
+    Box-Muller draw sites the entry-chain stride must not collide with
+    (the round-5 review's RNG-stride finding)."""
+    data = _two_gen_payload()
+    data["topology_graph"]["edges"][0]["latency"] = {
+        "mean": 0.004, "distribution": "normal", "variance": 0.002,
+    }
+    plan = compile_payload(SimulationPayload.model_validate(data))
+    keys = scenario_keys(17, S)
+    ev = Engine(plan).run_batch(keys)
+    ps = PallasEngine(plan, block=32).run_batch(keys)
+    _assert_parity(ev, ps)
+
+
+def test_multi_generator_kernel_lowers_for_tpu() -> None:
+    plan = compile_payload(SimulationPayload.model_validate(_two_gen_payload()))
+    eng = PallasEngine(plan, interpret=False)
+    lowered = eng.lower_tpu(scenario_keys(3, 4))
+    assert "tpu_custom_call" in lowered.as_text()
