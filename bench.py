@@ -55,6 +55,13 @@ PREWARM_WATCHDOG_S = int(os.environ.get("BENCH_PREWARM_WATCHDOG_S", "900"))
 PARTIAL_PATH = os.environ.get(
     "BENCH_PARTIAL_PATH", os.path.join(REPO, ".bench_partial.json"),
 )
+# Quiet gap between consecutive tunnel clients.  Round-5 incident: the
+# measurement child attached ~15 s after the pre-warm client detached and the
+# worker wedged at backend init (three rapid attach/detach cycles in ~3 min);
+# the earlier spaced-out shots on the same worker were fine.  Attach cadence
+# is the only controllable variable, so every accelerator-path stage now
+# waits before the next client connects.
+QUIET_S = float(os.environ.get("BENCH_QUIET_S", "60"))
 
 
 def _payload():
@@ -125,6 +132,19 @@ def run_measurement() -> None:
         n_scenarios = N_CPU
     else:
         n_scenarios = N_ACCEL
+        # Attach the tunnel client NOW, at a predictable moment right after
+        # the parent's quiet gap — not at whatever later point lazily first
+        # touches the backend.  A wedged attach then hangs here, before any
+        # measurement state exists; a silent fall-back to CPU exits nonzero
+        # so the parent runs the real (smaller) CPU fallback instead of a
+        # 10k-scenario sweep on one core.
+        import jax.numpy as jnp
+
+        if jax.default_backend() == "cpu":
+            msg = "accelerator child came up on CPU (plugin lost after probe)"
+            raise SystemExit(msg)
+        (jnp.ones((4, 128)) + 1).block_until_ready()
+        print("accelerator attached", file=sys.stderr)
 
     payload = _payload()
 
@@ -337,6 +357,13 @@ def _accel_probe(env: dict) -> bool:
     return proc.returncode == 0 and "ok" in proc.stdout
 
 
+def _quiet_then_prewarm(env: dict) -> bool:
+    """Give the worker a quiet gap after the probe client detaches, then
+    pre-warm (see QUIET_S: rapid attach cycles wedge the tunnel worker)."""
+    time.sleep(QUIET_S)
+    return _prewarm(env)
+
+
 def _prewarm(env: dict) -> bool:
     """Compile the exact benchmark executable into the persistent cache from
     a disposable subprocess with a hard kill.
@@ -396,10 +423,11 @@ def main() -> None:
             file=sys.stderr,
         )
         platforms = ("cpu",)
-    elif not _prewarm(dict(os.environ)):
+    elif not _quiet_then_prewarm(dict(os.environ)):
         # Without a successful pre-warm the measurement child would trigger
         # the uncached XLA compile itself — the exact pathological path the
         # pre-warm exists to absorb.  Never send it to the accelerator.
+        time.sleep(QUIET_S)  # quiet gap before the diagnostic re-probe too
         if _accel_probe(dict(os.environ)):
             print(
                 "WARNING: pre-warm failed (worker alive); measuring on CPU "
@@ -414,6 +442,10 @@ def main() -> None:
         platforms = ("cpu",)
 
     for platform in platforms:
+        if platform != "cpu":
+            # quiet gap between the pre-warm client detaching and the
+            # measurement child attaching (the round-5 wedge was exactly here)
+            time.sleep(QUIET_S)
         if platform == "cpu":
             env["BENCH_PLATFORM"] = "cpu"
             # a wedged accelerator tunnel can hang backend init for ANY

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Probe the tunneled TPU worker every 4 minutes; log the result.
+PROBE='import jax, jax.numpy as jnp; assert jax.default_backend()!="cpu"; (jnp.ones((4,128))+1).block_until_ready(); print("PROBE_OK")'
+while true; do
+    if timeout 90 python -c "$PROBE" 2>/dev/null | grep -q PROBE_OK; then
+        echo "$(date +%H:%M:%S) ALIVE"
+    else
+        echo "$(date +%H:%M:%S) wedged"
+    fi
+    sleep 240
+done
