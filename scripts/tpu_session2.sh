@@ -1,0 +1,78 @@
+#!/bin/bash
+# Round-5 manual measurement ladder (reprioritized after the first session).
+#
+# Ordering rationale:
+#   1. profile    — cached fast-path shape, cheap, tells us where device
+#                   time goes (the round's biggest unknown).
+#   2. pallas-60  — Mosaic compile sanity at a short horizon.
+#   3. pallas-600 — the flagship horizon: the kernel is the designed TPU
+#                   path; if it beats the fast path, bench auto-routing
+#                   flips to it.
+#   4. scanned-i32 — next width datapoint for the fast path (S=16 known
+#                   safe, S>=128 pathological).
+#   5. bench      — the full benchmark at whatever the evidence says.
+#
+# Quiet gaps (sleep 90) between steps: rapid attach/detach cycles wedge
+# the tunneled worker (round-5 incident, see bench.py QUIET_S).
+set -u
+cd "$(dirname "$0")/.."
+
+PROBE='import jax, jax.numpy as jnp; assert jax.default_backend()!="cpu"; (jnp.ones((4,128))+1).block_until_ready(); print("PROBE_OK")'
+probe() { timeout 90 python -c "$PROBE" 2>/dev/null | grep -q PROBE_OK; }
+
+recover() {
+    echo "== recovery wait =="
+    for i in $(seq 1 "$1"); do
+        sleep 240
+        if probe; then echo "== recovered after $i waits =="; sleep 90; return 0; fi
+        echo "   still wedged ($i)"
+    done
+    return 1
+}
+
+step() {
+    local name="$1" budget="$2"; shift 2
+    echo "== step: $name (budget ${budget}s) $(date +%H:%M:%S) =="
+    timeout "$budget" "$@"
+    local rc=$?
+    if [ $rc -eq 124 ] || [ $rc -eq 137 ]; then
+        echo "== step $name TIMED OUT =="
+        recover 7 || { echo "== worker did not recover; aborting =="; exit 1; }
+        return 1
+    fi
+    sleep 90
+    return $rc
+}
+
+probe || { echo "worker not available at session start"; exit 1; }
+echo "== worker alive; session2 starts $(date +%H:%M:%S) =="
+sleep 60
+
+step profile 600 env SHOT_CHUNK=512 SHOT_INNER=16 PROF_DIR=prof_trace_tpu \
+    python scripts/tpu_profile.py
+
+step pallas-60 900 env SHOT_CHUNK=128 SHOT_HORIZON=60 \
+    python scripts/tpu_shot_pallas.py
+
+step pallas-600 1500 env SHOT_CHUNK=128 SHOT_HORIZON=600 SHOT_REPEAT=3 \
+    python scripts/tpu_shot_pallas.py
+
+step pallas-512 1500 env SHOT_CHUNK=512 SHOT_HORIZON=600 SHOT_REPEAT=2 \
+    python scripts/tpu_shot_pallas.py
+
+step scanned-i32 1500 env SHOT_CHUNK=512 SHOT_INNER=32 SHOT_REPEAT=2 \
+    python scripts/tpu_shot.py
+
+step bench 3600 python bench.py
+
+# Width escalation past the round-3 "pathology" point: that diagnosis was
+# made on the pre-rewrite program whose argsorts lowered to tuple sorts;
+# the round-5 sort-free rank may have removed the pathological op.  Each
+# step doubles S; a timeout stops the escalation (recovery handled by step).
+if step scanned-i64 1500 env SHOT_CHUNK=512 SHOT_INNER=64 SHOT_REPEAT=2 \
+    python scripts/tpu_shot.py; then
+    step scanned-i128 1800 env SHOT_CHUNK=512 SHOT_INNER=128 SHOT_REPEAT=2 \
+        python scripts/tpu_shot.py
+fi
+
+echo "== session2 complete $(date +%H:%M:%S) =="

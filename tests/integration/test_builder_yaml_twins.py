@@ -1,0 +1,214 @@
+"""Builder <-> YAML door equivalence.
+
+The two front doors must produce payloads that compare equal via
+``model_dump()`` for the same scenario — the guarantee the docs
+(docs/api/high-level/builder.md) advertise.  Reference analog: its
+builder examples mirror its YAML examples 1:1
+(/root/reference/examples/builder_input vs examples/yaml_input).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import yaml
+
+from asyncflow_tpu import AsyncFlow
+from asyncflow_tpu.components import (
+    Client,
+    Edge,
+    Endpoint,
+    LoadBalancer,
+    Server,
+    ServerResources,
+    Step,
+)
+from asyncflow_tpu.schemas.payload import SimulationPayload
+from asyncflow_tpu.settings import SimulationSettings
+from asyncflow_tpu.workload import RVConfig, RqsGenerator
+
+DATA = Path(__file__).resolve().parents[2] / "examples" / "yaml_input" / "data"
+
+
+def _yaml_payload(name: str) -> SimulationPayload:
+    return SimulationPayload.model_validate(
+        yaml.safe_load((DATA / name).read_text()),
+    )
+
+
+def _exp(mean: float) -> RVConfig:
+    return RVConfig(mean=mean, distribution="exponential")
+
+
+def _single_server_flow() -> AsyncFlow:
+    return (
+        AsyncFlow()
+        .add_generator(
+            RqsGenerator(
+                id="rqs-1",
+                avg_active_users=RVConfig(mean=100),
+                avg_request_per_minute_per_user=RVConfig(mean=20),
+                user_sampling_window=60,
+            ),
+        )
+        .add_client(Client(id="client-1"))
+        .add_servers(
+            Server(
+                id="srv-1",
+                server_resources=ServerResources(cpu_cores=1, ram_mb=2048),
+                endpoints=[
+                    Endpoint(
+                        endpoint_name="ep-1",
+                        steps=[
+                            Step(
+                                kind="initial_parsing",
+                                step_operation={"cpu_time": 0.001},
+                            ),
+                            Step(kind="ram", step_operation={"necessary_ram": 100}),
+                            Step(
+                                kind="io_wait",
+                                step_operation={"io_waiting_time": 0.1},
+                            ),
+                        ],
+                    ),
+                ],
+            ),
+        )
+        .add_edges(
+            Edge(
+                id="gen-to-client",
+                source="rqs-1",
+                target="client-1",
+                latency=_exp(0.003),
+            ),
+            Edge(
+                id="client-to-server",
+                source="client-1",
+                target="srv-1",
+                latency=_exp(0.003),
+            ),
+            Edge(
+                id="server-to-client",
+                source="srv-1",
+                target="client-1",
+                latency=_exp(0.003),
+            ),
+        )
+    )
+
+
+def test_single_server_twin() -> None:
+    built = (
+        _single_server_flow()
+        .add_simulation_settings(
+            SimulationSettings(total_simulation_time=500, sample_period_s=0.05),
+        )
+        .build_payload()
+    )
+    assert built.model_dump() == _yaml_payload("single_server.yml").model_dump()
+
+
+def test_two_servers_lb_twin() -> None:
+    def endpoint() -> Endpoint:
+        return Endpoint(
+            endpoint_name="/api",
+            steps=[
+                Step(kind="initial_parsing", step_operation={"cpu_time": 0.002}),
+                Step(kind="ram", step_operation={"necessary_ram": 128}),
+                Step(kind="io_wait", step_operation={"io_waiting_time": 0.012}),
+            ],
+        )
+
+    built = (
+        AsyncFlow()
+        .add_generator(
+            RqsGenerator(
+                id="rqs-1",
+                avg_active_users=RVConfig(mean=400),
+                avg_request_per_minute_per_user=RVConfig(mean=20),
+                user_sampling_window=60,
+            ),
+        )
+        .add_client(Client(id="client-1"))
+        .add_load_balancer(
+            LoadBalancer(
+                id="lb-1",
+                algorithms="round_robin",
+                server_covered={"srv-1", "srv-2"},
+            ),
+        )
+        .add_servers(
+            Server(
+                id="srv-1",
+                server_resources=ServerResources(cpu_cores=1, ram_mb=2048),
+                endpoints=[endpoint()],
+            ),
+            Server(
+                id="srv-2",
+                server_resources=ServerResources(cpu_cores=1, ram_mb=2048),
+                endpoints=[endpoint()],
+            ),
+        )
+        .add_edges(
+            Edge(
+                id="gen-client",
+                source="rqs-1",
+                target="client-1",
+                latency=_exp(0.003),
+            ),
+            Edge(
+                id="client-lb",
+                source="client-1",
+                target="lb-1",
+                latency=_exp(0.002),
+            ),
+            Edge(id="lb-srv1", source="lb-1", target="srv-1", latency=_exp(0.002)),
+            Edge(id="lb-srv2", source="lb-1", target="srv-2", latency=_exp(0.002)),
+            Edge(
+                id="srv1-client",
+                source="srv-1",
+                target="client-1",
+                latency=_exp(0.003),
+            ),
+            Edge(
+                id="srv2-client",
+                source="srv-2",
+                target="client-1",
+                latency=_exp(0.003),
+            ),
+        )
+        .add_simulation_settings(
+            SimulationSettings(total_simulation_time=600, sample_period_s=0.05),
+        )
+        .build_payload()
+    )
+    assert built.model_dump() == _yaml_payload("two_servers_lb.yml").model_dump()
+
+
+def test_event_injection_twin() -> None:
+    built = (
+        _single_server_flow()
+        .add_simulation_settings(
+            SimulationSettings(
+                total_simulation_time=500,
+                sample_period_s=0.05,
+                enabled_sample_metrics=[
+                    "ready_queue_len",
+                    "event_loop_io_sleep",
+                    "ram_in_use",
+                    "edge_concurrent_connection",
+                ],
+                enabled_event_metrics=["rqs_clock"],
+            ),
+        )
+        .add_network_spike(
+            event_id="ev-spike-1",
+            edge_id="client-to-server",
+            t_start=120.0,
+            t_end=240.0,
+            spike_s=2.00,
+        )
+        .build_payload()
+    )
+    expected = _yaml_payload("event_inj_single_server.yml")
+    assert built.model_dump() == expected.model_dump()
