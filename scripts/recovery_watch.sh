@@ -2,7 +2,7 @@
 # Probe every 4 min; on first recovery, run the round-5 session2 ladder once.
 PROBE='import jax, jax.numpy as jnp; assert jax.default_backend()!="cpu"; (jnp.ones((4,128))+1).block_until_ready(); print("PROBE_OK")'
 while true; do
-    if timeout 90 python -c "$PROBE" 2>/dev/null | grep -q PROBE_OK; then
+    if timeout -k 10 90 python -c "$PROBE" 2>/dev/null | grep -q PROBE_OK; then
         echo "$(date +%H:%M:%S) ALIVE -> launching session2"
         sleep 90
         bash "$(dirname "$0")/tpu_session2.sh"

@@ -24,7 +24,7 @@ cd "$(dirname "$0")/.."
 PROBE='import jax, jax.numpy as jnp; assert jax.default_backend()!="cpu"; (jnp.ones((4,128))+1).block_until_ready(); print("PROBE_OK")'
 
 probe() {
-    timeout 90 python -c "$PROBE" 2>/dev/null | grep -q PROBE_OK
+    timeout -k 10 90 python -c "$PROBE" 2>/dev/null | grep -q PROBE_OK
 }
 
 recover() {
@@ -40,7 +40,7 @@ recover() {
 step() {
     local name="$1" budget="$2"; shift 2
     echo "== step: $name (budget ${budget}s) =="
-    timeout "$budget" "$@"
+    timeout -k 15 "$budget" "$@"
     local rc=$?
     if [ $rc -eq 124 ] || [ $rc -eq 137 ]; then
         echo "== step $name TIMED OUT; recovering =="

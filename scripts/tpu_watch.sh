@@ -15,7 +15,7 @@ PROBE='import jax, jax.numpy as jnp; assert jax.default_backend()!="cpu"; (jnp.o
 attempt=0
 while true; do
     attempt=$((attempt + 1))
-    if timeout 90 python -c "$PROBE" 2>/dev/null | grep -q PROBE_OK; then
+    if timeout -k 10 90 python -c "$PROBE" 2>/dev/null | grep -q PROBE_OK; then
         echo "$(date +%H:%M:%S) probe $attempt: WORKER ALIVE — starting session" >> "$LOG"
         bash scripts/tpu_session.sh >> "$LOG" 2>&1
         echo "$(date +%H:%M:%S) session finished (rc=$?)" >> "$LOG"
