@@ -1451,6 +1451,40 @@ def compile_payload(
     )
 
 
+def _socket_cap_scan_reason(
+    compiled_s: list,
+    cap: int,
+    fp_lowered_s: list | None,
+    db_binding: bool,
+) -> str:
+    """Why a reachable connection capacity cannot ride the socket scan
+    (empty string = eligible).  Conditions documented at the call site."""
+    visits = max(
+        (sum(1 for k, _ in segs if k == SEG_CPU) for segs, *_ in compiled_s),
+        default=0,
+    )
+    if visits > 1:
+        return "on a multi-burst endpoint"
+    if cap > 128:
+        return f"{cap} exceeds the scan ring bound (128)"
+    if db_binding:
+        return "with a binding DB connection pool"
+    pre_offsets = set()
+    for segs, *_ in compiled_s:
+        _dur, pre, _post = _burst_decomposition(segs)
+        if pre:  # burst endpoint: its single enqueue offset
+            pre_offsets.add(round(pre[0], 12))
+    if len(pre_offsets) > 1:
+        return "with heterogeneous pre-burst IO offsets"
+    if fp_lowered_s is not None and any(
+        slot >= 0
+        for _split, places, _reason in fp_lowered_s
+        for slot, _p, _x in places
+    ):
+        return "with stochastic pre-burst cache extras"
+    return ""
+
+
 def _fastpath_analysis(
     payload: SimulationPayload,
     compiled: list[list[tuple[list[tuple[int, float]], float, list]]],
@@ -1580,17 +1614,35 @@ def _fastpath_analysis(
     ram_slots = np.zeros(n_servers, dtype=np.int32)
     for s, server in enumerate(servers):
         if server_conn_cap is not None and server_conn_cap[s] >= 0:
-            # a reachable connection capacity refuses arrivals; the
-            # closed-form recursions have no refusal channel
-            return (
-                False,
-                f"server {server.id}: reachable connection capacity "
-                "(socket refusal modeled on the event engines)",
-                [],
-                no_slots,
-                0,
-                0.0,
+            # Socket capacity (round 5b): residency is a G/G/K loss system
+            # — refuse iff all K connection slots hold exits beyond the
+            # arrival.  Exact as one arrival-order pass (a sorted K-vector
+            # of exit times rides the scan carry, like the KW core vector)
+            # PROVIDED every residency endpoint is known at the lane's own
+            # step: at most one CPU burst, no RAM admission tier, no
+            # binding DB pool (its queue wait would feed exits), a uniform
+            # burst pre-IO offset across the server's burst endpoints
+            # (socket decisions are in ARRIVAL order; FIFO core grants are
+            # in ENQUEUE order — a uniform offset makes them the same
+            # order), and no stochastic pre-burst cache extras (same
+            # reason).  K bounded like the other scan rings.
+            reason = _socket_cap_scan_reason(
+                compiled[s],
+                int(server_conn_cap[s]),
+                fp_lowered[s] if fp_lowered is not None else None,
+                bool(server_db_pool is not None and server_db_pool[s] > 0),
             )
+            if reason:
+                return (
+                    False,
+                    f"server {server.id}: reachable connection capacity "
+                    f"{reason} (socket refusal modeled on the event "
+                    "engines)",
+                    [],
+                    no_slots,
+                    0,
+                    0.0,
+                )
         # Feedback-free overload controls (round 5).  A token-bucket rate
         # limit is a pure function of the arrival sequence (arrival-order
         # scan, any server shape).  A ready-queue cap / dequeue deadline is
@@ -1827,6 +1879,28 @@ def _fastpath_analysis(
             0,
             0.0,
         )
+
+    # Socket-scan RAM condition, decidable only now that the RAM tiers are
+    # settled: a MODELED admission queue (ram_slots > 0) would make exits
+    # depend on admission waits the socket pass doesn't carry; tier-1
+    # non-binding RAM (ram_slots == -1, admission never queues) is
+    # timing-inert and stays eligible.
+    for s, server in enumerate(servers):
+        if (
+            server_conn_cap is not None
+            and server_conn_cap[s] >= 0
+            and ram_slots[s] > 0
+        ):
+            return (
+                False,
+                f"server {server.id}: reachable connection capacity with a "
+                "binding RAM admission tier (socket refusal modeled on the "
+                "event engines)",
+                [],
+                no_slots,
+                0,
+                0.0,
+            )
 
     # topological order of the server exit DAG
     indeg = [0] * n_servers

@@ -56,6 +56,14 @@ collapses into pure array code:
    exact at any utilization.  Shapes outside the model (multiple queries,
    query before a burst, binding RAM + binding pool) decline with named
    reasons and run on the event engines.
+8. **Socket capacity** (round 5b): residency is a G/G/K loss system —
+   ``_socket_station_scan`` carries a sorted K-vector of connection-exit
+   times through one ARRIVAL-order pass, refusing arrivals whose every
+   slot exits in their future, composing with the token bucket (prefilter)
+   and the cap/deadline ring tests.  Eligibility
+   (``compiler/plan._socket_cap_scan_reason``): single burst, no modeled
+   RAM tier, no binding pool, uniform burst pre-IO (arrival order must
+   equal enqueue order), K <= 128.
 
 Everything is (N,) array work per scenario, vmapped over the batch: the
 whole Monte-Carlo sweep becomes sorts + scans + elementwise math — exactly
@@ -300,6 +308,72 @@ def _controlled_station_scan(
         step, init, (enq, dur, valid),
     )
     return wait, shed, abandoned
+
+
+def _socket_station_scan(
+    arr,
+    enq,
+    dur,
+    post,
+    is_burst,
+    valid,
+    n_cores: int,
+    conn_cap: int,
+    cap: int,
+    timeout: float,
+):
+    """Exact FIFO waits under a socket capacity (+ optional ready-queue cap
+    and dequeue deadline), one ARRIVAL-order pass per server.
+
+    Residency is a G/G/K loss system: a sorted K-vector of absolute
+    connection-exit times rides the carry (like the KW core vector) — an
+    arrival with every slot's exit in its future is refused before
+    admission (`engines/oracle/engine.py:203-213`); an admitted request
+    frees its slot at its own exit (shed: at its enqueue instant; abandon:
+    at its grant; completed: after service + trailing IO; io-only
+    endpoints: arrival + trailing IO).  Eligibility
+    (`compiler/plan._socket_cap_scan_reason`) guarantees exits are known
+    at the lane's own step and that arrival order equals enqueue order
+    among burst lanes (uniform pre-IO offset), so the queue-cap ring and
+    deadline tests from :func:`_controlled_station_scan` stay exact in
+    this ordering and compose.
+
+    Returns (wait, refused, shed, abandoned) per sorted element.
+    """
+    r = max(cap, 1)
+
+    def step(carry, x):
+        w, ring, conn = carry
+        a, e, s_dur, po, b, v = x
+        refused = v & (conn[0] > a)
+        live = v & ~refused
+        shed = live & b & jnp.bool_(cap >= 0) & (ring[0] > e)
+        g = jnp.maximum(e, w[0])
+        wait = jnp.where(b, g - e, 0.0)
+        through = live & b & ~shed
+        abandoned = through & jnp.bool_(timeout >= 0.0) & (wait > timeout)
+        exit_t = jnp.where(
+            b,
+            jnp.where(shed, e, jnp.where(abandoned, g, g + s_dur + po)),
+            a + po,
+        )
+        conn = jnp.where(live, jnp.sort(conn.at[0].set(exit_t)), conn)
+        w0 = g + jnp.where(abandoned, 0.0, s_dur)
+        w = jnp.where(through, jnp.sort(w.at[0].set(w0)), w)
+        ring = jnp.where(
+            through, jnp.concatenate([ring[1:], jnp.array([g])]), ring,
+        )
+        return (w, ring, conn), (wait, refused, shed, abandoned)
+
+    init = (
+        jnp.zeros(n_cores, jnp.float32),
+        jnp.full((r,), -INF, jnp.float32),
+        jnp.full((conn_cap,), -INF, jnp.float32),
+    )
+    _, (wait, refused, shed, abandoned) = jax.lax.scan(
+        step, init, (arr, enq, dur, post, is_burst, valid),
+    )
+    return wait, refused, shed, abandoned
 
 
 class FastEngine:
@@ -931,9 +1005,70 @@ class FastEngine:
                 if len(plan.server_queue_timeout)
                 else -1.0
             )
+            conn_s = (
+                int(plan.server_conn_cap[s])
+                if len(plan.server_conn_cap)
+                else -1
+            )
             controlled = cap_s >= 0 or qto_s >= 0
 
-            if kb == 0 and ram_k <= 0:
+            if conn_s >= 0:
+                # socket capacity (+ any cap/deadline): joint arrival-order
+                # pass — compiler guarantees kb <= 1, no RAM tier, no
+                # binding pool, uniform burst pre-IO, no pre-burst cache
+                # extras (`_socket_cap_scan_reason`)
+                assert kb <= 1 and ram_k <= 0
+                nb = n_bursts_t[s, ep]
+                is_b = nb >= 1
+                pre0 = jnp.where(is_b, burst_pre_t[s, ep][:, 0], 0.0)
+                dur0 = jnp.where(is_b, burst_dur_t[s, ep][:, 0], 0.0)
+                arr_c = jnp.where(mine, t, INF)
+                rank_c = time_rank(arr_c, mine)
+                wait_s_, ref_s, shed_s, aband_s = _socket_station_scan(
+                    jnp.full(n, INF).at[rank_c].set(arr_c),
+                    jnp.full(n, INF).at[rank_c].set(
+                        jnp.where(mine, t + pre0, INF),
+                    ),
+                    jnp.zeros(n).at[rank_c].set(jnp.where(mine, dur0, 0.0)),
+                    jnp.zeros(n).at[rank_c].set(jnp.where(mine, post, 0.0)),
+                    jnp.zeros(n, bool).at[rank_c].set(mine & is_b),
+                    jnp.zeros(n, bool).at[rank_c].set(mine),
+                    n_cores,
+                    conn_s,
+                    cap_s,
+                    qto_s,
+                )
+                refused = mine & ref_s[rank_c]
+                shed = mine & shed_s[rank_c]
+                abandoned = mine & aband_s[rank_c]
+                W_c = jnp.where(
+                    mine & is_b & ~refused & ~shed, wait_s_[rank_c], 0.0,
+                )
+                rejected = refused | shed | abandoned
+                n_rejected = n_rejected + jnp.sum(rejected)
+                alive = alive & ~rejected
+                served = mine & ~rejected
+                # gauge shapes shared with the other branches; refused
+                # never enqueue, shed enqueue with zero wait
+                part = mine & is_b & ~refused
+                E = (t + pre0)[:, None]
+                W = jnp.where(shed, 0.0, W_c)[:, None]
+                pre = pre0[:, None]
+                validb = part[:, None]
+                dep = t + pre0 + W_c + dur0 + post
+                # non-binding RAM held from arrival until the shed/abandon
+                # instant (the served interval is added by the shared
+                # gauge_ram block below, which only sees `mine`=served)
+                rej_end = jnp.where(shed, t + pre0, t + pre0 + W_c)
+                rej_ram = (shed | abandoned) & (ram > 0)
+                gauge = self._gauge_intervals(
+                    gauge, plan.gauge_ram(s), t, rej_end, ram, rej_ram,
+                )
+                gauge_means = gauge_means.at[plan.gauge_ram(s)].add(
+                    span(t, rej_end, rej_ram, amount=ram),
+                )
+                mine = served
+            elif kb == 0 and ram_k <= 0:
                 # pure-IO server: no queues, departure is deterministic
                 dep = t + post
             elif controlled:

@@ -187,13 +187,46 @@ class TestConnectionCapacity:
     """Socket capacity (reference roadmap milestone 1's network baseline):
     arrivals at a server with max_connections residents are refused."""
 
-    def test_reachable_capacity_routes_to_event_engine(self) -> None:
-        # ~20 rps x 0.2 s residence -> ~4 residents; capacity 4 binds hard
+    def test_reachable_capacity_rides_the_socket_scan(self) -> None:
+        # ~20 rps x 0.2 s residence -> ~4 residents; capacity 4 binds hard.
+        # Round 5b: the eligible shape (single burst, no RAM tier, no
+        # binding pool, uniform pre-IO) keeps the fast path — residency is
+        # a G/G/K loss pass (`fastpath._socket_station_scan`).
         plan = compile_payload(_conn_payload(4))
         assert plan.has_conn_cap
         assert plan.server_conn_cap[0] == 4
+        assert plan.fastpath_ok, plan.fastpath_reason
+
+    def test_reachable_capacity_on_multiburst_declines(self) -> None:
+        steps = [
+            *_CONN_STEPS,
+            {"kind": "cpu_bound_operation", "step_operation": {"cpu_time": 0.002}},
+        ]
+        plan = compile_payload(_build(steps, {"max_connections": 4}))
         assert not plan.fastpath_ok
-        assert "connection capacity" in plan.fastpath_reason
+        assert "connection capacity on a multi-burst" in plan.fastpath_reason
+
+    def test_reachable_capacity_with_binding_ram_declines(self) -> None:
+        steps = [
+            {"kind": "ram", "step_operation": {"necessary_ram": 512}},
+            {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.002}},
+            {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.200}},
+        ]
+        plan = compile_payload(
+            _build(steps, {"max_connections": 4}, users=120),
+        )
+        assert not plan.fastpath_ok
+        assert "binding RAM admission tier" in plan.fastpath_reason
+
+    def test_reachable_capacity_with_nonbinding_ram_stays(self) -> None:
+        steps = [
+            {"kind": "ram", "step_operation": {"necessary_ram": 1}},
+            *_CONN_STEPS,
+        ]
+        plan = compile_payload(_build(steps, {"max_connections": 4}))
+        assert plan.has_conn_cap
+        assert plan.fastpath_ok, plan.fastpath_reason
+        assert plan.ram_slots[0] == -1  # tier-1 proof, admission never queues
 
     def test_unreachable_capacity_lowers_away(self) -> None:
         plan = compile_payload(_conn_payload(100000))
@@ -323,6 +356,121 @@ def test_fast_path_shed_parity() -> None:
         [clock[i, : counts[i], 1] - clock[i, : counts[i], 0] for i in range(n)],
     )
     assert abs(lat_f.mean() - lat_o.mean()) / lat_o.mean() < 0.05
+    for q in (50, 95):
+        po, pf = np.percentile(lat_o, q), np.percentile(lat_f, q)
+        assert abs(pf - po) / po < 0.06, (q, po, pf)
+
+
+def _fast_counts(payload, n=8):
+    from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+
+    plan = compile_payload(payload)
+    assert plan.fastpath_ok, plan.fastpath_reason
+    engine = FastEngine(plan, collect_clocks=True)
+    final = engine.run_batch(scenario_keys(11, n))
+    clock = np.asarray(final.clock)
+    counts = np.asarray(final.clock_n)
+    lat = np.concatenate(
+        [clock[i, : counts[i], 1] - clock[i, : counts[i], 0] for i in range(n)],
+    )
+    return (
+        int(np.sum(np.asarray(final.n_generated))),
+        int(np.sum(np.asarray(final.n_rejected))),
+        lat,
+    )
+
+
+def _oracle_counts(payload, n=8):
+    res = [OracleEngine(payload, seed=s).run() for s in range(n)]
+    return (
+        sum(r.total_generated for r in res),
+        sum(r.total_rejected for r in res),
+        np.concatenate([r.latencies for r in res]),
+    )
+
+
+def test_socket_cap_fast_parity() -> None:
+    """Round 5b: a reachable connection capacity rides the fast path's
+    arrival-order loss pass; refusal fraction and latency percentiles
+    must match the oracle."""
+    payload = _conn_payload(4)
+    gen_o, rej_o, lat_o = _oracle_counts(payload)
+    frac_o = rej_o / gen_o
+    assert 0.1 < frac_o < 0.5  # the capacity genuinely binds
+
+    gen_f, rej_f, lat_f = _fast_counts(payload)
+    assert abs(rej_f / gen_f - frac_o) < 0.03, (rej_f / gen_f, frac_o)
+    assert abs(lat_f.mean() - lat_o.mean()) / lat_o.mean() < 0.05
+    for q in (50, 95):
+        po, pf = np.percentile(lat_o, q), np.percentile(lat_f, q)
+        assert abs(pf - po) / po < 0.06, (q, po, pf)
+
+
+def test_socket_cap_io_only_loss_system() -> None:
+    """A pure-IO server with a socket capacity is an Erlang-style loss
+    system (no queues at all); the scan must refuse the same fraction the
+    oracle does AND leave accepted latencies untouched."""
+    steps = [{"kind": "io_wait", "step_operation": {"io_waiting_time": 0.2}}]
+    payload = _build(steps, {"max_connections": 3}, users=60)
+    gen_o, rej_o, lat_o = _oracle_counts(payload)
+    frac_o = rej_o / gen_o
+    assert 0.2 < frac_o < 0.7
+
+    gen_f, rej_f, lat_f = _fast_counts(payload)
+    assert abs(rej_f / gen_f - frac_o) < 0.03, (rej_f / gen_f, frac_o)
+    for q in (50, 95):
+        po, pf = np.percentile(lat_o, q), np.percentile(lat_f, q)
+        assert abs(pf - po) / po < 0.05, (q, po, pf)
+
+
+def test_socket_cap_composes_with_rate_limit_and_deadline() -> None:
+    """All three arrival-order controls in one pass: the token bucket
+    prefilters, the socket check refuses, the cap/deadline tests shed and
+    abandon — each channel's accounting must survive the composition."""
+    steps = [
+        {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.030}},
+        {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.050}},
+    ]
+    overload = {
+        "max_connections": 6,
+        "rate_limit_rps": 25.0,
+        "rate_limit_burst": 25,
+        "queue_timeout_s": 0.2,
+    }
+    payload = _build(steps, overload, users=90)
+    gen_o, rej_o, lat_o = _oracle_counts(payload)
+    frac_o = rej_o / gen_o
+    assert frac_o > 0.05
+
+    gen_f, rej_f, lat_f = _fast_counts(payload)
+    assert abs(rej_f / gen_f - frac_o) < 0.04, (rej_f / gen_f, frac_o)
+    assert abs(lat_f.mean() - lat_o.mean()) / lat_o.mean() < 0.06
+
+
+def test_socket_cap_with_queue_cap_and_preburst_io() -> None:
+    """The shed channel under the socket scan, with a NONZERO pre-burst IO
+    (enqueue time != arrival time): refusal happens at arrival, the shed
+    ring test at enqueue, and the freed connection slot at the shed
+    instant — all three time points distinct per request."""
+    steps = [
+        {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.020}},
+        {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.035}},
+        {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.030}},
+    ]
+    overload = {"max_connections": 12, "max_ready_queue": 3}
+    payload = _build(steps, overload, users=70)
+    plan = compile_payload(payload)
+    assert plan.fastpath_ok, plan.fastpath_reason
+    assert plan.has_conn_cap
+    assert plan.has_queue_cap
+
+    gen_o, rej_o, lat_o = _oracle_counts(payload)
+    frac_o = rej_o / gen_o
+    assert frac_o > 0.03  # both controls genuinely fire
+
+    gen_f, rej_f, lat_f = _fast_counts(payload)
+    assert abs(rej_f / gen_f - frac_o) < 0.04, (rej_f / gen_f, frac_o)
+    assert abs(lat_f.mean() - lat_o.mean()) / lat_o.mean() < 0.06
     for q in (50, 95):
         po, pf = np.percentile(lat_o, q), np.percentile(lat_f, q)
         assert abs(pf - po) / po < 0.06, (q, po, pf)
