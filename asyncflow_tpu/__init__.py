@@ -9,7 +9,7 @@ behavioral reference and single-scenario runs.
 
 from asyncflow_tpu.builder.flow import AsyncFlow
 
-__version__ = "0.5.0"
+__version__ = "0.5.1"
 
 __all__ = ["AsyncFlow", "SimulationRunner", "__version__"]
 

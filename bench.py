@@ -42,6 +42,10 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 # calibration run, so one healthy-worker shot always produces a number.
 # The CPU fallback uses a size that finishes inside the watchdog on one core.
 N_ACCEL = int(os.environ.get("BENCH_SCENARIOS", "10240"))
+# Sweep engine: "auto" picks the fast path for the (eligible) bench plan;
+# "pallas"/"event"/"native" force one — used by the measurement ladder to
+# compare engines on-chip and to flip the default on evidence.
+ENGINE = os.environ.get("BENCH_ENGINE", "auto")
 N_CPU = int(os.environ.get("BENCH_SCENARIOS_CPU", "2048"))
 HORIZON = int(os.environ.get("BENCH_HORIZON", "600"))
 SEED = 1234
@@ -89,7 +93,9 @@ def _bench_shape() -> tuple[int, int]:
     while the tunnel may be wedged)."""
     from asyncflow_tpu.compiler import compile_payload  # numpy-only
 
-    fast = compile_payload(_payload()).fastpath_ok
+    fast = ENGINE == "fast" or (
+        ENGINE == "auto" and compile_payload(_payload()).fastpath_ok
+    )
     chunk_env = os.environ.get("BENCH_CHUNK")
     chunk = int(chunk_env) if chunk_env else (512 if fast else 256)
     chunk = min(chunk, N_ACCEL)
@@ -175,7 +181,7 @@ def run_measurement() -> None:
 
     chunk_cfg, inner_cfg = _bench_shape()
     on_accel = jax.default_backend() != "cpu"
-    runner = SweepRunner(payload, scan_inner=inner_cfg)
+    runner = SweepRunner(payload, engine=ENGINE, scan_inner=inner_cfg)
     if on_accel:
         # verbatim the pre-warmed shape: the accelerator child must never
         # compile anything the pre-warm subprocess didn't already cache
@@ -264,7 +270,7 @@ def run_measurement() -> None:
             runner.run(chunk, seed=SEED + 1, chunk_size=chunk)
             fast_rate = chunk / max(time.time() - t0, 1e-9)
             native_rate = 1.0 / native_wall
-            if native_rate > fast_rate:
+            if native_rate > fast_rate and ENGINE == "auto":
                 print(
                     f"CPU engine calibration: native {native_rate:.1f} scen/s"
                     f" > fast path {fast_rate:.1f} scen/s; measuring on the "
@@ -379,6 +385,7 @@ def _prewarm(env: dict) -> bool:
         SHOT_INNER=str(inner),
         SHOT_REPEAT="1",
         SHOT_HORIZON=str(HORIZON),
+        SHOT_ENGINE=ENGINE,
     )
     pre_env.pop("BENCH_CHILD", None)
     try:
