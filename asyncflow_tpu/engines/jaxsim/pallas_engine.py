@@ -206,6 +206,10 @@ def _tab(table, idx):
 def _gather_by_order(order, values):
     """Per-position gather over the (tiny, static) LB slot axis:
     ``out[:, pos] = values[:, order[:, pos]]`` as a one-hot loop."""
+    if values.dtype == jnp.bool_:
+        # selects PRODUCING i1 vectors have no Mosaic lowering (an i8->i1
+        # trunci the real compile rejects); gather in i32, re-mask after
+        return _gather_by_order(order, values.astype(jnp.int32)) > 0
     el = values.shape[1]
     out = jnp.zeros(order.shape, values.dtype)
     for j in range(el):
@@ -485,16 +489,20 @@ class PallasEngine:
             # exp-sum counting process: K ~ Poisson(mean) exactly
             def pcond(c):
                 _acc, _k, live, _seq = c
-                return jnp.sum(live.astype(jnp.int32)) > 0
+                return jnp.sum(live) > 0
 
             def pbody(c):
+                # `live` rides the carry as i32: i1 vectors in scf.while
+                # carries fail Mosaic's yield legalization (found by the
+                # real-compile gate, round 5)
                 acc, k, live, seq = c
                 u_p = rng.one(it, site + 2, seq)
                 g = -jnp.log(jnp.maximum(1.0 - u_p, np.float32(TINY)))
                 acc2 = acc + g
                 over = acc2 > jnp.maximum(mean, np.float32(TINY))
-                k = jnp.where(live & ~over, k + 1, k)
-                return acc2, k, live & ~over, seq + 1
+                go = (live > 0) & ~over
+                k = jnp.where(go, k + 1, k)
+                return acc2, k, go.astype(jnp.int32), seq + 1
 
             is_pois = dist == D_POISSON
             _, kcnt, _, _ = jax.lax.while_loop(
@@ -503,7 +511,7 @@ class PallasEngine:
                 (
                     jnp.zeros_like(mean),
                     jnp.zeros_like(mean, jnp.int32),
-                    is_pois,
+                    is_pois.astype(jnp.int32),
                     jnp.int32(0),
                 ),
             )
@@ -544,11 +552,11 @@ class PallasEngine:
         gcol = slice(gen, gen + 1)
 
         def cond(c):
-            _smp, _we, _widx, _lam, status, _gap, _d = c
+            _smp, _we, _widx, status, _gap, _d = c
             return jnp.sum((status == 0).astype(jnp.int32)) > 0
 
         def body(c):
-            smp_now, window_end, widx, lam, status, gap, dctr = c
+            smp_now, window_end, widx, status, gap, dctr = c
             active = status == 0
             # exhausted outright: the sampler clock passed the horizon
             done_h = active & (smp_now >= horizon)
@@ -557,8 +565,12 @@ class PallasEngine:
 
             need_window = active & (smp_now >= window_end)
             widx = jnp.where(need_window, widx + 1, widx)
-            lam_new = _sel_col(lam_tab, jnp.minimum(widx, nw - 1))
-            lam = jnp.where(need_window, lam_new, lam)
+            # lam is a pure function of widx: recompute instead of carrying
+            # it — selecting the lane-reduction output of _sel_col against a
+            # loop carry asks Mosaic for a concrete->replicated relayout of
+            # a non-singleton dim, which the real TPU compile rejects
+            # (first observed on-chip, round 5).
+            lam = _sel_col(lam_tab, jnp.minimum(widx, nw - 1))
             window_end = jnp.where(need_window, smp_now + window, window_end)
 
             no_users = lam <= 0.0
@@ -586,18 +598,30 @@ class PallasEngine:
             smp_now = jnp.where(active, smp_next, smp_now)
             gap = jnp.where(active & (new_status == 1), g, gap)
             status = jnp.where(active, new_status, status)
-            return smp_now, window_end, widx, lam, status, gap, dctr + 1
+            return smp_now, window_end, widx, status, gap, dctr + 1
 
+        # Layout anchor: when every init below is constant-derived (the
+        # kernel's t=0 call passes pred=True and a zero state), Mosaic gives
+        # the loop carries replicated vector layouts, and the RNG-driven
+        # (concrete-layout) yields then need an invalid concrete->replicated
+        # relayout.  Adding a data-dependent exact zero (k0 >= 0, so
+        # min(k0, 0) == 0, and no canonicalizer fold applies) pins every
+        # carry to a concrete layout.  Found by real AOT compile, round 5.
+        # (uint32->f32 has no Mosaic lowering: shift keeps the int32 cast
+        # positive, so min(.,0) is still an exact zero)
+        czi = jnp.minimum(
+            (rng.k0 >> jnp.uint32(9)).astype(jnp.int32), jnp.int32(0),
+        )
+        cz = czi.astype(jnp.float32)
         init = (
-            st["smp_now"][:, gcol],
-            st["smp_window_end"][:, gcol],
-            st["widx"][:, gcol],
-            st["smp_lam"][:, gcol],
-            jnp.where(pred, 0, 1).astype(jnp.int32),
-            jnp.zeros_like(st["smp_now"][:, gcol]),
+            st["smp_now"][:, gcol] + cz,
+            st["smp_window_end"][:, gcol] + cz,
+            st["widx"][:, gcol] + czi,
+            jnp.where(pred, 0, 1).astype(jnp.int32) + czi,
+            jnp.zeros_like(st["smp_now"][:, gcol]) + cz,
             jnp.int32(0),
         )
-        smp_now, window_end, widx, lam, status, gap, _ = jax.lax.while_loop(
+        smp_now, window_end, widx, status, gap, _ = jax.lax.while_loop(
             cond, body, init,
         )
         exhausted = status == 2
@@ -614,7 +638,6 @@ class PallasEngine:
         st["smp_now"] = upd(st["smp_now"], smp_now)
         st["smp_window_end"] = upd(st["smp_window_end"], window_end)
         st["widx"] = upd(st["widx"], widx)
-        st["smp_lam"] = upd(st["smp_lam"], lam)
         st["next_arrival"] = upd(st["next_arrival"], nxt)
         return st
 
@@ -694,16 +717,18 @@ class PallasEngine:
 
             def lcond(c):
                 _acc, _k, live, _seq = c
-                return jnp.sum(live.astype(jnp.int32)) > 0
+                return jnp.sum(live) > 0
 
             def lbody(c):
+                # i32 `live` carry: see the edge-Poisson loop note
                 acc, k, live, seq = c
                 u_p = rng.one(it, 25, seq)
                 g = -jnp.log(jnp.maximum(1.0 - u_p, np.float32(TINY)))
                 acc2 = acc + g
                 over = acc2 > lam_t
-                k = jnp.where(live & ~over, k + 1, k)
-                return acc2, k, live & ~over, seq + 1
+                go = (live > 0) & ~over
+                k = jnp.where(go, k + 1, k)
+                return acc2, k, go.astype(jnp.int32), seq + 1
 
             _, tok, _, _ = jax.lax.while_loop(
                 lcond,
@@ -711,7 +736,7 @@ class PallasEngine:
                 (
                     jnp.zeros_like(dur),
                     jnp.zeros_like(dur, jnp.int32),
-                    is_llm,
+                    is_llm.astype(jnp.int32),
                     jnp.int32(0),
                 ),
             )
@@ -936,7 +961,9 @@ class PallasEngine:
             chains = [plan.entry_edges.tolist()]
 
         sblk = st["req_ev"].shape[0]
-        alive = pred
+        # i32 accumulator: a jnp.where PRODUCING an i1 vector has no Mosaic
+        # lowering (same class as _gather_by_order's bool branch)
+        alive_i = pred.astype(jnp.int32)
         t_cur = now
         # _edge_draw consumes sites site..site+2 (Box-Muller pair, Poisson
         # loop), so edges need a stride of 4 and streams a block sized to
@@ -944,7 +971,7 @@ class PallasEngine:
         # preserved for G == 1
         max_chain = max(len(c) for c in chains)
         for gi, chain in enumerate(chains):
-            pred_gi = alive & (g_idx == gi)
+            pred_gi = (alive_i > 0) & (g_idx == gi)
             t_gi = now
             for j, eidx in enumerate(chain):
                 e = jnp.full((sblk, 1), np.int32(eidx))
@@ -963,7 +990,10 @@ class PallasEngine:
                 t_gi = jnp.where(survives, t_gi + delay, t_gi)
                 pred_gi = survives
             t_cur = jnp.where(g_idx == gi, t_gi, t_cur)
-            alive = jnp.where(g_idx == gi, pred_gi, alive)
+            alive_i = jnp.where(
+                g_idx == gi, pred_gi.astype(jnp.int32), alive_i,
+            )
+        alive = alive_i > 0
 
         slot, has_free = _argmax_bool_row(st["req_ev"] == EV_IDLE)
         overflow = alive & ~has_free
@@ -1399,7 +1429,6 @@ class PallasEngine:
             "smp_now": jnp.zeros((sblk, self._n_gen), jnp.float32),
             "smp_window_end": jnp.zeros((sblk, self._n_gen), jnp.float32),
             "widx": jnp.full((sblk, self._n_gen), -1, jnp.int32),
-            "smp_lam": jnp.zeros((sblk, self._n_gen), jnp.float32),
             "next_arrival": jnp.zeros((sblk, self._n_gen), jnp.float32),
             "tl_ptr": col(0, jnp.int32),
             "hist": jnp.zeros((sblk, self.n_hist_bins), jnp.int32),
@@ -1630,6 +1659,23 @@ class PallasEngine:
         call = self._get_call(sig)
         try:
             return call.trace(*args).lower(lowering_platforms=("tpu",))
+        finally:
+            self._tk = {}
+
+    def compile_tpu(self, keys: jnp.ndarray):
+        """REAL chipless TPU compile via a compile-only topology client.
+
+        Runs the full Mosaic pipeline including the vector-layout passes
+        that ``lower_tpu``'s conversion gate cannot reach (round 5: those
+        passes rejected a kernel the lowering gate passed).  Requires local
+        libtpu (``utils.tpu_aot.aot_available``); returns the ``Compiled``.
+        """
+        from asyncflow_tpu.utils.tpu_aot import aot_compile
+
+        args, sig, _ = self._prepare(keys, None, force_interpret=False)
+        call = self._get_call(sig)
+        try:
+            return aot_compile(call, *args)
         finally:
             self._tk = {}
 

@@ -1,0 +1,83 @@
+"""Chipless AOT compilation against a TPU topology.
+
+The Mosaic conversion passes run at *lowering* time, but the vector-layout
+passes (infer/apply) only run inside the real TPU compiler — a kernel can
+pass every conversion pass and still be rejected on hardware (round 5
+found exactly that: an invalid concrete->replicated relayout the
+cross-lowering gate could not see).  libtpu ships the full compiler, and
+PJRT exposes it through *compile-only* topology clients: no TPU chip, no
+tunnel attach, just the real pipeline.
+
+``aot_compile`` compiles a traced function against a v5e topology from any
+host with libtpu installed (the CI boxes have it).  Callers must be on the
+CPU backend (`JAX_PLATFORMS=cpu`); the topology client is independent of
+the runtime backend and never initializes one.
+
+Used by the Pallas compile gates (`tests/parity/test_pallas_engine.py`)
+and the compile-pathology diagnostics (`scripts/aot_compile_scan.py`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+#: topology compiled against — one v5e host (the bench target in
+#: BASELINE.md); chip count only affects device assignment, not Mosaic
+#: layout validation or scalar/vector lowering
+TOPOLOGY = "v5e:2x2x1"
+
+
+class AotUnavailable(RuntimeError):
+    """Raised when no local TPU compiler is available (no libtpu)."""
+
+
+@functools.cache
+def _topology_sharding():
+    import jax
+    from jax.sharding import SingleDeviceSharding
+
+    try:
+        from jax.experimental import topologies
+
+        topo = topologies.get_topology_desc(TOPOLOGY, "tpu")
+    except Exception as exc:  # noqa: BLE001 - any init failure means skip
+        raise AotUnavailable(f"no local TPU AOT compiler: {exc}") from exc
+    if jax.default_backend() != "cpu":
+        # compile-only clients coexist with the CPU backend only; a live
+        # accelerator backend would shadow the topology devices
+        raise AotUnavailable("AOT gate requires the CPU runtime backend")
+    return SingleDeviceSharding(topo.devices[0])
+
+
+@functools.cache
+def aot_available() -> bool:
+    """True when a chipless TPU compile can run on this host.
+
+    Cached including the negative: ``functools.cache`` on the probe alone
+    would retry plugin discovery on every gate test of a libtpu-less host.
+    """
+    try:
+        _topology_sharding()
+    except AotUnavailable:
+        return False
+    return True
+
+
+def aot_compile(fn: Any, *args: Any) -> Any:
+    """Compile ``fn(*args)`` for TPU via the compile-only topology client.
+
+    ``args`` are arrays or ShapeDtypeStructs; only shapes/dtypes are used.
+    Returns the ``Compiled`` object (its ``memory_analysis()`` /
+    ``cost_analysis()`` are meaningful).  Raises ``AotUnavailable`` when no
+    local compiler exists, or the underlying compile error verbatim.
+    """
+    import jax
+
+    sharding = _topology_sharding()
+    sds = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sharding),
+        list(args),
+    )
+    wrapped = jax.jit(lambda *a: fn(*a))
+    return wrapped.trace(*sds).lower().compile()

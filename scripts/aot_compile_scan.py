@@ -1,0 +1,97 @@
+"""Chipless XLA:TPU compile scan of the fast-path executables.
+
+The scanned fast path's compile time grows with the vmap width S (round 3:
+~2 min at S=16 on the tunneled worker, never returned at S=128; round 5:
+the S=32 cold compile blew its 25-min budget and wedged the worker).  Every
+probe of that curve used to cost a live-worker session — and a wedge when
+the guess was wrong.  With local libtpu the REAL TPU compiler runs on this
+box via a compile-only topology client (`utils/tpu_aot.py`), so the curve
+is measurable offline, wedge-free.
+
+Usage:
+    WIDTHS=8,16,32 CHUNK=512 HORIZON=600 python scripts/aot_compile_scan.py
+    ENGINE=pallas BLOCKS=128 python scripts/aot_compile_scan.py
+
+Prints one line per width: compile seconds + executable stats (the
+flops/bytes-accessed cost analysis of the compiled module).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+from _common import load_example_payload, log  # noqa: E402
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from asyncflow_tpu.compiler import compile_payload
+    from asyncflow_tpu.engines.jaxsim.engine import scenario_keys
+    from asyncflow_tpu.utils.tpu_aot import aot_available, aot_compile
+
+    if not aot_available():
+        log("no local TPU AOT compiler (libtpu missing); nothing to scan")
+        sys.exit(1)
+
+    chunk = int(os.environ.get("CHUNK", "512"))
+    horizon = int(os.environ.get("HORIZON", "600"))
+    widths = [int(w) for w in os.environ.get("WIDTHS", "8,16,32").split(",")]
+    engine = os.environ.get("ENGINE", "fast")
+
+    payload = load_example_payload(horizon)
+    plan = compile_payload(payload)
+
+    if engine == "pallas":
+        from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
+
+        block = int(os.environ.get("BLOCKS", "128"))
+        eng = PallasEngine(plan, interpret=False, block=block)
+        t0 = time.time()
+        compiled = eng.compile_tpu(scenario_keys(chunk, 7))
+        log(f"pallas block={block} chunk={chunk}: compiled in {time.time()-t0:.1f}s")
+        _report(compiled)
+        return
+
+    from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+
+    eng = FastEngine(plan)
+    for inner in widths:
+        keys_b, ov_b, _s, _t = eng.scanned_inputs(
+            scenario_keys(chunk, 7), None, inner=inner, total=chunk,
+        )
+        t0 = time.time()
+        try:
+            compiled = aot_compile(eng.scanned_fn(), keys_b, ov_b)
+        except Exception as exc:  # noqa: BLE001 - report and continue the scan
+            log(f"S={inner}: COMPILE FAILED after {time.time()-t0:.1f}s: "
+                f"{str(exc)[:200]}")
+            continue
+        log(f"S={inner} blocks={chunk//inner}: compiled in {time.time()-t0:.1f}s")
+        _report(compiled)
+
+
+def _report(compiled) -> None:
+    try:
+        cost = compiled.cost_analysis()
+        if cost:
+            flops = cost.get("flops", 0.0)
+            amemb = cost.get("bytes accessed", 0.0)
+            log(f"   cost: {flops:.3g} flops, {amemb:.3g} bytes accessed")
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            log(f"   memory: {mem.temp_size_in_bytes/1e6:.1f} MB temp, "
+                f"{mem.output_size_in_bytes/1e6:.1f} MB out")
+    except Exception:  # noqa: BLE001 - stats are best-effort diagnostics
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -316,16 +316,27 @@ def test_sweep_runner_pallas_engine() -> None:
     assert np.isfinite(s["latency_p95_s"])
 
 
-def test_kernel_lowers_for_tpu_from_cpu() -> None:
-    """Cross-platform Mosaic lowering gate (found round 4: the kernel's
-    uint32->f32 RNG cast had NO Mosaic lowering rule, so the engine could
-    never have compiled on hardware).  Lowering the full kernel for the
-    TPU target runs every Mosaic MLIR conversion pass on CPU — any op
-    without a TPU lowering rule fails HERE, in CI, not on a live worker."""
-    plan = compile_payload(SimulationPayload.model_validate(_lb_payload()))
+def _tpu_compile_gate(plan) -> None:
+    """REAL chipless TPU compile when libtpu is present (the full Mosaic
+    pipeline, layout passes included — round 5: layout inference rejected
+    a kernel every conversion pass accepted); conversion-pass lowering gate
+    otherwise."""
+    from asyncflow_tpu.utils.tpu_aot import aot_available
+
     eng = PallasEngine(plan, interpret=False)
-    lowered = eng.lower_tpu(scenario_keys(3, 4))
-    assert "tpu_custom_call" in lowered.as_text()
+    if aot_available():
+        eng.compile_tpu(scenario_keys(3, 4))
+    else:
+        lowered = eng.lower_tpu(scenario_keys(3, 4))
+        assert "tpu_custom_call" in lowered.as_text()
+
+
+def test_kernel_lowers_for_tpu_from_cpu() -> None:
+    """Cross-platform Mosaic compile gate (found round 4: the kernel's
+    uint32->f32 RNG cast had NO Mosaic lowering rule, so the engine could
+    never have compiled on hardware; round 5 upgraded the gate from
+    conversion-pass lowering to a real chipless compile)."""
+    _tpu_compile_gate(compile_payload(SimulationPayload.model_validate(_lb_payload())))
 
 
 # -- round-5 feature coverage: weights, cache, LLM, DB pools ----------------
@@ -483,9 +494,7 @@ def test_featured_kernel_lowers_for_tpu_from_cpu() -> None:
     plan = compile_payload(SimulationPayload.model_validate(data))
     assert plan.has_db_pool and plan.has_stochastic_cache
     assert plan.has_llm and plan.has_weighted_endpoints
-    eng = PallasEngine(plan, interpret=False)
-    lowered = eng.lower_tpu(scenario_keys(3, 4))
-    assert "tpu_custom_call" in lowered.as_text()
+    _tpu_compile_gate(plan)
 
 
 # -- round-5b: server-side overload policies in-kernel ----------------------
@@ -597,10 +606,7 @@ def test_controlled_kernel_lowers_for_tpu() -> None:
         },
         users=60, horizon=6.0,
     )
-    plan = compile_payload(SimulationPayload.model_validate(data))
-    eng = PallasEngine(plan, interpret=False)
-    lowered = eng.lower_tpu(scenario_keys(3, 4))
-    assert "tpu_custom_call" in lowered.as_text()
+    _tpu_compile_gate(compile_payload(SimulationPayload.model_validate(data)))
 
 
 def test_circuit_breaker_parity() -> None:
@@ -653,10 +659,7 @@ def test_breaker_kernel_lowers_for_tpu() -> None:
         "cooldown_s": 2.0,
         "half_open_probes": 2,
     }
-    plan = compile_payload(SimulationPayload.model_validate(data))
-    eng = PallasEngine(plan, interpret=False)
-    lowered = eng.lower_tpu(scenario_keys(3, 4))
-    assert "tpu_custom_call" in lowered.as_text()
+    _tpu_compile_gate(compile_payload(SimulationPayload.model_validate(data)))
 
 
 def _two_gen_payload(horizon: float = 8.0) -> dict:
@@ -711,7 +714,4 @@ def test_multi_generator_normal_edge_parity() -> None:
 
 
 def test_multi_generator_kernel_lowers_for_tpu() -> None:
-    plan = compile_payload(SimulationPayload.model_validate(_two_gen_payload()))
-    eng = PallasEngine(plan, interpret=False)
-    lowered = eng.lower_tpu(scenario_keys(3, 4))
-    assert "tpu_custom_call" in lowered.as_text()
+    _tpu_compile_gate(compile_payload(SimulationPayload.model_validate(_two_gen_payload())))
