@@ -65,6 +65,7 @@ from asyncflow_tpu.engines.jaxsim.sampling import (
     sample_bucket,
     truncated_normal,
 )
+from asyncflow_tpu.engines.jaxsim.sortutil import searchsorted_small
 from asyncflow_tpu.engines.results import SimulationResults, SweepResults
 from asyncflow_tpu.schemas.payload import SimulationPayload
 from asyncflow_tpu.engines.jaxsim.rotation import (
@@ -201,10 +202,7 @@ class Engine:
     def _spike(self, edge, t):
         if len(self.plan.spike_times) == 1:
             return jnp.float32(0.0)
-        idx = (
-            jnp.searchsorted(self.params.spike_times, t, side="right").astype(jnp.int32)
-            - 1
-        )
+        idx = searchsorted_small(self.params.spike_times, t, "right") - 1
         return self.params.spike_values[idx, edge]
 
     def _sample_delay(self, edge, key, ov):
@@ -1135,9 +1133,7 @@ class Engine:
         # weighted endpoint pick (uniform weights lower to the evenly
         # spaced cumulative table, preserving the reference's behavior)
         ep = jnp.minimum(
-            jnp.searchsorted(p.endpoint_cum[s], u, side="right").astype(
-                jnp.int32,
-            ),
+            searchsorted_small(p.endpoint_cum[s], u, "right"),
             p.n_endpoints[s] - 1,
         )
         st = st._replace(

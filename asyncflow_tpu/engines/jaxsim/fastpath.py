@@ -93,7 +93,7 @@ from asyncflow_tpu.engines.jaxsim.rotation import (
     rotation_insert,
     rotation_remove,
 )
-from asyncflow_tpu.engines.jaxsim.sortutil import time_rank
+from asyncflow_tpu.engines.jaxsim.sortutil import searchsorted_small, time_rank
 from asyncflow_tpu.engines.jaxsim.sampling import (
     as_threefry as _as_threefry,
     D_EXPONENTIAL as _D_EXPONENTIAL,
@@ -497,12 +497,7 @@ class FastEngine:
     def _add_spike(self, delay, t_send, eidx):
         """Active-spike superposition at send time (static or per-lane
         edge index)."""
-        idx = (
-            jnp.searchsorted(self._spike_times, t_send, side="right").astype(
-                jnp.int32,
-            )
-            - 1
-        )
+        idx = searchsorted_small(self._spike_times, t_send, "right") - 1
         return delay + self._spike_values[idx, eidx]
 
     def _edge_hop(self, key, edge: int, t_send, ov: ScenarioOverrides, u=None):
@@ -601,7 +596,7 @@ class FastEngine:
 
         slot = jnp.arange(n, dtype=jnp.int32)
         valid = slot < total
-        win = jnp.searchsorted(offsets, slot, side="right").astype(jnp.int32)
+        win = searchsorted_small(offsets, slot, "right")
         win = jnp.clip(win, 0, nw - 1)
         # SORTED uniforms per window without a sort (the profiler showed the
         # fast path is sort-dominated): K sorted uniforms are the normalized
@@ -950,9 +945,7 @@ class FastEngine:
                 else jax.random.uniform(jax.random.fold_in(key, 64 + s), (n,))
             )
             ep = jnp.minimum(
-                jnp.searchsorted(endpoint_cum_t[s], u, side="right").astype(
-                    jnp.int32,
-                ),
+                searchsorted_small(endpoint_cum_t[s], u, "right"),
                 nep - 1,
             )
             ram = jnp.asarray(plan.endpoint_ram)[s, ep]

@@ -45,7 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["argsort_time", "sortable_u32", "time_rank"]
+__all__ = ["argsort_time", "searchsorted_small", "sortable_u32", "time_rank"]
 
 # a numpy scalar, NOT jnp: a module-level jnp constant would initialize
 # the XLA backend at import time, breaking jax.distributed.initialize()
@@ -111,6 +111,24 @@ def _ffi_rank(keys: jnp.ndarray) -> jnp.ndarray:
     return rank
 
 
+def searchsorted_small(table: jnp.ndarray, q: jnp.ndarray, side: str) -> jnp.ndarray:
+    """Exact ``jnp.searchsorted`` for a SMALL sorted 1-D ``table``.
+
+    XLA:TPU lowers ``searchsorted`` to a binary-search while loop whose
+    per-round gathers cost ~14 ms at the fast path's query shapes (round-5
+    on-chip profile: 3 s/chunk spent searching a 21-entry window table).
+    For an n-entry table the insertion index is just a count — n broadcast
+    compares, fused, gather-free:
+    ``side='right'`` counts ``table <= q``; ``side='left'`` counts
+    ``table < q`` — the textbook insertion-point definitions.
+    """
+    if side not in ("left", "right"):
+        msg = f"side must be 'left' or 'right', got {side!r}"
+        raise ValueError(msg)
+    cmp = table <= q[..., None] if side == "right" else table < q[..., None]
+    return jnp.sum(cmp, axis=-1).astype(jnp.int32)
+
+
 def sortable_u32(t: jnp.ndarray) -> jnp.ndarray:
     """Order-isomorphic u32 image of finite f32 (sign-flip bijection).
 
@@ -146,6 +164,19 @@ def time_rank(t: jnp.ndarray, alive: jnp.ndarray) -> jnp.ndarray:
     return _time_rank_xla(jnp.where(alive, t, jnp.inf))
 
 
+#: TPU rank strategy: "search" = single-operand u32 sort + searchsorted +
+#: tie-fix (round-5 default); "kvsort" = ONE stable (key, iota) sort with
+#: num_keys=1 — the (values, indices) shape XLA:TPU specializes for top_k.
+#: The round-5 on-chip profile showed searchsorted's log-n gather rounds at
+#: 244 ms/block vs 79 ms for the sort itself, so the second sort may well
+#: be cheaper than the search; both are bit-identical, pick by measurement.
+_RANK_MODE = os.environ.get("AF_TPU_RANK", "search")
+if _RANK_MODE not in ("search", "kvsort"):
+    # a typo'd A/B knob must not silently measure the baseline twice
+    msg = f"AF_TPU_RANK must be 'search' or 'kvsort', got {_RANK_MODE!r}"
+    raise ValueError(msg)
+
+
 def _time_rank_xla(t: jnp.ndarray) -> jnp.ndarray:
     """Pure-XLA stable rank of f32 keys (+inf = padding; see time_rank)."""
     alive = t < jnp.inf
@@ -156,6 +187,10 @@ def _time_rank_xla(t: jnp.ndarray) -> jnp.ndarray:
     lane = jnp.arange(n, dtype=jnp.uint32)
     iota = jnp.arange(n, dtype=jnp.int32)
     key = jnp.where(alive, sortable_u32(t), _DEAD_BASE + lane)
+    if _RANK_MODE == "kvsort":
+        # stable kv-sort: the carried iota IS the argsort; invert by scatter
+        _, perm = jax.lax.sort((key, iota), dimension=0, num_keys=1)
+        return jnp.zeros((n,), jnp.int32).at[perm].set(iota)
     sk = jax.lax.sort(key, dimension=0)
     rank = jnp.searchsorted(sk, key, side="left").astype(jnp.int32)
 

@@ -26,6 +26,7 @@ def main() -> None:
     inner = int(os.environ.get("SHOT_INNER", "16"))
     horizon = int(os.environ.get("SHOT_HORIZON", "600"))
     prof_dir = os.environ.get("PROF_DIR", "prof_trace")
+    engine = os.environ.get("PROF_ENGINE", "fast")
 
     import jax
 
@@ -37,7 +38,9 @@ def main() -> None:
     from asyncflow_tpu.parallel.sweep import SweepRunner
 
     payload = load_example_payload(horizon)
-    runner = SweepRunner(payload, scan_inner=inner, use_mesh=False)
+    runner = SweepRunner(
+        payload, engine=engine, scan_inner=inner, use_mesh=False,
+    )
     log(f"engine={runner.engine_kind}; warm-up run (compile or cache load)")
     t0 = time.time()
     runner.run(chunk, seed=5, chunk_size=chunk)

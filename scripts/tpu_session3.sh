@@ -1,0 +1,67 @@
+#!/bin/bash
+# Round-5 measurement ladder, third revision (post Mosaic-fix).
+#
+# What changed since session2:
+#   - The Pallas kernel now passes the REAL Mosaic compile (verified offline
+#     via the chipless AOT gate, commit a8741d5), so the kernel shots go
+#     first: its compile is seconds-cheap (one custom call, no giant XLA
+#     graph) and it is the designed TPU path.
+#   - NO scanned compiles wider than S=16 on the worker: the S=32 cold
+#     compile blew a 25-minute budget and wedged the worker for good
+#     (session2).  The compile-time-vs-S curve is measured OFFLINE by
+#     scripts/aot_compile_scan.py instead.
+set -u
+cd "$(dirname "$0")/.."
+
+PROBE='import jax, jax.numpy as jnp; assert jax.default_backend()!="cpu"; (jnp.ones((4,128))+1).block_until_ready(); print("PROBE_OK")'
+probe() { timeout -k 10 90 python -c "$PROBE" 2>/dev/null | grep -q PROBE_OK; }
+
+recover() {
+    echo "== recovery wait =="
+    for i in $(seq 1 "$1"); do
+        sleep 240
+        if probe; then echo "== recovered after $i waits =="; sleep 90; return 0; fi
+        echo "   still wedged ($i)"
+    done
+    return 1
+}
+
+step() {
+    local name="$1" budget="$2"; shift 2
+    echo "== step: $name (budget ${budget}s) $(date +%H:%M:%S) =="
+    timeout -k 15 "$budget" "$@"
+    local rc=$?
+    if [ $rc -eq 124 ] || [ $rc -eq 137 ]; then
+        echo "== step $name TIMED OUT =="
+        recover 7 || { echo "== worker did not recover; aborting =="; exit 1; }
+        return 1
+    fi
+    sleep 90
+    return $rc
+}
+
+probe || { echo "worker not available at session start"; exit 1; }
+echo "== worker alive; session3 starts $(date +%H:%M:%S) =="
+sleep 60
+
+step pallas-60 600 env SHOT_CHUNK=128 SHOT_HORIZON=60 \
+    python scripts/tpu_shot_pallas.py
+
+step pallas-600 900 env SHOT_CHUNK=128 SHOT_HORIZON=600 SHOT_REPEAT=3 \
+    python scripts/tpu_shot_pallas.py
+
+step pallas-512 1200 env SHOT_CHUNK=512 SHOT_HORIZON=600 SHOT_REPEAT=2 \
+    python scripts/tpu_shot_pallas.py
+
+step pallas-profile 600 env PROF_ENGINE=pallas SHOT_CHUNK=512 PROF_DIR=prof_pallas_tpu \
+    python scripts/tpu_profile.py
+
+# A/B the TPU rank strategy at the known-safe S=16 width: the round-5
+# profile showed searchsorted's gather rounds at 68% of device time; the
+# kvsort variant replaces search+tie-fix with one stable (key, iota) sort.
+step scanned-kvsort 900 env AF_TPU_RANK=kvsort SHOT_CHUNK=512 SHOT_INNER=16 SHOT_REPEAT=2 \
+    python scripts/tpu_shot.py
+
+step bench 3600 python bench.py
+
+echo "== session3 complete $(date +%H:%M:%S) =="

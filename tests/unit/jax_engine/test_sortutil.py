@@ -64,9 +64,15 @@ def test_time_rank_matches_stable_argsort(name, t, alive):
     assert bool(jnp.all(order == _ref_argsort(tj, aj)))
 
 
+@pytest.mark.parametrize("mode", ["search", "kvsort"])
 @pytest.mark.parametrize("name,t,alive", list(_cases()), ids=[c[0] for c in _cases()])
-def test_xla_path_matches_stable_argsort(name, t, alive):
-    """The pure-XLA branch (what a real TPU lowers) is exact on its own."""
+def test_xla_path_matches_stable_argsort(name, t, alive, mode, monkeypatch):
+    """Both pure-XLA rank strategies (what a real TPU lowers) are exact on
+    their own: 'search' (sort + searchsorted + tie-fix) and 'kvsort' (one
+    stable (key, iota) sort, the AF_TPU_RANK=kvsort A/B arm)."""
+    from asyncflow_tpu.engines.jaxsim import sortutil
+
+    monkeypatch.setattr(sortutil, "_RANK_MODE", mode)
     tj = jnp.where(jnp.asarray(alive), jnp.asarray(t), jnp.inf)
     rank = jax.jit(_time_rank_xla)(tj)
     assert bool(jnp.all(rank == _ref_rank(jnp.asarray(t), jnp.asarray(alive))))
