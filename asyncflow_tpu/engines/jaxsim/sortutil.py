@@ -166,15 +166,86 @@ def time_rank(t: jnp.ndarray, alive: jnp.ndarray) -> jnp.ndarray:
 
 #: TPU rank strategy: "search" = single-operand u32 sort + searchsorted +
 #: tie-fix (round-5 default); "kvsort" = ONE stable (key, iota) sort with
-#: num_keys=1 — the (values, indices) shape XLA:TPU specializes for top_k.
-#: The round-5 on-chip profile showed searchsorted's log-n gather rounds at
-#: 244 ms/block vs 79 ms for the sort itself, so the second sort may well
-#: be cheaper than the search; both are bit-identical, pick by measurement.
+#: num_keys=1 — the (values, indices) shape XLA:TPU specializes for top_k;
+#: "bitonic" = a pure elementwise sorting network (no sort custom call, no
+#: gathers — see _bitonic_rank).  The round-5 on-chip profile showed
+#: searchsorted's log-n gather rounds at 244 ms/block vs 79 ms for the
+#: sort itself; all three arms are bit-identical, pick by measurement.
 _RANK_MODE = os.environ.get("AF_TPU_RANK", "search")
-if _RANK_MODE not in ("search", "kvsort"):
+if _RANK_MODE not in ("search", "kvsort", "bitonic"):
     # a typo'd A/B knob must not silently measure the baseline twice
-    msg = f"AF_TPU_RANK must be 'search' or 'kvsort', got {_RANK_MODE!r}"
+    msg = (
+        f"AF_TPU_RANK must be 'search', 'kvsort' or 'bitonic', "
+        f"got {_RANK_MODE!r}"
+    )
     raise ValueError(msg)
+
+
+def _bitonic_rank(key: jnp.ndarray, iota: jnp.ndarray) -> jnp.ndarray:
+    """Stable rank of u32 ``key`` via a bitonic network on (key, lane).
+
+    The round-5 on-chip profile showed BOTH halves of the sort+search rank
+    are dominated by ops the TPU backend serializes (the sort custom call,
+    searchsorted's per-round gathers).  A bitonic sorting network is the
+    opposite trade: sum(log2 k) = O(log^2 m) stages of pure elementwise
+    compare-exchanges — fused VPU min/max/selects, zero gathers, zero
+    custom calls.  Sorting the (key, lane) PAIR lexicographically makes
+    every element unique, so the network computes exactly the stable rank
+    — no tie-fix loop, unconditionally, for any input.
+
+    Batcher's XOR form: partner of i at distance j is i^j, which for
+    power-of-2 j is a static (m/2j, 2, j) reshape; the ascending/descending
+    direction bit (i & k) lives in the leading reshape axis, so it is a
+    broadcasted iota parity — everything static, everything fused.
+
+    Returns the rank (inverse argsort) directly: after the network sorts
+    the pairs, the carried lane at sorted position p IS argsort[p]; one
+    scatter inverts it.
+    """
+    n = key.shape[0]
+    m = 1 << max(int(n - 1).bit_length(), 1)  # next power of two
+    pad = m - n
+    # padding sorts after every real element BY THE POS TIEBREAK: pad pos
+    # starts at n, above every real pos.  (Key separation alone is not the
+    # guarantee — a dead lane's key 0xFF000000+lane reaches the 0xFFFFFFFF
+    # pad key at lane = 2^24-1, time_rank's documented limit.)
+    key = jnp.concatenate([key, jnp.full((pad,), jnp.uint32(0xFFFFFFFF))])
+    pos = jnp.concatenate([iota, jnp.arange(n, m, dtype=jnp.int32)])
+
+    span = 2
+    while span <= m:
+        half = span // 2
+        j = half
+        while j >= 1:
+            nb = m // (2 * j)
+            k2 = key.reshape(nb, 2, j)
+            p2 = pos.reshape(nb, 2, j)
+            ak, bk = k2[:, 0, :], k2[:, 1, :]
+            ai, bi = p2[:, 0, :], p2[:, 1, :]
+            gt = (ak > bk) | ((ak == bk) & (ai > bi))
+            # direction: descending where (i & span) != 0; bit log2(span)
+            # of i is bit log2(span)-log2(2j) of the block index
+            desc = (
+                jax.lax.broadcasted_iota(jnp.int32, (nb, 1), 0)
+                & jnp.int32(span // (2 * j))
+            ) != 0
+            swap = gt ^ desc
+            k2 = jnp.stack(
+                [jnp.where(swap, bk, ak), jnp.where(swap, ak, bk)], axis=1,
+            )
+            p2 = jnp.stack(
+                [jnp.where(swap, bi, ai), jnp.where(swap, ai, bi)], axis=1,
+            )
+            key = k2.reshape(m)
+            pos = p2.reshape(m)
+            j //= 2
+        span *= 2
+    # pos[p] = lane of sorted position p (the argsort); invert -> rank
+    return (
+        jnp.zeros((m,), jnp.int32)
+        .at[pos]
+        .set(jnp.arange(m, dtype=jnp.int32))[:n]
+    )
 
 
 def _time_rank_xla(t: jnp.ndarray) -> jnp.ndarray:
@@ -191,6 +262,8 @@ def _time_rank_xla(t: jnp.ndarray) -> jnp.ndarray:
         # stable kv-sort: the carried iota IS the argsort; invert by scatter
         _, perm = jax.lax.sort((key, iota), dimension=0, num_keys=1)
         return jnp.zeros((n,), jnp.int32).at[perm].set(iota)
+    if _RANK_MODE == "bitonic":
+        return _bitonic_rank(key, iota)
     sk = jax.lax.sort(key, dimension=0)
     rank = jnp.searchsorted(sk, key, side="left").astype(jnp.int32)
 

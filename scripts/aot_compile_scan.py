@@ -35,7 +35,13 @@ def main() -> None:
 
     from asyncflow_tpu.compiler import compile_payload
     from asyncflow_tpu.engines.jaxsim.engine import scenario_keys
+    from asyncflow_tpu.utils.compile_cache import enable_compile_cache
     from asyncflow_tpu.utils.tpu_aot import aot_available, aot_compile
+
+    # persist every successful compile: if the worker's cache keys match
+    # (they do — see docs/internals/mosaic-compile.md), an offline compile
+    # becomes an on-chip warm start
+    enable_compile_cache()
 
     if not aot_available():
         log("no local TPU AOT compiler (libtpu missing); nothing to scan")

@@ -44,6 +44,11 @@ probe || { echo "worker not available at session start"; exit 1; }
 echo "== worker alive; session3 starts $(date +%H:%M:%S) =="
 sleep 60
 
+# cache-key reconnaissance: if the axon client's platform_version matches
+# the local chipless client's, offline compiles can pre-seed .jax_cache
+# for the worker (docs/internals/mosaic-compile.md)
+step keyinfo 120 python -c "import jax; d = jax.devices()[0]; print('platform:', d.platform); print('platform_version:', repr(d.client.platform_version))"
+
 step pallas-60 600 env SHOT_CHUNK=128 SHOT_HORIZON=60 \
     python scripts/tpu_shot_pallas.py
 
@@ -63,5 +68,13 @@ step scanned-kvsort 900 env AF_TPU_RANK=kvsort SHOT_CHUNK=512 SHOT_INNER=16 SHOT
     python scripts/tpu_shot.py
 
 step bench 3600 python bench.py
+
+# third arm LAST, after the bench is banked: the sort-free bitonic network
+# (zero gathers, zero custom calls) adds ~153 unrolled stages per rank and
+# its on-chip compile time is only bounded by the offline AOT measurement
+# (run scripts/aot_compile_scan.py with AF_TPU_RANK=bitonic first); a blown
+# budget here wedges nothing we still need
+step scanned-bitonic 1500 env AF_TPU_RANK=bitonic SHOT_CHUNK=512 SHOT_INNER=16 SHOT_REPEAT=2 \
+    python scripts/tpu_shot.py
 
 echo "== session3 complete $(date +%H:%M:%S) =="

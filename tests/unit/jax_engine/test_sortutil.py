@@ -64,12 +64,13 @@ def test_time_rank_matches_stable_argsort(name, t, alive):
     assert bool(jnp.all(order == _ref_argsort(tj, aj)))
 
 
-@pytest.mark.parametrize("mode", ["search", "kvsort"])
+@pytest.mark.parametrize("mode", ["search", "kvsort", "bitonic"])
 @pytest.mark.parametrize("name,t,alive", list(_cases()), ids=[c[0] for c in _cases()])
 def test_xla_path_matches_stable_argsort(name, t, alive, mode, monkeypatch):
-    """Both pure-XLA rank strategies (what a real TPU lowers) are exact on
-    their own: 'search' (sort + searchsorted + tie-fix) and 'kvsort' (one
-    stable (key, iota) sort, the AF_TPU_RANK=kvsort A/B arm)."""
+    """Every pure-XLA rank strategy (what a real TPU lowers) is exact on
+    its own: 'search' (sort + searchsorted + tie-fix), 'kvsort' (one
+    stable (key, iota) sort), and 'bitonic' (the elementwise sorting
+    network) — the AF_TPU_RANK A/B arms."""
     from asyncflow_tpu.engines.jaxsim import sortutil
 
     monkeypatch.setattr(sortutil, "_RANK_MODE", mode)
@@ -78,15 +79,24 @@ def test_xla_path_matches_stable_argsort(name, t, alive, mode, monkeypatch):
     assert bool(jnp.all(rank == _ref_rank(jnp.asarray(t), jnp.asarray(alive))))
 
 
-def test_vmapped_rank_matches():
+@pytest.mark.parametrize("mode", ["search", "kvsort", "bitonic"])
+def test_vmapped_rank_matches(mode, monkeypatch):
+    """Batched exactly as the scanned fast path ships it to the TPU: the
+    rank under vmap, in every AF_TPU_RANK arm."""
+    from asyncflow_tpu.engines.jaxsim import sortutil
+
+    monkeypatch.setattr(sortutil, "_RANK_MODE", mode)
     rng = np.random.default_rng(3)
     n = 8192
     base = np.sort(rng.uniform(0, 600, (4, n)), axis=1).astype(np.float32)
     T = jnp.asarray(base + rng.exponential(0.005, (4, n)).astype(np.float32))
     A = jnp.asarray(rng.uniform(size=(4, n)) < 0.95)
-    got = jax.jit(jax.vmap(time_rank))(T, A)
+    Tinf = jnp.where(A, T, jnp.inf)
+    got = jax.jit(jax.vmap(sortutil._time_rank_xla))(Tinf)
     want = jax.vmap(_ref_rank)(T, A)
     assert bool(jnp.all(got == want))
+    got_tr = jax.jit(jax.vmap(time_rank))(T, A)
+    assert bool(jnp.all(got_tr == want))
 
 
 def test_ffi_availability_is_reported():
