@@ -195,6 +195,8 @@ def run_measurement() -> None:
         "platform": jax.default_backend(),
         "chunk": chunk,
         "scan_inner": getattr(runner, "_scan_inner", 0),
+        # which AF_TPU_RANK arm produced this number (sortutil A/B)
+        "tpu_rank": os.environ.get("AF_TPU_RANK", "search"),
         "oracle_wall_s_per_scenario": round(oracle_wall, 3),
         "native_oracle_wall_s_per_scenario": (
             round(native_wall, 4) if native_wall is not None else None

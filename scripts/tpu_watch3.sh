@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-5c watcher: probe with LONG quiet gaps (20->30 min backoff), then
+# run the session3 ladder once.
+#
+# Rationale for the backoff: a wedged worker needs every client killed and
+# sustained quiet to recover; the earlier 4-minute probe cadence may itself
+# have perpetuated the wedge (120+ fruitless probes in rounds 3/4, each an
+# attach attempt).  Probing rarely costs at most one late session start.
+set -u
+cd "$(dirname "$0")/.."
+LOG="${TPU_WATCH_LOG:-tpu_watch3.log}"
+
+PROBE='import jax, jax.numpy as jnp; assert jax.default_backend()!="cpu"; (jnp.ones((4,128))+1).block_until_ready(); print("PROBE_OK")'
+
+attempt=0
+delay=1200
+while true; do
+    attempt=$((attempt + 1))
+    if timeout -k 10 90 python -c "$PROBE" 2>/dev/null | grep -q PROBE_OK; then
+        echo "$(date +%H:%M:%S) probe $attempt: WORKER ALIVE — starting session3" >> "$LOG"
+        bash scripts/tpu_session3.sh >> "$LOG" 2>&1
+        echo "$(date +%H:%M:%S) session3 finished (rc=$?)" >> "$LOG"
+        exit 0
+    fi
+    echo "$(date +%H:%M:%S) probe $attempt: wedged (next probe in ${delay}s)" >> "$LOG"
+    sleep "$delay"
+    if [ "$delay" -lt 1800 ]; then delay=$((delay + 300)); fi
+done
