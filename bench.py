@@ -195,7 +195,10 @@ def run_measurement() -> None:
         "platform": jax.default_backend(),
         "chunk": chunk,
         "scan_inner": getattr(runner, "_scan_inner", 0),
-        # which AF_TPU_RANK arm produced this number (sortutil A/B)
+        # which AF_TPU_RANK arm produced this number (sortutil A/B).  The
+        # env default must mirror sortutil._RANK_MODE's — read via env, not
+        # import, because this parent process stays jax-free on purpose
+        # (a wedged tunnel hangs any process that initializes jax).
         "tpu_rank": os.environ.get("AF_TPU_RANK", "search"),
         "oracle_wall_s_per_scenario": round(oracle_wall, 3),
         "native_oracle_wall_s_per_scenario": (

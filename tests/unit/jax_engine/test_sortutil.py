@@ -108,3 +108,24 @@ def test_ffi_availability_is_reported():
     if shutil.which("g++") is None:
         pytest.skip("no g++: pure-XLA fallback is the supported path")
     assert _ensure_ffi() is True
+
+
+@pytest.mark.parametrize("side", ["left", "right"])
+def test_searchsorted_small_matches_numpy(side, rng):
+    """The broadcast compare-count is bit-identical to searchsorted for
+    every table size the call sites use, including exact boundary hits."""
+    from asyncflow_tpu.engines.jaxsim.sortutil import searchsorted_small
+
+    for nt in (1, 2, 5, 21, 40):
+        table = np.sort(
+            rng.choice(rng.uniform(0.0, 10.0, nt * 2), nt, replace=True),
+        ).astype(np.float32)
+        q = rng.uniform(-1.0, 11.0, 300).astype(np.float32)
+        q[:nt] = table  # exact hits exercise the <= / < boundary
+        want = np.searchsorted(table, q, side=side)
+        got = np.asarray(
+            searchsorted_small(jnp.asarray(table), jnp.asarray(q), side),
+        )
+        assert (got == want).all()
+    with pytest.raises(ValueError, match="side"):
+        searchsorted_small(jnp.zeros(3), jnp.zeros(4), "Right")
