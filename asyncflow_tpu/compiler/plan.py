@@ -489,6 +489,12 @@ class StaticPlan:
     gen_entry_target: np.ndarray = field(
         default_factory=lambda: np.empty(0, np.int32),
     )
+    #: (G,) per-generator fast-path slot budgets (the per-stream 6-sigma
+    #: count bounds; the multi-generator fast engine's slot axis is their
+    #: sum, each stream owning a static contiguous slice)
+    gen_slots: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.int64),
+    )
     #: (NS, NEP, NSEG+1) f32 SEG_LLM call dynamics: Poisson output-token
     #: mean, decode seconds per token, and cost units per token.
     seg_llm_tokens: np.ndarray = field(
@@ -667,27 +673,19 @@ def _estimate_capacity(payload: SimulationPayload) -> tuple[int, int]:
     # do their variances (multi-generator workloads superpose)
     rate = 0.0
     users = 0.0
-    count_var_draw = 0.0
+    count_var = 0.0
     max_window = 0.0
     for workload in payload.generators:
-        g_users = float(workload.avg_active_users.mean)
-        rate_per_user = (
-            float(workload.avg_request_per_minute_per_user.mean) / 60.0
+        g_users, g_rate, window, g_count_var = _workload_count_model(
+            workload, horizon,
         )
         users += g_users
-        rate += g_users * rate_per_user
-        window = float(workload.user_sampling_window)
+        rate += g_rate
         max_window = max(max_window, window)
-        users_var = (
-            float(workload.avg_active_users.variance) ** 2
-            if workload.avg_active_users.variance is not None
-            else g_users  # Poisson users
-        )
-        n_windows = max(1.0, horizon / window)
-        count_var_draw += n_windows * users_var * (rate_per_user * window) ** 2
+        # independent streams: total-count variances add (each stream's
+        # g_count_var already carries its Poisson + user-draw parts)
+        count_var += g_count_var
     expected = rate * horizon
-    # total-count variance = Poisson part + windowed user-draw part
-    count_var = expected + count_var_draw
     max_requests = int(expected + 6.0 * math.sqrt(max(count_var, 1.0)) + 64)
 
     # ~3-sigma burst of the windowed user draw
@@ -760,6 +758,42 @@ def _estimate_capacity(payload: SimulationPayload) -> tuple[int, int]:
     want = 4.0 * in_flight + 1.5 * (backlog + burst_backlog) + 64.0
     pool = int(2 ** math.ceil(math.log2(max(64.0, want))))
     return max_requests, min(pool, 32768)
+
+
+def _workload_count_model(workload, horizon: float) -> tuple[float, float, float, float]:
+    """(users, rate, window, count_var) of one stream's total arrival count.
+
+    ``count_var`` is the Poisson part plus the windowed user-draw part —
+    THE variance model behind both the aggregate ``max_requests`` bound
+    (:func:`_estimate_capacity`) and the per-stream slot slices
+    (:func:`_gen_slot_bounds`); one shared implementation keeps the two
+    bounds in lockstep.
+    """
+    users = float(workload.avg_active_users.mean)
+    rpu = float(workload.avg_request_per_minute_per_user.mean) / 60.0
+    rate = users * rpu
+    window = float(workload.user_sampling_window)
+    users_var = (
+        float(workload.avg_active_users.variance) ** 2
+        if workload.avg_active_users.variance is not None
+        else users  # Poisson users
+    )
+    n_windows = max(1.0, horizon / window)
+    count_var = rate * horizon + n_windows * users_var * (rpu * window) ** 2
+    return users, rate, window, count_var
+
+
+def _gen_slot_bounds(payload: SimulationPayload) -> np.ndarray:
+    """(G,) per-generator 6-sigma arrival-count bounds (the multi-generator
+    fast path gives each stream its own static slot slice)."""
+    horizon = float(payload.sim_settings.total_simulation_time)
+    out = []
+    for workload in payload.generators:
+        _, rate, _, count_var = _workload_count_model(workload, horizon)
+        out.append(
+            int(rate * horizon + 6.0 * math.sqrt(max(count_var, 1.0)) + 64),
+        )
+    return np.array(out, np.int64)
 
 
 def compile_payload(
@@ -1329,6 +1363,7 @@ def compile_payload(
             server_rate_limit=rate_limit_model,
             server_queue_timeout=queue_timeout_model,
             breaker_threshold=breaker_threshold,
+            gen_targets=[(int(k), int(t)) for _, k, t in gen_chains],
         )
     )
 
@@ -1412,6 +1447,7 @@ def compile_payload(
         gen_entry_target=np.array(
             [t for _, _, t in gen_chains], np.int32,
         ),
+        gen_slots=_gen_slot_bounds(payload),
         horizon=horizon,
         sample_period=sample_period,
         n_samples=n_samples,
@@ -1502,6 +1538,7 @@ def _fastpath_analysis(
     server_rate_limit: np.ndarray | None = None,
     server_queue_timeout: np.ndarray | None = None,
     breaker_threshold: int = 0,
+    gen_targets: list[tuple[int, int]] | None = None,
 ) -> tuple[bool, str, list[int], np.ndarray, int, float]:
     """Decide whether the scan engine can execute this plan faithfully.
 
@@ -1547,19 +1584,31 @@ def _fastpath_analysis(
             )
 
     if len(payload.generators) > 1:
-        # the closed-form arrival construction is single-stream; multiple
-        # generators run on the event engines (superposition semantics)
-        return (
-            False,
-            "multiple generators (modeled on the event engines)",
-            [],
-            no_slots,
-            0,
-            0.0,
-        )
-    workload = payload.generators[0]
-    users = float(workload.avg_active_users.mean)
-    rate = users * float(workload.avg_request_per_minute_per_user.mean) / 60.0
+        # Superposition rides the fast path (round 5c) when every stream
+        # converges on the SAME entry node: each stream synthesizes its
+        # own window-Poisson arrivals and walks its own entry chain on a
+        # disjoint static slot slice, and from the shared routing point on
+        # the pipeline is stream-agnostic.  Mixed entry targets would need
+        # per-slot routing topology — the event engines model those.
+        if gen_targets is not None and len(set(gen_targets)) > 1:
+            return (
+                False,
+                "multiple generators with distinct entry targets "
+                "(modeled on the event engines)",
+                [],
+                no_slots,
+                0,
+                0.0,
+            )
+    # every rate/burst bound below aggregates the superposed streams
+    # (identical to the single-stream values when G == 1)
+    users = sum(float(g.avg_active_users.mean) for g in payload.generators)
+    rate = sum(
+        float(g.avg_active_users.mean)
+        * float(g.avg_request_per_minute_per_user.mean)
+        / 60.0
+        for g in payload.generators
+    )
     burst_rate = rate * (1.0 + 3.0 / math.sqrt(max(users, 1.0)))
 
     lc_ring = 0

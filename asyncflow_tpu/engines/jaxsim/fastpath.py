@@ -429,7 +429,24 @@ class FastEngine:
         #: from the exact waits of a first-visits-only queue instead of 0
         #: (envelope experiments, docs/internals/fastpath.md §5)
         self.relax_init = "zero"
-        self.n = max_requests or plan.max_requests
+        if plan.n_generators > 1:
+            # superposition (round 5c): every stream owns a static
+            # contiguous slot slice sized by its own 6-sigma count bound;
+            # an explicit max_requests rescales the slices proportionally
+            # (the knob's contract is TOTAL capacity)
+            base = [int(x) for x in plan.gen_slots]
+            if max_requests:
+                total = sum(base)
+                scaled = [
+                    max(1, int(round(b * max_requests / total))) for b in base
+                ]
+                scaled[int(np.argmax(base))] += max_requests - sum(scaled)
+                base = [max(1, b) for b in scaled]
+            self.gen_n = base
+            self.n = sum(base)
+        else:
+            self.gen_n = []
+            self.n = max_requests or plan.max_requests
         self.n_windows = int(np.ceil(plan.horizon / plan.user_window))
         self.n_thr = int(np.ceil(plan.horizon)) or 1
         self.hist_lo, self.hist_scale = hist_constants(n_hist_bins)
@@ -567,24 +584,62 @@ class FastEngine:
     # ------------------------------------------------------------------
 
     def _arrivals(self, key, ov: ScenarioOverrides):
-        """(sim_times, valid) — simulation-clock arrival timestamps, sorted."""
+        """(sim_times, valid, overflow) — simulation-clock arrival times.
+
+        Single-stream plans produce one sorted vector; multi-generator
+        plans concatenate per-stream constructions (each sorted on its own
+        static slot slice — downstream consumers rank, they never assume
+        global slot-order sortedness)."""
         plan = self.plan
-        nw, n = self.n_windows, self.n
-        window = jnp.float32(plan.user_window)
+        if plan.n_generators > 1:
+            um = jnp.asarray(ov.user_mean)  # (G,)
+            rr = jnp.asarray(ov.req_rate)
+            ts, alives = [], []
+            overflow = jnp.int32(0)
+            for g in range(plan.n_generators):
+                t_g, v_g, of_g = self._arrivals_stream(
+                    jax.random.fold_in(key, 101 + g),
+                    um[g],
+                    rr[g],
+                    float(plan.gen_user_var[g]),
+                    float(plan.gen_window[g]),
+                    int(np.ceil(plan.horizon / float(plan.gen_window[g]))),
+                    self.gen_n[g],
+                )
+                ts.append(t_g)
+                alives.append(v_g)
+                overflow = overflow + of_g
+            return jnp.concatenate(ts), jnp.concatenate(alives), overflow
+        return self._arrivals_stream(
+            key,
+            ov.user_mean,
+            ov.req_rate,
+            plan.user_var,
+            plan.user_window,
+            self.n_windows,
+            self.n,
+        )
+
+    def _arrivals_stream(
+        self, key, user_mean, req_rate, user_var, window_s, nw, n,
+    ):
+        """One stream's window-Poisson arrival construction (sorted)."""
+        plan = self.plan
+        window = jnp.float32(window_s)
         starts = jnp.arange(nw, dtype=jnp.float32) * window
         ends = jnp.minimum(starts + window, plan.horizon)
         lens = ends - starts
 
-        if plan.user_var < 0:
+        if user_var < 0:
             users = jax.random.poisson(
                 _as_threefry(jax.random.fold_in(key, 1)),
-                jnp.maximum(ov.user_mean, _TINY),
+                jnp.maximum(user_mean, _TINY),
                 (nw,),
             ).astype(jnp.float32)
         else:
             z = jax.random.normal(jax.random.fold_in(key, 1), (nw,))
-            users = jnp.maximum(0.0, ov.user_mean + plan.user_var * z)
-        lam = users * ov.req_rate
+            users = jnp.maximum(0.0, user_mean + user_var * z)
+        lam = users * req_rate
 
         counts = jax.random.poisson(
             _as_threefry(jax.random.fold_in(key, 2)),
@@ -801,19 +856,53 @@ class FastEngine:
             return jnp.sum(jnp.where(on, amount * jnp.maximum(hi - lo, 0.0), 0.0))
 
         # ---- entry chain ------------------------------------------------
-        for j, eidx in enumerate(plan.entry_edges.tolist()):
-            # a send at t >= horizon never happens in the event engines
-            # (events past the horizon don't fire): freeze silently
-            alive = alive & (t < plan.horizon)
-            dropped, delay = self._edge_hop(
-                jax.random.fold_in(key, 16 + j), eidx, t, ov,
-            )
-            ok = alive & ~dropped
-            gauge = self._gauge_intervals(gauge, eidx, t, t + delay, 1.0, ok)
-            gauge_means = gauge_means.at[eidx].add(span(t, t + delay, ok))
-            n_dropped = n_dropped + jnp.sum(alive & dropped)
-            t = jnp.where(ok, t + delay, t)
-            alive = ok
+        # Each stream walks ITS chain on its static slot slice; all streams
+        # converge on the same entry node (compiler fence).  G == 1 is the
+        # whole-array special case (fold constants preserved: 16 + j).
+        if plan.n_generators > 1:
+            chains = [
+                plan.gen_entry_edges[g, : plan.gen_entry_len[g]].tolist()
+                for g in range(plan.n_generators)
+            ]
+            sizes = self.gen_n
+            stride = max(len(c) for c in chains)
+            fold_site = lambda g, j: 1024 + stride * g + j  # noqa: E731
+        else:
+            chains = [plan.entry_edges.tolist()]
+            sizes = [n]
+            fold_site = lambda g, j: 16 + j  # noqa: E731
+        off = 0
+        t_parts, alive_parts = [], []
+        for g, chain in enumerate(chains):
+            n_g = sizes[g]
+            t_g = t[off : off + n_g]
+            alive_g = alive[off : off + n_g]
+            for j, eidx in enumerate(chain):
+                # a send at t >= horizon never happens in the event engines
+                # (events past the horizon don't fire): freeze silently
+                alive_g = alive_g & (t_g < plan.horizon)
+                dropped, delay = self._edge_hop(
+                    jax.random.fold_in(key, fold_site(g, j)), eidx, t_g, ov,
+                )
+                ok = alive_g & ~dropped
+                gauge = self._gauge_intervals(
+                    gauge, eidx, t_g, t_g + delay, 1.0, ok,
+                )
+                gauge_means = gauge_means.at[eidx].add(
+                    span(t_g, t_g + delay, ok),
+                )
+                n_dropped = n_dropped + jnp.sum(alive_g & dropped)
+                t_g = jnp.where(ok, t_g + delay, t_g)
+                alive_g = ok
+            t_parts.append(t_g)
+            alive_parts.append(alive_g)
+            off += n_g
+        t = t_parts[0] if len(t_parts) == 1 else jnp.concatenate(t_parts)
+        alive = (
+            alive_parts[0]
+            if len(alive_parts) == 1
+            else jnp.concatenate(alive_parts)
+        )
 
         # ---- routing ----------------------------------------------------
         alive = alive & (t < plan.horizon)

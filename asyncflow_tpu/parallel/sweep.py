@@ -957,20 +957,20 @@ def _guard_overrides_against_plan(
     tier1 = len(plan.ram_slots) and bool(np.any(plan.ram_slots == -1))
     if not tier1 and plan.lc_ring == 0 and plan.relax_rho == 0.0:
         return
-    base = base_overrides(plan)
-    base_rate = float(base.user_mean) * float(base.req_rate)
-    max_rate = _sweep_max(overrides.user_mean) * _sweep_max(overrides.req_rate)
-    rate_raised = max_rate > base_rate * 1.001
+    # max over scenarios (and streams, on multi-generator plans) of the
+    # override rate relative to the base — the per-stream-aware scale
+    scale = _override_rate_scale(plan, overrides)
+    rate_raised = scale > 1.001
     # multi-burst relaxation envelope: eligibility was proven at the base
     # workload's utilization; a rate-scaling override moves every multi-burst
     # server's rho proportionally and must stay inside the envelope
-    if plan.relax_rho > 0.0 and base_rate > 0:
+    if plan.relax_rho > 0.0:
         from asyncflow_tpu.compiler.plan import RELAX_RHO_MAX
 
-        if plan.relax_rho * (max_rate / base_rate) > RELAX_RHO_MAX:
+        if plan.relax_rho * scale > RELAX_RHO_MAX:
             msg = (
                 "overrides scale the workload to utilization "
-                f"{plan.relax_rho * max_rate / base_rate:.2f} on a "
+                f"{plan.relax_rho * scale:.2f} on a "
                 f"multi-burst server, outside the relaxation's validity "
                 f"envelope ({RELAX_RHO_MAX}); use "
                 "SweepRunner(..., engine='event') for these scenarios"

@@ -97,10 +97,38 @@ class TestCompiler:
         assert plan.gen_rate.tolist() == pytest.approx([20 / 60, 40 / 60])
         assert plan.gen_entry_len.tolist() == [2, 2]
 
-    def test_fast_path_declines(self) -> None:
+    def test_fast_path_accepts_same_target_superposition(self) -> None:
+        # round 5c: per-stream slot slices make superposition eligible
+        # when every stream converges on the same entry node
         plan = compile_payload(_payload())
+        assert plan.fastpath_ok
+        assert plan.gen_slots.sum() > 8000  # covers both streams w/ slack
+
+    def test_fast_path_declines_distinct_targets(self) -> None:
+        # one stream entering at the LB and another directly at a server
+        # would need per-slot routing topology: event engines model it
+        data = yaml.safe_load(open(LB).read())
+        data["sim_settings"]["total_simulation_time"] = 60
+        data["rqs_input"] = [
+            dict(data["rqs_input"]),
+            {
+                "id": "rqs-2",
+                "avg_active_users": {"mean": 50},
+                "avg_request_per_minute_per_user": {"mean": 30},
+                "user_sampling_window": 30,
+            },
+        ]
+        data["topology_graph"]["edges"].append(
+            {
+                "id": "gen2-srv",
+                "source": "rqs-2",
+                "target": data["topology_graph"]["nodes"]["servers"][0]["id"],
+                "latency": {"mean": 0.004, "distribution": "exponential"},
+            },
+        )
+        plan = compile_payload(SimulationPayload.model_validate(data))
         assert not plan.fastpath_ok
-        assert "multiple generators" in plan.fastpath_reason
+        assert "distinct entry targets" in plan.fastpath_reason
 
     def test_pallas_models_multi_generator(self) -> None:
         # round 5 (late): per-stream lam tables + (S, G) arrival state
@@ -170,6 +198,42 @@ def test_three_engine_superposition_parity() -> None:
         lat_n = np.concatenate(lat_n)
         assert abs(gen_n / SEEDS - expected) / expected < 0.08
         assert abs(lat_n.mean() - lat_o.mean()) / lat_o.mean() < 0.05
+
+
+def test_fast_path_superposition_parity() -> None:
+    """Round 5c: the fast path's per-stream slot slices match the oracle's
+    superposed ensemble — pooled rate vs the expected composite rate, and
+    pooled mean/p95 vs the oracle, at the established multi-gen gates."""
+    from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+    from asyncflow_tpu.engines.jaxsim.params import hist_edges
+
+    p = _payload()
+    plan = compile_payload(p)
+    assert plan.fastpath_ok, plan.fastpath_reason
+    expected = (200 * 20 / 60 + 100 * 40 / 60) * 60  # 8000
+
+    lat_o = []
+    for s in range(SEEDS):
+        lat_o.append(OracleEngine(p, seed=s).run().latencies)
+    lat_o = np.concatenate(lat_o)
+
+    eng = FastEngine(plan)
+    fs = eng.run_batch(scenario_keys(11, 2 * SEEDS))
+    gen_f = int(np.asarray(fs.n_generated).sum())
+    assert abs(gen_f / (2 * SEEDS) - expected) / expected < 0.08
+    assert int(np.asarray(fs.n_overflow).sum()) == 0
+
+    mean_f = float(np.asarray(fs.lat_sum).sum()) / float(
+        np.asarray(fs.lat_count).sum(),
+    )
+    assert abs(mean_f - lat_o.mean()) / lat_o.mean() < 0.05
+
+    edges = hist_edges(eng.n_hist_bins)
+    hist = np.asarray(fs.hist).sum(0)
+    cum = np.cumsum(hist) / hist.sum()
+    p95_f = edges[min(int(np.searchsorted(cum, 0.95)) + 1, len(edges) - 1)]
+    p95_o = np.percentile(lat_o, 95)
+    assert abs(p95_f - p95_o) / p95_o < 0.06, (p95_f, p95_o)
 
 
 def test_traces_carry_generator_identity() -> None:
@@ -264,7 +328,9 @@ class TestPerGeneratorOverrides:
         from asyncflow_tpu.parallel import SweepRunner, make_overrides
 
         p = _payload(horizon=10)
-        sr = SweepRunner(p, use_mesh=False)
+        # round 5c: auto now routes eligible superpositions to the fast
+        # path, so the event engine is requested explicitly here
+        sr = SweepRunner(p, use_mesh=False, engine="event")
         assert sr.engine_kind == "event"
         n = 4
         um = np.stack(
@@ -276,6 +342,24 @@ class TestPerGeneratorOverrides:
         # stream 2 swept to zero: completions fall toward stream 1's rate
         assert c[0] > c[-1] * 1.2, c.tolist()
         # the zero-rate tail still completes stream 1's ~667 requests
+        assert c[-1] > 400
+
+    def test_fast_sweep_responds_per_stream(self) -> None:
+        # round 5c: (S, G) workload overrides ride the fast path's
+        # per-stream arrival slices directly
+        from asyncflow_tpu.parallel import SweepRunner, make_overrides
+
+        p = _payload(horizon=10)
+        sr = SweepRunner(p, use_mesh=False)
+        assert sr.engine_kind == "fast", sr.plan.fastpath_reason
+        n = 4
+        um = np.stack(
+            [np.full(n, 200.0), np.linspace(100.0, 0.0, n)], axis=1,
+        )
+        ov = make_overrides(sr.plan, n, user_mean=um)
+        rep = sr.run(n, seed=2, overrides=ov, chunk_size=n)
+        c = rep.results.completed
+        assert c[0] > c[-1] * 1.2, c.tolist()
         assert c[-1] > 400
 
     def test_native_sweep_responds_per_stream(self) -> None:
