@@ -9,9 +9,9 @@ behavioral reference and single-scenario runs.
 
 from asyncflow_tpu.builder.flow import AsyncFlow
 
-__version__ = "0.5.1"
+__version__ = "0.6.0"
 
-__all__ = ["AsyncFlow", "SimulationRunner", "__version__"]
+__all__ = ["AsyncFlow", "SimulationRunner", "TelemetryConfig", "__version__"]
 
 
 def __getattr__(name: str):
@@ -21,5 +21,9 @@ def __getattr__(name: str):
         from asyncflow_tpu.runtime.runner import SimulationRunner
 
         return SimulationRunner
+    if name == "TelemetryConfig":
+        from asyncflow_tpu.observability import TelemetryConfig
+
+        return TelemetryConfig
     msg = f"module 'asyncflow_tpu' has no attribute {name!r}"
     raise AttributeError(msg)

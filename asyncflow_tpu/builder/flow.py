@@ -7,7 +7,10 @@ One of the two front doors of the framework (the other is YAML through
 
 from __future__ import annotations
 
-from typing import Self
+try:
+    from typing import Self
+except ImportError:  # Python < 3.11
+    from typing_extensions import Self
 
 from asyncflow_tpu.config.constants import EventDescription
 from asyncflow_tpu.schemas.edges import Edge

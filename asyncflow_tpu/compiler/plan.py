@@ -802,6 +802,17 @@ def compile_payload(
     pool_size: int | None = None,
 ) -> StaticPlan:
     """Lower a validated payload to a :class:`StaticPlan`."""
+    from asyncflow_tpu.observability.telemetry import maybe_phase
+
+    with maybe_phase("build_plan"):
+        return _compile_payload(payload, pool_size=pool_size)
+
+
+def _compile_payload(
+    payload: SimulationPayload,
+    *,
+    pool_size: int | None = None,
+) -> StaticPlan:
     graph = payload.topology_graph
     settings = payload.sim_settings
     servers = graph.nodes.servers
@@ -882,9 +893,11 @@ def compile_payload(
     users_est = sum(
         float(g.avg_active_users.mean) for g in payload.generators
     )
-    # one burst-inflation model for every non-binding proof tier (DB pools,
-    # queue caps, and _fastpath_analysis's bounds use the same 3-sigma
-    # user-draw inflation — keep them in lockstep)
+    # one burst-inflation model for the non-binding proof tiers here (DB
+    # pools, queue caps).  _fastpath_analysis's lc_ring bound uses the
+    # per-stream variance-summed refinement of the same 3-sigma model
+    # (this pooled factor understates the burst on heterogeneous
+    # superpositions); at G == 1 the two are identical.
     burst_factor = 1.0 + 3.0 / math.sqrt(max(users_est, 1.0))
     db_model: list[bool] = []
     proof_rate_headroom = math.inf
@@ -1601,15 +1614,24 @@ def _fastpath_analysis(
                 0.0,
             )
     # every rate/burst bound below aggregates the superposed streams
-    # (identical to the single-stream values when G == 1)
-    users = sum(float(g.avg_active_users.mean) for g in payload.generators)
-    rate = sum(
-        float(g.avg_active_users.mean)
-        * float(g.avg_request_per_minute_per_user.mean)
-        / 60.0
-        for g in payload.generators
-    )
-    burst_rate = rate * (1.0 + 3.0 / math.sqrt(max(users, 1.0)))
+    # (identical to the single-stream values when G == 1).  The 3-sigma
+    # burst allowance sums PER-STREAM variances: a heterogeneous
+    # superposition (many low-rate users + few high-rate users) has a
+    # larger summed-rate sigma than the pooled-user formula admits, and
+    # the lc_ring below must be sized from the true bound.  Per stream the
+    # rate sigma is ~rpu*sqrt(users) (Poisson-count scale); streams with
+    # users < 1 cap their contribution at the full stream rate, matching
+    # the old formula's sqrt(max(users, 1)) guard at G == 1.
+    rate = 0.0
+    rate_var = 0.0
+    for g in payload.generators:
+        users_g = float(g.avg_active_users.mean)
+        rpu_g = float(g.avg_request_per_minute_per_user.mean) / 60.0
+        rate += users_g * rpu_g
+        rate_var += (
+            users_g * rpu_g * rpu_g if users_g >= 1.0 else (users_g * rpu_g) ** 2
+        )
+    burst_rate = rate + 3.0 * math.sqrt(rate_var)
 
     lc_ring = 0
     if lb is not None and lb_algo != 0:

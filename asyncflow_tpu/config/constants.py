@@ -17,7 +17,16 @@ Organisation:
 
 from __future__ import annotations
 
-from enum import Enum, IntEnum, StrEnum
+from enum import Enum, IntEnum
+
+try:
+    from enum import StrEnum
+except ImportError:  # Python < 3.11
+    class StrEnum(str, Enum):
+        """Backport of :class:`enum.StrEnum`: members are their values."""
+
+        def __str__(self) -> str:  # pragma: no cover - mirrors 3.11 behavior
+            return str(self.value)
 
 # ---------------------------------------------------------------------------
 # Random variables & workload

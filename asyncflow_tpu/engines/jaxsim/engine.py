@@ -66,6 +66,7 @@ from asyncflow_tpu.engines.jaxsim.sampling import (
     truncated_normal,
 )
 from asyncflow_tpu.engines.jaxsim.sortutil import searchsorted_small
+from asyncflow_tpu.observability.telemetry import instrument_jit
 from asyncflow_tpu.engines.results import SimulationResults, SweepResults
 from asyncflow_tpu.schemas.payload import SimulationPayload
 from asyncflow_tpu.engines.jaxsim.rotation import (
@@ -1513,8 +1514,11 @@ class Engine:
         )
         sig = ("init", tuple(axes))
         if sig not in self._compiled:
-            self._compiled[sig] = jax.jit(
-                jax.vmap(self._init_state, in_axes=(0, axes)),
+            self._compiled[sig] = instrument_jit(
+                jax.jit(jax.vmap(self._init_state, in_axes=(0, axes))),
+                engine="event",
+                variant="init",
+                pool=self.plan.pool_size,
             )
         return self._compiled[sig](keys, ov)
 
@@ -1563,16 +1567,21 @@ class Engine:
                     cond, lambda s: self._body(s, ov_, w), st,
                 )
 
-            self._compiled[sig] = jax.jit(
-                jax.vmap(
-                    one,
-                    in_axes=(
-                        0,
-                        0 if batched_stop else None,
-                        axes,
-                        0 if has_w else None,
+            self._compiled[sig] = instrument_jit(
+                jax.jit(
+                    jax.vmap(
+                        one,
+                        in_axes=(
+                            0,
+                            0 if batched_stop else None,
+                            axes,
+                            0 if has_w else None,
+                        ),
                     ),
                 ),
+                engine="event",
+                variant="until",
+                pool=self.plan.pool_size,
             )
         if has_w:
             weights = jnp.asarray(weights, jnp.float32)
@@ -1595,8 +1604,11 @@ class Engine:
         )
         sig = tuple(axes)
         if sig not in self._compiled:
-            self._compiled[sig] = jax.jit(
-                jax.vmap(self._run_one, in_axes=(0, axes)),
+            self._compiled[sig] = instrument_jit(
+                jax.jit(jax.vmap(self._run_one, in_axes=(0, axes))),
+                engine="event",
+                variant="vmap",
+                pool=self.plan.pool_size,
             )
         return self._compiled[sig](keys, ov)
 

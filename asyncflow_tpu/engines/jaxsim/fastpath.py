@@ -94,6 +94,7 @@ from asyncflow_tpu.engines.jaxsim.rotation import (
     rotation_remove,
 )
 from asyncflow_tpu.engines.jaxsim.sortutil import searchsorted_small, time_rank
+from asyncflow_tpu.observability.telemetry import instrument_jit
 from asyncflow_tpu.engines.jaxsim.sampling import (
     as_threefry as _as_threefry,
     D_EXPONENTIAL as _D_EXPONENTIAL,
@@ -433,15 +434,41 @@ class FastEngine:
             # superposition (round 5c): every stream owns a static
             # contiguous slot slice sized by its own 6-sigma count bound;
             # an explicit max_requests rescales the slices proportionally
-            # (the knob's contract is TOTAL capacity)
+            # (the knob's contract is TOTAL capacity: the slices must sum
+            # to exactly max_requests with every stream keeping >= 1 slot)
             base = [int(x) for x in plan.gen_slots]
             if max_requests:
+                if max_requests < len(base):
+                    msg = (
+                        f"max_requests={max_requests} cannot cover "
+                        f"{len(base)} generator streams (every stream "
+                        "needs at least one slot)"
+                    )
+                    raise ValueError(msg)
                 total = sum(base)
-                scaled = [
-                    max(1, int(round(b * max_requests / total))) for b in base
-                ]
-                scaled[int(np.argmax(base))] += max_requests - sum(scaled)
-                base = [max(1, b) for b in scaled]
+                shares = [b * max_requests / total for b in base]
+                scaled = [max(1, int(s)) for s in shares]
+                # settle the rounding residual largest-remainder-first so
+                # the total lands exactly on max_requests without driving
+                # any slice below 1 (max_requests >= n_generators above
+                # guarantees enough >1 slices to absorb a deficit)
+                by_frac = sorted(
+                    range(len(base)),
+                    key=lambda g: shares[g] - int(shares[g]),
+                    reverse=True,
+                )
+                residual = max_requests - sum(scaled)
+                i = 0
+                while residual != 0:
+                    g = by_frac[i % len(base)]
+                    if residual > 0:
+                        scaled[g] += 1
+                        residual -= 1
+                    elif scaled[g] > 1:
+                        scaled[g] -= 1
+                        residual += 1
+                    i += 1
+                base = scaled
             self.gen_n = base
             self.n = sum(base)
         else:
@@ -1478,7 +1505,12 @@ class FastEngine:
         )
         sig = tuple(axes)
         if sig not in self._compiled:
-            self._compiled[sig] = jax.jit(jax.vmap(self._run_one, in_axes=(0, axes)))
+            self._compiled[sig] = instrument_jit(
+                jax.jit(jax.vmap(self._run_one, in_axes=(0, axes))),
+                engine="fast",
+                variant="vmap",
+                n=self.n,
+            )
         return self._compiled[sig](keys, ov)
 
     def scanned_fn(self):
@@ -1575,7 +1607,14 @@ class FastEngine:
         blocks = t // inner
         sig = ("scan", inner, blocks)
         if sig not in self._compiled:
-            self._compiled[sig] = jax.jit(self.scanned_fn())
+            self._compiled[sig] = instrument_jit(
+                jax.jit(self.scanned_fn()),
+                engine="fast",
+                variant="scan",
+                inner=inner,
+                blocks=blocks,
+                n=self.n,
+            )
         out = self._compiled[sig](keys_b, ov_b)
         return jax.tree_util.tree_map(
             lambda a: a.reshape((t, *a.shape[2:]))[:s], out,

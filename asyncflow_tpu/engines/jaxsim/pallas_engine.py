@@ -1800,5 +1800,14 @@ class PallasEngine:
                     out_specs=(row_p,) * 5,
                     check_vma=False,
                 )
-            self._compiled[sig] = jax.jit(call)
+            from asyncflow_tpu.observability.telemetry import instrument_jit
+
+            self._compiled[sig] = instrument_jit(
+                jax.jit(call),
+                engine="pallas",
+                variant="interpret" if interpret else "mosaic",
+                block=blk,
+                blocks=nblk,
+                n_dev=n_dev,
+            )
         return self._compiled[sig]

@@ -111,6 +111,16 @@ def _ffi_rank(keys: jnp.ndarray) -> jnp.ndarray:
     return rank
 
 
+#: above this table length the dense compare matrix loses to binary search:
+#: the (q, len(table)) intermediate grows unbounded with the table (a 1 s
+#: user window over a 3600 s horizon gives a 3600-entry table; with ~1e5
+#: query slots that is a ~4e8-element broadcast the log-n search never
+#: materializes), while the while-loop overhead the dense form exists to
+#: avoid is only ~14 ms per call on TPU — a few hundred entries is where
+#: the trade flips
+DENSE_TABLE_MAX = 256
+
+
 def searchsorted_small(table: jnp.ndarray, q: jnp.ndarray, side: str) -> jnp.ndarray:
     """Exact ``jnp.searchsorted`` for a SMALL sorted 1-D ``table``.
 
@@ -121,10 +131,16 @@ def searchsorted_small(table: jnp.ndarray, q: jnp.ndarray, side: str) -> jnp.nda
     compares, fused, gather-free:
     ``side='right'`` counts ``table <= q``; ``side='left'`` counts
     ``table < q`` — the textbook insertion-point definitions.
+
+    Tables longer than :data:`DENSE_TABLE_MAX` fall back to the log-n
+    ``jnp.searchsorted`` — the dense compare matrix is a memory/latency
+    cliff there, not an optimization.
     """
     if side not in ("left", "right"):
         msg = f"side must be 'left' or 'right', got {side!r}"
         raise ValueError(msg)
+    if table.shape[-1] > DENSE_TABLE_MAX:
+        return jnp.searchsorted(table, q, side=side).astype(jnp.int32)
     cmp = table <= q[..., None] if side == "right" else table < q[..., None]
     return jnp.sum(cmp, axis=-1).astype(jnp.int32)
 

@@ -6,11 +6,34 @@ shape so the analyzer, plots, and parity tests are backend-blind.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import asdict, dataclass, field
 
 import numpy as np
 
 from asyncflow_tpu.schemas.settings import SimulationSettings
+
+
+@dataclass(frozen=True)
+class DeviceCounters:
+    """Unified request-accounting counters, identical across every engine.
+
+    One schema for the oracle, the native core, the JAX event engine, the
+    fast path, and the Pallas kernel — the telemetry layer and the parity
+    tests read these instead of engine-specific fields.  ``rejected`` is the
+    overload-policy shed count; ``overflow`` the request-pool drop count
+    (JAX engines only; always 0 on the oracle); ``truncated`` the number of
+    scenarios cut short by the event engine's iteration safety cap.
+    """
+
+    completed: int
+    generated: int
+    dropped: int
+    overflow: int
+    rejected: int
+    truncated: int = 0
+
+    def as_dict(self) -> dict[str, int]:
+        return asdict(self)
 
 
 @dataclass
@@ -54,6 +77,17 @@ class SimulationResults:
         if self.rqs_clock.size == 0:
             return np.empty(0, dtype=np.float64)
         return self.rqs_clock[:, 1] - self.rqs_clock[:, 0]
+
+    def counters(self) -> DeviceCounters:
+        """The unified counter schema (``completed`` counts recorded clock
+        rows, so engines run with ``collect_clocks=False`` report 0)."""
+        return DeviceCounters(
+            completed=int(self.rqs_clock.shape[0]),
+            generated=int(self.total_generated),
+            dropped=int(self.total_dropped),
+            overflow=int(self.overflow_dropped),
+            rejected=int(self.total_rejected),
+        )
 
 
 @dataclass
@@ -145,6 +179,23 @@ class SweepResults:
     def percentile(self, q: float) -> np.ndarray:
         """Per-scenario latency percentile estimated from the histograms."""
         return hist_percentile(self.latency_hist, self.hist_edges, q)
+
+    def counters(self) -> DeviceCounters:
+        """Sweep-total unified counters (summed over the scenario axis)."""
+        return DeviceCounters(
+            completed=int(np.sum(self.completed)),
+            generated=int(np.sum(self.total_generated)),
+            dropped=int(np.sum(self.total_dropped)),
+            overflow=int(np.sum(self.overflow_dropped)),
+            rejected=(
+                int(np.sum(self.total_rejected))
+                if self.total_rejected is not None
+                else 0
+            ),
+            truncated=(
+                int(np.sum(self.truncated)) if self.truncated is not None else 0
+            ),
+        )
 
 
 def hist_percentile(

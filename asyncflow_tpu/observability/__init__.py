@@ -1,0 +1,55 @@
+"""Structured run telemetry: phase timers, compile ledger, device counters,
+and Chrome-trace/Perfetto export.
+
+The first-class home of the instrumentation the perf milestones were built
+with.  Entry points:
+
+- :class:`TelemetryConfig` — what to record and where; pass as
+  ``SimulationRunner(..., telemetry=...)`` or
+  ``SweepRunner(..., telemetry=...)``.
+- :class:`RunTelemetry` — the per-run collector (constructed internally by
+  the runners; construct directly to instrument custom loops).
+- :class:`CompileLedger` — the persistent jit/AOT compile log beside
+  ``.jax_cache``.
+- :mod:`~asyncflow_tpu.observability.report` — device-trace summaries
+  (the promoted ``scripts/trace_summary.py``).
+
+See docs/guides/observability.md for the workflow.
+"""
+
+from asyncflow_tpu.observability.export import (
+    load_chrome_trace,
+    read_run_records,
+    validate_run_record,
+    write_chrome_trace,
+)
+from asyncflow_tpu.observability.ledger import CompileLedger, default_ledger_path
+from asyncflow_tpu.observability.phases import PHASES, PhaseRecord, PhaseTimer
+from asyncflow_tpu.observability.telemetry import (
+    RUN_RECORD_SCHEMA,
+    RunTelemetry,
+    TelemetryConfig,
+    current_telemetry,
+    instrument_jit,
+    maybe_phase,
+    telemetry_session,
+)
+
+__all__ = [
+    "PHASES",
+    "RUN_RECORD_SCHEMA",
+    "CompileLedger",
+    "PhaseRecord",
+    "PhaseTimer",
+    "RunTelemetry",
+    "TelemetryConfig",
+    "current_telemetry",
+    "default_ledger_path",
+    "instrument_jit",
+    "load_chrome_trace",
+    "maybe_phase",
+    "read_run_records",
+    "telemetry_session",
+    "validate_run_record",
+    "write_chrome_trace",
+]

@@ -1,0 +1,185 @@
+"""Exporters: JSONL run records and Chrome-trace/Perfetto host timelines.
+
+The Chrome trace format (the ``traceEvents`` JSON that Perfetto,
+``chrome://tracing``, and ``scripts/trace_summary.py`` all read) is the
+lingua franca of this repo's profiling work; the host phase timeline is
+emitted in the same format so one UI shows both the XLA device trace
+(``jax.profiler``) and the library's own phase spans.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+from pathlib import Path
+
+from asyncflow_tpu.observability.phases import PHASES, PhaseTimer
+
+#: synthetic pid/tid for the host phase track (Chrome traces need both)
+HOST_PID = 1
+HOST_TID = 1
+
+
+def chrome_trace_events(
+    timer: PhaseTimer,
+    *,
+    counters: dict | None = None,
+    label: str = "asyncflow-run",
+) -> list[dict]:
+    """Phase records -> Chrome ``traceEvents`` (complete 'X' spans).
+
+    Timestamps are microseconds from the timer's epoch; chunk-tagged spans
+    carry the chunk index in ``args`` so Perfetto can group/filter them.
+    Counter totals are appended as one 'C' (counter) event at the end of
+    the timeline.
+    """
+    events: list[dict] = [
+        {
+            "ph": "M",
+            "pid": HOST_PID,
+            "name": "process_name",
+            "args": {"name": f"asyncflow host ({label})"},
+        },
+        {
+            "ph": "M",
+            "pid": HOST_PID,
+            "tid": HOST_TID,
+            "name": "thread_name",
+            "args": {"name": "run phases"},
+        },
+    ]
+    end_us = 0.0
+    for rec in timer.events:
+        args: dict = {}
+        if rec.chunk is not None:
+            args["chunk"] = rec.chunk
+        if rec.meta:
+            args.update(rec.meta)
+        start_us = rec.start_s * 1e6
+        dur_us = rec.duration_s * 1e6
+        end_us = max(end_us, start_us + dur_us)
+        events.append(
+            {
+                "ph": "X",
+                "pid": HOST_PID,
+                "tid": HOST_TID,
+                "name": rec.name,
+                "ts": start_us,
+                "dur": dur_us,
+                "args": args,
+            },
+        )
+    if counters:
+        events.append(
+            {
+                "ph": "C",
+                "pid": HOST_PID,
+                "name": "device counters",
+                "ts": end_us,
+                "args": {k: int(v) for k, v in counters.items()},
+            },
+        )
+    return events
+
+
+def write_chrome_trace(
+    path: str | Path,
+    timer: PhaseTimer,
+    *,
+    counters: dict | None = None,
+    label: str = "asyncflow-run",
+) -> Path:
+    """Write the host phase timeline as a Chrome-trace file.
+
+    ``path`` ending in ``.gz`` writes gzip (the format
+    ``scripts/trace_summary.py`` and Perfetto both accept).
+    """
+    path = Path(path)
+    payload = {
+        "displayTimeUnit": "ms",
+        "traceEvents": chrome_trace_events(timer, counters=counters, label=label),
+    }
+    path.parent.mkdir(parents=True, exist_ok=True)
+    data = json.dumps(payload).encode()
+    if path.suffix == ".gz":
+        with gzip.open(path, "wb") as fh:
+            fh.write(data)
+    else:
+        path.write_bytes(data)
+    return path
+
+
+def load_chrome_trace(path: str | Path) -> dict:
+    """Read a Chrome-trace file written by :func:`write_chrome_trace`."""
+    path = Path(path)
+    if path.suffix == ".gz":
+        with gzip.open(path, "rb") as fh:
+            return json.load(fh)
+    return json.loads(path.read_text())
+
+
+def read_run_records(path: str | Path) -> list[dict]:
+    """Load every run record from a telemetry JSONL file (oldest first)."""
+    path = Path(path)
+    if not path.exists():
+        return []
+    out = []
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue  # torn tail line from a killed process
+    return out
+
+
+def validate_run_record(record: dict) -> list[str]:
+    """Schema check for one run record; returns problems (empty = valid).
+
+    The smoke tier runs this against a fresh record so schema drift is
+    caught per-commit without loading an accelerator.
+    """
+    problems: list[str] = []
+
+    def need(key: str, types) -> None:
+        if key not in record:
+            problems.append(f"missing key {key!r}")
+        elif not isinstance(record[key], types):
+            problems.append(
+                f"{key!r} has type {type(record[key]).__name__}, "
+                f"expected {types}",
+            )
+
+    need("schema", str)
+    need("ts", (int, float))
+    need("kind", str)
+    need("phase_totals_s", dict)
+    need("phases", list)
+    need("compiles", list)
+    need("counters", dict)
+    if problems:
+        return problems
+    if not record["schema"].startswith("asyncflow-telemetry/"):
+        problems.append(f"unknown schema {record['schema']!r}")
+    for i, ph in enumerate(record["phases"]):
+        for key in ("name", "start_s", "duration_s"):
+            if key not in ph:
+                problems.append(f"phases[{i}] missing {key!r}")
+        if ph.get("duration_s", 0) < 0:
+            problems.append(f"phases[{i}] negative duration")
+    known = set(PHASES)
+    for name in record["phase_totals_s"]:
+        if name not in known and not name.startswith("x-"):
+            # unknown phases are allowed but must opt in via the x- prefix,
+            # so typos in canonical names fail the smoke tier loudly
+            problems.append(f"non-canonical phase name {name!r}")
+    for i, c in enumerate(record["compiles"]):
+        for key in ("key", "engine", "cache_hit"):
+            if key not in c:
+                problems.append(f"compiles[{i}] missing {key!r}")
+    for key, value in record["counters"].items():
+        if not isinstance(value, (int, float)):
+            problems.append(f"counter {key!r} is not numeric")
+    return problems

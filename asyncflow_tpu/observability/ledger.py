@@ -1,0 +1,110 @@
+"""Persistent compile ledger: every jit/AOT compile, on the record.
+
+The compile pathology work (docs/internals/compile-pathology.md) was
+reconstructed from scattered session logs; the ledger makes that history a
+first-class artifact.  One JSONL file lives beside the persistent XLA
+compile cache (``.jax_cache`` — :mod:`asyncflow_tpu.utils.compile_cache`)
+and every library-level compile appends one line::
+
+    {"ts": ..., "key": "...", "engine": "fast", "variant": "scan",
+     "shape": {"chunk": 512, "inner": 16, "blocks": 32}, "lower_s": ...,
+     "compile_s": ..., "cache_hit": false, "backend": "tpu", "pid": ...}
+
+``cache_hit`` is the *ledger's* warm/cold verdict: a program key already
+recorded by an earlier process should be served by the persistent XLA
+cache, so its re-compile is a cache load, not a fresh XLA compile.  The
+duration columns keep the verdict honest — a "hit" at cold-compile cost is
+the signal the cache directory was moved or evicted.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+
+_SCHEMA = "asyncflow-compile-ledger/1"
+LEDGER_BASENAME = "compile_ledger.jsonl"
+
+
+def default_ledger_path() -> str:
+    """The ledger's home: beside the persistent XLA compile cache."""
+    from asyncflow_tpu.utils.compile_cache import cache_location
+
+    return os.path.join(os.path.dirname(cache_location()), LEDGER_BASENAME)
+
+
+class CompileLedger:
+    """Append-only JSONL compile log with warm/cold detection.
+
+    Construction loads the keys of every prior entry; :meth:`record`
+    appends one entry, marking ``cache_hit`` when the key was already on
+    file (a previous process — or an earlier chunk shape of this one —
+    compiled the same program).
+    """
+
+    def __init__(self, path: str | Path | None = None) -> None:
+        self.path = Path(path) if path is not None else Path(default_ledger_path())
+        self._seen: set[str] = set()
+        if self.path.exists():
+            for line in self.path.read_text().splitlines():
+                try:
+                    entry = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail line from a killed process
+                key = entry.get("key")
+                if key:
+                    self._seen.add(key)
+
+    def __len__(self) -> int:
+        return len(self._seen)
+
+    def seen(self, key: str) -> bool:
+        return key in self._seen
+
+    def record(
+        self,
+        key: str,
+        *,
+        engine: str,
+        variant: str = "",
+        shape: dict | None = None,
+        lower_s: float | None = None,
+        compile_s: float | None = None,
+        backend: str = "",
+        extra: dict | None = None,
+    ) -> dict:
+        """Append one compile entry; returns it (with the hit verdict)."""
+        entry = {
+            "schema": _SCHEMA,
+            "ts": time.time(),
+            "key": key,
+            "engine": engine,
+            "variant": variant,
+            "shape": shape or {},
+            "lower_s": round(lower_s, 6) if lower_s is not None else None,
+            "compile_s": round(compile_s, 6) if compile_s is not None else None,
+            "cache_hit": key in self._seen,
+            "backend": backend,
+            "pid": os.getpid(),
+        }
+        if extra:
+            entry.update(extra)
+        self._seen.add(key)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        with self.path.open("a") as fh:
+            fh.write(json.dumps(entry) + "\n")
+        return entry
+
+    def entries(self) -> list[dict]:
+        """Every parseable entry currently on file (oldest first)."""
+        if not self.path.exists():
+            return []
+        out = []
+        for line in self.path.read_text().splitlines():
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+        return out

@@ -1,0 +1,130 @@
+"""Phase timers: the run-level host timeline.
+
+:class:`PhaseTimer` extends :class:`asyncflow_tpu.utils.profiling.Stopwatch`
+(the tiny accumulator the ad-hoc perf scripts used) with an *event record*
+per section — start/end wall offsets plus an optional chunk tag — so a run
+can be replayed as a timeline (Chrome trace / Perfetto) instead of only a
+totals table.  The canonical phase names are the run pipeline stages::
+
+    validate -> build_plan -> lower -> compile -> transfer -> execute
+             -> fetch -> postprocess
+
+Phases may nest (``execute`` wraps ``lower``/``compile`` on a cold chunk);
+the exporter renders nesting as stacked spans on one track.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections.abc import Iterator
+from dataclasses import dataclass, field
+
+from asyncflow_tpu.utils.profiling import Stopwatch
+
+#: the canonical pipeline phases, in order (exporters sort unknown names last)
+PHASES = (
+    "validate",
+    "build_plan",
+    "lower",
+    "compile",
+    "transfer",
+    "execute",
+    "fetch",
+    "postprocess",
+)
+
+
+@dataclass(frozen=True)
+class PhaseRecord:
+    """One timed section: a closed span on the host timeline."""
+
+    name: str
+    #: seconds since the timer's epoch (its construction)
+    start_s: float
+    duration_s: float
+    #: sweep chunk index the span belongs to (None for run-level phases)
+    chunk: int | None = None
+    #: free-form annotations (program signature, shape, ...)
+    meta: dict | None = None
+
+    def as_dict(self) -> dict:
+        out = {
+            "name": self.name,
+            "start_s": round(self.start_s, 6),
+            "duration_s": round(self.duration_s, 6),
+        }
+        if self.chunk is not None:
+            out["chunk"] = self.chunk
+        if self.meta:
+            out["meta"] = self.meta
+        return out
+
+
+@dataclass
+class PhaseTimer(Stopwatch):
+    """Stopwatch that also keeps the per-section event records.
+
+    ``sections`` (inherited) stays the name -> total-seconds accumulator;
+    ``events`` is the ordered span list the exporters consume.
+    """
+
+    events: list[PhaseRecord] = field(default_factory=list)
+    epoch: float = field(default_factory=time.perf_counter)
+    #: wall-clock (epoch seconds) at construction, so exported timelines can
+    #: be aligned across processes
+    epoch_unix: float = field(default_factory=time.time)
+
+    @contextlib.contextmanager
+    def section(
+        self,
+        name: str,
+        *,
+        chunk: int | None = None,
+        meta: dict | None = None,
+    ) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            end = time.perf_counter()
+            self.sections[name] = self.sections.get(name, 0.0) + end - start
+            self.events.append(
+                PhaseRecord(
+                    name=name,
+                    start_s=start - self.epoch,
+                    duration_s=end - start,
+                    chunk=chunk,
+                    meta=meta,
+                ),
+            )
+
+    def record(
+        self,
+        name: str,
+        duration_s: float,
+        *,
+        start_s: float = 0.0,
+        chunk: int | None = None,
+        meta: dict | None = None,
+    ) -> None:
+        """Append an externally-measured span (e.g. a front door's
+        validation cost measured before the timer existed)."""
+        self.sections[name] = self.sections.get(name, 0.0) + duration_s
+        self.events.append(
+            PhaseRecord(
+                name=name,
+                start_s=start_s,
+                duration_s=duration_s,
+                chunk=chunk,
+                meta=meta,
+            ),
+        )
+
+    def phase_totals(self) -> dict[str, float]:
+        """name -> accumulated seconds, canonical phases first."""
+        known = {p: self.sections[p] for p in PHASES if p in self.sections}
+        rest = {
+            k: v for k, v in sorted(self.sections.items()) if k not in known
+        }
+        return {**known, **rest}

@@ -1,0 +1,136 @@
+"""Device-trace reporting: ``scripts/trace_summary.py``, as a library.
+
+The round-5 profile analysis (68% of device time in sortutil's rank
+machinery) was produced by an ad-hoc script; the TPU session ladders now
+consume these functions instead of forking it.  Input is a
+``jax.profiler`` trace directory (or an already-loaded Chrome trace dict —
+including the host timelines :mod:`asyncflow_tpu.observability.export`
+writes); output is a structured summary plus a formatter for the ladder
+logs.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+
+
+def find_trace_files(prof_dir: str | Path) -> list[str]:
+    """Every ``*.trace.json.gz`` under a ``jax.profiler`` directory
+    (sorted; the newest — last — is the one a summary should use)."""
+    return sorted(
+        glob.glob(
+            os.path.join(str(prof_dir), "**", "*.trace.json.gz"),
+            recursive=True,
+        ),
+    )
+
+
+def load_trace(prof_dir: str | Path) -> dict:
+    """Load the newest ``*.trace.json.gz`` under a ``jax.profiler`` dir.
+
+    Also accepts a direct path to a ``.json``/``.json.gz`` trace file (the
+    host timelines written by
+    :func:`asyncflow_tpu.observability.export.write_chrome_trace`).
+    """
+    prof_dir = str(prof_dir)
+    if os.path.isfile(prof_dir):
+        if prof_dir.endswith(".gz"):
+            with gzip.open(prof_dir) as f:
+                return json.load(f)
+        with open(prof_dir) as f:
+            return json.load(f)
+    paths = find_trace_files(prof_dir)
+    if not paths:
+        msg = f"no *.trace.json.gz under {prof_dir}"
+        raise FileNotFoundError(msg)
+    with gzip.open(paths[-1]) as f:
+        return json.load(f)
+
+
+@dataclass
+class TraceSummary:
+    """Device time attributed by op and by source line."""
+
+    #: pid -> process name, straight from the trace metadata
+    processes: dict[int, str | None] = field(default_factory=dict)
+    #: total attributed device op microseconds (nested ops double-count
+    #: inside their parents — same caveat the script always carried)
+    total_us: int = 0
+    #: op name -> device microseconds
+    by_op: dict[str, int] = field(default_factory=dict)
+    #: source attribution -> device microseconds
+    by_source: dict[str, int] = field(default_factory=dict)
+
+    def top_ops(self, n: int = 15) -> list[tuple[str, int]]:
+        return collections.Counter(self.by_op).most_common(n)
+
+    def top_sources(self, n: int = 15) -> list[tuple[str, int]]:
+        return collections.Counter(self.by_source).most_common(n)
+
+
+def summarize_trace(trace: dict) -> TraceSummary:
+    """Device time by op and by source from a loaded Chrome trace.
+
+    Device processes are recognized by "TPU"/"GPU" in their process name;
+    the outermost ``jit_*`` containers are skipped to avoid double counting
+    in the total (exactly the old script's accounting).
+    """
+    ev = trace["traceEvents"]
+    pids = {
+        e["pid"]: e["args"].get("name")
+        for e in ev
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    device_pids = {
+        p for p, n in pids.items() if n and ("TPU" in n or "GPU" in n)
+    }
+
+    summary = TraceSummary(processes=pids)
+    by_op: collections.Counter = collections.Counter()
+    by_src: collections.Counter = collections.Counter()
+    for e in ev:
+        if e.get("ph") != "X" or e.get("pid") not in device_pids:
+            continue
+        name = e.get("name", "?")
+        if name.startswith("jit_"):
+            continue
+        dur = e.get("dur", 0)
+        by_op[name] += dur
+        summary.total_us += dur
+        src = (e.get("args") or {}).get("source")
+        if src:
+            by_src[src] += dur
+    summary.by_op = dict(by_op)
+    summary.by_source = dict(by_src)
+    return summary
+
+
+def format_summary(summary: TraceSummary, *, top: int = 15) -> str:
+    """The ladder-log report (the old script's stdout, verbatim shape)."""
+    lines = [
+        f"processes: { {p: n for p, n in summary.processes.items()} }",
+        "",
+        f"attributed device op time: {summary.total_us / 1e6:.2f}s "
+        "(nested ops double-count inside their parents)",
+        "",
+        f"== top {top} device ops ==",
+    ]
+    lines += [
+        f"  {d / 1e6:8.3f}s  {name[:100]}" for name, d in summary.top_ops(top)
+    ]
+    lines += ["", f"== top {top} source attributions =="]
+    lines += [
+        f"  {d / 1e6:8.3f}s  {src}" for src, d in summary.top_sources(top)
+    ]
+    return "\n".join(lines)
+
+
+def summarize_profile_dir(prof_dir: str | Path, *, top: int = 15) -> str:
+    """One-call convenience: load + summarize + format."""
+    return format_summary(summarize_trace(load_trace(prof_dir)), top=top)

@@ -1,0 +1,344 @@
+"""Run telemetry: config, collection context, and the engine compile hook.
+
+The measurement substrate of the ROADMAP's "fast as the hardware allows"
+goal: every run (single scenario or sweep) can record phase timers, compile
+ledger entries, and unified device counters, and export them as JSONL run
+records plus a Chrome-trace/Perfetto host timeline — the instrumentation
+the ad-hoc perf scripts (``scripts/trace_summary.py`` & co.) used to fork.
+
+Design constraints, in order:
+
+1. **Telemetry off is free and bit-identical.**  With no active
+   :class:`RunTelemetry`, every hook is a ``None`` check; engines run the
+   exact same jit path as before this module existed.
+2. **Telemetry on is bit-identical too.**  The compile hook swaps lazy jit
+   dispatch for an explicit trace→lower→compile of the *same* program
+   (that split is what lets the ledger time the stages); the executable is
+   identical, so metrics are too — a test locks this.
+3. **No jax at import.**  The module is importable by the numpy-only
+   compiler layer; jax is only touched inside an active telemetry context.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import time
+from collections.abc import Iterator
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from asyncflow_tpu.observability.ledger import CompileLedger
+from asyncflow_tpu.observability.phases import PhaseRecord, PhaseTimer
+
+#: run-record schema version (bump on breaking field changes)
+RUN_RECORD_SCHEMA = "asyncflow-telemetry/1"
+
+_current: contextvars.ContextVar[RunTelemetry | None] = contextvars.ContextVar(
+    "asyncflow_telemetry", default=None,
+)
+
+
+def current_telemetry() -> RunTelemetry | None:
+    """The telemetry collector active in this context, if any."""
+    return _current.get()
+
+
+@contextlib.contextmanager
+def maybe_phase(
+    name: str,
+    *,
+    chunk: int | None = None,
+    meta: dict | None = None,
+) -> Iterator[None]:
+    """Time a section on the active telemetry; no-op when none is active.
+
+    The hook the compiler and engines call — cost without telemetry is one
+    contextvar read.
+    """
+    tel = _current.get()
+    if tel is None:
+        yield
+        return
+    with tel.timer.section(name, chunk=chunk, meta=meta):
+        yield
+
+
+@dataclass(frozen=True)
+class TelemetryConfig:
+    """What to record and where to put it.
+
+    All sinks are optional: with every path ``None`` the run still collects
+    phases/counters in memory (``RunTelemetry.run_record()``) without
+    writing anything.
+    """
+
+    #: append the JSONL run record here (one line per run)
+    jsonl_path: str | Path | None = None
+    #: write the Chrome-trace host timeline here (``.json`` or ``.json.gz``;
+    #: load in Perfetto / ``chrome://tracing``)
+    trace_path: str | Path | None = None
+    #: compile-ledger JSONL; ``None`` = the shared ledger beside ``.jax_cache``
+    ledger_path: str | Path | None = None
+    #: opt-in ``jax.profiler`` capture of the whole run into this directory
+    #: (reuses :func:`asyncflow_tpu.utils.profiling.profile_trace`)
+    profile_dir: str | Path | None = None
+    #: free-form tag copied into every record (e.g. "bench", "tpu-session-6")
+    label: str = ""
+    #: master switch so callers can thread one config unconditionally
+    enabled: bool = True
+
+
+class RunTelemetry:
+    """Collector for one run: phases + compile ledger + counters.
+
+    Use as a context manager around the run (it installs itself as the
+    ambient telemetry so engine hooks find it), then :meth:`finalize`::
+
+        tel = RunTelemetry(TelemetryConfig(jsonl_path="run.jsonl"), kind="sweep")
+        with tel:
+            ... run ...
+        record = tel.finalize(counters=report.results.counters())
+    """
+
+    def __init__(self, config: TelemetryConfig, *, kind: str = "run") -> None:
+        self.config = config
+        self.kind = kind
+        self.timer = PhaseTimer()
+        self.ledger = CompileLedger(config.ledger_path)
+        self.compiles: list[dict] = []
+        self.counters: dict[str, int] = {}
+        self.meta: dict = {}
+        self._token: contextvars.Token | None = None
+        self._profiler = None
+        self._finalized: dict | None = None
+
+    # -- context management -------------------------------------------------
+
+    def __enter__(self) -> RunTelemetry:
+        self._token = _current.set(self)
+        if self.config.profile_dir is not None:
+            from asyncflow_tpu.utils.profiling import profile_trace
+
+            self._profiler = profile_trace(str(self.config.profile_dir))
+            self._profiler.__enter__()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._profiler is not None:
+            self._profiler.__exit__(*exc)
+            self._profiler = None
+        if self._token is not None:
+            _current.reset(self._token)
+            self._token = None
+
+    # -- collection ---------------------------------------------------------
+
+    def phase(
+        self,
+        name: str,
+        *,
+        chunk: int | None = None,
+        meta: dict | None = None,
+    ):
+        return self.timer.section(name, chunk=chunk, meta=meta)
+
+    def record_compile(
+        self,
+        key: str,
+        *,
+        engine: str,
+        variant: str = "",
+        shape: dict | None = None,
+        lower_s: float | None = None,
+        compile_s: float | None = None,
+        backend: str = "",
+    ) -> None:
+        entry = self.ledger.record(
+            key,
+            engine=engine,
+            variant=variant,
+            shape=shape,
+            lower_s=lower_s,
+            compile_s=compile_s,
+            backend=backend,
+            extra={"label": self.config.label} if self.config.label else None,
+        )
+        self.compiles.append(entry)
+
+    def set_counters(self, counters) -> None:
+        """Record the run's unified device counters (a
+        :class:`~asyncflow_tpu.engines.results.DeviceCounters` or dict)."""
+        self.counters = dict(
+            counters.as_dict() if hasattr(counters, "as_dict") else counters,
+        )
+
+    def add_meta(self, **kw) -> None:
+        self.meta.update(kw)
+
+    # -- export -------------------------------------------------------------
+
+    def run_record(self) -> dict:
+        """The structured run record (the JSONL line, as a dict)."""
+        return {
+            "schema": RUN_RECORD_SCHEMA,
+            "ts": self.timer.epoch_unix,
+            "kind": self.kind,
+            "label": self.config.label,
+            "pid": os.getpid(),
+            "meta": dict(self.meta),
+            "phase_totals_s": {
+                k: round(v, 6) for k, v in self.timer.phase_totals().items()
+            },
+            "phases": [e.as_dict() for e in self.timer.events],
+            "compiles": list(self.compiles),
+            "counters": dict(self.counters),
+        }
+
+    def finalize(self, *, counters=None, **meta) -> dict:
+        """Close the run: fold in final counters/meta, write every sink.
+
+        Idempotent — a second call re-returns the first record.
+        """
+        if self._finalized is not None:
+            return self._finalized
+        if counters is not None:
+            self.set_counters(counters)
+        if meta:
+            self.add_meta(**meta)
+        record = self.run_record()
+        if self.config.jsonl_path is not None:
+            path = Path(self.config.jsonl_path)
+            path.parent.mkdir(parents=True, exist_ok=True)
+            with path.open("a") as fh:
+                fh.write(json.dumps(record) + "\n")
+        if self.config.trace_path is not None:
+            from asyncflow_tpu.observability.export import write_chrome_trace
+
+            write_chrome_trace(
+                self.config.trace_path,
+                self.timer,
+                counters=self.counters,
+                label=self.config.label or self.kind,
+            )
+        self._finalized = record
+        return record
+
+
+def telemetry_session(
+    config: TelemetryConfig | None,
+    *,
+    kind: str,
+) -> RunTelemetry | None:
+    """Construct a collector for ``config`` (None / disabled -> None)."""
+    if config is None or not config.enabled:
+        return None
+    return RunTelemetry(config, kind=kind)
+
+
+# ---------------------------------------------------------------------------
+# the engine compile hook
+# ---------------------------------------------------------------------------
+
+
+class InstrumentedJit:
+    """A jitted callable whose compiles are timed into the active ledger.
+
+    Without active telemetry this is a transparent pass-through to the
+    wrapped ``jax.jit`` callable (identical dispatch, identical caching).
+    With telemetry, each distinct input signature is explicitly
+    trace→lower→compile'd — the SAME program jit would have built — so the
+    ledger records honest per-stage durations, and the AOT executable is
+    reused for later calls at that signature.  Attribute access (``.lower``,
+    ``.trace``, ...) passes through to the jit object.
+    """
+
+    def __init__(self, fn, *, engine: str, variant: str = "", **shape) -> None:
+        self._fn = fn
+        self._engine = engine
+        self._variant = variant
+        self._shape = {k: v for k, v in shape.items() if v is not None}
+        self._exes: dict = {}
+
+    def __getattr__(self, name: str):
+        return getattr(self._fn, name)
+
+    @staticmethod
+    def _avals(args) -> tuple | None:
+        """Hashable (shape, dtype) signature; None if any leaf is abstract
+        (a tracer — we are inside someone else's trace) or not an array
+        (then the AOT path is skipped and plain jit dispatch runs)."""
+        import jax
+
+        sig = []
+        for leaf in jax.tree_util.tree_leaves(args):
+            if isinstance(leaf, jax.core.Tracer):
+                return None
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is None or dtype is None:
+                return None
+            sig.append((tuple(shape), str(dtype)))
+        return tuple(sig)
+
+    def __call__(self, *args):
+        tel = current_telemetry()
+        if tel is None:
+            return self._fn(*args)
+        sig = self._avals(args)
+        if sig is None:
+            return self._fn(*args)
+        exe = self._exes.get(sig)
+        if exe is None:
+            import jax
+
+            key = json.dumps(
+                {
+                    "engine": self._engine,
+                    "variant": self._variant,
+                    "shape": self._shape,
+                    "avals": sig,
+                },
+                sort_keys=True,
+            )
+            t0 = time.perf_counter()
+            with tel.phase("lower", meta={"engine": self._engine}):
+                lowered = self._fn.trace(*args).lower()
+            t1 = time.perf_counter()
+            with tel.phase(
+                "compile",
+                meta={"engine": self._engine, "variant": self._variant},
+            ):
+                exe = lowered.compile()
+            t2 = time.perf_counter()
+            tel.record_compile(
+                key,
+                engine=self._engine,
+                variant=self._variant,
+                shape=dict(self._shape, batch=sig[0][0][0] if sig else None),
+                lower_s=t1 - t0,
+                compile_s=t2 - t1,
+                backend=jax.default_backend(),
+            )
+            self._exes[sig] = exe
+        return exe(*args)
+
+
+def instrument_jit(fn, *, engine: str, variant: str = "", **shape):
+    """Wrap a ``jax.jit`` callable for compile-ledger accounting."""
+    return InstrumentedJit(fn, engine=engine, variant=variant, **shape)
+
+
+__all__ = [
+    "RUN_RECORD_SCHEMA",
+    "InstrumentedJit",
+    "PhaseRecord",
+    "RunTelemetry",
+    "TelemetryConfig",
+    "current_telemetry",
+    "instrument_jit",
+    "maybe_phase",
+    "telemetry_session",
+]

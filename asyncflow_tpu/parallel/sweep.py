@@ -9,6 +9,7 @@ histograms/counters (no inter-scenario communication exists during the run).
 
 from __future__ import annotations
 
+import contextlib
 from dataclasses import dataclass
 
 import jax
@@ -19,8 +20,19 @@ from asyncflow_tpu.compiler.plan import StaticPlan, compile_payload
 from asyncflow_tpu.engines.jaxsim.engine import Engine, scenario_keys, sweep_results
 from asyncflow_tpu.engines.jaxsim.params import ScenarioOverrides, base_overrides
 from asyncflow_tpu.engines.results import SweepResults
+from asyncflow_tpu.observability.telemetry import (
+    TelemetryConfig,
+    telemetry_session,
+)
 from asyncflow_tpu.parallel.mesh import scenario_mesh, scenario_sharding
 from asyncflow_tpu.schemas.payload import SimulationPayload
+
+
+def _ph(tel, name: str, *, chunk: int | None = None, meta: dict | None = None):
+    """Phase span on ``tel`` (no-op context without telemetry)."""
+    if tel is None:
+        return contextlib.nullcontext()
+    return tel.phase(name, chunk=chunk, meta=meta)
 
 
 def make_overrides(
@@ -304,6 +316,7 @@ class SweepRunner:
         engine: str = "auto",
         scan_inner: int | None = None,
         gauge_series: tuple | None = None,
+        telemetry: TelemetryConfig | None = None,
     ) -> None:
         """``engine``: "auto" picks the scan fast path when the plan is
         eligible (orders of magnitude faster), then the Pallas event kernel
@@ -341,7 +354,16 @@ class SweepRunner:
             )
             raise ValueError(msg)
         self.payload = payload
+        #: run-record config for every :meth:`run` (overridable per run);
+        #: docs/guides/observability.md
+        self.telemetry = telemetry
+        import time as _time
+
+        t0 = _time.perf_counter()
         self.plan = compile_payload(payload, pool_size=pool_size)
+        # the plan compiles before any RunTelemetry exists; stash the wall
+        # so run() can replay it as the build_plan span
+        self._build_plan_s = _time.perf_counter() - t0
         # process-local like scenario_mesh itself: a multihost process with
         # one chip must not build a 1-device mesh (it would disable the
         # scanned fast path and force the pathological big-batch compile)
@@ -500,6 +522,7 @@ class SweepRunner:
         chunk_size: int | None = None,
         checkpoint_dir: str | None = None,
         first_scenario: int = 0,
+        telemetry: TelemetryConfig | None = None,
     ) -> SweepReport:
         """Execute the sweep, chunking to bound memory and kernel runtime.
 
@@ -515,7 +538,65 @@ class SweepRunner:
         (:func:`asyncflow_tpu.parallel.multihost.run_multihost_sweep`)
         gives each process its own block this way.  ``overrides`` stay
         indexed by *local* row (the caller slices globally).
+
+        ``telemetry`` overrides the constructor-level config for this run;
+        results are bit-identical with telemetry on or off.
         """
+        tel = telemetry_session(
+            telemetry if telemetry is not None else self.telemetry,
+            kind="sweep",
+        )
+        if tel is None:
+            return self._run_impl(
+                n_scenarios,
+                seed=seed,
+                overrides=overrides,
+                chunk_size=chunk_size,
+                checkpoint_dir=checkpoint_dir,
+                first_scenario=first_scenario,
+                tel=None,
+            )
+        with tel:
+            tel.timer.record("build_plan", self._build_plan_s)
+            report = self._run_impl(
+                n_scenarios,
+                seed=seed,
+                overrides=overrides,
+                chunk_size=chunk_size,
+                checkpoint_dir=checkpoint_dir,
+                first_scenario=first_scenario,
+                tel=tel,
+            )
+        tel.add_meta(
+            engine=self.engine_kind,
+            backend=(
+                "host" if self.engine_kind == "native" else jax.default_backend()
+            ),
+            n_scenarios=n_scenarios,
+            seed=seed,
+            first_scenario=first_scenario,
+            scan_inner=getattr(self, "_scan_inner", 0),
+            n_devices=(
+                len(self.mesh.devices.flat) if self.mesh is not None else 1
+            ),
+            horizon_s=float(self.plan.horizon),
+            wall_seconds=round(report.wall_seconds, 6),
+            scenarios_per_second=round(report.scenarios_per_second, 3),
+        )
+        tel.finalize(counters=report.results.counters())
+        return report
+
+    def _run_impl(
+        self,
+        n_scenarios: int,
+        *,
+        seed: int,
+        overrides: ScenarioOverrides | None,
+        chunk_size: int | None,
+        checkpoint_dir: str | None,
+        first_scenario: int,
+        tel,
+    ) -> SweepReport:
         import time
 
         self._guard_fastpath_overrides(overrides)
@@ -552,6 +633,7 @@ class SweepRunner:
         partials: list[SweepResults] = []
         inflight: list[tuple[int, object]] = []
         done = 0
+        chunk_idx = 0
         while done < n_scenarios:
             take = min(chunk, n_scenarios - done)
             take = max(n_dev, (take // n_dev) * n_dev)  # pad to device multiple
@@ -559,6 +641,7 @@ class SweepRunner:
             if cached is not None:
                 partials.append(cached)
                 done += take
+                chunk_idx += 1
                 continue
             lo = first_scenario + done
             ov = (
@@ -567,31 +650,40 @@ class SweepRunner:
                 else None
             )
             if self.engine_kind == "native":
-                part = self.engine.run_chunk(
-                    seed, lo, take, ov, self.payload.sim_settings,
-                )
+                with _ph(tel, "execute", chunk=chunk_idx, meta={"take": take}):
+                    part = self.engine.run_chunk(
+                        seed, lo, take, ov, self.payload.sim_settings,
+                    )
                 if ckpt:
                     ckpt.save(done, part)
                 partials.append(part)
                 done += take
+                chunk_idx += 1
                 continue
-            keys = all_keys[lo : lo + take]
-            if self.mesh is not None:
-                keys = jax.device_put(keys, scenario_sharding(self.mesh))
-            if self.engine_kind == "fast" and getattr(self, "_scan_inner", 0):
-                final = self.engine.run_batch_scanned(
-                    keys, ov, inner=self._scan_inner, total=chunk,
-                )
-            else:
-                final = self.engine.run_batch(keys, ov)
+            with _ph(tel, "transfer", chunk=chunk_idx):
+                keys = all_keys[lo : lo + take]
+                if self.mesh is not None:
+                    keys = jax.device_put(keys, scenario_sharding(self.mesh))
+            # the execute span is the (async) dispatch; device completion is
+            # observed by the fetch span that converts the state to host
+            # arrays — on a cold chunk the engines' instrumented jits nest
+            # lower/compile spans inside this one
+            with _ph(tel, "execute", chunk=chunk_idx, meta={"take": take}):
+                if self.engine_kind == "fast" and getattr(self, "_scan_inner", 0):
+                    final = self.engine.run_batch_scanned(
+                        keys, ov, inner=self._scan_inner, total=chunk,
+                    )
+                else:
+                    final = self.engine.run_batch(keys, ov)
             if ckpt:
                 # checkpointing persists each chunk as numpy -> sync per chunk
-                part = sweep_results(
-                    self.engine,
-                    final,
-                    self.payload.sim_settings,
-                    gauge_sel=self._gauge_sel,
-                )
+                with _ph(tel, "fetch", chunk=chunk_idx):
+                    part = sweep_results(
+                        self.engine,
+                        final,
+                        self.payload.sim_settings,
+                        gauge_sel=self._gauge_sel,
+                    )
                 ckpt.save(done, part)
                 partials.append(part)
             else:
@@ -605,23 +697,27 @@ class SweepRunner:
                 inflight.append((len(partials) - 1, final))
                 while len(inflight) > self.INFLIGHT_CHUNKS:
                     slot, oldest = inflight.pop(0)
-                    partials[slot] = sweep_results(
-                        self.engine,
-                        oldest,
-                        self.payload.sim_settings,
-                        gauge_sel=self._gauge_sel,
-                    )
+                    with _ph(tel, "fetch", chunk=slot):
+                        partials[slot] = sweep_results(
+                            self.engine,
+                            oldest,
+                            self.payload.sim_settings,
+                            gauge_sel=self._gauge_sel,
+                        )
             done += take
+            chunk_idx += 1
         for slot, final in inflight:
-            partials[slot] = sweep_results(
-                self.engine,
-                final,
-                self.payload.sim_settings,
-                gauge_sel=self._gauge_sel,
-            )
+            with _ph(tel, "fetch", chunk=slot):
+                partials[slot] = sweep_results(
+                    self.engine,
+                    final,
+                    self.payload.sim_settings,
+                    gauge_sel=self._gauge_sel,
+                )
         wall = time.time() - t0
 
-        merged = _concat_sweeps(partials)[:n_scenarios]
+        with _ph(tel, "postprocess"):
+            merged = _concat_sweeps(partials)[:n_scenarios]
         return SweepReport(
             results=merged,
             n_scenarios=n_scenarios,

@@ -7,16 +7,26 @@ engine, and ``run()`` returns a :class:`ResultsAnalyzer` with the same
 accessor API.  The ``backend`` switch selects the sequential CPU oracle or
 the batched JAX engine (single scenario); Monte-Carlo sweeps live in
 :mod:`asyncflow_tpu.parallel.sweep`.
+
+``telemetry=TelemetryConfig(...)`` records the structured run record
+(phase timers, compile ledger, unified device counters) described in
+docs/guides/observability.md.  Telemetry never changes simulation results:
+with it on or off the metrics are bit-identical (a test locks this).
 """
 
 from __future__ import annotations
 
+import time
 from pathlib import Path
 
 import yaml
 
 from asyncflow_tpu.config.constants import Backend
 from asyncflow_tpu.metrics.analyzer import ResultsAnalyzer
+from asyncflow_tpu.observability.telemetry import (
+    TelemetryConfig,
+    telemetry_session,
+)
 from asyncflow_tpu.schemas.payload import SimulationPayload
 
 
@@ -30,11 +40,16 @@ class SimulationRunner:
         backend: Backend | str = Backend.ORACLE,
         seed: int | None = None,
         engine_options: dict | None = None,
+        telemetry: TelemetryConfig | None = None,
     ) -> None:
         self.simulation_input = simulation_input
         self.backend = Backend(backend)
         self.seed = seed
         self.engine_options = engine_options or {}
+        self.telemetry = telemetry
+        #: validation wall seconds, when this runner came through a parsing
+        #: front door (from_yaml) that could actually measure it
+        self._validate_s: float | None = None
 
     def _effective_seed(self) -> int:
         """Same determinism rule on every backend: seeded iff the caller
@@ -45,8 +60,31 @@ class SimulationRunner:
 
         return secrets.randbits(63)
 
-    def run(self) -> ResultsAnalyzer:
-        """Execute the scenario on the selected engine."""
+    def run(
+        self,
+        *,
+        telemetry: TelemetryConfig | None = None,
+    ) -> ResultsAnalyzer:
+        """Execute the scenario on the selected engine.
+
+        ``telemetry`` overrides the constructor-level config for this run.
+        """
+        tel = telemetry_session(
+            telemetry if telemetry is not None else self.telemetry,
+            kind="run",
+        )
+        if tel is None:
+            return self._run(None)
+        with tel:
+            if self._validate_s is not None:
+                # the front door measured validation before this timer
+                # existed; replay it as a zero-offset span so the record
+                # covers the full pipeline
+                tel.timer.record("validate", self._validate_s)
+            analyzer = self._run(tel)
+        return analyzer
+
+    def _run(self, tel) -> ResultsAnalyzer:
         backend = self.backend
         if backend == Backend.NATIVE:
             from asyncflow_tpu.engines.oracle.native import native_available
@@ -71,13 +109,23 @@ class SimulationRunner:
                     # hop decoding needs the component ids the compiled
                     # plan does not carry
                     opts["payload"] = self.simulation_input
-                results = run_native(
-                    compile_payload(self.simulation_input),
-                    seed=self._effective_seed(),
-                    settings=self.simulation_input.sim_settings,
-                    **opts,
-                )
-                return ResultsAnalyzer(results)
+                plan = compile_payload(self.simulation_input)
+                if tel is not None:
+                    with tel.phase("execute"):
+                        results = run_native(
+                            plan,
+                            seed=self._effective_seed(),
+                            settings=self.simulation_input.sim_settings,
+                            **opts,
+                        )
+                else:
+                    results = run_native(
+                        plan,
+                        seed=self._effective_seed(),
+                        settings=self.simulation_input.sim_settings,
+                        **opts,
+                    )
+                return self._analyze(results, tel, engine="native")
             import warnings
 
             warnings.warn(
@@ -90,20 +138,53 @@ class SimulationRunner:
         if backend == Backend.ORACLE:
             from asyncflow_tpu.engines.oracle.engine import OracleEngine
 
-            results = OracleEngine(
+            engine = OracleEngine(
                 self.simulation_input,
                 seed=self.seed,
                 **self.engine_options,
-            ).run()
-        else:
-            from asyncflow_tpu.engines.jaxsim.engine import run_single
+            )
+            if tel is not None:
+                with tel.phase("execute"):
+                    results = engine.run()
+            else:
+                results = engine.run()
+            return self._analyze(results, tel, engine="oracle")
 
+        from asyncflow_tpu.engines.jaxsim.engine import run_single
+
+        if tel is not None:
+            # build_plan / lower / compile spans are recorded by the
+            # compiler hook and the engines' instrumented jits, nested
+            # inside this execute span
+            with tel.phase("execute"):
+                results = run_single(
+                    self.simulation_input,
+                    seed=self._effective_seed(),
+                    **self.engine_options,
+                )
+        else:
             results = run_single(
                 self.simulation_input,
                 seed=self._effective_seed(),
                 **self.engine_options,
             )
-        return ResultsAnalyzer(results)
+        return self._analyze(results, tel, engine="jax")
+
+    def _analyze(self, results, tel, *, engine: str) -> ResultsAnalyzer:
+        if tel is None:
+            return ResultsAnalyzer(results)
+        with tel.phase("postprocess"):
+            analyzer = ResultsAnalyzer(results)
+        tel.add_meta(
+            backend=str(self.backend),
+            engine=engine,
+            seed=self.seed,
+            horizon_s=float(
+                self.simulation_input.sim_settings.total_simulation_time,
+            ),
+        )
+        tel.finalize(counters=results.counters())
+        return analyzer
 
     @classmethod
     def from_yaml(
@@ -113,13 +194,19 @@ class SimulationRunner:
         backend: Backend | str = Backend.ORACLE,
         seed: int | None = None,
         engine_options: dict | None = None,
+        telemetry: TelemetryConfig | None = None,
     ) -> SimulationRunner:
         """Load, validate, and wrap a YAML scenario file."""
+        t0 = time.perf_counter()
         data = yaml.safe_load(Path(yaml_path).read_text())
         payload = SimulationPayload.model_validate(data)
-        return cls(
+        validate_s = time.perf_counter() - t0
+        runner = cls(
             simulation_input=payload,
             backend=backend,
             seed=seed,
             engine_options=engine_options,
+            telemetry=telemetry,
         )
+        runner._validate_s = validate_s
+        return runner

@@ -18,13 +18,19 @@ _DEFAULT = os.path.join(
 )
 
 
+def cache_location(path: str | None = None) -> str:
+    """The cache directory that would be used, without enabling anything
+    (the compile ledger lives beside it — observability/ledger.py)."""
+    return path or os.environ.get(ENV_VAR) or _DEFAULT
+
+
 def enable_compile_cache(path: str | None = None) -> str | None:
     """Point jax at a persistent on-disk compilation cache.
 
     Returns the cache directory, or ``None`` if the cache could not be
     enabled (best-effort: benchmarks must run without it).
     """
-    cache_dir = path or os.environ.get(ENV_VAR) or _DEFAULT
+    cache_dir = cache_location(path)
     try:
         import jax
 

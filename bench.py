@@ -27,6 +27,7 @@ EVERY process.  So the benchmark
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import subprocess
@@ -36,6 +37,27 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _parse_args(argv: list[str]) -> dict:
+    """Tiny flag parser (argparse would swallow the child re-invocation).
+
+    ``--telemetry out.jsonl``: record the measured sweep's structured run
+    telemetry (phases, compile ledger, counters + a Chrome-trace timeline
+    beside it) and compare the headline against the newest ``BENCH_*.json``.
+    """
+    opts = {"telemetry": None}
+    it = iter(argv)
+    for arg in it:
+        if arg == "--telemetry":
+            opts["telemetry"] = next(it, None)
+            if opts["telemetry"] is None:
+                raise SystemExit("--telemetry needs an output path")
+        elif arg.startswith("--telemetry="):
+            opts["telemetry"] = arg.split("=", 1)[1]
+        else:
+            raise SystemExit(f"unknown argument {arg!r}")
+    return opts
 
 # On an accelerator the sweep targets the north star (10k-scenario sweep,
 # BASELINE.md) but adapts the measured size to the wall budget from a
@@ -286,7 +308,19 @@ def run_measurement() -> None:
                 detail_base["engine"] = "native"
                 detail_base["scan_inner"] = 0
 
-    report = runner.run(n_scenarios, seed=SEED, chunk_size=chunk)
+    telemetry_out = os.environ.get("BENCH_TELEMETRY")
+    telemetry_cfg = None
+    if telemetry_out:
+        from asyncflow_tpu.observability import TelemetryConfig
+
+        telemetry_cfg = TelemetryConfig(
+            jsonl_path=telemetry_out,
+            trace_path=telemetry_out + ".trace.json",
+            label="bench",
+        )
+    report = runner.run(
+        n_scenarios, seed=SEED, chunk_size=chunk, telemetry=telemetry_cfg,
+    )
     summary = report.summary()
 
     if summary["overflow_total"] > 0:
@@ -303,6 +337,8 @@ def run_measurement() -> None:
         "completed_total": summary["completed_total"],
         "overflow_total": summary["overflow_total"],
     }
+    if telemetry_out:
+        detail["telemetry"] = telemetry_out
     if on_accel:
         # Device-time breakdown.  One blocking dispatch costs
         # warm_chunk_wall_s = kernel time + tunnel round trip, and the RTT
@@ -419,10 +455,70 @@ def _prewarm(env: dict) -> bool:
     return True
 
 
+def _latest_bench_record() -> tuple[str, dict] | None:
+    """(filename, parsed result) of the newest committed BENCH_*.json."""
+    paths = sorted(glob.glob(os.path.join(REPO, "BENCH_*.json")))
+    for path in reversed(paths):
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = data.get("parsed") if isinstance(data, dict) else None
+        if parsed and "value" in parsed:
+            return os.path.basename(path), parsed
+    return None
+
+
+def _compare_with_baseline(result: dict, telemetry_out: str | None) -> None:
+    """Print regression deltas vs the newest BENCH_*.json; append the
+    headline (with the deltas) to the telemetry JSONL as a bench record."""
+    ref = _latest_bench_record()
+    comparison = None
+    if ref is None:
+        print("telemetry: no BENCH_*.json baseline to compare", file=sys.stderr)
+    else:
+        name, prev = ref
+        value = float(result["value"])
+        prev_value = float(prev["value"])
+        delta = (value - prev_value) / prev_value if prev_value else float("nan")
+        same_platform = result.get("detail", {}).get("platform") == prev.get(
+            "detail", {},
+        ).get("platform")
+        comparison = {
+            "baseline_file": name,
+            "baseline_value": prev_value,
+            "baseline_platform": prev.get("detail", {}).get("platform"),
+            "value": value,
+            "delta_pct": round(delta * 100.0, 2),
+            "same_platform": same_platform,
+        }
+        direction = "faster" if delta >= 0 else "SLOWER"
+        note = "" if same_platform else " (different platform — not comparable)"
+        print(
+            f"telemetry: headline {value:.3f} vs {name} "
+            f"{prev_value:.3f} scen/s: {delta * 100.0:+.1f}% {direction}{note}",
+            file=sys.stderr,
+        )
+    if telemetry_out:
+        record = {
+            "schema": "asyncflow-bench-headline/1",
+            "ts": time.time(),
+            "result": result,
+            "vs_latest_bench": comparison,
+        }
+        with open(telemetry_out, "a") as fh:
+            fh.write(json.dumps(record) + "\n")
+
+
 def main() -> None:
     if os.environ.get("BENCH_CHILD") == "1":
         run_measurement()
         return
+
+    opts = _parse_args(sys.argv[1:])
+    if opts["telemetry"]:
+        os.environ["BENCH_TELEMETRY"] = opts["telemetry"]
 
     if os.path.exists(PARTIAL_PATH):
         os.unlink(PARTIAL_PATH)
@@ -481,7 +577,13 @@ def main() -> None:
             proc = None
         if proc is not None and proc.returncode == 0 and proc.stdout.strip():
             sys.stderr.write(proc.stderr)
-            print(proc.stdout.strip().splitlines()[-1])
+            line = proc.stdout.strip().splitlines()[-1]
+            print(line)
+            if opts["telemetry"]:
+                try:
+                    _compare_with_baseline(json.loads(line), opts["telemetry"])
+                except json.JSONDecodeError:
+                    print("telemetry: headline line not JSON", file=sys.stderr)
             if os.path.exists(PARTIAL_PATH):
                 os.unlink(PARTIAL_PATH)
             return
@@ -498,6 +600,8 @@ def main() -> None:
                 file=sys.stderr,
             )
             _emit(partial)
+            if opts["telemetry"]:
+                _compare_with_baseline(partial, opts["telemetry"])
             os.unlink(PARTIAL_PATH)
             return
     msg = "benchmark failed on both accelerator and CPU"

@@ -1,5 +1,11 @@
 #!/usr/bin/env bash
 # Headline benchmark (defaults: 2048-scenario sweep of the 600 s LB example).
+# Emits the structured run telemetry (phases, compile ledger, counters,
+# Chrome-trace timeline) as a build artifact beside the headline:
+#   BENCH_TELEMETRY_OUT   telemetry JSONL path (default .bench_telemetry.jsonl)
 set -euo pipefail
 cd "$(dirname "$0")/.."
-python bench.py
+TELEMETRY_OUT="${BENCH_TELEMETRY_OUT:-.bench_telemetry.jsonl}"
+rm -f "$TELEMETRY_OUT" "$TELEMETRY_OUT.trace.json"
+python bench.py --telemetry "$TELEMETRY_OUT"
+echo "telemetry artifact: $TELEMETRY_OUT (+ $TELEMETRY_OUT.trace.json)" >&2

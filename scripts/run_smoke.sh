@@ -5,4 +5,10 @@
 # gate (scripts/run_tests.sh, ci-main).
 set -euo pipefail
 cd "$(dirname "$0")/.."
+# telemetry schema gate first: jax-free and sub-second, it fails fast when
+# the run-record schema drifts (docs/guides/observability.md)
+python -m pytest \
+  tests/unit/observability/test_telemetry.py::test_summary_smoke_schema \
+  tests/unit/observability/test_telemetry.py::test_run_record_schema_is_valid \
+  -q -p no:cacheprovider
 python -m pytest tests/ -m smoke -q "$@"

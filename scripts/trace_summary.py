@@ -1,10 +1,9 @@
 """Summarize a jax.profiler chrome trace: device time by op and by source.
 
-Reads the ``*.trace.json.gz`` a `jax.profiler.trace` directory contains and
-prints the process table, the top device ops by time, and device time
-attributed to source lines (the round-5 profile analysis that found 68% of
-device time in sortutil's rank machinery — this script is that analysis,
-made repeatable).
+Thin CLI over :mod:`asyncflow_tpu.observability.report` (the round-5
+profile analysis that found 68% of device time in sortutil's rank
+machinery — promoted into the library; the TPU session ladders import the
+module, this wrapper keeps the command-line habit working).
 
 Usage:
     python scripts/trace_summary.py prof_trace_tpu
@@ -14,25 +13,17 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import collections
-import glob
-import gzip
-import json
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def load_trace(prof_dir: str) -> dict:
-    paths = sorted(
-        glob.glob(os.path.join(prof_dir, "**", "*.trace.json.gz"), recursive=True),
-    )
-    if not paths:
-        sys.exit(f"no *.trace.json.gz under {prof_dir}")
-    if len(paths) > 1:
-        print(f"note: {len(paths)} trace files found; summarizing only "
-              f"{paths[-1]} (one file per host/run)", file=sys.stderr)
-    with gzip.open(paths[-1]) as f:
-        return json.load(f)
+from asyncflow_tpu.observability.report import (  # noqa: E402
+    find_trace_files,
+    format_summary,
+    load_trace,
+    summarize_trace,
+)
 
 
 def main() -> None:
@@ -41,45 +32,19 @@ def main() -> None:
     ap.add_argument("--top", type=int, default=15)
     args = ap.parse_args()
 
-    tr = load_trace(args.prof_dir)
-    ev = tr["traceEvents"]
-
-    pids = {
-        e["pid"]: e["args"].get("name")
-        for e in ev
-        if e.get("ph") == "M" and e.get("name") == "process_name"
-    }
-    device_pids = {
-        p for p, n in pids.items() if n and ("TPU" in n or "GPU" in n)
-    }
-
-    by_op: collections.Counter = collections.Counter()
-    by_src: collections.Counter = collections.Counter()
-    total = 0
-    for e in ev:
-        if e.get("ph") != "X" or e.get("pid") not in device_pids:
-            continue
-        name = e.get("name", "?")
-        dur = e.get("dur", 0)
-        a = e.get("args") or {}
-        # skip the outermost containers to avoid double counting in totals
-        if name.startswith("jit_"):
-            continue
-        by_op[name] += dur
-        total += dur
-        src = a.get("source")
-        if src:
-            by_src[src] += dur
-
-    print(f"processes: { {p: n for p, n in pids.items()} }")
-    print(f"\nattributed device op time: {total/1e6:.2f}s "
-          "(nested ops double-count inside their parents)")
-    print(f"\n== top {args.top} device ops ==")
-    for name, d in by_op.most_common(args.top):
-        print(f"  {d/1e6:8.3f}s  {name[:100]}")
-    print(f"\n== top {args.top} source attributions ==")
-    for src, d in by_src.most_common(args.top):
-        print(f"  {d/1e6:8.3f}s  {src}")
+    if os.path.isdir(args.prof_dir):
+        n_files = len(find_trace_files(args.prof_dir))
+        if n_files > 1:
+            print(
+                f"note: {n_files} trace files found; summarizing only the "
+                "newest (one file per host/run)",
+                file=sys.stderr,
+            )
+    try:
+        trace = load_trace(args.prof_dir)
+    except FileNotFoundError as exc:
+        sys.exit(str(exc))
+    print(format_summary(summarize_trace(trace), top=args.top))
 
 
 if __name__ == "__main__":

@@ -132,6 +132,7 @@ _SMOKE_MODULES = (
     "tests/unit/public_api",
     "tests/unit/jax_engine/test_sortutil.py",
     "tests/unit/jax_engine/test_traces.py",
+    "tests/unit/observability",
     "tests/parity/test_native_parity.py",
     "tests/parity/test_native_sweep.py",
     "tests/parity/test_db_pool.py",
@@ -153,6 +154,7 @@ _SMOKE_TESTS = (
     "tests/parity/test_milestone5_controls.py::TestFastPathControls::test_rate_limit_fast_parity",
     "tests/parity/test_overload_policy.py::test_fast_path_shed_parity",
     "tests/unit/test_rl_batched.py::test_windowed_run_until_is_bit_identical",
+    "tests/parity/test_telemetry_counters.py::test_sweep_counters_match_per_scenario_sums",
 )
 
 

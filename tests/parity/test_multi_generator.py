@@ -417,3 +417,33 @@ def test_zero_rate_override_terminates() -> None:
     c = rep.results.completed
     assert c[1] == 0
     assert c[0] > 0
+
+
+class TestMaxRequestsRescale:
+    """The explicit max_requests knob's TOTAL-capacity contract on
+    multi-generator plans (ADVICE r5 #3): slices sum to exactly the
+    requested total, every stream keeps >= 1 slot, and an unsatisfiable
+    request raises instead of silently exceeding the contract."""
+
+    def _engine(self, max_requests: int):
+        from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+
+        return FastEngine(compile_payload(_payload()), max_requests=max_requests)
+
+    @pytest.mark.parametrize("total", [2, 3, 100, 101, 8191])
+    def test_total_capacity_contract_holds(self, total: int) -> None:
+        eng = self._engine(total)
+        assert sum(eng.gen_n) == total
+        assert eng.n == total
+        assert all(s >= 1 for s in eng.gen_n)
+
+    def test_slices_stay_proportional(self) -> None:
+        plan = compile_payload(_payload())
+        base = [int(x) for x in plan.gen_slots]
+        eng = self._engine(1000)
+        for slot, b in zip(eng.gen_n, base):
+            assert slot == pytest.approx(1000 * b / sum(base), abs=1)
+
+    def test_too_small_for_stream_count_raises(self) -> None:
+        with pytest.raises(ValueError, match="at least one slot"):
+            self._engine(1)

@@ -176,3 +176,135 @@ def test_sample_count_matches_oracle_convention(minimal_payload) -> None:
     assert plan.n_samples == round(
         settings.total_simulation_time / settings.sample_period_s,
     ) - 1
+
+
+# ---------------------------------------------------------------------------
+# least-connections burst bound: per-stream variance sum (ADVICE r5 #1)
+# ---------------------------------------------------------------------------
+
+
+def _lc_payload(generators: list[dict], lb_edge_mean: float) -> SimulationPayload:
+    def mutate(data: dict) -> None:
+        data["topology_graph"]["nodes"]["load_balancer"]["algorithms"] = (
+            "least_connection"
+        )
+        for edge in data["topology_graph"]["edges"]:
+            if edge["id"] in ("lb-srv1", "lb-srv2"):
+                edge["latency"]["mean"] = lb_edge_mean
+        data["rqs_input"] = generators
+        for gen in generators[1:]:
+            data["topology_graph"]["edges"].append(
+                {
+                    "id": f"{gen['id']}-client",
+                    "source": gen["id"],
+                    "target": "client-1",
+                    "latency": {"mean": 0.003, "distribution": "exponential"},
+                },
+            )
+
+    return _payload(LB, mutate)
+
+
+def _expected_ring(burst_rate: float, worst_delay: float) -> int:
+    import math
+
+    m = burst_rate * worst_delay
+    return int(math.ceil(m + 6.0 * math.sqrt(max(m, 1.0)) + 16.0))
+
+
+def test_lc_ring_single_stream_formula_unchanged() -> None:
+    import math
+
+    users, rpm, delay = 400.0, 20.0, 0.2
+    plan = compile_payload(
+        _lc_payload(
+            [
+                {
+                    "id": "rqs-1",
+                    "avg_active_users": {"mean": users},
+                    "avg_request_per_minute_per_user": {"mean": rpm},
+                    "user_sampling_window": 60,
+                },
+            ],
+            delay,
+        ),
+    )
+    assert plan.fastpath_ok
+    rate = users * rpm / 60.0
+    burst = rate * (1.0 + 3.0 / math.sqrt(users))  # the G==1 closed form
+    assert plan.lc_ring == _expected_ring(burst, delay)
+
+
+def test_lc_ring_heterogeneous_superposition_sums_variances() -> None:
+    """Many low-rate users + few high-rate users at the same total rate:
+    the summed-rate 3-sigma exceeds the pooled-user formula, and the ring
+    must be sized from the true bound (the pooled one undersizes it and
+    lets the 'astronomically unlikely' overflow become likely)."""
+    import math
+
+    delay = 0.2
+    plan = compile_payload(
+        _lc_payload(
+            [
+                {
+                    "id": "rqs-1",
+                    "avg_active_users": {"mean": 1000},
+                    "avg_request_per_minute_per_user": {"mean": 6},
+                    "user_sampling_window": 60,
+                },
+                {
+                    "id": "rqs-2",
+                    "avg_active_users": {"mean": 10},
+                    "avg_request_per_minute_per_user": {"mean": 600},
+                    "user_sampling_window": 60,
+                },
+            ],
+            delay,
+        ),
+    )
+    assert plan.fastpath_ok, plan.fastpath_reason
+    rate = 1000 * 6 / 60.0 + 10 * 600 / 60.0  # 200 rps either way
+    pooled_burst = rate * (1.0 + 3.0 / math.sqrt(1010.0))
+    true_burst = rate + 3.0 * math.sqrt(1000 * 0.1**2 + 10 * 10.0**2)
+    assert plan.lc_ring == _expected_ring(true_burst, delay)
+    assert plan.lc_ring > _expected_ring(pooled_burst, delay)
+
+
+def test_lc_ring_homogeneous_split_matches_pooled_formula() -> None:
+    """Splitting one stream into two identical halves must not change the
+    bound: variance summing reduces to the pooled formula exactly."""
+    delay = 0.2
+    single = compile_payload(
+        _lc_payload(
+            [
+                {
+                    "id": "rqs-1",
+                    "avg_active_users": {"mean": 400},
+                    "avg_request_per_minute_per_user": {"mean": 20},
+                    "user_sampling_window": 60,
+                },
+            ],
+            delay,
+        ),
+    )
+    split = compile_payload(
+        _lc_payload(
+            [
+                {
+                    "id": "rqs-1",
+                    "avg_active_users": {"mean": 200},
+                    "avg_request_per_minute_per_user": {"mean": 20},
+                    "user_sampling_window": 60,
+                },
+                {
+                    "id": "rqs-2",
+                    "avg_active_users": {"mean": 200},
+                    "avg_request_per_minute_per_user": {"mean": 20},
+                    "user_sampling_window": 60,
+                },
+            ],
+            delay,
+        ),
+    )
+    assert single.fastpath_ok and split.fastpath_ok
+    assert split.lc_ring == single.lc_ring

@@ -129,3 +129,26 @@ def test_searchsorted_small_matches_numpy(side, rng):
         assert (got == want).all()
     with pytest.raises(ValueError, match="side"):
         searchsorted_small(jnp.zeros(3), jnp.zeros(4), "Right")
+
+
+@pytest.mark.parametrize("side", ["left", "right"])
+def test_searchsorted_large_table_falls_back_exactly(side, rng):
+    """Past DENSE_TABLE_MAX the dense (n, nt) compare matrix is a memory
+    cliff (a 3600-window offsets table x 1e5 slots is a ~4e8-element
+    intermediate), so the helper must switch to the log-n search — and stay
+    bit-identical across the threshold."""
+    from asyncflow_tpu.engines.jaxsim.sortutil import (
+        DENSE_TABLE_MAX,
+        searchsorted_small,
+    )
+
+    for nt in (DENSE_TABLE_MAX, DENSE_TABLE_MAX + 1, 3600):
+        table = np.sort(rng.uniform(0.0, 10.0, nt)).astype(np.float32)
+        q = rng.uniform(-1.0, 11.0, 500).astype(np.float32)
+        q[:100] = table[:100]  # exact hits exercise the boundary either path
+        want = np.searchsorted(table, q, side=side)
+        got = np.asarray(
+            searchsorted_small(jnp.asarray(table), jnp.asarray(q), side),
+        )
+        assert (got == want).all(), nt
+        assert got.dtype == np.int32

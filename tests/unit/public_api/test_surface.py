@@ -11,10 +11,29 @@ import asyncflow_tpu.workload as workload
 
 
 def test_top_level_surface() -> None:
-    assert set(asyncflow_tpu.__all__) == {"AsyncFlow", "SimulationRunner", "__version__"}
+    assert set(asyncflow_tpu.__all__) == {
+        "AsyncFlow",
+        "SimulationRunner",
+        "TelemetryConfig",
+        "__version__",
+    }
     assert asyncflow_tpu.AsyncFlow is not None
     assert asyncflow_tpu.SimulationRunner is not None
+    assert asyncflow_tpu.TelemetryConfig is not None
     assert isinstance(asyncflow_tpu.__version__, str)
+
+
+def test_observability_surface() -> None:
+    import asyncflow_tpu.observability as observability
+
+    assert {
+        "TelemetryConfig",
+        "RunTelemetry",
+        "CompileLedger",
+        "PhaseTimer",
+        "validate_run_record",
+        "write_chrome_trace",
+    } <= set(observability.__all__)
 
 
 def test_components_surface() -> None:
