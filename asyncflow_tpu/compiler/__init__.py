@@ -1,5 +1,18 @@
 """Payload -> StaticPlan lowering for the batched engine."""
 
+from asyncflow_tpu.compiler.faults import (
+    FaultArrays,
+    RetryScalars,
+    lower_faults,
+    lower_retry,
+)
 from asyncflow_tpu.compiler.plan import StaticPlan, compile_payload
 
-__all__ = ["StaticPlan", "compile_payload"]
+__all__ = [
+    "FaultArrays",
+    "RetryScalars",
+    "StaticPlan",
+    "compile_payload",
+    "lower_faults",
+    "lower_retry",
+]

@@ -373,6 +373,14 @@ class StaticPlan:
                 setattr(self, name, np.full(self.n_servers, -1.0, np.float32))
         if not self.server_rate_burst.size:
             self.server_rate_burst = np.zeros(self.n_servers, np.int32)
+        # hand-built plans: identity fault tables at the plan's own widths
+        if self.fault_srv_down.shape[1] != self.n_servers:
+            self.fault_srv_times = np.zeros(1, np.float32)
+            self.fault_srv_down = np.zeros((1, self.n_servers), np.int32)
+        if self.fault_edge_lat.shape[1] != self.n_edges:
+            self.fault_edge_times = np.zeros(1, np.float32)
+            self.fault_edge_lat = np.ones((1, self.n_edges), np.float32)
+            self.fault_edge_drop = np.zeros((1, self.n_edges), np.float32)
         if not self.endpoint_cum.size and self.n_endpoints.size:
             # uniform selection table for hand-built plans, at the SAME
             # row stride as every other per-endpoint array (the native
@@ -507,6 +515,36 @@ class StaticPlan:
         default_factory=lambda: np.empty((0, 0, 0), np.float32),
     )
 
+    #: resilience fault tables (compiler/faults.py): piecewise-constant
+    #: breakpoints with a leading identity row at t = 0.  (K,) change
+    #: times + (K, NS) outage flags; (M,) change times + (M, NE)
+    #: multiplicative latency factors and additive dropout boosts.
+    fault_srv_times: np.ndarray = field(
+        default_factory=lambda: np.zeros(1, np.float32),
+    )
+    fault_srv_down: np.ndarray = field(
+        default_factory=lambda: np.empty((1, 0), np.int32),
+    )
+    fault_edge_times: np.ndarray = field(
+        default_factory=lambda: np.zeros(1, np.float32),
+    )
+    fault_edge_lat: np.ndarray = field(
+        default_factory=lambda: np.empty((1, 0), np.float32),
+    )
+    fault_edge_drop: np.ndarray = field(
+        default_factory=lambda: np.empty((1, 0), np.float32),
+    )
+    #: client retry policy scalars (compiler/faults.py RetryScalars);
+    #: retry_timeout < 0 = no policy.  budget_tokens < 0 = unlimited.
+    retry_timeout: float = -1.0
+    retry_max_attempts: int = 1
+    retry_backoff_base: float = 0.0
+    retry_backoff_mult: float = 1.0
+    retry_backoff_cap: float = 0.0
+    retry_jitter: float = 0.0
+    retry_budget_tokens: float = -1.0
+    retry_budget_refill: float = 0.0
+
     @property
     def has_weighted_endpoints(self) -> bool:
         """True when any server's selection weights deviate from uniform."""
@@ -544,6 +582,40 @@ class StaticPlan:
     def has_db_pool(self) -> bool:
         """True when any server's connection pool is actually modeled."""
         return bool(np.any(self.server_db_pool >= 0))
+
+    @property
+    def has_faults(self) -> bool:
+        """True when any fault window actually mutates a server or edge."""
+        return bool(
+            np.any(self.fault_srv_down != 0)
+            or np.any(self.fault_edge_lat != 1.0)
+            or np.any(self.fault_edge_drop != 0.0),
+        )
+
+    @property
+    def has_retry(self) -> bool:
+        """True when a client retry/timeout policy is modeled."""
+        return self.retry_timeout > 0
+
+    def array_digest(self) -> str:
+        """Stable hash of every lowered plan array and scalar — the part
+        of a sweep-checkpoint identity that tracks plan-level semantics,
+        so ANY future plan field (fault tables, retry scalars, ...)
+        invalidates stale checkpoints without a schema bump."""
+        import dataclasses
+        import hashlib
+
+        digest = hashlib.sha256()
+        for f in dataclasses.fields(self):
+            value = getattr(self, f.name)
+            digest.update(f.name.encode())
+            if isinstance(value, np.ndarray):
+                digest.update(str(value.dtype).encode())
+                digest.update(str(value.shape).encode())
+                digest.update(np.ascontiguousarray(value).tobytes())
+            else:
+                digest.update(repr(value).encode())
+        return digest.hexdigest()
 
     @property
     def n_gauges(self) -> int:
@@ -685,6 +757,14 @@ def _estimate_capacity(payload: SimulationPayload) -> tuple[int, int]:
         # independent streams: total-count variances add (each stream's
         # g_count_var already carries its Poisson + user-draw parts)
         count_var += g_count_var
+    # client retries amplify offered load: every logical request can spawn
+    # up to max_attempts issues, and orphaned (timed-out) attempts keep
+    # consuming server resources until they drain — scale the capacity
+    # bounds by the attempt cap (an upper bound on the amplification)
+    if payload.retry_policy is not None:
+        amp = float(payload.retry_policy.max_attempts)
+        rate *= amp
+        count_var *= amp * amp
     expected = rate * horizon
     max_requests = int(expected + 6.0 * math.sqrt(max(count_var, 1.0)) + 64)
 
@@ -1284,12 +1364,20 @@ def _compile_payload(
         else 0
     )
 
+    # ---- resilience: fault windows + client retry policy ----
+    from asyncflow_tpu.compiler.faults import lower_faults, lower_retry
+
+    fault_arrays = lower_faults(payload)
+    retry = lower_retry(payload.retry_policy)
+
     # Circuit breaker (reference roadmap milestone 5): modeled only when a
     # failure channel exists on some covered target — a modeled refusal /
-    # shed / rate-limit / deadline on a target server, or dropout on an LB
-    # out-edge.  With no channel the breaker can never trip and lowers
-    # away; ``breaker_lowered`` flags the plan so sweep overrides that
-    # could CREATE a channel (raising LB-edge dropout) are refused.
+    # shed / rate-limit / deadline on a target server, dropout on an LB
+    # out-edge, a server-outage fault window on a covered server, or an
+    # edge fault boosting dropout on an LB out-edge.  With no channel the
+    # breaker can never trip and lowers away; ``breaker_lowered`` flags
+    # the plan so sweep overrides that could CREATE a channel (raising
+    # LB-edge dropout) are refused.
     breaker = lb.circuit_breaker if lb is not None else None
     breaker_threshold = 0
     breaker_cooldown = 0.0
@@ -1297,13 +1385,21 @@ def _compile_payload(
     breaker_lowered = False
     if breaker is not None and lb_slots:
         covered = {server_index[edges[eidx].target] for eidx in lb_slots}
-        has_channel = any(
-            queue_cap_model[s_c] >= 0
-            or conn_cap_model[s_c] >= 0
-            or rate_limit_model[s_c] >= 0
-            or queue_timeout_model[s_c] >= 0
-            for s_c in covered
-        ) or any(float(edges[eidx].dropout_rate) > 0 for eidx in lb_slots)
+        has_channel = (
+            any(
+                queue_cap_model[s_c] >= 0
+                or conn_cap_model[s_c] >= 0
+                or rate_limit_model[s_c] >= 0
+                or queue_timeout_model[s_c] >= 0
+                or bool(np.any(fault_arrays.srv_down[:, s_c] != 0))
+                for s_c in covered
+            )
+            or any(float(edges[eidx].dropout_rate) > 0 for eidx in lb_slots)
+            or any(
+                bool(np.any(fault_arrays.edge_drop[:, eidx] > 0))
+                for eidx in lb_slots
+            )
+        )
         if has_channel:
             breaker_threshold = int(breaker.failure_threshold)
             breaker_cooldown = float(breaker.cooldown_s)
@@ -1497,6 +1593,19 @@ def _compile_payload(
         fp_cache_slot=fp_cache_slot,
         fp_cache_miss_prob=fp_cache_miss_prob,
         fp_cache_extra=fp_cache_extra,
+        fault_srv_times=fault_arrays.srv_times,
+        fault_srv_down=fault_arrays.srv_down,
+        fault_edge_times=fault_arrays.edge_times,
+        fault_edge_lat=fault_arrays.edge_lat,
+        fault_edge_drop=fault_arrays.edge_drop,
+        retry_timeout=retry.timeout,
+        retry_max_attempts=retry.max_attempts,
+        retry_backoff_base=retry.backoff_base,
+        retry_backoff_mult=retry.backoff_mult,
+        retry_backoff_cap=retry.backoff_cap,
+        retry_jitter=retry.jitter,
+        retry_budget_tokens=retry.budget_tokens,
+        retry_budget_refill=retry.budget_refill,
     )
 
 
@@ -1579,6 +1688,34 @@ def _fastpath_analysis(
     servers = payload.topology_graph.nodes.servers
     n_servers = len(servers)
     no_slots = np.empty(0, np.int32)
+
+    # Resilience scenarios are categorically event-engine work: client
+    # retries are feedback from completions/failures into the arrival
+    # process (the scan has no re-issue channel), and fault windows gate
+    # server availability and edge parameters in time, which the
+    # closed-form per-station recursions cannot replay.
+    if payload.retry_policy is not None:
+        return (
+            False,
+            "client retry policy: timeout/backoff re-issues feed "
+            "completions back into the arrival stream (modeled on the "
+            "event engines; use engine='event' or drop retry_policy)",
+            [],
+            no_slots,
+            0,
+            0.0,
+        )
+    if payload.fault_timeline is not None and payload.fault_timeline.events:
+        return (
+            False,
+            "fault timeline: outage/degradation windows gate servers and "
+            "edges in time (modeled on the event engines; use "
+            "engine='event' or drop fault_timeline)",
+            [],
+            no_slots,
+            0,
+            0.0,
+        )
 
     lb = payload.topology_graph.nodes.load_balancer
     if n_outage_marks > 0 and lb is None:

@@ -149,6 +149,31 @@ class EventDescription(StrEnum):
     NETWORK_SPIKE_END = "network_spike_end"
 
 
+class FaultKind(StrEnum):
+    """Fault-injection window kinds (resilience modeling; see
+    :mod:`asyncflow_tpu.schemas.resilience`).
+
+    ``SERVER_OUTAGE`` hard-refuses arrivals at the server (the LB only
+    learns through its breaker — unlike ``EventDescription.SERVER_DOWN``,
+    which is a graceful rotation removal).  ``EDGE_DEGRADE`` multiplies
+    edge latency and/or boosts dropout inside the window;
+    ``EDGE_PARTITION`` drops every send on the edge.
+    """
+
+    SERVER_OUTAGE = "server_outage"
+    EDGE_DEGRADE = "edge_degrade"
+    EDGE_PARTITION = "edge_partition"
+
+
+class RetryDefaults(IntEnum):
+    """Defaults / bounds for the client retry policy."""
+
+    MAX_ATTEMPTS = 3
+    #: hard cap on attempts per logical request: bounds the attempts
+    #: histogram width and the retry amplification of capacity estimates
+    MAX_ATTEMPTS_CAP = 16
+
+
 # ---------------------------------------------------------------------------
 # Metrics
 # ---------------------------------------------------------------------------

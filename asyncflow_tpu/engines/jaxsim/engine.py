@@ -75,11 +75,13 @@ from asyncflow_tpu.engines.jaxsim.rotation import (
     rotation_remove,
 )
 from asyncflow_tpu.engines.jaxsim.params import (
+    EV_ARRIVE_CLIENT,
     EV_ARRIVE_LB,
     EV_ABANDON,
     EV_ARRIVE_SRV,
     EV_IDLE,
     EV_RESUME,
+    EV_RETRY,
     EV_SEG_END,
     EV_WAIT_CPU,
     EV_WAIT_DB,
@@ -89,6 +91,7 @@ from asyncflow_tpu.engines.jaxsim.params import (
     EngineState,
     ScenarioOverrides,
     base_overrides,
+    fill_overrides,
     params_from_plan,
 )
 
@@ -153,6 +156,26 @@ class Engine:
         self._has_rl = plan.has_rate_limit
         self._has_timeout = plan.has_queue_timeout
         self._has_breaker = plan.breaker_threshold > 0
+        # resilience: fault-window gating + client retry machinery, each
+        # statically pruned when the plan carries none
+        self._has_srv_faults = bool(np.any(plan.fault_srv_down != 0))
+        self._has_edge_faults = bool(
+            np.any(plan.fault_edge_lat != 1.0)
+            or np.any(plan.fault_edge_drop != 0.0),
+        )
+        self._has_retry = plan.has_retry
+        self._att_bins = max(int(plan.retry_max_attempts), 1)
+        #: retry-budget capacity; None = unlimited (no bucket compiled in)
+        self._rb_cap = (
+            float(plan.retry_budget_tokens)
+            if plan.retry_budget_tokens >= 0
+            else None
+        )
+        if self._has_retry and plan.n_generators > 1:  # pragma: no cover
+            # the payload validator forbids this combination; double-fence
+            # so hand-built plans fail loudly instead of mis-routing
+            msg = "retry policy with multiple generators is unsupported"
+            raise ValueError(msg)
         self._n_gen = plan.n_generators
         self._compiled: dict = {}
 
@@ -206,6 +229,27 @@ class Engine:
         idx = searchsorted_small(self.params.spike_times, t, "right") - 1
         return self.params.spike_values[idx, edge]
 
+    def _srv_faulted(self, s, t, ov):
+        """1 while server ``s`` sits inside a server_outage fault window.
+        Breakpoint TIMES come from the overrides (fault-timing sweeps);
+        the down-flag table is plan-static."""
+        if not self._has_srv_faults:
+            return jnp.bool_(False)
+        idx = jnp.maximum(
+            searchsorted_small(ov.fault_srv_times, t, "right") - 1, 0,
+        )
+        return self.params.fault_srv_down[idx, s] == 1
+
+    def _edge_fault(self, e, t, ov):
+        """(latency factor, dropout boost) active on edge ``e`` at ``t``."""
+        idx = jnp.maximum(
+            searchsorted_small(ov.fault_edge_times, t, "right") - 1, 0,
+        )
+        return (
+            self.params.fault_edge_lat[idx, e],
+            self.params.fault_edge_drop[idx, e],
+        )
+
     def _sample_delay(self, edge, key, ov):
         """One latency draw for ``edge``; branches statically pruned to the
         distributions this plan actually uses."""
@@ -233,10 +277,21 @@ class Engine:
         return delay
 
     def _sample_edge(self, edge, t_send, key, ov):
-        """(dropped, effective delay incl. active spike) for one traversal."""
+        """(dropped, effective delay incl. active spike) for one traversal.
+
+        Fault windows gate the draw: an active edge fault multiplies the
+        latency draw and boosts the dropout probability (partition windows
+        boost it to 1), mirroring the oracle's ``_EdgeRuntime.transport``.
+        """
         u = jax.random.uniform(jax.random.fold_in(key, 0))
-        dropped = u < ov.edge_dropout[edge]
-        return dropped, self._sample_delay(edge, key, ov) + self._spike(edge, t_send)
+        drop_p = ov.edge_dropout[edge]
+        delay = self._sample_delay(edge, key, ov)
+        if self._has_edge_faults:
+            factor, boost = self._edge_fault(edge, t_send, ov)
+            drop_p = jnp.clip(drop_p + boost, 0.0, 1.0)
+            delay = delay * factor
+        dropped = u < drop_p
+        return dropped, delay + self._spike(edge, t_send)
 
     # ==================================================================
     # metric write primitives (masked; index clamped)
@@ -278,6 +333,242 @@ class Engine:
                 clock_n=st.clock_n + one,
             )
         return st
+
+    # ==================================================================
+    # client retry/timeout machinery (statically pruned without a policy)
+    # ==================================================================
+
+    def _consume_retry_token(self, st: EngineState, now, want):
+        """(granted, state): lazily refill the retry-budget bucket and
+        take one token for lanes in ``want``; denials count in
+        ``n_budget_exhausted``.  Unlimited budgets grant unconditionally."""
+        if self._rb_cap is None:
+            return want, st
+        refill = jnp.float32(self.plan.retry_budget_refill)
+        tokens = jnp.minimum(
+            jnp.float32(self._rb_cap),
+            st.rb_tokens + (now - st.rb_last) * refill,
+        )
+        ok = want & (tokens >= 1.0)
+        st = st._replace(
+            rb_tokens=jnp.where(
+                want, tokens - jnp.where(ok, 1.0, 0.0), st.rb_tokens,
+            ),
+            rb_last=jnp.where(want, now, st.rb_last),
+            n_budget_exhausted=st.n_budget_exhausted
+            + jnp.where(want & ~ok, 1, 0),
+        )
+        return ok, st
+
+    def _backoff_delay(self, attempt, key):
+        """Backoff before re-issuing after ``attempt`` failed:
+        ``min(cap, base * mult**(attempt-1))`` times the jitter factor
+        (uniform in [1-j, 1+j]); the draw is a pure function of the
+        iteration key, so traces are seed-deterministic."""
+        plan = self.plan
+        expo = jnp.maximum(attempt.astype(jnp.float32) - 1.0, 0.0)
+        delay = jnp.minimum(
+            jnp.float32(plan.retry_backoff_cap),
+            jnp.float32(plan.retry_backoff_base)
+            * jnp.float32(plan.retry_backoff_mult) ** expo,
+        )
+        if plan.retry_jitter > 0:
+            u = jax.random.uniform(jax.random.fold_in(key, 57))
+            delay = delay * (
+                1.0 + jnp.float32(plan.retry_jitter) * (2.0 * u - 1.0)
+            )
+        return delay
+
+    def _record_attempts(self, st: EngineState, attempt, pred) -> EngineState:
+        """A logical request ended (completed or given up): bin how many
+        attempts it used."""
+        if not self._has_retry:
+            return st
+        idx = jnp.clip(attempt - 1, 0, self._att_bins - 1)
+        return st._replace(
+            att_hist=st.att_hist.at[idx].add(jnp.where(pred, 1, 0)),
+        )
+
+    def _client_fail(self, st: EngineState, i, now, key, pred) -> EngineState:
+        """A tracked attempt failed (edge drop, refusal, shed, abandon,
+        outage) and the client notices at failure time: re-park slot ``i``
+        as an EV_RETRY backoff wait, or give the logical request up.
+
+        Runs AFTER the failure site freed the slot, so give-up lanes stay
+        freed; retry lanes are re-claimed in place (no allocation race —
+        spawn and pool branches are disjoint within one iteration).
+        Orphaned attempts (client already timed out) just stay freed."""
+        if not self._has_retry:
+            return st
+        tracked = pred & (st.req_orphan[i] == 0)
+        attempt = st.req_attempt[i]
+        want = tracked & (attempt < self.plan.retry_max_attempts)
+        can, st = self._consume_retry_token(st, now, want)
+        delay = self._backoff_delay(attempt, key)
+        st = st._replace(
+            req_ev=st.req_ev.at[i].set(
+                jnp.where(can, EV_RETRY, st.req_ev[i]),
+            ),
+            req_t=st.req_t.at[i].set(
+                jnp.where(can, now + delay, st.req_t[i]),
+            ),
+            req_attempt=st.req_attempt.at[i].set(
+                jnp.where(can, attempt + 1, attempt),
+            ),
+            req_deadline=st.req_deadline.at[i].set(
+                jnp.where(pred, INF, st.req_deadline[i]),
+            ),
+            req_orphan=st.req_orphan.at[i].set(
+                jnp.where(pred, 0, st.req_orphan[i]),
+            ),
+            n_retries=st.n_retries + jnp.where(can, 1, 0),
+        )
+        return self._record_attempts(st, attempt, tracked & ~can)
+
+    def _timeout_branch(self, st: EngineState, i, now, key, ov, pred) -> EngineState:
+        """Slot ``i``'s client deadline fired while the attempt is still in
+        flight: orphan it (the server keeps processing — the retry-storm
+        amplification channel) and either park a NEW slot for the backoff
+        re-issue or give the logical request up."""
+        if not self._has_retry:
+            return st
+        attempt = st.req_attempt[i]
+        st = st._replace(
+            n_timed_out=st.n_timed_out + jnp.where(pred, 1, 0),
+            req_deadline=st.req_deadline.at[i].set(
+                jnp.where(pred, INF, st.req_deadline[i]),
+            ),
+            req_orphan=st.req_orphan.at[i].set(
+                jnp.where(pred, 1, st.req_orphan[i]),
+            ),
+        )
+        want = pred & (attempt < self.plan.retry_max_attempts)
+        can, st = self._consume_retry_token(st, now, want)
+        free_mask = st.req_ev == EV_IDLE
+        slot = jnp.argmax(free_mask).astype(jnp.int32)
+        has_free = free_mask[slot]
+        place = can & has_free
+        overflow = can & ~has_free
+        delay = self._backoff_delay(attempt, key)
+        idx = jnp.where(place, slot, jnp.int32(self.pool))
+        st = st._replace(
+            req_ev=st.req_ev.at[idx].set(EV_RETRY, mode="drop"),
+            req_t=st.req_t.at[idx].set(now + delay, mode="drop"),
+            req_attempt=st.req_attempt.at[idx].set(attempt + 1, mode="drop"),
+            req_deadline=st.req_deadline.at[idx].set(INF, mode="drop"),
+            req_orphan=st.req_orphan.at[idx].set(0, mode="drop"),
+            req_ram=st.req_ram.at[idx].set(0.0, mode="drop"),
+            req_ticket=st.req_ticket.at[idx].set(NO_TICKET, mode="drop"),
+            req_lbslot=st.req_lbslot.at[idx].set(-1, mode="drop"),
+            n_retries=st.n_retries + jnp.where(place, 1, 0),
+            n_overflow=st.n_overflow + jnp.where(overflow, 1, 0),
+        )
+        if self._has_llm:
+            st = st._replace(req_llm=st.req_llm.at[idx].set(0.0, mode="drop"))
+        # gave up: attempt cap, budget denial, or pool overflow
+        return self._record_attempts(st, attempt, pred & ~place)
+
+    def _retry_branch(self, st: EngineState, i, now, key, ov, pred) -> EngineState:
+        """An EV_RETRY park elapsed: re-issue the request down the (single
+        generator's) entry chain — the re-issue is a fresh attempt with its
+        own start time and client deadline."""
+        if not self._has_retry:
+            return st
+        plan = self.plan
+        alive = pred
+        t_cur = now
+        for j, eidx in enumerate(plan.entry_edges.tolist()):
+            e = jnp.int32(eidx)
+            dropped, delay = self._sample_edge(
+                e, t_cur, jax.random.fold_in(key, 8 + j), ov,
+            )
+            survives = alive & ~dropped
+            st = self._edge_interval(st, e, t_cur, t_cur + delay, survives)
+            st = st._replace(
+                n_dropped=st.n_dropped + jnp.where(alive & dropped, 1, 0),
+            )
+            t_cur = jnp.where(survives, t_cur + delay, t_cur)
+            alive = survives
+        ev0 = (
+            EV_ARRIVE_LB
+            if plan.entry_target_kind == TARGET_LB
+            else EV_ARRIVE_SRV
+        )
+        st = st._replace(
+            req_ev=st.req_ev.at[i].set(jnp.where(alive, ev0, st.req_ev[i])),
+            req_t=st.req_t.at[i].set(jnp.where(alive, t_cur, st.req_t[i])),
+            req_srv=st.req_srv.at[i].set(
+                jnp.where(
+                    alive, jnp.int32(max(plan.entry_target, 0)), st.req_srv[i],
+                ),
+            ),
+            req_start=st.req_start.at[i].set(
+                jnp.where(pred, now, st.req_start[i]),
+            ),
+            req_deadline=st.req_deadline.at[i].set(
+                jnp.where(alive, now + ov.retry_timeout, st.req_deadline[i]),
+            ),
+            req_lbslot=st.req_lbslot.at[i].set(
+                jnp.where(pred, -1, st.req_lbslot[i]),
+            ),
+            req_ram=st.req_ram.at[i].set(jnp.where(pred, 0.0, st.req_ram[i])),
+            req_ticket=st.req_ticket.at[i].set(
+                jnp.where(pred, NO_TICKET, st.req_ticket[i]),
+            ),
+        )
+        # dropped on the entry chain: this attempt failed before arriving
+        dead = pred & ~alive
+        st = st._replace(
+            req_ev=st.req_ev.at[i].set(
+                jnp.where(dead, EV_IDLE, st.req_ev[i]),
+            ),
+            req_t=st.req_t.at[i].set(jnp.where(dead, INF, st.req_t[i])),
+        )
+        return self._client_fail(st, i, now, key, dead)
+
+    def _client_arrive_branch(self, st, i, now, key, ov, pred) -> EngineState:
+        """Final delivery at the client (retry plans only): a non-orphan
+        arrival completes the logical request; an orphaned one is the
+        server-side tail of an abandoned attempt and records nothing."""
+        if not self._has_retry:
+            return st
+        done = pred & (st.req_orphan[i] == 0)
+        st = self._record_attempts(st, st.req_attempt[i], done)
+        if self._has_llm:
+            cost = st.req_llm[i]
+            st = st._replace(
+                llm_sum=st.llm_sum + jnp.where(done, cost, 0.0),
+                llm_sumsq=st.llm_sumsq + jnp.where(done, cost * cost, 0.0),
+            )
+            if self.collect_clocks:
+                lidx = jnp.where(
+                    done, st.clock_n, jnp.int32(st.llm_store.shape[0]),
+                )
+                st = st._replace(
+                    llm_store=st.llm_store.at[lidx].set(cost, mode="drop"),
+                )
+        if self.collect_traces:
+            st = self._hop(st, i, self.HOP_CLIENT, now, done)
+            idx = jnp.where(done, st.clock_n, jnp.int32(st.tr_code.shape[0]))
+            st = st._replace(
+                tr_code=st.tr_code.at[idx].set(st.req_hops[i], mode="drop"),
+                tr_t=st.tr_t.at[idx].set(st.req_hop_t[i], mode="drop"),
+                tr_n=st.tr_n.at[idx].set(
+                    jnp.minimum(st.req_hop_n[i], self._hop_cap),
+                    mode="drop",
+                ),
+            )
+        st = self._complete(st, st.req_start[i], now, done)
+        return st._replace(
+            req_ev=st.req_ev.at[i].set(jnp.where(pred, EV_IDLE, st.req_ev[i])),
+            req_t=st.req_t.at[i].set(jnp.where(pred, INF, st.req_t[i])),
+            req_deadline=st.req_deadline.at[i].set(
+                jnp.where(pred, INF, st.req_deadline[i]),
+            ),
+            req_orphan=st.req_orphan.at[i].set(
+                jnp.where(pred, 0, st.req_orphan[i]),
+            ),
+        )
 
     # ==================================================================
     # arrival sampler (window-jump semantics cloned from the reference)
@@ -539,6 +830,29 @@ class Engine:
         has_free = free_mask[slot]
         overflow = alive & ~has_free
         place = alive & has_free
+        # with a retry policy, an entry-chain drop is a FAILED first
+        # attempt the client retries: claim the slot as an EV_RETRY
+        # backoff park instead of forgetting the request
+        place_retry = jnp.bool_(False)
+        retry_delay = jnp.float32(0.0)
+        if self._has_retry:
+            failed = pred & ~alive
+            want = (
+                failed
+                if self.plan.retry_max_attempts > 1
+                else jnp.bool_(False)
+            )
+            can, st = self._consume_retry_token(st, now, want)
+            place_retry = can & has_free
+            overflow = overflow | (can & ~has_free)
+            st = self._record_attempts(
+                st, jnp.int32(1), failed & ~place_retry,
+            )
+            st = st._replace(
+                n_retries=st.n_retries + jnp.where(place_retry, 1, 0),
+            )
+            retry_delay = self._backoff_delay(jnp.int32(1), key)
+            place = place | place_retry
         if self._n_gen > 1:
             kinds = jnp.asarray(plan.gen_entry_target_kind)
             ev0 = jnp.where(
@@ -556,8 +870,12 @@ class Engine:
             entry_target = jnp.int32(max(plan.entry_target, 0))
         idx = jnp.where(place, slot, jnp.int32(self.pool))
         st = st._replace(
-            req_ev=st.req_ev.at[idx].set(ev0, mode="drop"),
-            req_t=st.req_t.at[idx].set(t_cur, mode="drop"),
+            req_ev=st.req_ev.at[idx].set(
+                jnp.where(place_retry, EV_RETRY, ev0), mode="drop",
+            ),
+            req_t=st.req_t.at[idx].set(
+                jnp.where(place_retry, now + retry_delay, t_cur), mode="drop",
+            ),
             req_srv=st.req_srv.at[idx].set(entry_target, mode="drop"),
             req_start=st.req_start.at[idx].set(now, mode="drop"),
             req_lbslot=st.req_lbslot.at[idx].set(-1, mode="drop"),
@@ -565,6 +883,17 @@ class Engine:
             req_ticket=st.req_ticket.at[idx].set(NO_TICKET, mode="drop"),
             n_overflow=st.n_overflow + jnp.where(overflow, 1, 0),
         )
+        if self._has_retry:
+            st = st._replace(
+                req_deadline=st.req_deadline.at[idx].set(
+                    jnp.where(place_retry, INF, now + ov.retry_timeout),
+                    mode="drop",
+                ),
+                req_attempt=st.req_attempt.at[idx].set(
+                    jnp.where(place_retry, 2, 1), mode="drop",
+                ),
+                req_orphan=st.req_orphan.at[idx].set(0, mode="drop"),
+            )
         if self._has_llm:
             st = st._replace(
                 req_llm=st.req_llm.at[idx].set(0.0, mode="drop"),
@@ -573,12 +902,13 @@ class Engine:
             # fresh ring: generator hop (code = generator index), then one
             # NETWORK + CLIENT pair per entry edge (the chain's
             # intermediate targets are clients; the LAST target is the
-            # LB/server, recorded by its own branch)
+            # LB/server, recorded by its own branch).  EV_RETRY parks
+            # record no hops (their walk was cut short by the drop).
             st = st._replace(
                 req_hop_n=st.req_hop_n.at[idx].set(0, mode="drop"),
             )
             for gi, chain in enumerate(chains):
-                place_gi = place & (g == gi)
+                place_gi = place & ~place_retry & (g == gi)
                 st = self._hop(st, idx, self.HOP_GEN + gi, now, place_gi)
                 gi_hops = [h for h in hop_chain if h[0] == gi]
                 for j, (_, eidx, t_hop) in enumerate(gi_hops):
@@ -727,6 +1057,7 @@ class Engine:
             st = self._breaker_server_report(
                 st, i, now, jnp.bool_(True), shed,
             )
+            st = self._client_fail(st, i, now, key, shed)
         return self._exit_flow(st, i, s, now, key, ov, is_end)
 
     def _release_ram(self, st, i, s, now, pred) -> EngineState:
@@ -813,6 +1144,53 @@ class Engine:
         drop_here = pred & dropped
 
         st = self._edge_interval(st, e, now, arrive, pred & ~dropped)
+        if self._has_retry:
+            # the final leg stays EVENT-DRIVEN: the client deadline must
+            # race the last transit exactly like the oracle's heap (a
+            # timeout during the final edge orphans the attempt), so
+            # completion is deferred to an EV_ARRIVE_CLIENT event at
+            # ``arrive`` instead of being folded into this exit event
+            if self.collect_traces:
+                st = self._hop(st, i, self.HOP_EDGE + e, arrive, pred & ~dropped)
+            st = st._replace(
+                req_ev=st.req_ev.at[i].set(
+                    jnp.where(
+                        drop_here,
+                        EV_IDLE,
+                        jnp.where(
+                            to_client,
+                            EV_ARRIVE_CLIENT,
+                            jnp.where(
+                                to_server,
+                                EV_ARRIVE_SRV,
+                                jnp.where(to_lb, EV_ARRIVE_LB, st.req_ev[i]),
+                            ),
+                        ),
+                    ),
+                ),
+                req_t=st.req_t.at[i].set(
+                    jnp.where(
+                        drop_here,
+                        INF,
+                        jnp.where(
+                            to_server | to_lb | to_client,
+                            arrive,
+                            st.req_t[i],
+                        ),
+                    ),
+                ),
+                req_srv=st.req_srv.at[i].set(
+                    jnp.where(to_server, p.exit_target[s], st.req_srv[i]),
+                ),
+                req_lbslot=st.req_lbslot.at[i].set(
+                    jnp.where(pred, -1, st.req_lbslot[i]),
+                ),
+                req_ram=st.req_ram.at[i].set(
+                    jnp.where(pred, 0.0, st.req_ram[i]),
+                ),
+                n_dropped=st.n_dropped + jnp.where(drop_here, 1, 0),
+            )
+            return self._client_fail(st, i, now, key, drop_here)
         done = to_client & (arrive < plan.horizon)
         if self._has_llm:
             cost = st.req_llm[i]
@@ -1037,6 +1415,7 @@ class Engine:
         st = self._hop(st, i, self.HOP_EDGE + p.lb_edge_index[slot], arrive, ok)
         st = self._edge_interval(st, e, now, arrive, ok)
         free = drop_empty | drop_edge
+        client_fail = (free | reject) if self._has_breaker else free
         st = st._replace(
             lb_order=order,
             lb_conn=st.lb_conn.at[slot].add(jnp.where(ok, 1, 0)),
@@ -1054,7 +1433,7 @@ class Engine:
             ),
             n_dropped=st.n_dropped + jnp.where(free, 1, 0),
         )
-        return st
+        return self._client_fail(st, i, now, key, client_fail)
 
     def _arrive_srv_branch(self, st, i, now, key, ov, pred) -> EngineState:
         """Arrival at a server: endpoint pick, RAM-first admission."""
@@ -1074,6 +1453,27 @@ class Engine:
                 ),
             )
 
+        if self._has_srv_faults:
+            # server-outage fault window: the server is dark and hard-
+            # refuses the arrival.  Unlike the legacy SERVER_DOWN event
+            # (LB rotation removal — a graceful drain), the LB only learns
+            # about this through the breaker's failure channel; the client
+            # through its retry policy.
+            dark = pred & self._srv_faulted(s, now, ov)
+            st = st._replace(
+                req_ev=st.req_ev.at[i].set(
+                    jnp.where(dark, EV_IDLE, st.req_ev[i]),
+                ),
+                req_t=st.req_t.at[i].set(
+                    jnp.where(dark, INF, st.req_t[i]),
+                ),
+                n_rejected=st.n_rejected + jnp.where(dark, 1, 0),
+            )
+            st = self._breaker_server_report(
+                st, i, now, jnp.bool_(True), dark,
+            )
+            st = self._client_fail(st, i, now, key, dark)
+            pred = pred & ~dark
         if self._has_rl:
             # token-bucket rate limiter: lazy refill at arrival, refuse
             # when no whole token remains (runs before the socket check)
@@ -1107,6 +1507,7 @@ class Engine:
             st = self._breaker_server_report(
                 st, i, now, jnp.bool_(True), limited,
             )
+            st = self._client_fail(st, i, now, key, limited)
             pred = pred & ~limited
         if self._has_conn:
             # socket capacity: refuse the arrival when the server is full
@@ -1124,6 +1525,7 @@ class Engine:
             st = self._breaker_server_report(
                 st, i, now, jnp.bool_(True), refuse,
             )
+            st = self._client_fail(st, i, now, key, refuse)
             pred = pred & ~refuse
             st = st._replace(
                 srv_conn=st.srv_conn.at[s].add(jnp.where(pred, 1, 0)),
@@ -1235,7 +1637,8 @@ class Engine:
             req_ram=st.req_ram.at[i].set(jnp.where(pred, 0.0, st.req_ram[i])),
             n_rejected=st.n_rejected + jnp.where(pred, 1, 0),
         )
-        return self._breaker_server_report(st, i, now, jnp.bool_(True), pred)
+        st = self._breaker_server_report(st, i, now, jnp.bool_(True), pred)
+        return self._client_fail(st, i, now, key, pred)
 
     def _seg_end_branch(self, st, i, now, key, ov, pred) -> EngineState:
         """A CPU burst or IO sleep finished: hand off the core / leave the IO
@@ -1390,6 +1793,27 @@ class Engine:
                 else jnp.zeros((1, 1), jnp.float32)
             ),
             tr_n=jnp.zeros(maxn if self.collect_traces else 1, jnp.int32),
+            req_deadline=(
+                jnp.full(pool, INF, jnp.float32)
+                if self._has_retry
+                else jnp.zeros(1, jnp.float32)
+            ),
+            req_attempt=(
+                jnp.ones(pool, jnp.int32)
+                if self._has_retry
+                else jnp.zeros(1, jnp.int32)
+            ),
+            req_orphan=jnp.zeros(pool if self._has_retry else 1, jnp.int32),
+            rb_tokens=jnp.float32(
+                self._rb_cap if self._rb_cap is not None else 0.0,
+            ),
+            rb_last=jnp.float32(0.0),
+            att_hist=jnp.zeros(
+                self._att_bins if self._has_retry else 1, jnp.int32,
+            ),
+            n_timed_out=jnp.int32(0),
+            n_retries=jnp.int32(0),
+            n_budget_exhausted=jnp.int32(0),
             req_llm=jnp.zeros(pool if self._has_llm else 1, jnp.float32),
             llm_sum=jnp.float32(0.0),
             llm_sumsq=jnp.float32(0.0),
@@ -1452,9 +1876,15 @@ class Engine:
 
     def _refresh_pool_min(self, st: EngineState) -> EngineState:
         """The single pool scan per iteration: cache argmin index + value so
-        ``_cond`` and the next body read scalars."""
-        i = jnp.argmin(st.req_t).astype(jnp.int32)
-        return st._replace(nxt_i=i, nxt_t=st.req_t[i])
+        ``_cond`` and the next body read scalars.  With a retry policy the
+        effective per-slot time is ``min(req_t, req_deadline)`` — a client
+        timeout is an event even while the attempt is parked at INF."""
+        if self._has_retry:
+            eff = jnp.minimum(st.req_t, st.req_deadline)
+        else:
+            eff = st.req_t
+        i = jnp.argmin(eff).astype(jnp.int32)
+        return st._replace(nxt_i=i, nxt_t=eff[i])
 
     def _cond(self, st: EngineState):
         t_pool, t_arr, t_tl = self._next_times(st)
@@ -1480,6 +1910,20 @@ class Engine:
         # `now`, so the cached index stays the pool minimum when is_pool
         i = st.nxt_i
         ev = st.req_ev[i]
+        if self._has_retry:
+            # the slot fired on its client deadline rather than its own
+            # event (deadline <= req_t; on ties the timeout wins, matching
+            # the oracle heap's schedule order) — orphan + maybe re-issue;
+            # the slot's real event stays pending for a later iteration
+            is_to = is_pool & (st.req_deadline[i] <= st.req_t[i])
+            st = self._timeout_branch(st, i, now, kit, ov, is_to)
+            is_pool = is_pool & ~is_to
+            st = self._retry_branch(
+                st, i, now, kit, ov, is_pool & (ev == EV_RETRY),
+            )
+            st = self._client_arrive_branch(
+                st, i, now, kit, ov, is_pool & (ev == EV_ARRIVE_CLIENT),
+            )
         st = self._arrive_lb_branch(
             st, i, now, kit, ov, is_pool & (ev == EV_ARRIVE_LB), weights,
         )
@@ -1507,10 +1951,15 @@ class Engine:
     ) -> EngineState:
         """Fresh (vmapped) pre-loop state for |keys| scenarios — the entry
         point of the segmented stepping API (:meth:`run_until`)."""
-        ov = overrides if overrides is not None else base_overrides(self.plan)
+        _base_ov = base_overrides(self.plan)
+        ov = (
+            fill_overrides(overrides, _base_ov)
+            if overrides is not None
+            else _base_ov
+        )
         axes = ScenarioOverrides(
             *[0 if o.ndim > b.ndim else None
-              for o, b in zip(ov, base_overrides(self.plan))],
+              for o, b in zip(ov, _base_ov)],
         )
         sig = ("init", tuple(axes))
         if sig not in self._compiled:
@@ -1542,10 +1991,15 @@ class Engine:
         derivation are the same; windows only pause it (events exactly at
         ``t_stop`` run in the next window, matching the oracle kernel's
         ``sim.run(until=...)``)."""
-        ov = overrides if overrides is not None else base_overrides(self.plan)
+        _base_ov = base_overrides(self.plan)
+        ov = (
+            fill_overrides(overrides, _base_ov)
+            if overrides is not None
+            else _base_ov
+        )
         axes = ScenarioOverrides(
             *[0 if o.ndim > b.ndim else None
-              for o, b in zip(ov, base_overrides(self.plan))],
+              for o, b in zip(ov, _base_ov)],
         )
         t_stop = jnp.asarray(t_stop, jnp.float32)
         batched_stop = t_stop.ndim > 0
@@ -1597,10 +2051,15 @@ class Engine:
         ``overrides`` fields may carry a leading scenario axis or be base
         values shared by every scenario.
         """
-        ov = overrides if overrides is not None else base_overrides(self.plan)
+        _base_ov = base_overrides(self.plan)
+        ov = (
+            fill_overrides(overrides, _base_ov)
+            if overrides is not None
+            else _base_ov
+        )
         axes = ScenarioOverrides(
             *[0 if o.ndim > b.ndim else None
-              for o, b in zip(ov, base_overrides(self.plan))],
+              for o, b in zip(ov, _base_ov)],
         )
         sig = tuple(axes)
         if sig not in self._compiled:
@@ -1792,6 +2251,14 @@ def run_single(
         edge_ids=plan.edge_ids,
         traces=traces,
         llm_cost=llm_cost,
+        total_timed_out=int(getattr(state, "n_timed_out", 0)),
+        total_retries=int(getattr(state, "n_retries", 0)),
+        retry_budget_exhausted=int(getattr(state, "n_budget_exhausted", 0)),
+        attempts_hist=(
+            np.asarray(state.att_hist)
+            if plan.has_retry and hasattr(state, "att_hist")
+            else None
+        ),
     )
 
 
@@ -1892,6 +2359,26 @@ def sweep_results(
         total_rejected=(
             np.asarray(final.n_rejected)
             if hasattr(final, "n_rejected")
+            else None
+        ),
+        total_timed_out=(
+            np.asarray(final.n_timed_out)
+            if engine.plan.has_retry and hasattr(final, "n_timed_out")
+            else None
+        ),
+        total_retries=(
+            np.asarray(final.n_retries)
+            if engine.plan.has_retry and hasattr(final, "n_retries")
+            else None
+        ),
+        retry_budget_exhausted=(
+            np.asarray(final.n_budget_exhausted)
+            if engine.plan.has_retry and hasattr(final, "n_budget_exhausted")
+            else None
+        ),
+        attempts_hist=(
+            np.asarray(final.att_hist)
+            if engine.plan.has_retry and hasattr(final, "att_hist")
             else None
         ),
         gauge_means=(

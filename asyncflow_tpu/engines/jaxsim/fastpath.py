@@ -87,7 +87,12 @@ from asyncflow_tpu.compiler.plan import (
     TARGET_SERVER,
     StaticPlan,
 )
-from asyncflow_tpu.engines.jaxsim.params import INF, ScenarioOverrides, base_overrides
+from asyncflow_tpu.engines.jaxsim.params import (
+    INF,
+    ScenarioOverrides,
+    base_overrides,
+    fill_overrides,
+)
 from asyncflow_tpu.engines.jaxsim.rotation import (
     rotation_advance,
     rotation_insert,
@@ -1496,11 +1501,16 @@ class FastEngine:
         overrides: ScenarioOverrides | None = None,
     ) -> FastState:
         """Run |keys| scenarios as one vmapped kernel."""
-        ov = overrides if overrides is not None else base_overrides(self.plan)
+        _base_ov = base_overrides(self.plan)
+        ov = (
+            fill_overrides(overrides, _base_ov)
+            if overrides is not None
+            else _base_ov
+        )
         axes = ScenarioOverrides(
             *[
                 0 if jnp.asarray(o).ndim > jnp.asarray(b).ndim else None
-                for o, b in zip(ov, base_overrides(self.plan))
+                for o, b in zip(ov, _base_ov)
             ],
         )
         sig = tuple(axes)
@@ -1552,7 +1562,12 @@ class FastEngine:
         compile-scaling gate (``asyncflow_tpu.utils.program_size``) — the
         gate must trace the SAME program production compiles.
         """
-        ov = overrides if overrides is not None else base_overrides(self.plan)
+        _base_ov = base_overrides(self.plan)
+        ov = (
+            fill_overrides(overrides, _base_ov)
+            if overrides is not None
+            else _base_ov
+        )
         s = keys.shape[0]
         t = total or s
         t = max(t, s)

@@ -335,6 +335,12 @@ class PallasEngine:
         kernel on its scenario shard (the kernel itself is a single-device
         program — GSPMD cannot partition a ``pallas_call``, so the sharding
         seam has to be explicit)."""
+        if plan.has_faults or plan.has_retry:
+            msg = (
+                "the Pallas VMEM kernel does not model fault windows / "
+                "client retries; use the XLA event engine"
+            )
+            raise ValueError(msg)
         self.plan = plan
         self.mesh = mesh
         self.n_hist_bins = n_hist_bins

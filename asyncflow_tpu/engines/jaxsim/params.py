@@ -24,6 +24,10 @@ EV_WAIT_CPU = 5
 EV_WAIT_RAM = 6
 EV_WAIT_DB = 7  # parked in the server's DB connection-pool FIFO
 EV_ABANDON = 8  # granted the core past its dequeue deadline: abandon now
+EV_RETRY = 9  # client backoff park: re-issue down the entry chain at t
+# final client delivery as a real event (retry plans only): the client
+# deadline must race the last transit, exactly like the oracle's heap
+EV_ARRIVE_CLIENT = 10
 
 
 class PlanParams(NamedTuple):
@@ -63,6 +67,11 @@ class PlanParams(NamedTuple):
     user_mean: jnp.ndarray  # scalar, overridable per scenario
     user_var: jnp.ndarray
     req_rate: jnp.ndarray  # requests / user / second
+    # resilience fault tables (values; the breakpoint TIMES ride the
+    # overrides so fault-timing Monte-Carlo sweeps can batch per scenario)
+    fault_srv_down: jnp.ndarray  # (K, NS) i32
+    fault_edge_lat: jnp.ndarray  # (M, NE) f32 multiplicative factor
+    fault_edge_drop: jnp.ndarray  # (M, NE) f32 additive dropout boost
 
 
 def params_from_plan(plan: StaticPlan) -> PlanParams:
@@ -103,6 +112,9 @@ def params_from_plan(plan: StaticPlan) -> PlanParams:
         user_mean=jnp.float32(plan.user_mean),
         user_var=jnp.float32(plan.user_var),
         req_rate=jnp.float32(plan.req_per_user_per_sec),
+        fault_srv_down=jnp.asarray(plan.fault_srv_down),
+        fault_edge_lat=jnp.asarray(plan.fault_edge_lat),
+        fault_edge_drop=jnp.asarray(plan.fault_edge_drop),
     )
 
 
@@ -164,6 +176,22 @@ class EngineState(NamedTuple):
     llm_sum: jnp.ndarray  # scalar f32: total cost of completed requests
     llm_sumsq: jnp.ndarray  # scalar f32
     llm_store: jnp.ndarray  # (maxN,) f32 per-completion cost (clock-aligned)
+    # client retry/timeout machinery (size (1,) unless the plan has a
+    # retry policy).  req_deadline is the ABSOLUTE client timeout of the
+    # slot's in-flight attempt (INF once orphaned / parked / idle);
+    # req_attempt the attempt number of the current issue (spawn = 1);
+    # req_orphan = 1 after the client abandoned the in-flight attempt
+    # (the request keeps consuming server resources but its completion
+    # no longer counts).
+    req_deadline: jnp.ndarray  # (P,) f32
+    req_attempt: jnp.ndarray  # (P,) i32
+    req_orphan: jnp.ndarray  # (P,) i32
+    rb_tokens: jnp.ndarray  # scalar f32: retry-budget bucket fill
+    rb_last: jnp.ndarray  # scalar f32: last budget refill timestamp
+    att_hist: jnp.ndarray  # (A,) i32: attempts used per finished request
+    n_timed_out: jnp.ndarray  # scalar i32: client timeouts fired
+    n_retries: jnp.ndarray  # scalar i32: re-issues performed
+    n_budget_exhausted: jnp.ndarray  # scalar i32: retries denied by budget
     # outage timeline cursor
     tl_ptr: jnp.ndarray  # scalar i32
     # cached pool argmin (computed once at the end of each loop body so the
@@ -202,6 +230,13 @@ class ScenarioOverrides(NamedTuple):
     edge_dropout: jnp.ndarray
     user_mean: jnp.ndarray  # scalar or (S,)
     req_rate: jnp.ndarray
+    # resilience sweep axes: per-scenario fault-window TIMINGS (the value
+    # tables stay plan-static in PlanParams) and the client timeout.
+    # ``None`` (legacy constructors) means "the base plan's value" —
+    # engines normalize through :func:`fill_overrides` before tracing.
+    fault_srv_times: jnp.ndarray | None = None  # (K,) or (S, K)
+    fault_edge_times: jnp.ndarray | None = None  # (M,) or (S, M)
+    retry_timeout: jnp.ndarray | None = None  # scalar or (S,)
 
 
 def base_overrides(plan: StaticPlan) -> ScenarioOverrides:
@@ -223,6 +258,20 @@ def base_overrides(plan: StaticPlan) -> ScenarioOverrides:
         edge_dropout=jnp.asarray(plan.edge_dropout),
         user_mean=user_mean,
         req_rate=req_rate,
+        fault_srv_times=jnp.asarray(plan.fault_srv_times),
+        fault_edge_times=jnp.asarray(plan.fault_edge_times),
+        retry_timeout=jnp.float32(plan.retry_timeout),
+    )
+
+
+def fill_overrides(
+    ov: ScenarioOverrides,
+    base: ScenarioOverrides,
+) -> ScenarioOverrides:
+    """Replace ``None`` fields (legacy 5-field constructors) with the base
+    plan's values so every consumer sees fully-populated overrides."""
+    return ScenarioOverrides(
+        *[b if o is None else o for o, b in zip(ov, base)],
     )
 
 

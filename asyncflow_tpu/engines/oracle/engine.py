@@ -77,6 +77,13 @@ class Request:
     probe: bool = False
     #: accumulated LLM cost units (io_llm steps with call dynamics)
     llm_cost: float = 0.0
+    #: client retry machinery: attempt number of this issue (spawn = 1),
+    #: True once the client abandoned it (timeout fired; the request
+    #: keeps consuming server resources but no longer counts), True once
+    #: the client-side outcome (completion or failure) is settled.
+    attempt: int = 1
+    orphan: bool = False
+    settled: bool = False
 
     def record_hop(self, kind: str, component_id: str, now: float) -> None:
         self.history.append(Hop(kind, component_id, now))
@@ -95,7 +102,13 @@ class _EdgeRuntime:
 
     def transport(self, req: Request) -> None:
         engine = self.engine
-        if engine.rng.uniform() < self.cfg.dropout_rate:
+        # fault windows gate the traversal: dropout boosted (partition
+        # windows boost it to 1), latency draws multiplied
+        lat_factor, drop_boost = engine.edge_fault_at(
+            self.cfg.id, engine.sim.now,
+        )
+        drop_p = min(1.0, self.cfg.dropout_rate + drop_boost)
+        if engine.rng.uniform() < drop_p:
             req.finish_time = engine.sim.now
             req.record_hop(
                 SystemEdges.NETWORK_CONNECTION,
@@ -107,11 +120,12 @@ class _EdgeRuntime:
                 # a dropped send on the routing edge is a connection
                 # failure to the breaker
                 engine.breaker_failure(req)
+            engine.client_fail(req)
             return
 
         self.concurrent += 1
         self.total_sent += 1
-        transit = sample_rv(self.cfg.latency, engine.rng)
+        transit = sample_rv(self.cfg.latency, engine.rng) * lat_factor
         transit += engine.edge_spike.get(self.cfg.id, 0.0)
 
         def deliver() -> None:
@@ -183,6 +197,18 @@ class _ServerRuntime:
 
     def receive(self, req: Request) -> None:
         engine = self.engine
+        if engine.server_faulted(self.cfg.id, engine.sim.now):
+            # server-outage fault window: the server is dark and hard-
+            # refuses the arrival (the LB only learns via the breaker;
+            # the client via its retry policy)
+            req.finish_time = engine.sim.now
+            req.record_hop(
+                SystemNodes.SERVER, f"{self.cfg.id}-outage", engine.sim.now,
+            )
+            engine.total_rejected += 1
+            engine.breaker_failure(req)
+            engine.client_fail(req)
+            return
         if self.rate_limit is not None:
             now = engine.sim.now
             self.rl_tokens = min(
@@ -198,6 +224,7 @@ class _ServerRuntime:
                 )
                 engine.total_rejected += 1
                 engine.breaker_failure(req)
+                engine.client_fail(req)
                 return
             self.rl_tokens -= 1.0
         if self.conn_cap is not None and self.residents >= self.conn_cap:
@@ -210,6 +237,7 @@ class _ServerRuntime:
             )
             engine.total_rejected += 1
             engine.breaker_failure(req)
+            engine.client_fail(req)
             return
         self.residents += 1
         engine.sim.process(self._handle(req))
@@ -265,6 +293,7 @@ class _ServerRuntime:
                             )
                             engine.total_rejected += 1
                             engine.breaker_failure(req)
+                            engine.client_fail(req)
                             return
                         waiting_cpu = True
                         self.ready_queue_len += 1
@@ -292,6 +321,7 @@ class _ServerRuntime:
                             )
                             engine.total_rejected += 1
                             engine.breaker_failure(req)
+                            engine.client_fail(req)
                             return
                     core_locked = True
                 yield Timeout(step.quantity)
@@ -363,6 +393,29 @@ class OracleEngine:
         self.total_generated = 0
         self.total_dropped = 0
         self.total_rejected = 0
+        # resilience: fault tables (same lowering the JAX plan consumes)
+        # and the client retry machinery
+        from asyncflow_tpu.compiler.faults import lower_faults, lower_retry
+
+        self._faults = lower_faults(payload)
+        self._edge_idx = {
+            e.id: i for i, e in enumerate(payload.topology_graph.edges)
+        }
+        self._server_idx = {
+            s.id: i
+            for i, s in enumerate(payload.topology_graph.nodes.servers)
+        }
+        self.retry = lower_retry(payload.retry_policy)
+        self.total_timed_out = 0
+        self.total_retries = 0
+        self.retry_budget_exhausted = 0
+        self.attempts_hist = np.zeros(
+            max(self.retry.max_attempts, 1), dtype=np.int64,
+        )
+        self._rb_tokens = (
+            self.retry.budget_tokens if self.retry.budget_tokens >= 0 else 0.0
+        )
+        self._rb_last = 0.0
         self.rqs_clock: list[tuple[float, float]] = []
         self.llm_costs: list[float] = []  # aligned with rqs_clock
         # gate the llm_cost OUTPUT on llm presence in the payload (not on
@@ -399,6 +452,10 @@ class OracleEngine:
         self.lb_weights: dict[str, float] | None = None
         self._gen_ids = {g.id for g in payload.generators}
         self.generator_out_by_id: dict[str, _EdgeRuntime] = {}
+        # re-issue path for the client retry policy (single generator —
+        # enforced by the payload validator)
+        self._entry_out: _EdgeRuntime | None = None
+        self._entry_gen_id: str | None = None
 
         self._wire()
 
@@ -440,6 +497,9 @@ class OracleEngine:
         """One arrival process per generator; multi-generator payloads
         superpose (each with its own workload params and entry edge)."""
         out = self.generator_out_by_id[workload.id]
+        if self.retry.enabled:
+            self._entry_out = out
+            self._entry_gen_id = workload.id
         for gap in arrival_gaps(
             workload,
             self.settings,
@@ -453,6 +513,11 @@ class OracleEngine:
                 workload.id,
                 self.sim.now,
             )
+            if self.retry.enabled:
+                self.sim.after(
+                    self.retry.timeout,
+                    lambda r=req: self._on_timeout(r),
+                )
             out.transport(req)
 
     def _client_receive(self, req: Request) -> None:
@@ -461,6 +526,14 @@ class OracleEngine:
         # generator + edge + first client visit leave exactly 3 hops).
         if len(req.history) > 3:
             req.finish_time = self.sim.now
+            if req.orphan:
+                # the client already timed out and moved on: the orphaned
+                # completion is invisible (no latency, cost, or trace)
+                req.settled = True
+                return
+            req.settled = True
+            if self.retry.enabled:
+                self._record_attempts(req.attempt)
             self.rqs_clock.append((req.initial_time, req.finish_time))
             self.llm_costs.append(req.llm_cost)
             if self.collect_traces:
@@ -480,6 +553,7 @@ class OracleEngine:
             # subset of the declared servers): the request has nowhere to go.
             req.finish_time = self.sim.now
             self.total_dropped += 1
+            self.client_fail(req)
             return
         out = self._pick_lb_edge()
         if out is None:
@@ -493,6 +567,7 @@ class OracleEngine:
                 self.sim.now,
             )
             self.total_rejected += 1
+            self.client_fail(req)
             return
         if self.breaker is not None:
             st = self._breaker_st(out.cfg.id)
@@ -591,6 +666,111 @@ class OracleEngine:
             return
         if st["state"] == 0:
             st["consec"] = 0
+
+    # ------------------------------------------------------------------
+    # resilience: fault lookups + client retry/timeout/backoff
+    # ------------------------------------------------------------------
+
+    def edge_fault_at(self, edge_id: str, now: float) -> tuple[float, float]:
+        """(latency factor, dropout boost) active on ``edge_id`` at ``now``."""
+        if not self._faults.has_faults:
+            return 1.0, 0.0
+        return self._faults.edge_fault(self._edge_idx[edge_id], now)
+
+    def server_faulted(self, server_id: str, now: float) -> bool:
+        """True while ``server_id`` sits inside an outage fault window."""
+        return self._faults.has_faults and self._faults.server_down(
+            self._server_idx[server_id], now,
+        )
+
+    def _retry_token(self) -> bool:
+        """Lazily refill the retry-budget bucket and take one token."""
+        if self.retry.budget_tokens < 0:
+            return True  # unlimited budget
+        now = self.sim.now
+        self._rb_tokens = min(
+            self.retry.budget_tokens,
+            self._rb_tokens + (now - self._rb_last) * self.retry.budget_refill,
+        )
+        self._rb_last = now
+        if self._rb_tokens >= 1.0:
+            self._rb_tokens -= 1.0
+            return True
+        self.retry_budget_exhausted += 1
+        return False
+
+    def _backoff(self, attempt: int) -> float:
+        """Backoff before re-issuing after ``attempt`` failed, with the
+        jitter factor drawn from the seeded engine RNG."""
+        delay = min(
+            self.retry.backoff_cap,
+            self.retry.backoff_base
+            * self.retry.backoff_mult ** max(attempt - 1, 0),
+        )
+        if self.retry.jitter > 0:
+            delay *= 1.0 + self.retry.jitter * (2.0 * self.rng.uniform() - 1.0)
+        return delay
+
+    def _record_attempts(self, attempt: int) -> None:
+        self.attempts_hist[
+            min(attempt, len(self.attempts_hist)) - 1
+        ] += 1
+
+    def issue(self, req: Request) -> None:
+        """Send one attempt down the entry chain, arming its client
+        deadline (no-op without a retry policy)."""
+        out = self._entry_out
+        assert out is not None
+        if self.retry.enabled:
+            self.sim.after(
+                self.retry.timeout, lambda: self._on_timeout(req),
+            )
+        out.transport(req)
+
+    def _on_timeout(self, req: Request) -> None:
+        """The client's per-attempt deadline fired: if the attempt is
+        still unresolved, orphan it (server-side work continues — the
+        retry-storm amplification channel) and maybe re-issue."""
+        if req.settled or req.orphan:
+            return
+        req.orphan = True
+        self.total_timed_out += 1
+        self._maybe_reissue(req)
+
+    def client_fail(self, req: Request) -> None:
+        """A tracked attempt failed (drop / refusal / shed / abandon /
+        outage) and the client notices at failure time: back off and
+        re-issue, or give the logical request up.  Orphaned attempts are
+        already abandoned — their failures are silent."""
+        if not self.retry.enabled:
+            return
+        if req.orphan or req.settled:
+            req.settled = True
+            return
+        req.settled = True
+        self._maybe_reissue(req)
+
+    def _maybe_reissue(self, req: Request) -> None:
+        if req.attempt >= self.retry.max_attempts or not self._retry_token():
+            self._record_attempts(req.attempt)
+            return
+        self.total_retries += 1
+        delay = self._backoff(req.attempt)
+        attempt = req.attempt + 1
+
+        def reissue() -> None:
+            new_req = Request(
+                id=req.id,
+                initial_time=self.sim.now,
+                attempt=attempt,
+            )
+            if self._entry_gen_id is not None:
+                new_req.record_hop(
+                    SystemNodes.GENERATOR, self._entry_gen_id, self.sim.now,
+                )
+            self.issue(new_req)
+
+        self.sim.after(delay, reissue)
 
     # ------------------------------------------------------------------
     # event injection
@@ -738,5 +918,11 @@ class OracleEngine:
                 np.asarray(self.llm_costs, dtype=np.float64)
                 if self._has_llm
                 else None
+            ),
+            total_timed_out=self.total_timed_out,
+            total_retries=self.total_retries,
+            retry_budget_exhausted=self.retry_budget_exhausted,
+            attempts_hist=(
+                self.attempts_hist.copy() if self.retry.enabled else None
             ),
         )

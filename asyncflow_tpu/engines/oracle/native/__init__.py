@@ -196,6 +196,12 @@ def run_native(
     if collect_traces and payload is None:
         msg = "collect_traces=True needs the payload to decode component ids"
         raise ValueError(msg)
+    if plan.has_faults or plan.has_retry:
+        msg = (
+            "the native core does not model fault windows / client "
+            "retries; use the oracle or the jax event engine"
+        )
+        raise ValueError(msg)
     lib = load_library()
     if lib is None:
         msg = f"native core unavailable: {_lib_error}"

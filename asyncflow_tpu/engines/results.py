@@ -23,6 +23,11 @@ class DeviceCounters:
     overload-policy shed count; ``overflow`` the request-pool drop count
     (JAX engines only; always 0 on the oracle); ``truncated`` the number of
     scenarios cut short by the event engine's iteration safety cap.
+
+    The resilience counters (0 without a retry policy): ``timed_out``
+    client deadlines fired, ``retries`` re-issues performed,
+    ``budget_exhausted`` retries denied by the token-bucket retry budget.
+    Goodput is ``completed``; offered load is ``generated + retries``.
     """
 
     completed: int
@@ -31,6 +36,9 @@ class DeviceCounters:
     overflow: int
     rejected: int
     truncated: int = 0
+    timed_out: int = 0
+    retries: int = 0
+    budget_exhausted: int = 0
 
     def as_dict(self) -> dict[str, int]:
         return asdict(self)
@@ -70,6 +78,14 @@ class SimulationResults:
     #: ``rqs_clock`` rows (io_llm steps with call dynamics; the
     #: reference's reserved ``llm_cost`` event metric, activated).
     llm_cost: np.ndarray | None = None
+    #: resilience counters (client retry policy; 0 / None without one):
+    #: client timeouts fired, re-issues performed, retries denied by the
+    #: retry budget, and the per-logical-request attempts histogram
+    #: (length = max_attempts; bin k = requests that used k+1 attempts).
+    total_timed_out: int = 0
+    total_retries: int = 0
+    retry_budget_exhausted: int = 0
+    attempts_hist: np.ndarray | None = None
 
     @property
     def latencies(self) -> np.ndarray:
@@ -77,6 +93,11 @@ class SimulationResults:
         if self.rqs_clock.size == 0:
             return np.empty(0, dtype=np.float64)
         return self.rqs_clock[:, 1] - self.rqs_clock[:, 0]
+
+    @property
+    def offered(self) -> int:
+        """Total issues the system saw: spawns + client re-issues."""
+        return int(self.total_generated) + int(self.total_retries)
 
     def counters(self) -> DeviceCounters:
         """The unified counter schema (``completed`` counts recorded clock
@@ -87,6 +108,9 @@ class SimulationResults:
             dropped=int(self.total_dropped),
             overflow=int(self.overflow_dropped),
             rejected=int(self.total_rejected),
+            timed_out=int(self.total_timed_out),
+            retries=int(self.total_retries),
+            budget_exhausted=int(self.retry_budget_exhausted),
         )
 
 
@@ -137,6 +161,13 @@ class SweepResults:
     #: only for engines with no shed channel at all (fast path / Pallas,
     #: which the compiler restricts to plans without reachable caps).
     total_rejected: np.ndarray | None = None
+    #: (S,) resilience counters and the (S, A) per-scenario attempts
+    #: histogram (event engine on plans with a retry policy; None
+    #: otherwise — the compiler routes such plans off the fast path).
+    total_timed_out: np.ndarray | None = None
+    total_retries: np.ndarray | None = None
+    retry_budget_exhausted: np.ndarray | None = None
+    attempts_hist: np.ndarray | None = None
 
     def __getitem__(self, idx) -> SweepResults:
         """Slice along the scenario axis."""
@@ -164,6 +195,26 @@ class SweepResults:
             total_rejected=(
                 self.total_rejected[idx]
                 if self.total_rejected is not None
+                else None
+            ),
+            total_timed_out=(
+                self.total_timed_out[idx]
+                if self.total_timed_out is not None
+                else None
+            ),
+            total_retries=(
+                self.total_retries[idx]
+                if self.total_retries is not None
+                else None
+            ),
+            retry_budget_exhausted=(
+                self.retry_budget_exhausted[idx]
+                if self.retry_budget_exhausted is not None
+                else None
+            ),
+            attempts_hist=(
+                self.attempts_hist[idx]
+                if self.attempts_hist is not None
                 else None
             ),
             llm_cost_sum=(
@@ -194,6 +245,21 @@ class SweepResults:
             ),
             truncated=(
                 int(np.sum(self.truncated)) if self.truncated is not None else 0
+            ),
+            timed_out=(
+                int(np.sum(self.total_timed_out))
+                if self.total_timed_out is not None
+                else 0
+            ),
+            retries=(
+                int(np.sum(self.total_retries))
+                if self.total_retries is not None
+                else 0
+            ),
+            budget_exhausted=(
+                int(np.sum(self.retry_budget_exhausted))
+                if self.retry_budget_exhausted is not None
+                else 0
             ),
         )
 

@@ -18,7 +18,11 @@ import numpy as np
 
 from asyncflow_tpu.compiler.plan import StaticPlan, compile_payload
 from asyncflow_tpu.engines.jaxsim.engine import Engine, scenario_keys, sweep_results
-from asyncflow_tpu.engines.jaxsim.params import ScenarioOverrides, base_overrides
+from asyncflow_tpu.engines.jaxsim.params import (
+    ScenarioOverrides,
+    base_overrides,
+    fill_overrides,
+)
 from asyncflow_tpu.engines.results import SweepResults
 from asyncflow_tpu.observability.telemetry import (
     TelemetryConfig,
@@ -44,12 +48,35 @@ def make_overrides(
     dropout_scale: np.ndarray | None = None,
     user_mean: np.ndarray | None = None,
     req_per_minute: np.ndarray | None = None,
+    fault_shift: np.ndarray | None = None,
+    retry_timeout: np.ndarray | None = None,
 ) -> ScenarioOverrides:
     """Per-scenario parameter overrides; every scale is (S,) or (S, NE).
 
     On multi-generator plans, ``user_mean`` / ``req_per_minute`` must be
-    (S, G) — one value per scenario per generator stream."""
+    (S, G) — one value per scenario per generator stream.
+
+    ``fault_shift``: (S,) seconds added to every fault-window breakpoint
+    (the Monte-Carlo axis for fault TIMING; window shapes stay the
+    plan's); shifted times clip at 0 and the leading identity row stays
+    pinned at t = 0.  ``retry_timeout``: (S,) per-scenario client request
+    timeouts.  Both require the base plan to model faults / a retry
+    policy — the lowered tables they perturb must exist."""
     base = base_overrides(plan)
+    if fault_shift is not None and not plan.has_faults:
+        msg = (
+            "fault_shift overrides need a fault_timeline in the payload: "
+            "the compiler lowers the window shapes; overrides only move "
+            "their timings"
+        )
+        raise ValueError(msg)
+    if retry_timeout is not None and not plan.has_retry:
+        msg = (
+            "retry_timeout overrides need a retry_policy in the payload: "
+            "the retry machinery is compiled in only when the base plan "
+            "models it"
+        )
+        raise ValueError(msg)
     g = plan.n_generators
     if g > 1:
         for name, arr in (("user_mean", user_mean),
@@ -82,12 +109,41 @@ def make_overrides(
         if req_per_minute is None
         else jnp.asarray(req_per_minute, jnp.float32) / 60.0
     )
+
+    def _shifted(times: jnp.ndarray) -> jnp.ndarray:
+        shift = jnp.asarray(fault_shift, jnp.float32)
+        if shift.shape != (n_scenarios,):
+            msg = (
+                f"fault_shift must have shape ({n_scenarios},), got "
+                f"{shift.shape}"
+            )
+            raise ValueError(msg)
+        out = jnp.maximum(times[None, :] + shift[:, None], 0.0)
+        # the leading row is the identity state before any window: keep
+        # it pinned at t=0 so lookups before the first window stay sane
+        return out.at[:, 0].set(0.0)
+
     return ScenarioOverrides(
         edge_mean=_edges(edge_mean_scale, base.edge_mean),
         edge_var=_edges(edge_var_scale, base.edge_var),
         edge_dropout=jnp.clip(_edges(dropout_scale, base.edge_dropout), 0.0, 1.0),
         user_mean=user,
         req_rate=rate,
+        fault_srv_times=(
+            base.fault_srv_times
+            if fault_shift is None
+            else _shifted(base.fault_srv_times)
+        ),
+        fault_edge_times=(
+            base.fault_edge_times
+            if fault_shift is None
+            else _shifted(base.fault_edge_times)
+        ),
+        retry_timeout=(
+            base.retry_timeout
+            if retry_timeout is None
+            else jnp.asarray(retry_timeout, jnp.float32)
+        ),
     )
 
 
@@ -172,6 +228,10 @@ class SweepReport:
     plan: StaticPlan | None = None
     #: component ids of gauge_series columns (the sweep's gauge_series spec)
     gauge_series_ids: list[str] | None = None
+    #: chunk-size downshifts taken after accelerator OOMs (None when the
+    #: sweep ran at its configured chunk size throughout); each entry is
+    #: {"scenario_start", "from", "to"} — also recorded in telemetry meta
+    downshifts: list[dict] | None = None
 
     def mean_gauge(self, metric: str, component_id: str) -> np.ndarray:
         """(S,) per-scenario time-average of one gauge (fast path sweeps).
@@ -286,6 +346,37 @@ class SweepReport:
             "truncated_total": (
                 int(res.truncated.sum()) if res.truncated is not None else 0
             ),
+            "timed_out_total": (
+                int(res.total_timed_out.sum())
+                if res.total_timed_out is not None
+                else 0
+            ),
+            "retries_total": (
+                int(res.total_retries.sum())
+                if res.total_retries is not None
+                else 0
+            ),
+            "retry_budget_exhausted_total": (
+                int(res.retry_budget_exhausted.sum())
+                if res.retry_budget_exhausted is not None
+                else 0
+            ),
+            # goodput fraction: completions over offered issues (spawns +
+            # re-issues); 1.0 when nothing was offered
+            "goodput_fraction": (
+                float(
+                    completed
+                    / max(
+                        int(res.total_generated.sum())
+                        + (
+                            int(res.total_retries.sum())
+                            if res.total_retries is not None
+                            else 0
+                        ),
+                        1,
+                    ),
+                )
+            ),
             "latency_mean_s": float(mean),
             "llm_cost_total": (
                 float(res.llm_cost_sum.sum())
@@ -381,6 +472,19 @@ class SweepRunner:
             self._gauge_sel, gauge_stride, self._gauge_series_ids = (
                 _resolve_gauge_series(self.plan, gauge_series)
             )
+        # Resilience plans (fault windows / client retries) are modeled by
+        # the oracle and the XLA event engine only: the fast path refuses
+        # them at compile time (fastpath_reason), and the native C++ core
+        # and Pallas VMEM kernel do not carry the machinery yet — forcing
+        # them is an explicit error, never a silent mis-model.
+        resilient = self.plan.has_faults or self.plan.has_retry
+        if resilient and engine in ("native", "pallas"):
+            msg = (
+                f"engine={engine!r} does not model fault windows / client "
+                "retries; use engine='event' (or 'auto', which routes "
+                "resilience plans to the event engine)"
+            )
+            raise ValueError(msg)
         if engine == "native":
             # the single-core C++ oracle, looped over the scenario grid:
             # no batching, but the lowest per-scenario constant of any
@@ -424,10 +528,12 @@ class SweepRunner:
         elif engine == "pallas" or (
             engine == "auto"
             and jax.default_backend() == "tpu"
-            # the VMEM kernel models the full event-engine feature set
-            # (round 5): overload policies, circuit breakers, DB pools,
-            # cache mixtures, LLM dynamics, weighted endpoints, and
-            # multi-generator workloads
+            and not resilient
+            # the VMEM kernel models the round-5 event-engine feature set
+            # (overload policies, circuit breakers, DB pools, cache
+            # mixtures, LLM dynamics, weighted endpoints, multi-generator
+            # workloads) but NOT fault windows / client retries — those
+            # route to the XLA event engine
         ):
             from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
 
@@ -475,8 +581,14 @@ class SweepRunner:
         digest = hashlib.sha256()
         # bump when the per-chunk npz schema changes so stale chunks are
         # never silently merged (e.g. pre-gauge_means chunks)
-        digest.update(b"chunk-schema-v4")
+        digest.update(b"chunk-schema-v5")
         digest.update(self.payload.model_dump_json().encode())
+        # the LOWERED plan arrays, not just the payload: any plan-level
+        # field (fault tables, retry scalars, capacity estimates — and
+        # every future field, automatically) must invalidate old chunks,
+        # so resuming a checkpoint against a changed scenario fails loudly
+        # into a fresh directory instead of splicing incompatible partials
+        digest.update(self.plan.array_digest().encode())
         digest.update(self.engine_kind.encode())
         digest.update(str(self.engine.n_hist_bins).encode())
         # capacity knobs change overflow truncation in saturated runs, so
@@ -582,6 +694,7 @@ class SweepRunner:
             horizon_s=float(self.plan.horizon),
             wall_seconds=round(report.wall_seconds, 6),
             scenarios_per_second=round(report.scenarios_per_second, 3),
+            chunk_downshifts=report.downshifts or [],
         )
         tel.finalize(counters=report.results.counters())
         return report
@@ -599,7 +712,12 @@ class SweepRunner:
     ) -> SweepReport:
         import time
 
+        if overrides is not None:
+            # legacy 5-field constructors leave the resilience fields None;
+            # normalize once so guards/digests/engines see full overrides
+            overrides = fill_overrides(overrides, base_overrides(self.plan))
         self._guard_fastpath_overrides(overrides)
+        _guard_resilience_overrides(self.plan, overrides)
         n_dev = len(self.mesh.devices.flat) if self.mesh is not None else 1
         default = self.default_chunk(self.engine_kind)
         chunk = chunk_size or min(default * n_dev, n_scenarios)
@@ -630,36 +748,45 @@ class SweepRunner:
             if self.engine_kind == "native"
             else scenario_keys(seed, first_scenario + n_scenarios + n_dev - 1)
         )
-        partials: list[SweepResults] = []
-        inflight: list[tuple[int, object]] = []
-        done = 0
-        chunk_idx = 0
-        while done < n_scenarios:
-            take = min(chunk, n_scenarios - done)
-            take = max(n_dev, (take // n_dev) * n_dev)  # pad to device multiple
-            cached = ckpt.load(done) if ckpt else None
-            if cached is not None:
-                partials.append(cached)
-                done += take
-                chunk_idx += 1
-                continue
-            lo = first_scenario + done
+        downshifts: list[dict] = []
+
+        def _downshift(failed_take: int, err: Exception, start: int) -> int:
+            """Halve the chunk after an accelerator OOM, floored at one
+            device-multiple; at the floor, re-raise with a sizing hint."""
+            if failed_take <= n_dev:
+                msg = (
+                    f"chunk of {failed_take} scenario(s) still exhausts "
+                    "device memory at the minimum chunk size; shrink the "
+                    "plan (pool_size / max_requests / horizon) or run on "
+                    "a device with more memory"
+                )
+                raise RuntimeError(msg) from err
+            new = max(n_dev, ((failed_take // 2) // n_dev) * n_dev)
+            downshifts.append(
+                {"scenario_start": start, "from": failed_take, "to": new},
+            )
+            return new
+
+        def _fetch(final, slot: int, start: int) -> SweepResults:
+            with _ph(tel, "fetch", chunk=slot):
+                part = sweep_results(
+                    self.engine,
+                    final,
+                    self.payload.sim_settings,
+                    gauge_sel=self._gauge_sel,
+                )
+            _check_finite(part, self.engine_kind, slot, start)
+            return part
+
+        def _dispatch(done_local: int, take: int, chunk_idx: int):
+            lo = first_scenario + done_local
             ov = (
-                _slice_overrides(overrides, base_overrides(self.plan), done, take)
+                _slice_overrides(
+                    overrides, base_overrides(self.plan), done_local, take,
+                )
                 if overrides
                 else None
             )
-            if self.engine_kind == "native":
-                with _ph(tel, "execute", chunk=chunk_idx, meta={"take": take}):
-                    part = self.engine.run_chunk(
-                        seed, lo, take, ov, self.payload.sim_settings,
-                    )
-                if ckpt:
-                    ckpt.save(done, part)
-                partials.append(part)
-                done += take
-                chunk_idx += 1
-                continue
             with _ph(tel, "transfer", chunk=chunk_idx):
                 keys = all_keys[lo : lo + take]
                 if self.mesh is not None:
@@ -670,51 +797,116 @@ class SweepRunner:
             # lower/compile spans inside this one
             with _ph(tel, "execute", chunk=chunk_idx, meta={"take": take}):
                 if self.engine_kind == "fast" and getattr(self, "_scan_inner", 0):
-                    final = self.engine.run_batch_scanned(
+                    return self.engine.run_batch_scanned(
                         keys, ov, inner=self._scan_inner, total=chunk,
                     )
-                else:
-                    final = self.engine.run_batch(keys, ov)
-            if ckpt:
-                # checkpointing persists each chunk as numpy -> sync per chunk
-                with _ph(tel, "fetch", chunk=chunk_idx):
-                    part = sweep_results(
-                        self.engine,
-                        final,
-                        self.payload.sim_settings,
-                        gauge_sel=self._gauge_sel,
+                return self.engine.run_batch(keys, ov)
+
+        def _run_range_sync(
+            done_local: int, take: int, size: int, chunk_idx: int,
+        ) -> tuple[SweepResults, int]:
+            """Run scenarios [done_local, done_local + take) synchronously
+            in sub-chunks of ``size``, downshifting further on OOM; returns
+            (merged results, final sub-chunk size)."""
+            parts: list[SweepResults] = []
+            off = 0
+            while off < take:
+                sub = min(size, take - off)
+                sub = max(n_dev, (sub // n_dev) * n_dev)
+                try:
+                    final = _dispatch(done_local + off, sub, chunk_idx)
+                    parts.append(_fetch(final, chunk_idx, done_local + off))
+                except Exception as err:  # noqa: BLE001 - filtered below
+                    if not _is_oom(err):
+                        raise
+                    size = _downshift(sub, err, done_local + off)
+                    continue
+                off += sub
+            return _concat_sweeps(parts), size
+
+        partials: list[SweepResults] = []
+        #: (slot, scenario start, take, device state) pipelining window
+        inflight: list[tuple[int, int, int, object]] = []
+        done = 0
+        chunk_idx = 0
+        while done < n_scenarios:
+            take = min(chunk, n_scenarios - done)
+            take = max(n_dev, (take // n_dev) * n_dev)  # pad to device multiple
+            cached = ckpt.load(done) if ckpt else None
+            if cached is not None:
+                partials.append(cached)
+                # advance by the CACHED chunk's actual row count: a prior
+                # run may have saved downshifted (smaller) chunks
+                done += int(cached.completed.shape[0])
+                chunk_idx += 1
+                continue
+            if self.engine_kind == "native":
+                lo = first_scenario + done
+                ov = (
+                    _slice_overrides(
+                        overrides, base_overrides(self.plan), done, take,
                     )
-                ckpt.save(done, part)
+                    if overrides
+                    else None
+                )
+                with _ph(tel, "execute", chunk=chunk_idx, meta={"take": take}):
+                    part = self.engine.run_chunk(
+                        seed, lo, take, ov, self.payload.sim_settings,
+                    )
+                _check_finite(part, self.engine_kind, chunk_idx, done)
+                if ckpt:
+                    ckpt.save(done, part)
                 partials.append(part)
-            else:
-                # pipeline: jax dispatch is async, so keep a small window of
-                # chunks in flight and convert the oldest to host arrays as
-                # new ones are dispatched — device compute overlaps the host
-                # merge and (on tunneled accelerators) the per-dispatch round
-                # trip, while device memory for results stays bounded by the
-                # window instead of growing with the sweep
-                partials.append(None)  # ordered placeholder
-                inflight.append((len(partials) - 1, final))
-                while len(inflight) > self.INFLIGHT_CHUNKS:
-                    slot, oldest = inflight.pop(0)
-                    with _ph(tel, "fetch", chunk=slot):
-                        partials[slot] = sweep_results(
-                            self.engine,
-                            oldest,
-                            self.payload.sim_settings,
-                            gauge_sel=self._gauge_sel,
-                        )
+                done += take
+                chunk_idx += 1
+                continue
+            try:
+                final = _dispatch(done, take, chunk_idx)
+                if ckpt:
+                    # checkpointing persists chunks as numpy -> sync fetch
+                    part = _fetch(final, chunk_idx, done)
+                    ckpt.save(done, part)
+                    partials.append(part)
+                else:
+                    # pipeline: jax dispatch is async, so keep a small
+                    # window of chunks in flight and convert the oldest to
+                    # host arrays as new ones are dispatched — device
+                    # compute overlaps the host merge while device memory
+                    # stays bounded by the window
+                    partials.append(None)  # ordered placeholder
+                    inflight.append((len(partials) - 1, done, take, final))
+                    while len(inflight) > self.INFLIGHT_CHUNKS:
+                        slot, start, itake, oldest = inflight.pop(0)
+                        try:
+                            partials[slot] = _fetch(oldest, slot, start)
+                        except Exception as err:  # noqa: BLE001
+                            if not _is_oom(err):
+                                raise
+                            # an earlier in-flight chunk OOMed at fetch:
+                            # re-run just that range at the smaller size
+                            chunk = _downshift(itake, err, start)
+                            partials[slot], chunk = _run_range_sync(
+                                start, itake, chunk, slot,
+                            )
+            except Exception as err:  # noqa: BLE001 - filtered below
+                if not _is_oom(err):
+                    raise
+                chunk = _downshift(take, err, done)
+                continue  # re-run this chunk at the smaller size
             done += take
             chunk_idx += 1
-        for slot, final in inflight:
-            with _ph(tel, "fetch", chunk=slot):
-                partials[slot] = sweep_results(
-                    self.engine,
-                    final,
-                    self.payload.sim_settings,
-                    gauge_sel=self._gauge_sel,
+        for slot, start, itake, final in inflight:
+            try:
+                partials[slot] = _fetch(final, slot, start)
+            except Exception as err:  # noqa: BLE001 - filtered below
+                if not _is_oom(err):
+                    raise
+                chunk = _downshift(itake, err, start)
+                partials[slot], chunk = _run_range_sync(
+                    start, itake, chunk, slot,
                 )
         wall = time.time() - t0
+        self._last_downshifts = downshifts
 
         with _ph(tel, "postprocess"):
             merged = _concat_sweeps(partials)[:n_scenarios]
@@ -724,6 +916,7 @@ class SweepRunner:
             wall_seconds=wall,
             plan=self.plan,
             gauge_series_ids=self._gauge_series_ids,
+            downshifts=downshifts or None,
         )
 
 
@@ -908,6 +1101,12 @@ class _SweepCheckpoint:
             payload["llm_cost_sumsq"] = part.llm_cost_sumsq
         if part.truncated is not None:
             payload["truncated"] = part.truncated
+        if part.total_timed_out is not None:
+            payload["total_timed_out"] = part.total_timed_out
+            payload["total_retries"] = part.total_retries
+            payload["retry_budget_exhausted"] = part.retry_budget_exhausted
+        if part.attempts_hist is not None:
+            payload["attempts_hist"] = part.attempts_hist
         # atomic write so an interrupt never leaves a half-written chunk
         tmp = self.dir / f".chunk_{start:08d}.{os.getpid()}.tmp.npz"
         np.savez(tmp, **payload)
@@ -940,8 +1139,123 @@ class _SweepCheckpoint:
                     data["llm_cost_sumsq"] if "llm_cost_sumsq" in data else None
                 ),
                 truncated=data["truncated"] if "truncated" in data else None,
+                total_timed_out=(
+                    data["total_timed_out"]
+                    if "total_timed_out" in data
+                    else None
+                ),
+                total_retries=(
+                    data["total_retries"] if "total_retries" in data else None
+                ),
+                retry_budget_exhausted=(
+                    data["retry_budget_exhausted"]
+                    if "retry_budget_exhausted" in data
+                    else None
+                ),
+                attempts_hist=(
+                    data["attempts_hist"] if "attempts_hist" in data else None
+                ),
                 **{name: data[name] for name in self._ARRAY_FIELDS},
             )
+
+
+def _is_oom(err: Exception) -> bool:
+    """Does this look like an accelerator memory exhaustion?  XLA surfaces
+    them as RESOURCE_EXHAUSTED (TPU/GPU) or host allocator OOM messages."""
+    text = f"{type(err).__name__}: {err}"
+    return (
+        "RESOURCE_EXHAUSTED" in text
+        or "out of memory" in text.lower()
+        or "OutOfMemory" in text
+    )
+
+
+_FINITE_FIELDS = (
+    "latency_sum",
+    "latency_sumsq",
+    "latency_max",
+    "throughput",
+    "gauge_means",
+    "gauge_series",
+    "llm_cost_sum",
+    "llm_cost_sumsq",
+)
+
+
+def _check_finite(
+    part: SweepResults,
+    engine_kind: str,
+    chunk_idx: int,
+    first_row: int,
+) -> None:
+    """Cheap isfinite gate after every chunk fetch: a NaN/inf from a bad
+    override or an engine numeric bug must fail HERE, naming its source,
+    instead of propagating silently into percentile aggregation."""
+    for name in _FINITE_FIELDS:
+        arr = getattr(part, name)
+        if arr is None:
+            continue
+        arr = np.asarray(arr)
+        if arr.size and not np.all(np.isfinite(arr)):
+            msg = (
+                f"non-finite metric from the '{engine_kind}' engine: chunk "
+                f"{chunk_idx} (scenarios from local row {first_row}) "
+                f"produced non-finite values in {name!r}; check the "
+                "overrides feeding this chunk before trusting any "
+                "aggregate of this sweep"
+            )
+            raise ValueError(msg)
+    # latency_min is +inf for scenarios with zero completions (legal);
+    # only scenarios that completed something must be finite
+    lat_min = np.asarray(part.latency_min)
+    has_completions = np.asarray(part.completed) > 0
+    if lat_min.size and not np.all(np.isfinite(lat_min[has_completions])):
+        msg = (
+            f"non-finite metric from the '{engine_kind}' engine: chunk "
+            f"{chunk_idx} (scenarios from local row {first_row}) produced "
+            "non-finite values in 'latency_min' on scenarios with "
+            "completions"
+        )
+        raise ValueError(msg)
+
+
+def _guard_resilience_overrides(
+    plan,
+    overrides: ScenarioOverrides | None,
+) -> None:
+    """Refuse resilience overrides the compiled plan cannot honor: the
+    engines gate the fault/retry machinery statically on the BASE plan,
+    so a retry_timeout or fault-timing override on a plan without the
+    corresponding subsystem would be silently ignored."""
+    if overrides is None:
+        return
+    if not plan.has_retry and overrides.retry_timeout is not None:
+        rt = np.asarray(overrides.retry_timeout)
+        if rt.ndim > 0 or not np.isclose(float(rt), float(plan.retry_timeout)):
+            msg = (
+                "retry_timeout overrides need a retry_policy in the "
+                "payload: the retry machinery is compiled in only when "
+                "the base plan models it"
+            )
+            raise _FastpathOverrideError(msg)
+    if not plan.has_faults:
+        for name, base_arr in (
+            ("fault_srv_times", plan.fault_srv_times),
+            ("fault_edge_times", plan.fault_edge_times),
+        ):
+            ov_arr = getattr(overrides, name)
+            if ov_arr is None:
+                continue
+            ov_arr = np.asarray(ov_arr)
+            if ov_arr.shape != np.asarray(base_arr).shape or not np.allclose(
+                ov_arr, base_arr,
+            ):
+                msg = (
+                    f"{name} overrides need a fault_timeline in the "
+                    "payload: the compiler lowers the window shapes; "
+                    "overrides only move their timings"
+                )
+                raise _FastpathOverrideError(msg)
 
 
 def _mean_ci(values: np.ndarray, level: float) -> tuple[float, float, float]:
@@ -1156,6 +1470,26 @@ def _concat_sweeps(parts: list[SweepResults]) -> SweepResults:
             total_rejected=(
                 np.concatenate([p.total_rejected for p in parts])
                 if all(p.total_rejected is not None for p in parts)
+                else None
+            ),
+            total_timed_out=(
+                np.concatenate([p.total_timed_out for p in parts])
+                if all(p.total_timed_out is not None for p in parts)
+                else None
+            ),
+            total_retries=(
+                np.concatenate([p.total_retries for p in parts])
+                if all(p.total_retries is not None for p in parts)
+                else None
+            ),
+            retry_budget_exhausted=(
+                np.concatenate([p.retry_budget_exhausted for p in parts])
+                if all(p.retry_budget_exhausted is not None for p in parts)
+                else None
+            ),
+            attempts_hist=(
+                np.concatenate([p.attempts_hist for p in parts])
+                if all(p.attempts_hist is not None for p in parts)
                 else None
             ),
             llm_cost_sum=(

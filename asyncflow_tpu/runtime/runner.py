@@ -86,6 +86,21 @@ class SimulationRunner:
 
     def _run(self, tel) -> ResultsAnalyzer:
         backend = self.backend
+        if backend == Backend.NATIVE and (
+            self.simulation_input.retry_policy is not None
+            or (
+                self.simulation_input.fault_timeline is not None
+                and self.simulation_input.fault_timeline.events
+            )
+        ):
+            import warnings
+
+            warnings.warn(
+                "the native C++ core does not model fault windows / "
+                "client retries yet; falling back to the Python oracle",
+                stacklevel=2,
+            )
+            backend = Backend.ORACLE
         if backend == Backend.NATIVE:
             from asyncflow_tpu.engines.oracle.native import native_available
 

@@ -18,6 +18,7 @@ from asyncflow_tpu.schemas.nodes import (
 )
 from asyncflow_tpu.schemas.payload import SimulationPayload
 from asyncflow_tpu.schemas.random_variables import RVConfig
+from asyncflow_tpu.schemas.resilience import FaultEvent, FaultTimeline, RetryPolicy
 from asyncflow_tpu.schemas.settings import SimulationSettings
 from asyncflow_tpu.schemas.workload import RqsGenerator
 
@@ -27,8 +28,11 @@ __all__ = [
     "End",
     "Endpoint",
     "EventInjection",
+    "FaultEvent",
+    "FaultTimeline",
     "LoadBalancer",
     "RVConfig",
+    "RetryPolicy",
     "RqsGenerator",
     "Server",
     "ServerResources",

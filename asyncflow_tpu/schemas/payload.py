@@ -11,9 +11,10 @@ from __future__ import annotations
 
 from pydantic import BaseModel, field_validator, model_validator
 
-from asyncflow_tpu.config.constants import EventDescription
+from asyncflow_tpu.config.constants import EventDescription, FaultKind
 from asyncflow_tpu.schemas.events import EventInjection
 from asyncflow_tpu.schemas.graph import TopologyGraph
+from asyncflow_tpu.schemas.resilience import FaultTimeline, RetryPolicy
 from asyncflow_tpu.schemas.settings import SimulationSettings
 from asyncflow_tpu.schemas.workload import RqsGenerator
 
@@ -53,6 +54,11 @@ class SimulationPayload(BaseModel):
     topology_graph: TopologyGraph
     sim_settings: SimulationSettings
     events: list[EventInjection] | None = None
+    #: client-side timeout/retry/backoff/budget discipline (resilience
+    #: modeling; see schemas/resilience.py)
+    retry_policy: RetryPolicy | None = None
+    #: scheduled fault windows (server outages, edge degradation/partition)
+    fault_timeline: FaultTimeline | None = None
 
     @property
     def generators(self) -> list[RqsGenerator]:
@@ -101,6 +107,58 @@ class SimulationPayload(BaseModel):
                 )
                 raise ValueError(msg)
         return self
+
+    # ------------------------------------------------------------------
+    # Resilience validators (retry policy + fault timeline)
+    # ------------------------------------------------------------------
+
+    @model_validator(mode="after")
+    def _retry_policy_single_generator(self) -> SimulationPayload:
+        if self.retry_policy is not None and len(self.generators) > 1:
+            msg = (
+                "retry_policy with multiple generators is not supported "
+                "yet: re-issues would need per-request entry-chain state; "
+                "model the superposition as one generator or drop the "
+                "retry policy"
+            )
+            raise ValueError(msg)
+        return self
+
+    @model_validator(mode="after")
+    def _fault_targets_exist_and_match_kind(self) -> SimulationPayload:
+        if self.fault_timeline is None:
+            return self
+        server_ids = {s.id for s in self.topology_graph.nodes.servers}
+        edge_ids = {e.id for e in self.topology_graph.edges}
+        horizon = float(self.sim_settings.total_simulation_time)
+        for fault in self.fault_timeline.events:
+            if fault.kind == FaultKind.SERVER_OUTAGE:
+                if fault.target_id not in server_ids:
+                    msg = (
+                        f"fault {fault.fault_id!r}: server_outage target "
+                        f"{fault.target_id!r} is not a declared server"
+                    )
+                    raise ValueError(msg)
+            elif fault.target_id not in edge_ids:
+                msg = (
+                    f"fault {fault.fault_id!r}: {fault.kind} target "
+                    f"{fault.target_id!r} is not a declared edge"
+                )
+                raise ValueError(msg)
+            if fault.t_start > horizon or fault.t_end > horizon:
+                msg = (
+                    f"fault {fault.fault_id!r}: window "
+                    f"[{fault.t_start}, {fault.t_end}] exceeds the "
+                    f"simulation horizon T={horizon}"
+                )
+                raise ValueError(msg)
+        return self
+
+    # NOTE: unlike legacy SERVER_DOWN events (where an all-servers-down
+    # instant strands requests inside the LB and is forbidden), outage
+    # FAULT windows may cover every server simultaneously — arrivals are
+    # hard-refused, which is exactly the "total outage + retry storm"
+    # scenario the resilience subsystem exists to model.
 
     # ------------------------------------------------------------------
     # Event validators

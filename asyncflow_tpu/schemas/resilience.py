@@ -1,0 +1,153 @@
+"""Resilience-modeling input schemas: client retry policy + fault timeline.
+
+These extend the reference's event injection (which only knows clean
+``server_down`` rotation removals and latency spikes) with the failure
+modes serving studies actually sweep over:
+
+- :class:`RetryPolicy` — the *client side*: a per-request timeout, capped
+  exponential backoff with jitter, a bounded number of attempts, and a
+  token-bucket retry *budget* so retry storms can be modeled and capped
+  (the Finagle/gRPC budget discipline).  Attached to the workload/client
+  via ``SimulationPayload.retry_policy``.
+- :class:`FaultTimeline` / :class:`FaultEvent` — the *infrastructure
+  side*: scheduled windows during which a server hard-refuses arrivals
+  (``server_outage``), an edge degrades (``edge_degrade``: latency
+  multiplied, dropout boosted), or an edge partitions entirely
+  (``edge_partition``: every send dropped).
+
+Unlike the legacy ``server_down`` event (a graceful drain: the LB stops
+routing to the server), a ``server_outage`` fault refuses requests that
+reach the server — the load balancer only learns about it through its
+circuit breaker's failure channel, which is exactly the dynamics a
+resilience study wants to observe.
+"""
+
+from __future__ import annotations
+
+from pydantic import (
+    BaseModel,
+    ConfigDict,
+    Field,
+    NonNegativeFloat,
+    PositiveFloat,
+    PositiveInt,
+    model_validator,
+)
+
+from asyncflow_tpu.config.constants import FaultKind, RetryDefaults
+
+
+class RetryPolicy(BaseModel):
+    """Client-side request timeout + retry/backoff/budget discipline.
+
+    Semantics (identical across the oracle and the JAX event engine):
+
+    - every issued attempt carries a deadline ``request_timeout_s`` after
+      its issue time; if the attempt has not completed by then the client
+      *abandons* it (the in-flight request becomes an orphan that still
+      consumes server resources — the retry-storm amplification channel)
+      and may re-issue;
+    - a failed attempt (edge drop, rate-limit/socket refusal, queue shed,
+      dequeue-deadline abandon, outage refusal) is reported to the client
+      at failure time and may re-issue immediately after backoff;
+    - re-issue ``k`` (for attempt ``k+1``) waits
+      ``min(backoff_cap_s, backoff_base_s * backoff_multiplier**(k-1))``
+      seconds, multiplied by a jitter factor uniform in
+      ``[1 - jitter, 1 + jitter]``;
+    - at most ``max_attempts`` attempts total (first issue included);
+    - each re-issue consumes one token from a bucket of
+      ``budget_tokens`` refilled at ``budget_refill_per_s`` tokens/s;
+      with no whole token the client gives up immediately
+      (``retry_budget_exhausted`` counter).  ``budget_tokens=None``
+      disables the budget (unbounded retries up to ``max_attempts``).
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    request_timeout_s: PositiveFloat
+    max_attempts: int = Field(
+        default=int(RetryDefaults.MAX_ATTEMPTS),
+        ge=1,
+        le=int(RetryDefaults.MAX_ATTEMPTS_CAP),
+        description="Total attempts per logical request, first issue included.",
+    )
+    backoff_base_s: NonNegativeFloat = 0.1
+    backoff_multiplier: float = Field(default=2.0, ge=1.0)
+    backoff_cap_s: PositiveFloat = 10.0
+    jitter: float = Field(
+        default=0.0,
+        ge=0.0,
+        le=1.0,
+        description="Backoff delays are multiplied by U[1 - jitter, 1 + jitter].",
+    )
+    budget_tokens: PositiveInt | None = None
+    budget_refill_per_s: NonNegativeFloat = 0.0
+
+    def backoff_delay(self, attempt: int) -> float:
+        """Nominal (jitter-free) backoff before re-issue number ``attempt``
+        (attempt 2 = first retry -> ``backoff_base_s``)."""
+        k = max(attempt - 2, 0)
+        return min(
+            float(self.backoff_cap_s),
+            float(self.backoff_base_s) * float(self.backoff_multiplier) ** k,
+        )
+
+
+class FaultEvent(BaseModel):
+    """One scheduled fault window applied to a server or an edge."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    fault_id: str
+    kind: FaultKind
+    target_id: str
+    t_start: NonNegativeFloat
+    t_end: PositiveFloat
+    #: ``edge_degrade`` only: edge latency draws are multiplied by this
+    #: during the window (superposed windows multiply together).
+    latency_factor: float = Field(default=1.0, ge=1.0)
+    #: ``edge_degrade`` only: added to the edge's dropout rate during the
+    #: window (clipped to 1; superposed windows add).
+    dropout_boost: float = Field(default=0.0, ge=0.0, le=1.0)
+
+    @model_validator(mode="after")
+    def _window_and_fields_consistent(self) -> FaultEvent:
+        if self.t_start >= self.t_end:
+            msg = (
+                f"fault {self.fault_id!r}: t_start={self.t_start} must be "
+                f"smaller than t_end={self.t_end}"
+            )
+            raise ValueError(msg)
+        degrade_fields = (
+            self.latency_factor != 1.0 or self.dropout_boost != 0.0
+        )
+        if self.kind != FaultKind.EDGE_DEGRADE and degrade_fields:
+            msg = (
+                f"fault {self.fault_id!r}: latency_factor/dropout_boost "
+                "apply only to edge_degrade faults"
+            )
+            raise ValueError(msg)
+        if self.kind == FaultKind.EDGE_DEGRADE and not degrade_fields:
+            msg = (
+                f"fault {self.fault_id!r}: edge_degrade needs "
+                "latency_factor > 1 and/or dropout_boost > 0"
+            )
+            raise ValueError(msg)
+        return self
+
+
+class FaultTimeline(BaseModel):
+    """The scenario's scheduled faults, validated as a set."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    events: list[FaultEvent]
+
+    @model_validator(mode="after")
+    def _unique_ids(self) -> FaultTimeline:
+        ids = [event.fault_id for event in self.events]
+        if len(ids) != len(set(ids)):
+            dup = sorted({i for i in ids if ids.count(i) > 1})
+            msg = f"duplicate fault ids: {dup}"
+            raise ValueError(msg)
+        return self

@@ -11,4 +11,13 @@ python -m pytest \
   tests/unit/observability/test_telemetry.py::test_summary_smoke_schema \
   tests/unit/observability/test_telemetry.py::test_run_record_schema_is_valid \
   -q -p no:cacheprovider
+# resilience slice: a handful of outage/retry scenarios on the CPU backend
+# so fault-injection + client-retry paths can't silently rot behind the
+# fastpath-only benchmarks (docs/guides/resilience.md)
+python -m pytest \
+  tests/parity/test_resilience.py::test_seed_determinism_bit_identical \
+  tests/parity/test_resilience.py::test_fastpath_refuses_resilience_plans \
+  tests/parity/test_resilience.py::test_outage_fault_is_not_a_rotation_removal \
+  tests/parity/test_resilience.py::test_retry_budget_exhaustion_parity \
+  -q -p no:cacheprovider
 python -m pytest tests/ -m smoke -q "$@"

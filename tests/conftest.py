@@ -155,6 +155,12 @@ _SMOKE_TESTS = (
     "tests/parity/test_overload_policy.py::test_fast_path_shed_parity",
     "tests/unit/test_rl_batched.py::test_windowed_run_until_is_bit_identical",
     "tests/parity/test_telemetry_counters.py::test_sweep_counters_match_per_scenario_sums",
+    # resilience tier (fault injection + client retry): determinism,
+    # fastpath refusal, and one full oracle<->event parity loop
+    "tests/parity/test_resilience.py::test_seed_determinism_bit_identical",
+    "tests/parity/test_resilience.py::test_fastpath_refuses_resilience_plans",
+    "tests/parity/test_resilience.py::test_retry_budget_exhaustion_parity",
+    "tests/unit/test_sweep_resilience.py::test_sweep_survives_injected_oom_with_downshift",
 )
 
 

@@ -1,0 +1,326 @@
+"""Resilience-modeling parity: fault injection + client retry/timeout.
+
+Semantics under test (``schemas/resilience.py``; lowered by
+``compiler/faults.py``; modeled by the oracle and the jax event engine):
+
+- ``server_outage`` fault windows hard-refuse arrivals (and feed the LB
+  circuit breaker's failure channel);
+- ``edge_degrade`` / ``edge_partition`` windows multiply edge latency and
+  boost dropout inside the window;
+- the client retry policy re-issues timed-out/failed attempts with capped
+  exponential backoff under a token-bucket retry budget, and orphaned
+  attempts keep consuming server resources without counting.
+
+The two engines draw from different RNG families, so parity is
+distributional (rates within tolerances over a seed ensemble); seed
+determinism within one engine is bit-exact.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import yaml
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.engines.jaxsim.engine import run_single
+from asyncflow_tpu.engines.oracle.engine import OracleEngine
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+pytestmark = pytest.mark.integration
+
+BASE = "tests/integration/data/single_server.yml"
+LB = "examples/yaml_input/data/two_servers_lb.yml"
+SEEDS = 6
+
+
+def _payload(mut, base: str = BASE, horizon: int = 120) -> SimulationPayload:
+    data = yaml.safe_load(open(base).read())
+    data["sim_settings"]["total_simulation_time"] = horizon
+    mut(data)
+    return SimulationPayload.model_validate(data)
+
+
+def _oracle_stats(payload, n=SEEDS):
+    gen = rej = to = retries = bexh = 0
+    att = None
+    lats = []
+    for s in range(n):
+        r = OracleEngine(payload, seed=s).run()
+        gen += r.offered
+        rej += r.total_rejected
+        to += r.total_timed_out
+        retries += r.total_retries
+        bexh += r.retry_budget_exhausted
+        if r.attempts_hist is not None:
+            att = r.attempts_hist if att is None else att + r.attempts_hist
+        lats.append(r.latencies)
+    return gen, rej, to, retries, bexh, att, np.concatenate(lats)
+
+
+def _event_stats(payload, n=SEEDS):
+    """One compiled batched event engine for all n seeds (the per-seed
+    run_single path would recompile the kernel n times)."""
+    from asyncflow_tpu.engines.jaxsim.engine import Engine, scenario_keys
+
+    plan = compile_payload(payload)
+    engine = Engine(plan, collect_clocks=True)
+    fin = engine.run_batch(scenario_keys(11, n))
+    clock = np.asarray(fin.clock)
+    cnt = np.asarray(fin.clock_n)
+    lats = np.concatenate(
+        [clock[i, : cnt[i], 1] - clock[i, : cnt[i], 0] for i in range(n)],
+    )
+    gen = int(np.sum(np.asarray(fin.n_generated)))
+    retries = int(np.sum(np.asarray(fin.n_retries)))
+    att = (
+        np.asarray(fin.att_hist).sum(axis=0) if plan.has_retry else None
+    )
+    return (
+        gen + retries,
+        int(np.sum(np.asarray(fin.n_rejected))),
+        int(np.sum(np.asarray(fin.n_timed_out))),
+        retries,
+        int(np.sum(np.asarray(fin.n_budget_exhausted))),
+        att,
+        lats,
+    )
+
+
+def _assert_rates(name, a, b, *, frac_tol=0.04, lat_tol=0.08):
+    gen_a, rej_a, to_a, re_a, be_a, att_a, lat_a = a
+    gen_b, rej_b, to_b, re_b, be_b, att_b, lat_b = b
+    for label, xa, xb in (
+        ("rejected", rej_a, rej_b),
+        ("timed_out", to_a, to_b),
+        ("retries", re_a, re_b),
+        ("budget_exhausted", be_a, be_b),
+    ):
+        fa, fb = xa / max(gen_a, 1), xb / max(gen_b, 1)
+        assert abs(fa - fb) < frac_tol, (name, label, fa, fb)
+    if lat_a.size and lat_b.size:
+        p95_a = np.percentile(lat_a, 95)
+        p95_b = np.percentile(lat_b, 95)
+        assert abs(p95_a - p95_b) <= lat_tol * max(p95_a, p95_b, 1e-9), (
+            name,
+            "p95",
+            p95_a,
+            p95_b,
+        )
+    if att_a is not None and att_b is not None:
+        da = att_a / max(att_a.sum(), 1)
+        db = att_b / max(att_b.sum(), 1)
+        assert np.all(np.abs(da - db) < frac_tol), (name, "attempts", da, db)
+
+
+# ---------------------------------------------------------------------------
+# scenario mutators
+# ---------------------------------------------------------------------------
+
+
+def _outage_with_breaker(data) -> None:
+    """Mid-run outage on one LB-covered server with a circuit breaker: the
+    LB only learns about the dark server through breaker trips.  The short
+    cooldown keeps the probe cadence (one refused probe per reopen) high
+    enough that rejections are a visible fraction of the traffic."""
+    data["rqs_input"]["avg_active_users"]["mean"] = 60
+    data["topology_graph"]["nodes"]["load_balancer"]["circuit_breaker"] = {
+        "failure_threshold": 3,
+        "cooldown_s": 1.0,
+        "half_open_probes": 1,
+    }
+    data["fault_timeline"] = {
+        "events": [
+            {
+                "fault_id": "srv2-crash",
+                "kind": "server_outage",
+                "target_id": "srv-2",
+                "t_start": 30.0,
+                "t_end": 80.0,
+            },
+        ],
+    }
+
+
+def _retry_under_queue_timeout(data) -> None:
+    """Client retries + backoff against a server whose dequeue deadline
+    sheds slow waiters (rho ~ 0.9): shed requests retry, amplifying load."""
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    srv["endpoints"][0]["steps"] = [
+        {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.05}},
+    ]
+    # deterministic user count: the engines draw from different RNG
+    # families, and at this utilization the queueing delay amplifies any
+    # ensemble noise in the per-window user draws into p95 divergence
+    # that would swamp the parity signal
+    data["rqs_input"]["avg_active_users"] = {
+        "mean": 35,
+        "distribution": "normal",
+        "variance": 0,
+    }
+    srv["overload"] = {"queue_timeout_s": 0.2}
+    data["retry_policy"] = {
+        "request_timeout_s": 2.0,
+        "max_attempts": 3,
+        "backoff_base_s": 0.1,
+        "backoff_multiplier": 2.0,
+        "backoff_cap_s": 1.0,
+    }
+
+
+def _budget_exhaustion(data) -> None:
+    """A partition window floods the client with failures; the tiny retry
+    budget must cap the storm (budget_exhausted counts the denials)."""
+    data["retry_policy"] = {
+        "request_timeout_s": 1.0,
+        "max_attempts": 4,
+        "backoff_base_s": 0.05,
+        "backoff_multiplier": 2.0,
+        "backoff_cap_s": 0.5,
+        "budget_tokens": 10,
+        "budget_refill_per_s": 0.5,
+    }
+    data["fault_timeline"] = {
+        "events": [
+            {
+                "fault_id": "partition",
+                "kind": "edge_partition",
+                "target_id": "client-srv",
+                "t_start": 30.0,
+                "t_end": 70.0,
+            },
+        ],
+    }
+
+
+def _tight_timeout(data) -> None:
+    data["retry_policy"] = {
+        "request_timeout_s": 0.03,
+        "max_attempts": 4,
+        "backoff_base_s": 0.05,
+        "backoff_multiplier": 2.0,
+        "backoff_cap_s": 0.5,
+    }
+
+
+# ---------------------------------------------------------------------------
+# oracle <-> jax event engine parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_outage_breaker_parity() -> None:
+    payload = _payload(_outage_with_breaker, base=LB)
+    a = _oracle_stats(payload)
+    b = _event_stats(payload)
+    # the outage must actually bite: both engines reject a visible share
+    assert a[1] / max(a[0], 1) > 0.005, a
+    assert b[1] / max(b[0], 1) > 0.005, b
+    _assert_rates("outage+breaker", a, b)
+
+
+@pytest.mark.slow
+def test_retry_backoff_queue_timeout_parity() -> None:
+    payload = _payload(_retry_under_queue_timeout)
+    a = _oracle_stats(payload)
+    b = _event_stats(payload)
+    assert a[3] > 0 and b[3] > 0, "retries must actually occur"
+    _assert_rates("retry+queue-timeout", a, b)
+
+
+@pytest.mark.slow
+def test_retry_budget_exhaustion_parity() -> None:
+    payload = _payload(_budget_exhaustion)
+    a = _oracle_stats(payload)
+    b = _event_stats(payload)
+    assert a[4] > 0 and b[4] > 0, "the budget must actually exhaust"
+    _assert_rates("budget-exhaustion", a, b)
+
+
+@pytest.mark.slow
+def test_client_timeout_orphans_parity() -> None:
+    """Tight timeouts orphan in-flight work; the attempts histogram and
+    timeout rate must agree across engines."""
+    payload = _payload(_tight_timeout)
+    a = _oracle_stats(payload)
+    b = _event_stats(payload)
+    assert a[2] > 0 and b[2] > 0, "timeouts must actually fire"
+    _assert_rates("client-timeout", a, b)
+
+
+# ---------------------------------------------------------------------------
+# determinism + routing contracts
+# ---------------------------------------------------------------------------
+
+
+def test_seed_determinism_bit_identical() -> None:
+    """Two runs with identical seeds produce bit-identical retry/fault
+    traces on BOTH engines (counters, clocks, attempts histograms)."""
+    payload = _payload(_budget_exhaustion, horizon=80)
+    r1 = OracleEngine(payload, seed=13).run()
+    r2 = OracleEngine(payload, seed=13).run()
+    assert np.array_equal(r1.rqs_clock, r2.rqs_clock)
+    assert r1.counters().as_dict() == r2.counters().as_dict()
+    assert np.array_equal(r1.attempts_hist, r2.attempts_hist)
+    j1 = run_single(payload, seed=13, engine="event")
+    j2 = run_single(payload, seed=13, engine="event")
+    assert np.array_equal(j1.rqs_clock, j2.rqs_clock)
+    assert j1.counters().as_dict() == j2.counters().as_dict()
+    assert np.array_equal(j1.attempts_hist, j2.attempts_hist)
+
+
+def test_fastpath_refuses_resilience_plans() -> None:
+    """The compiler must route retry/fault scenarios OFF the scan engine
+    with an actionable diagnostic."""
+    retry_plan = compile_payload(_payload(_tight_timeout, horizon=30))
+    assert not retry_plan.fastpath_ok
+    assert "retry policy" in retry_plan.fastpath_reason
+    assert "event" in retry_plan.fastpath_reason
+
+    def only_fault(data):
+        data["fault_timeline"] = {
+            "events": [
+                {
+                    "fault_id": "f",
+                    "kind": "server_outage",
+                    "target_id": "srv-1",
+                    "t_start": 5.0,
+                    "t_end": 10.0,
+                },
+            ],
+        }
+
+    fault_plan = compile_payload(_payload(only_fault, horizon=30))
+    assert not fault_plan.fastpath_ok
+    assert "fault timeline" in fault_plan.fastpath_reason
+
+    from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+
+    with pytest.raises(ValueError, match="not eligible"):
+        FastEngine(retry_plan)
+
+
+def test_outage_fault_is_not_a_rotation_removal() -> None:
+    """Outage FAULTS differ from legacy SERVER_DOWN events: without a
+    breaker the LB keeps routing to the dark server and those arrivals are
+    refused — the legacy event would have drained the rotation instead."""
+    def fault_only(data):
+        data["rqs_input"]["avg_active_users"]["mean"] = 60
+        data["fault_timeline"] = {
+            "events": [
+                {
+                    "fault_id": "crash",
+                    "kind": "server_outage",
+                    "target_id": "srv-2",
+                    "t_start": 20.0,
+                    "t_end": 60.0,
+                },
+            ],
+        }
+
+    payload = _payload(fault_only, base=LB, horizon=90)
+    r = OracleEngine(payload, seed=3).run()
+    j = run_single(payload, seed=3, engine="event")
+    # about half the traffic hits the dark server for ~44% of the horizon
+    assert r.total_rejected / max(r.total_generated, 1) > 0.1
+    assert j.total_rejected / max(j.total_generated, 1) > 0.1

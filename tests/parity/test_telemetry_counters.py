@@ -26,6 +26,11 @@ EXPECTED_KEYS = {
     "overflow",
     "rejected",
     "truncated",
+    # resilience counters (0 without a retry policy; see
+    # docs/guides/resilience.md)
+    "timed_out",
+    "retries",
+    "budget_exhausted",
 }
 
 
@@ -40,8 +45,12 @@ def _check_identities(c: DeviceCounters) -> None:
     assert all(isinstance(v, int) for v in c.as_dict().values())
     assert c.completed > 0
     # conservation: everything completed, dropped, shed, or overflowed was
-    # generated (requests still in flight at the horizon make this strict)
-    assert c.completed + c.dropped + c.overflow + c.rejected <= c.generated
+    # offered — generated spawns plus client re-issues (requests still in
+    # flight at the horizon make this strict)
+    assert (
+        c.completed + c.dropped + c.overflow + c.rejected
+        <= c.generated + c.retries
+    )
 
 
 def _engine_counters() -> dict[str, DeviceCounters]:

@@ -1,0 +1,201 @@
+"""Compiler lowering of the resilience subsystem: fault breakpoint tables,
+retry scalars, capacity amplification, breaker channels, and the
+plan-array digest feeding sweep-checkpoint identity."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import yaml
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.compiler.faults import lower_faults, lower_retry
+from asyncflow_tpu.schemas.payload import SimulationPayload
+from asyncflow_tpu.schemas.resilience import RetryPolicy
+
+BASE = "tests/integration/data/single_server.yml"
+LB = "examples/yaml_input/data/two_servers_lb.yml"
+
+
+def _payload(mut=None, base: str = BASE, horizon: int = 100) -> SimulationPayload:
+    data = yaml.safe_load(open(base).read())
+    data["sim_settings"]["total_simulation_time"] = horizon
+    if mut:
+        mut(data)
+    return SimulationPayload.model_validate(data)
+
+
+def test_lower_faults_identity_without_timeline() -> None:
+    arrays = lower_faults(_payload())
+    assert not arrays.has_faults
+    assert arrays.srv_times.shape == (1,)
+    assert np.all(arrays.srv_down == 0)
+    assert np.all(arrays.edge_lat == 1.0)
+    assert np.all(arrays.edge_drop == 0.0)
+
+
+def test_lower_faults_breakpoints_and_superposition() -> None:
+    def mut(data):
+        data["fault_timeline"] = {
+            "events": [
+                {
+                    "fault_id": "a",
+                    "kind": "edge_degrade",
+                    "target_id": "client-srv",
+                    "t_start": 10.0,
+                    "t_end": 30.0,
+                    "latency_factor": 2.0,
+                },
+                {
+                    "fault_id": "b",
+                    "kind": "edge_degrade",
+                    "target_id": "client-srv",
+                    "t_start": 20.0,
+                    "t_end": 40.0,
+                    "latency_factor": 3.0,
+                    "dropout_boost": 0.1,
+                },
+                {
+                    "fault_id": "c",
+                    "kind": "edge_partition",
+                    "target_id": "client-srv",
+                    "t_start": 50.0,
+                    "t_end": 60.0,
+                },
+            ],
+        }
+
+    payload = _payload(mut)
+    arrays = lower_faults(payload)
+    e = {e.id: i for i, e in enumerate(payload.topology_graph.edges)}[
+        "client-srv"
+    ]
+    # overlapping degrade windows multiply factors and add boosts
+    assert arrays.edge_fault(e, 5.0) == (1.0, 0.0)
+    assert arrays.edge_fault(e, 15.0)[0] == pytest.approx(2.0)
+    assert arrays.edge_fault(e, 25.0)[0] == pytest.approx(6.0)
+    assert arrays.edge_fault(e, 25.0)[1] == pytest.approx(0.1)
+    assert arrays.edge_fault(e, 35.0)[0] == pytest.approx(3.0)
+    assert arrays.edge_fault(e, 45.0) == (1.0, 0.0)
+    # partition = dropout boost 1.0
+    assert arrays.edge_fault(e, 55.0)[1] == pytest.approx(1.0)
+    assert arrays.edge_fault(e, 65.0) == (1.0, 0.0)
+
+
+def test_lower_faults_server_outage_union() -> None:
+    def mut(data):
+        data["fault_timeline"] = {
+            "events": [
+                {
+                    "fault_id": "a",
+                    "kind": "server_outage",
+                    "target_id": "srv-1",
+                    "t_start": 10.0,
+                    "t_end": 30.0,
+                },
+                {
+                    "fault_id": "b",
+                    "kind": "server_outage",
+                    "target_id": "srv-1",
+                    "t_start": 20.0,
+                    "t_end": 40.0,
+                },
+            ],
+        }
+
+    arrays = lower_faults(_payload(mut))
+    assert not arrays.server_down(0, 5.0)
+    assert arrays.server_down(0, 15.0)
+    assert arrays.server_down(0, 25.0)  # overlap: still (once) down
+    assert arrays.server_down(0, 35.0)
+    assert not arrays.server_down(0, 45.0)
+
+
+def test_lower_retry_scalars() -> None:
+    scalars = lower_retry(None)
+    assert not scalars.enabled
+    scalars = lower_retry(
+        RetryPolicy(
+            request_timeout_s=0.5,
+            max_attempts=4,
+            budget_tokens=20,
+            budget_refill_per_s=1.5,
+        ),
+    )
+    assert scalars.enabled
+    assert scalars.timeout == 0.5
+    assert scalars.max_attempts == 4
+    assert scalars.budget_tokens == 20.0
+    assert scalars.budget_refill == 1.5
+
+
+def test_retry_amplifies_capacity_estimates() -> None:
+    base_plan = compile_payload(_payload())
+
+    def mut(data):
+        data["retry_policy"] = {"request_timeout_s": 1.0, "max_attempts": 4}
+
+    retry_plan = compile_payload(_payload(mut))
+    # every logical request can spawn up to max_attempts issues
+    assert retry_plan.max_requests > 2 * base_plan.max_requests
+    assert retry_plan.pool_size >= base_plan.pool_size
+
+
+def test_faults_keep_breaker_modeled() -> None:
+    """An outage fault on a covered server IS a failure channel: the
+    breaker must not be lowered away."""
+
+    def breaker_only(data):
+        data["topology_graph"]["nodes"]["load_balancer"]["circuit_breaker"] = {
+            "failure_threshold": 3,
+            "cooldown_s": 5.0,
+            "half_open_probes": 1,
+        }
+        for edge in data["topology_graph"]["edges"]:
+            edge["dropout_rate"] = 0.0
+
+    plan = compile_payload(_payload(breaker_only, base=LB))
+    assert plan.breaker_lowered  # no channel: lowered away
+    assert plan.breaker_threshold == 0
+
+    def breaker_and_fault(data):
+        breaker_only(data)
+        data["fault_timeline"] = {
+            "events": [
+                {
+                    "fault_id": "crash",
+                    "kind": "server_outage",
+                    "target_id": "srv-2",
+                    "t_start": 10.0,
+                    "t_end": 20.0,
+                },
+            ],
+        }
+
+    plan = compile_payload(_payload(breaker_and_fault, base=LB))
+    assert not plan.breaker_lowered
+    assert plan.breaker_threshold == 3
+
+
+def test_plan_array_digest_tracks_fault_timing() -> None:
+    def at(t0):
+        def mut(data):
+            data["fault_timeline"] = {
+                "events": [
+                    {
+                        "fault_id": "f",
+                        "kind": "server_outage",
+                        "target_id": "srv-1",
+                        "t_start": t0,
+                        "t_end": t0 + 10.0,
+                    },
+                ],
+            }
+
+        return mut
+
+    d1 = compile_payload(_payload(at(10.0))).array_digest()
+    d2 = compile_payload(_payload(at(10.0))).array_digest()
+    d3 = compile_payload(_payload(at(20.0))).array_digest()
+    assert d1 == d2  # deterministic
+    assert d1 != d3  # fault timing is part of the identity

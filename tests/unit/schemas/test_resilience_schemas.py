@@ -1,0 +1,170 @@
+"""Validation contracts of the resilience schemas (retry + faults)."""
+
+from __future__ import annotations
+
+import pytest
+import yaml
+from pydantic import ValidationError
+
+from asyncflow_tpu.schemas.payload import SimulationPayload
+from asyncflow_tpu.schemas.resilience import FaultEvent, FaultTimeline, RetryPolicy
+
+BASE = "tests/integration/data/single_server.yml"
+
+
+def _data():
+    return yaml.safe_load(open(BASE).read())
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+def test_retry_policy_defaults_and_backoff_schedule() -> None:
+    policy = RetryPolicy(request_timeout_s=1.0)
+    assert policy.max_attempts == 3
+    assert policy.budget_tokens is None  # unlimited by default
+    p = RetryPolicy(
+        request_timeout_s=1.0,
+        backoff_base_s=0.1,
+        backoff_multiplier=2.0,
+        backoff_cap_s=0.35,
+        max_attempts=5,
+    )
+    # attempt 2 = first retry -> base; growth is capped
+    assert p.backoff_delay(2) == pytest.approx(0.1)
+    assert p.backoff_delay(3) == pytest.approx(0.2)
+    assert p.backoff_delay(4) == pytest.approx(0.35)
+    assert p.backoff_delay(5) == pytest.approx(0.35)
+
+
+def test_retry_policy_bounds() -> None:
+    with pytest.raises(ValidationError):
+        RetryPolicy(request_timeout_s=0.0)
+    with pytest.raises(ValidationError):
+        RetryPolicy(request_timeout_s=1.0, max_attempts=0)
+    with pytest.raises(ValidationError):
+        RetryPolicy(request_timeout_s=1.0, max_attempts=17)  # > cap
+    with pytest.raises(ValidationError):
+        RetryPolicy(request_timeout_s=1.0, jitter=1.5)
+    with pytest.raises(ValidationError):
+        RetryPolicy(request_timeout_s=1.0, backoff_multiplier=0.5)
+
+
+# ---------------------------------------------------------------------------
+# FaultEvent / FaultTimeline
+# ---------------------------------------------------------------------------
+
+
+def test_fault_event_window_and_field_consistency() -> None:
+    with pytest.raises(ValidationError, match="smaller than t_end"):
+        FaultEvent(
+            fault_id="f",
+            kind="server_outage",
+            target_id="s",
+            t_start=5.0,
+            t_end=5.0,
+        )
+    with pytest.raises(ValidationError, match="only to edge_degrade"):
+        FaultEvent(
+            fault_id="f",
+            kind="server_outage",
+            target_id="s",
+            t_start=0.0,
+            t_end=1.0,
+            latency_factor=2.0,
+        )
+    with pytest.raises(ValidationError, match="needs"):
+        FaultEvent(
+            fault_id="f",
+            kind="edge_degrade",
+            target_id="e",
+            t_start=0.0,
+            t_end=1.0,
+        )
+    ok = FaultEvent(
+        fault_id="f",
+        kind="edge_degrade",
+        target_id="e",
+        t_start=0.0,
+        t_end=1.0,
+        latency_factor=3.0,
+        dropout_boost=0.2,
+    )
+    assert ok.latency_factor == 3.0
+
+
+def test_fault_timeline_unique_ids() -> None:
+    event = {
+        "fault_id": "dup",
+        "kind": "server_outage",
+        "target_id": "s",
+        "t_start": 0.0,
+        "t_end": 1.0,
+    }
+    with pytest.raises(ValidationError, match="duplicate fault ids"):
+        FaultTimeline(events=[event, dict(event)])
+
+
+# ---------------------------------------------------------------------------
+# payload cross-validators
+# ---------------------------------------------------------------------------
+
+
+def test_fault_target_must_exist_and_match_kind() -> None:
+    data = _data()
+    data["fault_timeline"] = {
+        "events": [
+            {
+                "fault_id": "f",
+                "kind": "server_outage",
+                "target_id": "no-such-server",
+                "t_start": 0.0,
+                "t_end": 1.0,
+            },
+        ],
+    }
+    with pytest.raises(ValidationError, match="not a declared server"):
+        SimulationPayload.model_validate(data)
+    data["fault_timeline"]["events"][0]["kind"] = "edge_partition"
+    with pytest.raises(ValidationError, match="not a declared edge"):
+        SimulationPayload.model_validate(data)
+
+
+def test_fault_window_inside_horizon() -> None:
+    data = _data()
+    horizon = float(data["sim_settings"]["total_simulation_time"])
+    data["fault_timeline"] = {
+        "events": [
+            {
+                "fault_id": "f",
+                "kind": "server_outage",
+                "target_id": "srv-1",
+                "t_start": 0.0,
+                "t_end": horizon + 1.0,
+            },
+        ],
+    }
+    with pytest.raises(ValidationError, match="exceeds the"):
+        SimulationPayload.model_validate(data)
+
+
+def test_retry_policy_refused_with_multiple_generators() -> None:
+    data = _data()
+    gen = dict(data["rqs_input"])
+    gen2 = dict(gen)
+    gen2["id"] = "rqs-2"
+    data["rqs_input"] = [gen, gen2]
+    # give the second generator its own entry edge
+    data["topology_graph"]["edges"].append(
+        {
+            "id": "gen2-client",
+            "source": "rqs-2",
+            "target": data["topology_graph"]["nodes"]["client"]["id"],
+            "latency": {"mean": 0.003, "distribution": "exponential"},
+        },
+    )
+    data["retry_policy"] = {"request_timeout_s": 1.0}
+    with pytest.raises(ValidationError, match="multiple generators"):
+        SimulationPayload.model_validate(data)

@@ -1,0 +1,240 @@
+"""Sweep-layer resilience: fault-timing Monte-Carlo through SweepRunner,
+OOM chunk downshift, the finite-results guard, and plan-aware checkpoint
+identity."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import yaml
+
+from asyncflow_tpu.parallel.sweep import (
+    SweepRunner,
+    _check_finite,
+    _is_oom,
+    make_overrides,
+)
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+BASE = "tests/integration/data/single_server.yml"
+HORIZON = 40
+
+
+def _payload(mut=None, horizon: int = HORIZON) -> SimulationPayload:
+    data = yaml.safe_load(open(BASE).read())
+    data["sim_settings"]["total_simulation_time"] = horizon
+    data["sim_settings"]["enabled_sample_metrics"] = []
+    if mut:
+        mut(data)
+    return SimulationPayload.model_validate(data)
+
+
+def _resilient(data) -> None:
+    data["retry_policy"] = {
+        "request_timeout_s": 0.5,
+        "max_attempts": 3,
+        "backoff_base_s": 0.05,
+        "backoff_multiplier": 2.0,
+        "backoff_cap_s": 0.5,
+        "budget_tokens": 40,
+        "budget_refill_per_s": 2.0,
+    }
+    data["fault_timeline"] = {
+        "events": [
+            {
+                "fault_id": "crash",
+                "kind": "server_outage",
+                "target_id": "srv-1",
+                "t_start": 10.0,
+                "t_end": 20.0,
+            },
+        ],
+    }
+
+
+def test_fault_sweep_end_to_end_and_deterministic() -> None:
+    """A fault-timing sweep batches on the event engine, produces the new
+    per-scenario counters, and is deterministic under a fixed seed."""
+    payload = _payload(_resilient)
+    runner = SweepRunner(payload, engine="auto", use_mesh=False)
+    assert runner.engine_kind == "event"
+    n = 8
+    shifts = np.linspace(0.0, 15.0, n)
+    ov = make_overrides(
+        runner.plan, n, fault_shift=shifts, retry_timeout=np.full(n, 0.5),
+    )
+    rep1 = runner.run(n, seed=5, overrides=ov, chunk_size=4)
+    rep2 = runner.run(n, seed=5, overrides=ov, chunk_size=4)
+    res = rep1.results
+    assert res.total_timed_out is not None
+    assert res.total_retries is not None
+    assert res.retry_budget_exhausted is not None
+    assert res.attempts_hist is not None
+    assert res.attempts_hist.shape == (n, 3)
+    assert int(res.total_rejected.sum()) > 0  # the outage bites
+    for name in (
+        "completed",
+        "total_generated",
+        "total_rejected",
+        "total_timed_out",
+        "total_retries",
+        "attempts_hist",
+    ):
+        assert np.array_equal(
+            getattr(rep1.results, name), getattr(rep2.results, name),
+        ), name
+    summary = rep1.summary()
+    assert summary["retries_total"] == int(res.total_retries.sum())
+    assert 0.0 < summary["goodput_fraction"] <= 1.0
+
+
+def test_resilient_plans_refuse_native_and_pallas() -> None:
+    payload = _payload(_resilient)
+    for engine in ("native", "pallas"):
+        with pytest.raises(ValueError, match="does not model"):
+            SweepRunner(payload, engine=engine, use_mesh=False)
+
+
+def test_fault_overrides_need_fault_plan() -> None:
+    runner = SweepRunner(_payload(), engine="auto", use_mesh=False)
+    with pytest.raises(ValueError, match="fault_timeline"):
+        make_overrides(runner.plan, 4, fault_shift=np.zeros(4))
+    with pytest.raises(ValueError, match="retry_policy"):
+        make_overrides(runner.plan, 4, retry_timeout=np.full(4, 0.5))
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation: OOM -> chunk downshift
+# ---------------------------------------------------------------------------
+
+
+class _FakeOOM(RuntimeError):
+    pass
+
+
+def test_is_oom_classifier() -> None:
+    assert _is_oom(_FakeOOM("RESOURCE_EXHAUSTED: Out of memory on TPU"))
+    assert _is_oom(RuntimeError("Allocator ran out of memory"))
+    assert not _is_oom(ValueError("shape mismatch"))
+
+
+def test_sweep_survives_injected_oom_with_downshift(monkeypatch) -> None:
+    """An injected RESOURCE_EXHAUSTED on the first chunk halves the chunk,
+    re-runs it, and the sweep's results are identical to an undisturbed
+    run (the scenario key grid is position-stable under chunking)."""
+    payload = _payload(_resilient)
+    runner = SweepRunner(payload, engine="auto", use_mesh=False)
+    n = 8
+    baseline = runner.run(n, seed=9, chunk_size=8)
+
+    runner2 = SweepRunner(payload, engine="auto", use_mesh=False)
+    real_run_batch = runner2.engine.run_batch
+    calls = {"n": 0}
+
+    def flaky_run_batch(keys, ov=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            msg = "RESOURCE_EXHAUSTED: out of memory allocating 1.0GiB"
+            raise _FakeOOM(msg)
+        return real_run_batch(keys, ov)
+
+    monkeypatch.setattr(runner2.engine, "run_batch", flaky_run_batch)
+    report = runner2.run(n, seed=9, chunk_size=8)
+    assert report.downshifts == [{"scenario_start": 0, "from": 8, "to": 4}]
+    assert np.array_equal(report.results.completed, baseline.results.completed)
+    assert np.array_equal(
+        report.results.latency_hist, baseline.results.latency_hist,
+    )
+
+
+def test_sweep_oom_at_floor_reraises_with_hint(monkeypatch) -> None:
+    payload = _payload()
+    runner = SweepRunner(payload, engine="event", use_mesh=False)
+
+    def always_oom(keys, ov=None):
+        raise _FakeOOM("RESOURCE_EXHAUSTED: out of memory")
+
+    monkeypatch.setattr(runner.engine, "run_batch", always_oom)
+    with pytest.raises(RuntimeError, match="minimum chunk size"):
+        runner.run(4, seed=0, chunk_size=2)
+
+
+# ---------------------------------------------------------------------------
+# finite-results guard
+# ---------------------------------------------------------------------------
+
+
+def test_check_finite_names_engine_chunk_and_metric() -> None:
+    payload = _payload()
+    runner = SweepRunner(payload, engine="event", use_mesh=False)
+    report = runner.run(2, seed=0, chunk_size=2)
+    part = report.results
+    _check_finite(part, "event", 0, 0)  # clean results pass
+    import dataclasses
+
+    bad = dataclasses.replace(
+        part, latency_sum=np.array([np.nan, 1.0]),
+    )
+    with pytest.raises(ValueError, match="event.*chunk 3.*latency_sum"):
+        _check_finite(bad, "event", 3, 128)
+    # +inf latency_min on a zero-completion scenario is LEGAL
+    empty_min = dataclasses.replace(
+        part,
+        latency_min=np.array([np.inf, 0.01]),
+        completed=np.array([0, 5]),
+    )
+    _check_finite(empty_min, "event", 0, 0)
+
+
+def test_sweep_raises_on_nonfinite_chunk(monkeypatch) -> None:
+    payload = _payload()
+    runner = SweepRunner(payload, engine="event", use_mesh=False)
+    import asyncflow_tpu.parallel.sweep as sweep_mod
+
+    real = sweep_mod.sweep_results
+
+    def poisoned(engine, final, settings=None, gauge_sel=None):
+        part = real(engine, final, settings, gauge_sel=gauge_sel)
+        part.latency_sum = np.full_like(part.latency_sum, np.nan)
+        return part
+
+    monkeypatch.setattr(sweep_mod, "sweep_results", poisoned)
+    with pytest.raises(ValueError, match="non-finite.*latency_sum"):
+        runner.run(2, seed=0, chunk_size=2)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint identity incorporates the lowered plan
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_identity_tracks_fault_timing(tmp_path) -> None:
+    """Resuming a checkpoint against a changed fault timeline must land in
+    a DIFFERENT checkpoint directory (no silent splicing)."""
+
+    def at(t0):
+        def mut(data):
+            _resilient(data)
+            data["fault_timeline"]["events"][0]["t_start"] = t0
+            data["fault_timeline"]["events"][0]["t_end"] = t0 + 10.0
+
+        return mut
+
+    r1 = SweepRunner(_payload(at(5.0)), engine="event", use_mesh=False)
+    r2 = SweepRunner(_payload(at(12.0)), engine="event", use_mesh=False)
+    assert r1._checkpoint_identity(None) != r2._checkpoint_identity(None)
+    # identical scenarios agree (checkpoints remain shareable)
+    r1b = SweepRunner(_payload(at(5.0)), engine="event", use_mesh=False)
+    assert r1._checkpoint_identity(None) == r1b._checkpoint_identity(None)
+
+    # and a checkpointed resilient sweep resumes cleanly from disk
+    runner = SweepRunner(_payload(_resilient), engine="event", use_mesh=False)
+    rep = runner.run(4, seed=2, chunk_size=2, checkpoint_dir=str(tmp_path))
+    resumed = runner.run(4, seed=2, chunk_size=2, checkpoint_dir=str(tmp_path))
+    assert np.array_equal(rep.results.completed, resumed.results.completed)
+    assert np.array_equal(
+        rep.results.total_retries, resumed.results.total_retries,
+    )
+    assert np.array_equal(
+        rep.results.attempts_hist, resumed.results.attempts_hist,
+    )
