@@ -1,5 +1,62 @@
-"""Curated public surface for post-run analysis."""
+"""Monte-Carlo inference over sweep ensembles: interval estimators,
+variance reduction, CRN-paired A/B comparison, and adaptive sequential
+sweeps (docs/guides/mc-inference.md).
 
+Heavy runtime imports (jax, the sweep layer) are deferred into the call
+paths that need them — importing this package costs numpy only.
+"""
+
+from asyncflow_tpu.analysis.adaptive import (
+    AdaptiveReport,
+    AdaptiveRound,
+    AdaptiveSweep,
+)
+from asyncflow_tpu.analysis.compare import ComparisonReport, compare
+from asyncflow_tpu.analysis.estimators import (
+    IntervalEstimate,
+    binomial_rank_bounds,
+    bootstrap_mean_ci,
+    bootstrap_quantile_ci,
+    bootstrap_ratio_ci,
+    interval_for_metric,
+    paired_delta_for_metric,
+    paired_delta_quantile_ci,
+    paired_delta_ratio_ci,
+    pooled_quantile_ci,
+)
+from asyncflow_tpu.analysis.vr import (
+    antithetic_mean_ci,
+    antithetic_pair_means,
+    coupling_diagnostics,
+)
 from asyncflow_tpu.metrics.analyzer import ResultsAnalyzer
+from asyncflow_tpu.schemas.experiment import (
+    ExperimentConfig,
+    PrecisionTarget,
+    VarianceReduction,
+)
 
-__all__ = ["ResultsAnalyzer"]
+__all__ = [
+    "AdaptiveReport",
+    "AdaptiveRound",
+    "AdaptiveSweep",
+    "ComparisonReport",
+    "ExperimentConfig",
+    "IntervalEstimate",
+    "PrecisionTarget",
+    "ResultsAnalyzer",
+    "VarianceReduction",
+    "antithetic_mean_ci",
+    "antithetic_pair_means",
+    "binomial_rank_bounds",
+    "bootstrap_mean_ci",
+    "bootstrap_quantile_ci",
+    "bootstrap_ratio_ci",
+    "compare",
+    "coupling_diagnostics",
+    "interval_for_metric",
+    "paired_delta_for_metric",
+    "paired_delta_quantile_ci",
+    "paired_delta_ratio_ci",
+    "pooled_quantile_ci",
+]

@@ -1,0 +1,268 @@
+"""Adaptive sequential sweeps: run until the intervals are tight enough.
+
+:class:`AdaptiveSweep` drives a :class:`SweepRunner` in growing rounds
+until every :class:`PrecisionTarget` of the experiment's design is met or
+the scenario budget is exhausted.  Each round runs only the INCREMENT of
+the deterministic scenario grid (``first_scenario`` continuation — the
+per-scenario key grid is prefix-stable, so the union of the rounds is
+bit-identical to one uninterrupted sweep of the same total), re-estimates
+every target metric's confidence interval on the merged ensemble, and
+records the half-width trajectory.  The stop reason, the per-round
+trajectory, and the final intervals all land in the report — and in the
+run-record telemetry when configured (docs/guides/mc-inference.md).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass
+
+from asyncflow_tpu.analysis.estimators import (
+    IntervalEstimate,
+    interval_for_metric,
+)
+from asyncflow_tpu.schemas.experiment import ExperimentConfig
+
+#: stop reasons an :class:`AdaptiveReport` can carry
+STOP_TARGETS_MET = "targets_met"
+STOP_BUDGET_EXHAUSTED = "budget_exhausted"
+
+
+@dataclass(frozen=True)
+class AdaptiveRound:
+    """One round of the sequential schedule."""
+
+    index: int
+    #: scenarios added this round / cumulative after it
+    n_new: int
+    n_total: int
+    wall_seconds: float
+    #: per-target interval on the CUMULATIVE ensemble after this round
+    intervals: dict[str, IntervalEstimate]
+    #: target metrics whose precision is still unmet after this round
+    unmet: tuple[str, ...]
+
+    def as_dict(self) -> dict:
+        return {
+            "index": self.index,
+            "n_new": self.n_new,
+            "n_total": self.n_total,
+            "wall_seconds": round(self.wall_seconds, 6),
+            "intervals": {m: e.as_dict() for m, e in self.intervals.items()},
+            "half_widths": {
+                m: e.half_width for m, e in self.intervals.items()
+            },
+            "unmet": list(self.unmet),
+        }
+
+
+@dataclass(frozen=True)
+class AdaptiveReport:
+    """Outcome of an adaptive sweep: merged report + stopping trace."""
+
+    #: merged SweepReport over every scenario the driver ran
+    report: object
+    rounds: list[AdaptiveRound]
+    stop_reason: str
+    experiment: ExperimentConfig
+    seed: int
+
+    @property
+    def n_scenarios(self) -> int:
+        return self.rounds[-1].n_total if self.rounds else 0
+
+    @property
+    def intervals(self) -> dict[str, IntervalEstimate]:
+        """Final per-target intervals (last round's)."""
+        return self.rounds[-1].intervals if self.rounds else {}
+
+    def as_dict(self) -> dict:
+        return {
+            "stop_reason": self.stop_reason,
+            "n_scenarios": self.n_scenarios,
+            "n_rounds": len(self.rounds),
+            "seed": self.seed,
+            "rounds": [r.as_dict() for r in self.rounds],
+        }
+
+
+class AdaptiveSweep:
+    """Sequential-stopping driver over a :class:`SweepRunner`.
+
+    The schedule comes from the experiment's design: round 1 runs
+    ``initial_scenarios``; each later round grows the cumulative ensemble
+    by ``growth_factor`` (clipped to ``max_scenarios``).  A round's
+    increment continues the deterministic grid via ``first_scenario``, so
+    checkpointing composes (an interrupted adaptive run resumes its rounds'
+    chunks) and results match an uninterrupted sweep of the same total.
+
+    With antithetic pairing on, increments are kept even so every round
+    closes its reflected pairs.
+    """
+
+    def __init__(
+        self,
+        payload,
+        experiment: ExperimentConfig,
+        *,
+        engine: str = "auto",
+        use_mesh: bool = True,
+        n_boot: int = 1000,
+        chunk_size: int | None = None,
+        checkpoint_dir: str | None = None,
+        telemetry=None,
+        runner=None,
+    ) -> None:
+        """``runner``: inject a pre-built :class:`SweepRunner` (it must
+        carry the SAME experiment config); otherwise one is constructed
+        from ``payload`` with the remaining knobs."""
+        if not experiment.precision:
+            msg = (
+                "adaptive sweeps need at least one PrecisionTarget in "
+                "ExperimentConfig.precision (otherwise there is nothing "
+                "to stop on)"
+            )
+            raise ValueError(msg)
+        self.experiment = experiment
+        self._n_boot = n_boot
+        self._chunk_size = chunk_size
+        self._checkpoint_dir = checkpoint_dir
+        self._telemetry = telemetry
+        if runner is not None:
+            self.runner = runner
+        else:
+            from asyncflow_tpu.parallel.sweep import SweepRunner
+
+            self.runner = SweepRunner(
+                payload,
+                engine=engine,
+                use_mesh=use_mesh,
+                experiment=experiment,
+            )
+
+    def _schedule(self) -> list[int]:
+        """Cumulative scenario totals per round (monotone, capped)."""
+        exp = self.experiment
+        anti = exp.variance_reduction.antithetic
+        totals: list[int] = []
+        total = int(exp.initial_scenarios)
+        if anti and total % 2:
+            total += 1
+        while True:
+            total = min(total, int(exp.max_scenarios))
+            if anti and total % 2:
+                total -= 1
+            if totals and total <= totals[-1]:
+                break
+            totals.append(total)
+            if total >= exp.max_scenarios:
+                break
+            total = int(math.ceil(totals[-1] * exp.growth_factor))
+        return totals
+
+    def run(self, *, seed: int = 0, overrides=None) -> AdaptiveReport:
+        """Run rounds until every target is met or the budget runs out.
+
+        ``overrides`` must be base (unbatched) values — per-scenario
+        batches don't compose with a data-dependent total.
+        """
+        from asyncflow_tpu.parallel.sweep import (
+            SweepReport,
+            _concat_sweeps,
+        )
+
+        exp = self.experiment
+        level = exp.confidence_level
+        anti = exp.variance_reduction.antithetic
+        rounds: list[AdaptiveRound] = []
+        partials = []
+        merged = None
+        done = 0  # scenarios completed
+        keys_used = 0  # rows of the key grid consumed (n/2 per antithetic n)
+        wall_total = 0.0
+        stop_reason = STOP_BUDGET_EXHAUSTED
+        for idx, total in enumerate(self._schedule()):
+            n_new = total - done
+            t0 = time.perf_counter()
+            rep = self.runner.run(
+                n_new,
+                seed=seed,
+                overrides=overrides,
+                chunk_size=self._chunk_size,
+                checkpoint_dir=self._checkpoint_dir,
+                first_scenario=keys_used,
+                telemetry=self._telemetry,
+            )
+            wall = time.perf_counter() - t0
+            wall_total += wall
+            partials.append(rep.results)
+            merged = _concat_sweeps(partials)
+            done = total
+            keys_used += n_new // 2 if anti else n_new
+            intervals = {
+                t.metric: interval_for_metric(
+                    merged,
+                    t.metric,
+                    level,
+                    n_boot=self._n_boot,
+                    seed=seed,
+                )
+                for t in exp.precision
+            }
+            unmet = tuple(
+                t.metric
+                for t in exp.precision
+                if not intervals[t.metric].meets(
+                    t.half_width, relative=t.relative,
+                )
+            )
+            rounds.append(
+                AdaptiveRound(
+                    index=idx,
+                    n_new=n_new,
+                    n_total=total,
+                    wall_seconds=wall,
+                    intervals=intervals,
+                    unmet=unmet,
+                ),
+            )
+            if not unmet:
+                stop_reason = STOP_TARGETS_MET
+                break
+
+        report = SweepReport(
+            results=merged,
+            n_scenarios=done,
+            wall_seconds=wall_total,
+            plan=self.runner.plan,
+            antithetic=anti,
+        )
+        out = AdaptiveReport(
+            report=report,
+            rounds=rounds,
+            stop_reason=stop_reason,
+            experiment=exp,
+            seed=seed,
+        )
+        self._emit_telemetry(out)
+        return out
+
+    def _emit_telemetry(self, result: AdaptiveReport) -> None:
+        """One ``kind="adaptive"`` run record: rounds, half-width
+        trajectory, stop reason — beside the per-round sweep records."""
+        from asyncflow_tpu.observability.telemetry import telemetry_session
+
+        tel = telemetry_session(self._telemetry, kind="adaptive")
+        if tel is None:
+            return
+        with tel:
+            tel.add_meta(
+                stop_reason=result.stop_reason,
+                n_rounds=len(result.rounds),
+                n_scenarios=result.n_scenarios,
+                seed=result.seed,
+                targets=[t.model_dump() for t in self.experiment.precision],
+                rounds=[r.as_dict() for r in result.rounds],
+            )
+        tel.finalize()

@@ -1,0 +1,203 @@
+"""CRN-paired A/B comparison: "is topology B actually better?".
+
+:func:`compare` runs a baseline and a candidate scenario configuration of
+the SAME payload under common random numbers — both arms share the
+per-scenario key grid (and, on the event engine, per-request substreams via
+``crn=True``) — and reports paired-delta confidence intervals per metric.
+Because the arms see the same noise, the scenario-level deltas carry far
+less variance than two independently-seeded sweeps, which is the entire
+point: a delta-p95 CI narrow enough to call a winner at a fraction of the
+scenario budget (docs/guides/mc-inference.md has the worked example and the
+measured tightening).
+
+The delta intervals come from scenario-paired bootstrap resampling
+(:func:`asyncflow_tpu.analysis.estimators.paired_delta_for_metric`), which
+is valid for independently-seeded arms too — coupling only *narrows* it —
+so ``candidate_seed`` exists to run the uncoupled comparison the coupled
+one should be benchmarked against.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from asyncflow_tpu.analysis.estimators import (
+    _QUANTILE_METRICS,
+    IntervalEstimate,
+    _ratio_components,
+    paired_delta_for_metric,
+)
+from asyncflow_tpu.analysis.vr import coupling_diagnostics
+from asyncflow_tpu.schemas.experiment import (
+    SUPPORTED_METRICS,
+    ExperimentConfig,
+    VarianceReduction,
+)
+
+#: default metric set of a comparison (every SUPPORTED_METRICS entry the
+#: "which arm wins" question usually turns on)
+DEFAULT_COMPARE_METRICS = (
+    "latency_p50_s",
+    "latency_p95_s",
+    "latency_p99_s",
+    "goodput_fraction",
+)
+
+
+def per_scenario_metric(results, metric: str) -> np.ndarray:
+    """(S,) per-scenario values of one summary metric (quantiles from the
+    per-scenario histograms, ratios from the per-scenario totals)."""
+    if metric in _QUANTILE_METRICS:
+        return np.asarray(results.percentile(_QUANTILE_METRICS[metric]))
+    num, den = _ratio_components(results, metric)
+    return num / np.maximum(den, 1e-300)
+
+
+@dataclass(frozen=True)
+class ComparisonReport:
+    """Paired A/B comparison outcome (candidate minus baseline)."""
+
+    #: per-metric CI on candidate - baseline (negative latency delta =
+    #: candidate is faster; positive goodput delta = candidate completes
+    #: a larger share)
+    deltas: dict[str, IntervalEstimate]
+    #: per-metric coupling diagnostics over the per-scenario metric arrays
+    #: (``correlation`` near +1 = CRN bit; ``variance_ratio_vs_independent``
+    #: < 1 = the paired delta is that much tighter than independent arms)
+    coupling: dict[str, dict]
+    baseline: object  # SweepReport
+    candidate: object  # SweepReport
+    n_scenarios: int
+    seed: int
+    candidate_seed: int
+    level: float
+    engine: str
+    metrics: tuple[str, ...] = field(default=DEFAULT_COMPARE_METRICS)
+
+    @property
+    def coupled(self) -> bool:
+        """Did the arms share the scenario key grid (CRN)?"""
+        return self.seed == self.candidate_seed
+
+    def decisive(self, metric: str) -> bool:
+        """Does the ``metric`` delta CI exclude zero?"""
+        est = self.deltas[metric]
+        return bool(est.lo > 0.0 or est.hi < 0.0)
+
+    def as_dict(self) -> dict:
+        return {
+            "n_scenarios": self.n_scenarios,
+            "seed": self.seed,
+            "candidate_seed": self.candidate_seed,
+            "coupled": self.coupled,
+            "level": self.level,
+            "engine": self.engine,
+            "deltas": {m: e.as_dict() for m, e in self.deltas.items()},
+            "decisive": {m: self.decisive(m) for m in self.deltas},
+            "coupling": self.coupling,
+        }
+
+
+def compare(
+    payload,
+    baseline_overrides=None,
+    candidate_overrides=None,
+    *,
+    n_scenarios: int = 256,
+    seed: int = 0,
+    candidate_seed: int | None = None,
+    metrics: tuple[str, ...] = DEFAULT_COMPARE_METRICS,
+    level: float = 0.95,
+    n_boot: int = 1000,
+    engine: str = "auto",
+    use_mesh: bool = True,
+    chunk_size: int | None = None,
+    experiment: ExperimentConfig | None = None,
+    telemetry=None,
+) -> ComparisonReport:
+    """Run both arms of an A/B experiment under CRN and interval the deltas.
+
+    ``baseline_overrides`` / ``candidate_overrides`` are each a
+    :class:`ScenarioOverrides` (base values shared by every scenario, or a
+    per-scenario batch of ``n_scenarios`` rows), a dict of
+    :func:`asyncflow_tpu.parallel.make_overrides` keyword arguments, or
+    ``None`` for the payload as lowered.  The two arms run through ONE
+    :class:`SweepRunner` — same plan, same key grid — differing only in
+    their overrides, which is exactly the "two sweeps differing only in
+    ScenarioOverrides share draws" CRN contract.
+
+    ``candidate_seed`` (default: same as ``seed``) de-couples the arms to
+    quantify what CRN buys; ``experiment`` overrides the default CRN-on
+    design (its precision targets are ignored here — see
+    :class:`asyncflow_tpu.analysis.AdaptiveSweep` for sequential stopping).
+    """
+    from asyncflow_tpu.parallel.sweep import SweepRunner, make_overrides
+
+    unknown = [m for m in metrics if m not in SUPPORTED_METRICS]
+    if unknown:
+        msg = (
+            f"unknown comparison metrics {unknown}; supported: "
+            f"{', '.join(SUPPORTED_METRICS)}"
+        )
+        raise ValueError(msg)
+    if experiment is None:
+        experiment = ExperimentConfig(
+            variance_reduction=VarianceReduction(crn=True),
+        )
+    runner = SweepRunner(
+        payload,
+        engine=engine,
+        use_mesh=use_mesh,
+        experiment=experiment,
+        telemetry=telemetry,
+    )
+
+    def _arm_overrides(spec):
+        if spec is None or not isinstance(spec, dict):
+            return spec
+        return make_overrides(runner.plan, n_scenarios, **spec)
+
+    cand_seed = seed if candidate_seed is None else candidate_seed
+    rep_a = runner.run(
+        n_scenarios,
+        seed=seed,
+        overrides=_arm_overrides(baseline_overrides),
+        chunk_size=chunk_size,
+    )
+    rep_b = runner.run(
+        n_scenarios,
+        seed=cand_seed,
+        overrides=_arm_overrides(candidate_overrides),
+        chunk_size=chunk_size,
+    )
+
+    deltas: dict[str, IntervalEstimate] = {}
+    coupling: dict[str, dict] = {}
+    for i, metric in enumerate(metrics):
+        deltas[metric] = paired_delta_for_metric(
+            rep_a.results,
+            rep_b.results,
+            metric,
+            level,
+            n_boot=n_boot,
+            # distinct (deterministic) resample streams per metric
+            seed=seed * 1000 + i,
+        )
+        coupling[metric] = coupling_diagnostics(
+            per_scenario_metric(rep_a.results, metric),
+            per_scenario_metric(rep_b.results, metric),
+        )
+    return ComparisonReport(
+        deltas=deltas,
+        coupling=coupling,
+        baseline=rep_a,
+        candidate=rep_b,
+        n_scenarios=n_scenarios,
+        seed=seed,
+        candidate_seed=cand_seed,
+        level=level,
+        engine=runner.engine_kind,
+        metrics=tuple(metrics),
+    )

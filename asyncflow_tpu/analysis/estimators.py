@@ -1,0 +1,442 @@
+"""Monte-Carlo interval estimators over sweep ensembles.
+
+Two estimator families, both operating on the per-scenario reductions a
+sweep already streams to the host (histograms, moment sums, counters) — no
+per-request data is ever needed:
+
+- **Order-statistic (binomial) CIs on pooled quantiles**
+  (:func:`pooled_quantile_ci`): the classic distribution-free interval on
+  the latency quantile of the POOLED request population.  This is the
+  statistically meaningful interval for "p99 latency of the system" — not
+  the mean of per-scenario percentiles the legacy
+  ``SweepReport.percentile_ci`` reported (kept as
+  ``per_scenario_percentile_mean_ci``).
+- **Scenario-resampling bootstrap** (:func:`bootstrap_mean_ci`,
+  :func:`bootstrap_ratio_ci`, :func:`bootstrap_quantile_ci`,
+  :func:`paired_delta_quantile_ci`, :func:`paired_delta_ratio_ci`):
+  resamples whole scenarios (the i.i.d. replication unit), so
+  within-scenario dependence between requests is honored.  Replicates are
+  weighted-histogram matmuls, so one call is a single (B, S) x (S, ...)
+  contraction: NumPy on CPU, on-device via vmapped bincount + matmul for
+  large ensembles on an accelerator (ABMax's ensemble-statistics idiom).
+
+Paired estimators resample the SAME scenario indices in both arms, which is
+what turns CRN coupling (``docs/guides/mc-inference.md``) into narrower
+delta intervals: the common noise cancels inside each replicate.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from statistics import NormalDist
+
+import numpy as np
+
+from asyncflow_tpu.engines.results import hist_percentile
+
+#: replicate count past which (and only on a live accelerator backend) the
+#: resample-weight construction runs on device
+_DEVICE_RESAMPLE_MIN = 4_000_000
+
+
+@dataclass(frozen=True)
+class IntervalEstimate:
+    """A point estimate with a two-sided confidence interval."""
+
+    point: float
+    lo: float
+    hi: float
+    level: float
+    n: int
+    method: str
+
+    @property
+    def half_width(self) -> float:
+        """Half the interval width (NaN propagates from empty ensembles)."""
+        return (self.hi - self.lo) / 2.0
+
+    def meets(self, half_width: float, *, relative: bool = False) -> bool:
+        """Does the interval resolve the metric to ``half_width``?"""
+        hw = self.half_width
+        if not math.isfinite(hw):
+            return False
+        if relative:
+            scale = abs(self.point)
+            return hw <= half_width * scale if scale > 0 else hw == 0.0
+        return hw <= half_width
+
+    def as_dict(self) -> dict:
+        return {
+            "point": self.point,
+            "lo": self.lo,
+            "hi": self.hi,
+            "level": self.level,
+            "n": self.n,
+            "method": self.method,
+            "half_width": self.half_width,
+        }
+
+
+def _nan_interval(level: float, method: str) -> IntervalEstimate:
+    nan = float("nan")
+    return IntervalEstimate(nan, nan, nan, level, 0, method)
+
+
+def _check_level(level: float) -> None:
+    if not 0.0 < level < 1.0:
+        msg = f"confidence level must be in (0, 1), got {level}"
+        raise ValueError(msg)
+
+
+# ---------------------------------------------------------------------------
+# order-statistic (binomial) pooled-quantile CI
+# ---------------------------------------------------------------------------
+
+
+def binomial_rank_bounds(n: int, p: float, level: float) -> tuple[int, int]:
+    """1-indexed order-statistic ranks (r, s) with
+    ``P(x_(r) <= xi_p <= x_(s)) >= level`` for n i.i.d. draws.
+
+    Exact binomial-CDF inversion for small n; the normal approximation to
+    Bin(n, p) beyond (its rank error is sub-integer well before the
+    crossover).  Ranks are clamped into [1, n].
+    """
+    _check_level(level)
+    if n < 1:
+        msg = f"need at least one observation, got n={n}"
+        raise ValueError(msg)
+    alpha = 1.0 - level
+    if n <= 2000:
+        k = np.arange(n + 1, dtype=np.float64)
+        lg = np.vectorize(math.lgamma)
+        logpmf = (
+            lg(n + 1.0)
+            - lg(k + 1.0)
+            - lg(n - k + 1.0)
+            + k * math.log(max(p, 1e-300))
+            + (n - k) * math.log1p(-min(p, 1.0 - 1e-16))
+        )
+        cdf = np.cumsum(np.exp(logpmf))
+        # largest r with F(r-1) <= alpha/2; smallest s with F(s-1) >= 1-alpha/2
+        r = int(np.searchsorted(cdf, alpha / 2.0, side="right"))
+        s = int(np.searchsorted(cdf, 1.0 - alpha / 2.0, side="left")) + 1
+    else:
+        z = NormalDist().inv_cdf(1.0 - alpha / 2.0)
+        mu = n * p
+        sd = math.sqrt(n * p * (1.0 - p))
+        r = int(math.floor(mu - z * sd))
+        s = int(math.ceil(mu + z * sd)) + 1
+    return max(r, 1), min(s, n)
+
+
+def pooled_quantile_ci(
+    counts: np.ndarray,
+    edges: np.ndarray,
+    q: float,
+    level: float = 0.95,
+) -> IntervalEstimate:
+    """Order-statistic CI on the pooled latency quantile ``q`` (percent).
+
+    ``counts`` is the per-scenario histogram stack ``(S, B)`` (or an
+    already-pooled ``(B,)`` row); the interval maps the binomial rank
+    bounds through the pooled histogram's inverse CDF, so resolution is
+    the log-bin width (~1.6% of the value at 1024 bins).
+    """
+    _check_level(level)
+    counts = np.asarray(counts, np.float64)
+    pooled = counts.sum(axis=0) if counts.ndim == 2 else counts
+    n = int(round(float(pooled.sum())))
+    if n == 0:
+        return _nan_interval(level, "order-statistic")
+    point = float(hist_percentile(pooled, edges, q))
+    r, s = binomial_rank_bounds(n, q / 100.0, level)
+    lo = float(hist_percentile(pooled, edges, 100.0 * r / n))
+    hi = float(hist_percentile(pooled, edges, 100.0 * s / n))
+    return IntervalEstimate(point, lo, hi, level, n, "order-statistic")
+
+
+# ---------------------------------------------------------------------------
+# scenario-resampling bootstrap
+# ---------------------------------------------------------------------------
+
+
+def resample_weights(n: int, n_boot: int, seed: int) -> np.ndarray:
+    """(B, n) multinomial resample-count matrix — the bootstrap's only
+    random object; every replicate statistic is a weighted reduction by one
+    of its rows.  Host path draws ``numpy`` multinomials; on a live
+    accelerator backend large problems build the counts on device
+    (vmapped randint + bincount).  The two paths draw different (equally
+    valid) resamples; each is deterministic in ``seed``.
+    """
+    if n < 1 or n_boot < 1:
+        msg = f"need n >= 1 and n_boot >= 1, got n={n}, n_boot={n_boot}"
+        raise ValueError(msg)
+    use_device = False
+    if n * n_boot >= _DEVICE_RESAMPLE_MIN:
+        try:
+            import jax
+
+            use_device = jax.default_backend() != "cpu"
+        except Exception:  # pragma: no cover - jax always importable here
+            use_device = False
+    if use_device:  # pragma: no cover - exercised on accelerator hosts
+        import jax
+        import jax.numpy as jnp
+
+        keys = jax.random.split(jax.random.PRNGKey(seed), n_boot)
+
+        def one(k):
+            idx = jax.random.randint(k, (n,), 0, n)
+            return jnp.bincount(idx, length=n)
+
+        return np.asarray(jax.jit(jax.vmap(one))(keys), np.float64)
+    rng = np.random.default_rng(seed)
+    return rng.multinomial(n, np.full(n, 1.0 / n), size=n_boot).astype(
+        np.float64,
+    )
+
+
+def _percentile_interval(
+    reps: np.ndarray,
+    point: float,
+    level: float,
+    n: int,
+    method: str,
+) -> IntervalEstimate:
+    reps = np.asarray(reps, np.float64)
+    reps = reps[np.isfinite(reps)]
+    if reps.size == 0:
+        return _nan_interval(level, method)
+    alpha = 1.0 - level
+    lo, hi = np.percentile(reps, [100.0 * alpha / 2.0, 100.0 * (1.0 - alpha / 2.0)])
+    return IntervalEstimate(point, float(lo), float(hi), level, n, method)
+
+
+def bootstrap_mean_ci(
+    values: np.ndarray,
+    level: float = 0.95,
+    *,
+    n_boot: int = 1000,
+    seed: int = 0,
+) -> IntervalEstimate:
+    """Percentile-bootstrap CI on the mean of i.i.d. per-scenario values."""
+    _check_level(level)
+    values = np.asarray(values, np.float64)
+    values = values[np.isfinite(values)]
+    n = values.size
+    if n == 0:
+        return _nan_interval(level, "bootstrap-mean")
+    w = resample_weights(n, n_boot, seed)
+    reps = (w @ values) / n
+    return _percentile_interval(
+        reps, float(values.mean()), level, n, "bootstrap-mean",
+    )
+
+
+def bootstrap_ratio_ci(
+    num: np.ndarray,
+    den: np.ndarray,
+    level: float = 0.95,
+    *,
+    n_boot: int = 1000,
+    seed: int = 0,
+) -> IntervalEstimate:
+    """Percentile-bootstrap CI on ``sum(num) / sum(den)`` over scenarios.
+
+    The ratio-of-sums estimator covers pooled means of per-scenario totals
+    — mean latency (latency_sum / completed) and goodput
+    (completed / offered) both take this shape.
+    """
+    _check_level(level)
+    num = np.asarray(num, np.float64)
+    den = np.asarray(den, np.float64)
+    if num.shape != den.shape:
+        msg = f"num/den shape mismatch: {num.shape} vs {den.shape}"
+        raise ValueError(msg)
+    n = num.size
+    if n == 0 or den.sum() <= 0:
+        return _nan_interval(level, "bootstrap-ratio")
+    w = resample_weights(n, n_boot, seed)
+    reps = (w @ num) / np.maximum(w @ den, 1e-300)
+    return _percentile_interval(
+        reps, float(num.sum() / den.sum()), level, n, "bootstrap-ratio",
+    )
+
+
+def bootstrap_quantile_ci(
+    counts: np.ndarray,
+    edges: np.ndarray,
+    q: float,
+    level: float = 0.95,
+    *,
+    n_boot: int = 1000,
+    seed: int = 0,
+) -> IntervalEstimate:
+    """Scenario-resampled bootstrap CI on the pooled quantile ``q``.
+
+    Unlike :func:`pooled_quantile_ci` (which treats pooled requests as
+    i.i.d.), this resamples whole scenarios, honoring within-scenario
+    dependence — the conservative choice when scenarios are heterogeneous.
+    """
+    _check_level(level)
+    counts = np.atleast_2d(np.asarray(counts, np.float64))
+    n = counts.shape[0]
+    if n == 0 or counts.sum() == 0:
+        return _nan_interval(level, "bootstrap-quantile")
+    w = resample_weights(n, n_boot, seed)
+    reps = hist_percentile(w @ counts, edges, q)
+    point = float(hist_percentile(counts.sum(axis=0), edges, q))
+    return _percentile_interval(reps, point, level, n, "bootstrap-quantile")
+
+
+def paired_delta_quantile_ci(
+    counts_a: np.ndarray,
+    counts_b: np.ndarray,
+    edges: np.ndarray,
+    q: float,
+    level: float = 0.95,
+    *,
+    n_boot: int = 1000,
+    seed: int = 0,
+) -> IntervalEstimate:
+    """CI on ``quantile_b - quantile_a`` with scenario-paired resampling.
+
+    Each bootstrap replicate resamples ONE set of scenario indices and
+    applies it to BOTH arms — under CRN the coupled noise cancels inside
+    each replicate, which is where the paired interval's narrowness comes
+    from; for independently-seeded arms it degrades gracefully to the
+    independent-comparison width.
+    """
+    _check_level(level)
+    counts_a = np.atleast_2d(np.asarray(counts_a, np.float64))
+    counts_b = np.atleast_2d(np.asarray(counts_b, np.float64))
+    if counts_a.shape != counts_b.shape:
+        msg = (
+            "paired arms need matching (S, B) histogram stacks, got "
+            f"{counts_a.shape} vs {counts_b.shape}"
+        )
+        raise ValueError(msg)
+    n = counts_a.shape[0]
+    if n == 0 or counts_a.sum() == 0 or counts_b.sum() == 0:
+        return _nan_interval(level, "paired-bootstrap-quantile")
+    w = resample_weights(n, n_boot, seed)
+    reps = hist_percentile(w @ counts_b, edges, q) - hist_percentile(
+        w @ counts_a, edges, q,
+    )
+    point = float(
+        hist_percentile(counts_b.sum(axis=0), edges, q)
+        - hist_percentile(counts_a.sum(axis=0), edges, q),
+    )
+    return _percentile_interval(
+        reps, point, level, n, "paired-bootstrap-quantile",
+    )
+
+
+def paired_delta_ratio_ci(
+    num_a: np.ndarray,
+    den_a: np.ndarray,
+    num_b: np.ndarray,
+    den_b: np.ndarray,
+    level: float = 0.95,
+    *,
+    n_boot: int = 1000,
+    seed: int = 0,
+) -> IntervalEstimate:
+    """CI on ``ratio_b - ratio_a`` with scenario-paired resampling."""
+    _check_level(level)
+    num_a = np.asarray(num_a, np.float64)
+    den_a = np.asarray(den_a, np.float64)
+    num_b = np.asarray(num_b, np.float64)
+    den_b = np.asarray(den_b, np.float64)
+    n = num_a.size
+    if not (den_a.size == num_b.size == den_b.size == n):
+        msg = "paired ratio arms need four equal-length scenario arrays"
+        raise ValueError(msg)
+    if n == 0 or den_a.sum() <= 0 or den_b.sum() <= 0:
+        return _nan_interval(level, "paired-bootstrap-ratio")
+    w = resample_weights(n, n_boot, seed)
+    reps = (w @ num_b) / np.maximum(w @ den_b, 1e-300) - (w @ num_a) / (
+        np.maximum(w @ den_a, 1e-300)
+    )
+    point = float(num_b.sum() / den_b.sum() - num_a.sum() / den_a.sum())
+    return _percentile_interval(
+        reps, point, level, n, "paired-bootstrap-ratio",
+    )
+
+
+# ---------------------------------------------------------------------------
+# metric dispatch over SweepResults (shared by compare() and AdaptiveSweep)
+# ---------------------------------------------------------------------------
+
+_QUANTILE_METRICS = {
+    "latency_p50_s": 50.0,
+    "latency_p90_s": 90.0,
+    "latency_p95_s": 95.0,
+    "latency_p99_s": 99.0,
+}
+
+
+def _ratio_components(results, metric: str) -> tuple[np.ndarray, np.ndarray]:
+    """(num, den) per-scenario arrays of a ratio-of-sums metric."""
+    completed = np.asarray(results.completed, np.float64)
+    if metric == "latency_mean_s":
+        return np.asarray(results.latency_sum, np.float64), completed
+    if metric == "goodput_fraction":
+        offered = np.asarray(results.total_generated, np.float64)
+        if results.total_retries is not None:
+            offered = offered + np.asarray(results.total_retries, np.float64)
+        return completed, np.maximum(offered, 1e-300)
+    msg = f"unknown ratio metric {metric!r}"
+    raise ValueError(msg)
+
+
+def interval_for_metric(
+    results,
+    metric: str,
+    level: float = 0.95,
+    *,
+    n_boot: int = 1000,
+    seed: int = 0,
+) -> IntervalEstimate:
+    """Interval estimate of one summary metric from a ``SweepResults``.
+
+    Quantile metrics use the pooled order-statistic CI; ratio-of-sums
+    metrics (mean latency, goodput) bootstrap over scenarios.  Metric names
+    match ``SweepReport.summary()`` keys and
+    :data:`asyncflow_tpu.schemas.experiment.SUPPORTED_METRICS`.
+    """
+    if metric in _QUANTILE_METRICS:
+        return pooled_quantile_ci(
+            results.latency_hist, results.hist_edges,
+            _QUANTILE_METRICS[metric], level,
+        )
+    num, den = _ratio_components(results, metric)
+    return bootstrap_ratio_ci(num, den, level, n_boot=n_boot, seed=seed)
+
+
+def paired_delta_for_metric(
+    results_a,
+    results_b,
+    metric: str,
+    level: float = 0.95,
+    *,
+    n_boot: int = 1000,
+    seed: int = 0,
+) -> IntervalEstimate:
+    """Paired-delta interval (arm B minus arm A) of one summary metric."""
+    if metric in _QUANTILE_METRICS:
+        return paired_delta_quantile_ci(
+            results_a.latency_hist,
+            results_b.latency_hist,
+            results_a.hist_edges,
+            _QUANTILE_METRICS[metric],
+            level,
+            n_boot=n_boot,
+            seed=seed,
+        )
+    num_a, den_a = _ratio_components(results_a, metric)
+    num_b, den_b = _ratio_components(results_b, metric)
+    return paired_delta_ratio_ci(
+        num_a, den_a, num_b, den_b, level, n_boot=n_boot, seed=seed,
+    )

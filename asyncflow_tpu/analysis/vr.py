@@ -1,0 +1,98 @@
+"""Variance-reduction analysis helpers: antithetic pairing + CRN coupling.
+
+The engine-level hooks live where the draws happen
+(:mod:`asyncflow_tpu.engines.jaxsim.sampling` for the JAX engines,
+:func:`asyncflow_tpu.samplers.variates.sample_rv` for the host-side oracle)
+and are gated by :class:`asyncflow_tpu.schemas.experiment.VarianceReduction`
+through ``SweepRunner(..., experiment=...)``.  This module holds the
+host-side estimator seam those hooks feed:
+
+- an antithetic sweep lays out pair member A at scenario row ``i`` and its
+  reflected partner at row ``n/2 + i``; :func:`antithetic_pair_means`
+  collapses any per-scenario metric to the n/2 i.i.d. pair means whose
+  sample variance is the correct CI input (treating the 2n halves as
+  independent would understate the variance of a *positively* correlated
+  pairing and overstate it for the intended negative one);
+- :func:`coupling_diagnostics` quantifies how much coupling (antithetic or
+  CRN) actually bought on a metric — the number to check before trusting a
+  tight paired interval.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from asyncflow_tpu.analysis.estimators import IntervalEstimate
+
+
+def antithetic_halves(values: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(primary, reflected) halves of an antithetic sweep's metric array.
+
+    Row layout contract (``SweepRunner`` with ``antithetic=True``): pair
+    ``i`` is rows ``(i, n/2 + i)``; both halves share scenario keys, the
+    second ran the reflected-draw program.
+    """
+    values = np.asarray(values)
+    n = values.shape[0]
+    if n % 2:
+        msg = f"antithetic sweeps have an even scenario count, got {n}"
+        raise ValueError(msg)
+    return values[: n // 2], values[n // 2 :]
+
+
+def antithetic_pair_means(values: np.ndarray) -> np.ndarray:
+    """(n/2,) i.i.d. pair means of a per-scenario metric array."""
+    a, b = antithetic_halves(values)
+    return (np.asarray(a, np.float64) + np.asarray(b, np.float64)) / 2.0
+
+
+def antithetic_mean_ci(
+    values: np.ndarray,
+    level: float = 0.95,
+) -> IntervalEstimate:
+    """Normal-approximation CI on the mean of an antithetic sweep's metric,
+    computed over pair means (the correct i.i.d. unit)."""
+    # lazy: parallel.sweep imports analysis.estimators for its summary CIs
+    from asyncflow_tpu.parallel.sweep import _mean_ci
+
+    means = antithetic_pair_means(values)
+    means = means[np.isfinite(means)]
+    point, lo, hi = _mean_ci(means, level)
+    return IntervalEstimate(
+        point, lo, hi, level, means.size, "antithetic-pair-mean",
+    )
+
+
+def coupling_diagnostics(a: np.ndarray, b: np.ndarray) -> dict:
+    """How strongly coupled are two metric arrays, and what did it buy?
+
+    Returns ``correlation`` (Pearson, over finite pairs), and
+    ``variance_ratio_vs_independent``: Var(b - a) relative to what
+    independent arms with the same marginals would give (… = 1 - rho for
+    equal variances; < 1 means the coupling tightened the paired delta,
+    > 1 — e.g. a successful antithetic pairing — means it widened the
+    *difference* while tightening the *sum*).
+    """
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    if a.shape != b.shape:
+        msg = f"coupled arms need matching shapes, got {a.shape} vs {b.shape}"
+        raise ValueError(msg)
+    ok = np.isfinite(a) & np.isfinite(b)
+    a, b = a[ok], b[ok]
+    if a.size < 2 or a.std() == 0 or b.std() == 0:
+        return {
+            "n": int(a.size),
+            "correlation": float("nan"),
+            "variance_ratio_vs_independent": float("nan"),
+        }
+    rho = float(np.corrcoef(a, b)[0, 1])
+    var_indep = float(a.var(ddof=1) + b.var(ddof=1))
+    var_paired = float(np.var(b - a, ddof=1))
+    return {
+        "n": int(a.size),
+        "correlation": rho,
+        "variance_ratio_vs_independent": (
+            var_paired / var_indep if var_indep > 0 else float("nan")
+        ),
+    }

@@ -30,6 +30,7 @@ Engine shape (see SURVEY.md §7):
 
 from __future__ import annotations
 
+import contextlib
 from typing import NamedTuple
 
 import jax
@@ -58,6 +59,9 @@ from asyncflow_tpu.engines.jaxsim.sampling import (
     D_POISSON as _D_POISSON,
     D_UNIFORM as _D_UNIFORM,
     TINY as _TINY,
+    antithetic_trace,
+    draw_normal,
+    draw_uniform,
     exponential_from_u,
     hist_constants,
     latency_bin,
@@ -116,7 +120,16 @@ class Engine:
         n_hist_bins: int = 1024,
         pool_size: int | None = None,
         max_requests: int | None = None,
+        crn: bool = False,
     ) -> None:
+        """``crn``: common-random-numbers keying — every draw is keyed by
+        the REQUEST's identity (spawn sequence + per-request event counter)
+        instead of the global iteration counter, so two runs whose event
+        interleavings diverge under different :class:`ScenarioOverrides`
+        still hand request r's k-th event the same substream (the coupling
+        :func:`asyncflow_tpu.analysis.compare` relies on).  Off by default:
+        streams stay bit-identical to pre-CRN builds.
+        """
         if collect_traces and not collect_clocks:
             msg = "collect_traces requires collect_clocks (traces index rows)"
             raise ValueError(msg)
@@ -177,6 +190,7 @@ class Engine:
             msg = "retry policy with multiple generators is unsupported"
             raise ValueError(msg)
         self._n_gen = plan.n_generators
+        self._crn = crn
         self._compiled: dict = {}
 
     # hop codes (decoded by run_single against the payload's ids)
@@ -256,14 +270,14 @@ class Engine:
         dist = self.params.edge_dist[edge]
         mean = ov.edge_mean[edge]
         var = ov.edge_var[edge]
-        u = jax.random.uniform(jax.random.fold_in(key, 1))
+        u = draw_uniform(jax.random.fold_in(key, 1))
         delay = jnp.float32(0.0)
         if _D_UNIFORM in self._dists_present:
             delay = jnp.where(dist == _D_UNIFORM, u, delay)
         if _D_EXPONENTIAL in self._dists_present:
             delay = jnp.where(dist == _D_EXPONENTIAL, exponential_from_u(mean, u), delay)
         if {_D_NORMAL, _D_LOGNORMAL} & set(self._dists_present):
-            z = jax.random.normal(jax.random.fold_in(key, 2))
+            z = draw_normal(jax.random.fold_in(key, 2))
             if _D_NORMAL in self._dists_present:
                 delay = jnp.where(dist == _D_NORMAL, truncated_normal(mean, var, z), delay)
             if _D_LOGNORMAL in self._dists_present:
@@ -283,7 +297,7 @@ class Engine:
         latency draw and boosts the dropout probability (partition windows
         boost it to 1), mirroring the oracle's ``_EdgeRuntime.transport``.
         """
-        u = jax.random.uniform(jax.random.fold_in(key, 0))
+        u = draw_uniform(jax.random.fold_in(key, 0))
         drop_p = ov.edge_dropout[edge]
         delay = self._sample_delay(edge, key, ov)
         if self._has_edge_faults:
@@ -373,7 +387,7 @@ class Engine:
             * jnp.float32(plan.retry_backoff_mult) ** expo,
         )
         if plan.retry_jitter > 0:
-            u = jax.random.uniform(jax.random.fold_in(key, 57))
+            u = draw_uniform(jax.random.fold_in(key, 57))
             delay = delay * (
                 1.0 + jnp.float32(plan.retry_jitter) * (2.0 * u - 1.0)
             )
@@ -622,13 +636,13 @@ class Engine:
                     jnp.maximum(u_mean, _TINY),
                 ).astype(jnp.float32)
             else:
-                z = jax.random.normal(jax.random.fold_in(kd, 1))
+                z = draw_normal(jax.random.fold_in(kd, 1))
                 users = jnp.maximum(0.0, u_mean + u_var * z)
             window_end = jnp.where(need_window, smp_now + window, window_end)
             lam = jnp.where(need_window, users * u_rate, lam)
 
             no_users = lam <= 0.0
-            u = jnp.maximum(jax.random.uniform(jax.random.fold_in(kd, 2)), _TINY)
+            u = jnp.maximum(draw_uniform(jax.random.fold_in(kd, 2)), _TINY)
             g = -jnp.log(1.0 - u) / jnp.maximum(lam, _TINY)
             beyond = smp_now + g > horizon
             crosses = smp_now + g >= window_end
@@ -717,7 +731,7 @@ class Engine:
         total = jnp.sum(w)
         w = jnp.where(total > 0, w, elig.astype(jnp.float32))
         cum = jnp.cumsum(w)
-        u = jax.random.uniform(key) * cum[-1]
+        u = draw_uniform(key) * cum[-1]
         idx = jnp.sum((cum <= u).astype(jnp.int32))
         # float rounding can put u exactly at cum[-1] (idx == el); clamp to
         # the LAST ELIGIBLE slot, never a removed/ineligible position
@@ -883,6 +897,13 @@ class Engine:
             req_ticket=st.req_ticket.at[idx].set(NO_TICKET, mode="drop"),
             n_overflow=st.n_overflow + jnp.where(overflow, 1, 0),
         )
+        if self._crn:
+            # the slot's request identity: the arrival counter at spawn
+            # (already incremented for this iteration, so values are >= 1)
+            st = st._replace(
+                req_seq=st.req_seq.at[idx].set(st.arr_ctr, mode="drop"),
+                req_draws=st.req_draws.at[idx].set(0, mode="drop"),
+            )
         if self._has_retry:
             st = st._replace(
                 req_deadline=st.req_deadline.at[idx].set(
@@ -941,7 +962,7 @@ class Engine:
             # hit/miss mixture: hit latency (seg_dur) with probability
             # seg_hit_prob, else the backing store's miss latency
             is_cache = pred & (kind == SEG_CACHE)
-            u_cache = jax.random.uniform(jax.random.fold_in(key, 24))
+            u_cache = draw_uniform(jax.random.fold_in(key, 24))
             dur = jnp.where(
                 is_cache & (u_cache >= p.seg_hit_prob[s, ep, seg]),
                 p.seg_miss_dur[s, ep, seg],
@@ -1532,7 +1553,7 @@ class Engine:
             )
 
         st = self._hop(st, i, self.HOP_SERVER + s, now, pred)
-        u = jax.random.uniform(jax.random.fold_in(key, 16))
+        u = draw_uniform(jax.random.fold_in(key, 16))
         # weighted endpoint pick (uniform weights lower to the evenly
         # spaced cumulative table, preserving the reference's behavior)
         ep = jnp.minimum(
@@ -1840,6 +1861,9 @@ class Engine:
             n_rejected=jnp.int32(0),
             n_dropped=jnp.int32(0),
             n_overflow=jnp.int32(0),
+            req_seq=jnp.zeros(pool if self._crn else 1, jnp.int32),
+            req_draws=jnp.zeros(pool if self._crn else 1, jnp.int32),
+            arr_ctr=jnp.int32(0),
         )
         # first arrival (gap from t=0), per generator stream
         if self._n_gen > 1:
@@ -1899,8 +1923,29 @@ class Engine:
         is_pool = in_horizon & ~is_tl & (t_pool <= now)
         is_arr = in_horizon & ~is_tl & ~is_pool
 
-        kit = jax.random.fold_in(st.key, st.it)
-        st = st._replace(it=st.it + 1)
+        if self._crn:
+            # CRN keying: pool events draw from (request spawn sequence,
+            # per-request event counter); spawns draw from the arrival
+            # sequence.  Domain separation: the arrival family folds 0,
+            # pool families fold req_seq + 1 >= 1 (spawned slots >= 2).
+            base = jax.random.fold_in(st.key, 0x2E4C_11B7)
+            i0 = st.nxt_i
+            kit_pool = jax.random.fold_in(
+                jax.random.fold_in(base, st.req_seq[i0] + 1),
+                st.req_draws[i0],
+            )
+            kit_arr = jax.random.fold_in(
+                jax.random.fold_in(base, 0), st.arr_ctr,
+            )
+            kit = jnp.where(is_arr, kit_arr, kit_pool)
+            st = st._replace(
+                it=st.it + 1,
+                arr_ctr=st.arr_ctr + jnp.where(is_arr, 1, 0),
+                req_draws=st.req_draws.at[i0].add(jnp.where(is_pool, 1, 0)),
+            )
+        else:
+            kit = jax.random.fold_in(st.key, st.it)
+            st = st._replace(it=st.it + 1)
 
         st = self._timeline_branch(st, is_tl)
         st = self._spawn_branch(st, now, kit, ov, is_arr)
@@ -2045,11 +2090,16 @@ class Engine:
         self,
         keys: jnp.ndarray,
         overrides: ScenarioOverrides | None = None,
+        *,
+        antithetic: bool = False,
     ) -> EngineState:
         """Run |keys| scenarios in one vmapped kernel.
 
         ``overrides`` fields may carry a leading scenario axis or be base
-        values shared by every scenario.
+        values shared by every scenario.  ``antithetic`` traces/runs the
+        reflected-draw program variant (u -> 1-u, z -> -z); pairing it with
+        an un-reflected batch under the SAME keys yields antithetic couples
+        (docs/guides/mc-inference.md).
         """
         _base_ov = base_overrides(self.plan)
         ov = (
@@ -2061,20 +2111,34 @@ class Engine:
             *[0 if o.ndim > b.ndim else None
               for o, b in zip(ov, _base_ov)],
         )
-        sig = tuple(axes)
-        if sig not in self._compiled:
-            self._compiled[sig] = instrument_jit(
-                jax.jit(jax.vmap(self._run_one, in_axes=(0, axes))),
-                engine="event",
-                variant="vmap",
-                pool=self.plan.pool_size,
-            )
-        return self._compiled[sig](keys, ov)
+        sig = (tuple(axes), antithetic)
+        # hold the trace flag across the CALL, not just the first trace:
+        # a shape-driven retrace inside a cached jit must re-see it
+        with antithetic_trace() if antithetic else contextlib.nullcontext():
+            if sig not in self._compiled:
+                self._compiled[sig] = instrument_jit(
+                    jax.jit(jax.vmap(self._run_one, in_axes=(0, axes))),
+                    engine="event",
+                    variant="vmap",
+                    pool=self.plan.pool_size,
+                )
+            return self._compiled[sig](keys, ov)
 
 
 def scenario_keys(seed: int, n: int) -> jnp.ndarray:
-    """Independent per-scenario PRNG keys."""
-    return jax.random.split(jax.random.PRNGKey(seed), n)
+    """Independent per-scenario PRNG keys, prefix-stable in ``n``.
+
+    Scenario ``i``'s key is ``fold_in(PRNGKey(seed), i)`` — a pure function
+    of ``(seed, i)``, so any block ``[a, b)`` of the global deterministic
+    grid derives the same keys no matter how the sweep is chunked or
+    range-split across runs and hosts.  (``jax.random.split`` is NOT
+    prefix-stable in ``n``: the earlier split-based grid silently gave
+    ``run(k)`` different streams than the first ``k`` scenarios of
+    ``run(n)`` — the substream contract CRN pairing and multi-range sweeps
+    depend on; tests/parity/test_sweep_determinism.py pins it.)
+    """
+    base = jax.random.PRNGKey(seed)
+    return jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.arange(n))
 
 
 def engine_truncated(engine: Engine, state) -> np.ndarray:

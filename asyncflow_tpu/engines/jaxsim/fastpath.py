@@ -74,6 +74,7 @@ engine, so metric output is identical in shape and semantics.
 
 from __future__ import annotations
 
+import contextlib
 from typing import NamedTuple
 
 import jax
@@ -101,12 +102,15 @@ from asyncflow_tpu.engines.jaxsim.rotation import (
 from asyncflow_tpu.engines.jaxsim.sortutil import searchsorted_small, time_rank
 from asyncflow_tpu.observability.telemetry import instrument_jit
 from asyncflow_tpu.engines.jaxsim.sampling import (
+    antithetic_trace,
     as_threefry as _as_threefry,
     D_EXPONENTIAL as _D_EXPONENTIAL,
     D_LOGNORMAL as _D_LOGNORMAL,
     D_NORMAL as _D_NORMAL,
     D_UNIFORM as _D_UNIFORM,
     TINY as _TINY,
+    draw_normal,
+    draw_uniform,
     exponential_from_u,
     hist_constants,
     latency_bin,
@@ -562,10 +566,10 @@ class FastEngine:
         """
         dist_id = int(self.plan.edge_dist[edge])
         if u is None:
-            u = jax.random.uniform(jax.random.fold_in(key, 0), t_send.shape)
+            u = draw_uniform(jax.random.fold_in(key, 0), t_send.shape)
         dropped, u_lat = self._fused_drop_rescale(u, ov.edge_dropout[edge])
         z = (
-            jax.random.normal(jax.random.fold_in(key, 2), t_send.shape)
+            draw_normal(jax.random.fold_in(key, 2), t_send.shape)
             if dist_id in (_D_NORMAL, _D_LOGNORMAL)
             else 0.0
         )
@@ -583,14 +587,14 @@ class FastEngine:
         plan = self.plan
         mean = ov.edge_mean[eidx_arr]
         var = ov.edge_var[eidx_arr]
-        u = jax.random.uniform(jax.random.fold_in(key, 0), t_send.shape)
+        u = draw_uniform(jax.random.fold_in(key, 0), t_send.shape)
         dropped, u_lat = self._fused_drop_rescale(u, ov.edge_dropout[eidx_arr])
         lb_dists = sorted(
             {int(plan.edge_dist[e]) for e in plan.lb_edge_index.tolist()},
         )
         if len(lb_dists) == 1:
             z = (
-                jax.random.normal(jax.random.fold_in(key, 2), t_send.shape)
+                draw_normal(jax.random.fold_in(key, 2), t_send.shape)
                 if lb_dists[0] in (_D_NORMAL, _D_LOGNORMAL)
                 else 0.0
             )
@@ -598,7 +602,7 @@ class FastEngine:
         else:
             dist = jnp.asarray(plan.edge_dist)[eidx_arr]
             z = (
-                jax.random.normal(jax.random.fold_in(key, 2), t_send.shape)
+                draw_normal(jax.random.fold_in(key, 2), t_send.shape)
                 if {_D_NORMAL, _D_LOGNORMAL} & set(lb_dists)
                 else 0.0
             )
@@ -669,7 +673,7 @@ class FastEngine:
                 (nw,),
             ).astype(jnp.float32)
         else:
-            z = jax.random.normal(jax.random.fold_in(key, 1), (nw,))
+            z = draw_normal(jax.random.fold_in(key, 1), (nw,))
             users = jnp.maximum(0.0, user_mean + user_var * z)
         lam = users * req_rate
 
@@ -692,14 +696,14 @@ class FastEngine:
         # gathers replace the 88k-key sort: S_i within window w is
         # cum[i] - cum[start_w - 1], and the denominator adds one extra gap
         # per window.  Distributionally identical to sorting iid uniforms.
-        gaps = -jnp.log1p(-jax.random.uniform(jax.random.fold_in(key, 3), (n,)))
+        gaps = -jnp.log1p(-draw_uniform(jax.random.fold_in(key, 3), (n,)))
         cum = jnp.cumsum(gaps)
         prefix = jnp.concatenate([jnp.zeros(1, cum.dtype), cum])  # (n+1,)
         begin = jnp.concatenate([jnp.zeros(1, jnp.int32), offsets[:-1]])
         base = prefix[jnp.clip(begin, 0, n)]  # (nw,) cum before each window
         wsum = prefix[jnp.clip(offsets, 0, n)] - base
         extra = -jnp.log1p(
-            -jax.random.uniform(jax.random.fold_in(key, 4), (nw,)),
+            -draw_uniform(jax.random.fold_in(key, 4), (nw,)),
         )
         denom = jnp.maximum(wsum + extra, _TINY)
         u = jnp.clip((cum - base[win]) / denom[win], 0.0, 1.0)
@@ -1027,12 +1031,12 @@ class FastEngine:
         u_ep_shared = (
             None
             if chained
-            else jax.random.uniform(jax.random.fold_in(key, 6), (n,))
+            else draw_uniform(jax.random.fold_in(key, 6), (n,))
         )
         u_exit_shared = (
             None
             if chained
-            else jax.random.uniform(jax.random.fold_in(key, 7), (n,))
+            else draw_uniform(jax.random.fold_in(key, 7), (n,))
         )
         for s in plan.server_topo_order:
             mine = alive & (srv == s) & (t < plan.horizon)
@@ -1063,7 +1067,7 @@ class FastEngine:
             u = (
                 u_ep_shared
                 if u_ep_shared is not None
-                else jax.random.uniform(jax.random.fold_in(key, 64 + s), (n,))
+                else draw_uniform(jax.random.fold_in(key, 64 + s), (n,))
             )
             ep = jnp.minimum(
                 searchsorted_small(endpoint_cum_t[s], u, "right"),
@@ -1085,7 +1089,7 @@ class FastEngine:
             cache_extra_r = None
             cache_slot_r = None
             if server_has_cache:
-                u_c = jax.random.uniform(
+                u_c = draw_uniform(
                     jax.random.fold_in(key, 160 + s), (n, cmax),
                 )
                 cache_slot_r = jnp.asarray(plan.fp_cache_slot)[s, ep]  # (n, cmax)
@@ -1499,8 +1503,17 @@ class FastEngine:
         self,
         keys: jnp.ndarray,
         overrides: ScenarioOverrides | None = None,
+        *,
+        antithetic: bool = False,
     ) -> FastState:
-        """Run |keys| scenarios as one vmapped kernel."""
+        """Run |keys| scenarios as one vmapped kernel.
+
+        ``antithetic``: trace/run the reflected-draw program variant (every
+        uniform u -> 1-u, every normal z -> -z); pairing a batch with the
+        SAME keys run un-reflected gives antithetic couples for variance
+        reduction (docs/guides/mc-inference.md).  Off by default —
+        bit-identical streams to builds without the hook.
+        """
         _base_ov = base_overrides(self.plan)
         ov = (
             fill_overrides(overrides, _base_ov)
@@ -1513,15 +1526,18 @@ class FastEngine:
                 for o, b in zip(ov, _base_ov)
             ],
         )
-        sig = tuple(axes)
-        if sig not in self._compiled:
-            self._compiled[sig] = instrument_jit(
-                jax.jit(jax.vmap(self._run_one, in_axes=(0, axes))),
-                engine="fast",
-                variant="vmap",
-                n=self.n,
-            )
-        return self._compiled[sig](keys, ov)
+        sig = (tuple(axes), antithetic)
+        # hold the trace flag across the CALL, not just the first trace:
+        # a shape-driven retrace inside a cached jit must re-see it
+        with antithetic_trace() if antithetic else contextlib.nullcontext():
+            if sig not in self._compiled:
+                self._compiled[sig] = instrument_jit(
+                    jax.jit(jax.vmap(self._run_one, in_axes=(0, axes))),
+                    engine="fast",
+                    variant="vmap",
+                    n=self.n,
+                )
+            return self._compiled[sig](keys, ov)
 
     def scanned_fn(self):
         """The scanned sweep program: ``lax.scan`` over (blocks, inner, ...)
@@ -1600,6 +1616,7 @@ class FastEngine:
         *,
         inner: int = 16,
         total: int | None = None,
+        antithetic: bool = False,
     ) -> FastState:
         """Run |keys| scenarios as a ``lax.scan`` over blocks of ``inner``
         vmapped scenarios inside ONE compiled program.
@@ -1620,17 +1637,18 @@ class FastEngine:
             keys, overrides, inner=inner, total=total,
         )
         blocks = t // inner
-        sig = ("scan", inner, blocks)
-        if sig not in self._compiled:
-            self._compiled[sig] = instrument_jit(
-                jax.jit(self.scanned_fn()),
-                engine="fast",
-                variant="scan",
-                inner=inner,
-                blocks=blocks,
-                n=self.n,
-            )
-        out = self._compiled[sig](keys_b, ov_b)
+        sig = ("scan", inner, blocks, antithetic)
+        with antithetic_trace() if antithetic else contextlib.nullcontext():
+            if sig not in self._compiled:
+                self._compiled[sig] = instrument_jit(
+                    jax.jit(self.scanned_fn()),
+                    engine="fast",
+                    variant="scan",
+                    inner=inner,
+                    blocks=blocks,
+                    n=self.n,
+                )
+            out = self._compiled[sig](keys_b, ov_b)
         return jax.tree_util.tree_map(
             lambda a: a.reshape((t, *a.shape[2:]))[:s], out,
         )

@@ -216,6 +216,16 @@ class EngineState(NamedTuple):
     n_dropped: jnp.ndarray
     n_overflow: jnp.ndarray
     n_rejected: jnp.ndarray  # requests shed by overload policies
+    # CRN (common-random-numbers) keying state — size (1,) placeholders
+    # unless the engine was built with ``crn=True``.  ``req_seq`` is the
+    # slot's spawn sequence number (the arrival counter at spawn),
+    # ``req_draws`` its per-request event-draw counter, ``arr_ctr`` the
+    # scenario's arrival counter; together they re-key every draw by
+    # REQUEST identity instead of global iteration so paired A/B sweeps
+    # share substreams (docs/guides/mc-inference.md).
+    req_seq: jnp.ndarray  # (P,) i32 (or (1,))
+    req_draws: jnp.ndarray  # (P,) i32 (or (1,))
+    arr_ctr: jnp.ndarray  # scalar i32
 
 
 class ScenarioOverrides(NamedTuple):

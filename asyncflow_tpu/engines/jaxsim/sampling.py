@@ -8,6 +8,8 @@ scale argument, see ``/root/reference/src/asyncflow/samplers/common_helpers.py``
 
 from __future__ import annotations
 
+import contextlib
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -55,6 +57,67 @@ def sample_bucket(t, period: float, n_samples: int):
     """Sample-tick bucket: a delta at ``t`` affects samples at ticks >= t."""
     b = jnp.ceil(t / period).astype(jnp.int32)
     return jnp.clip(b, 0, n_samples + 1)
+
+
+# ---------------------------------------------------------------------------
+# Variance-reduction hooks (docs/guides/mc-inference.md).
+#
+# Antithetic sampling is a TRACE-TIME program variant: inside
+# :func:`antithetic_trace`, every uniform the engines draw through
+# :func:`draw_uniform` is reflected (u -> 1-u) and every standard normal
+# through :func:`draw_normal` is negated (z -> -z).  Poisson/counting draws
+# are left untouched — an antithetic pair run under the SAME scenario key
+# shares its arrival counts exactly and reflects the continuous draws, which
+# is a valid (conditional) antithetic coupling for every latency metric.
+#
+# Outside the context the helpers are literally ``jax.random.uniform`` /
+# ``jax.random.normal``: streams are bit-identical to a build without the
+# hook.  Callers that compile under the flag must (a) key their jit cache on
+# :func:`antithetic_active` and (b) hold the context across the *call*, not
+# just the first trace, so shape-driven retraces can never silently lose the
+# reflection (see ``FastEngine.run_batch`` / ``Engine.run_batch``).
+# ---------------------------------------------------------------------------
+
+_ANTITHETIC = False
+
+
+def antithetic_active() -> bool:
+    """Is the current trace an antithetic (reflected-draw) program?"""
+    return _ANTITHETIC
+
+
+@contextlib.contextmanager
+def antithetic_trace():
+    """Trace engine programs with reflected uniform/normal draws."""
+    global _ANTITHETIC
+    prev = _ANTITHETIC
+    _ANTITHETIC = True
+    try:
+        yield
+    finally:
+        _ANTITHETIC = prev
+
+
+def draw_uniform(key, shape=(), **kw):
+    """``jax.random.uniform`` that reflects (u -> 1-u) in antithetic traces.
+
+    The reflection preserves U(0,1) exactly (including the half-open
+    endpoint convention up to float rounding), so every inverse-CDF
+    transform downstream keeps its law while becoming monotonically
+    anti-correlated with its partner draw.
+    """
+    import jax
+
+    u = jax.random.uniform(key, shape, **kw)
+    return (1.0 - u) if _ANTITHETIC else u
+
+
+def draw_normal(key, shape=(), **kw):
+    """``jax.random.normal`` that negates (z -> -z) in antithetic traces."""
+    import jax
+
+    z = jax.random.normal(key, shape, **kw)
+    return (-z) if _ANTITHETIC else z
 
 
 def as_threefry(key):

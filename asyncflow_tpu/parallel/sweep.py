@@ -29,6 +29,7 @@ from asyncflow_tpu.observability.telemetry import (
     telemetry_session,
 )
 from asyncflow_tpu.parallel.mesh import scenario_mesh, scenario_sharding
+from asyncflow_tpu.schemas.experiment import ExperimentConfig
 from asyncflow_tpu.schemas.payload import SimulationPayload
 
 
@@ -232,6 +233,11 @@ class SweepReport:
     #: sweep ran at its configured chunk size throughout); each entry is
     #: {"scenario_start", "from", "to"} — also recorded in telemetry meta
     downshifts: list[dict] | None = None
+    #: antithetic pairing layout (SweepRunner with VarianceReduction
+    #: antithetic=True): pair i is rows (i, n/2 + i) — feed per-scenario
+    #: metrics through :func:`asyncflow_tpu.analysis.antithetic_pair_means`
+    #: before any mean CI
+    antithetic: bool = False
 
     def mean_gauge(self, metric: str, component_id: str) -> np.ndarray:
         """(S,) per-scenario time-average of one gauge (fast path sweeps).
@@ -284,23 +290,56 @@ class SweepReport:
         pooled = dataclasses.replace(self.results, latency_hist=pooled_hist)
         return float(pooled.percentile(q)[0])
 
+    def per_scenario_percentile_mean_ci(
+        self,
+        q: float,
+        level: float = 0.95,
+    ) -> tuple[float, float, float]:
+        """(point, lo, hi): the across-scenario MEAN of the per-scenario
+        latency percentile ``q`` with a ``level`` confidence interval.
+
+        The sweep's scenarios are i.i.d. replications, so the CI is the
+        classic normal-approximation interval on the mean of the
+        per-scenario percentile estimates.  NOTE this is a CI on "the
+        average scenario's p``q``", NOT on the pooled tail quantile of the
+        request population — for "the system's p99 with an interval" use
+        :meth:`pooled_percentile_ci` (the former ``percentile_ci`` name
+        invited exactly that misreading; docs/guides/mc-inference.md).
+        """
+        per = self.results.percentile(q)
+        return _mean_ci(per[np.isfinite(per)], level)
+
     def percentile_ci(
         self,
         q: float,
         level: float = 0.95,
     ) -> tuple[float, float, float]:
-        """(point, lo, hi): the across-scenario mean of the per-scenario
-        latency percentile ``q`` with a ``level`` confidence interval.
+        """Deprecated alias of :meth:`per_scenario_percentile_mean_ci`."""
+        import warnings
 
-        The sweep's scenarios are i.i.d. replications, so the CI is the
-        classic normal-approximation interval on the mean of the
-        per-scenario percentile estimates — the "confidence intervals"
-        deliverable of the reference's Monte-Carlo roadmap milestone
-        (`/root/reference/ROADMAP.md` §3), computed from per-scenario
-        histograms at any sweep size.
+        warnings.warn(
+            "SweepReport.percentile_ci is a CI on the MEAN of per-scenario "
+            "percentiles, not on the pooled quantile; it was renamed "
+            "per_scenario_percentile_mean_ci.  For an interval on the "
+            "pooled p-quantile use pooled_percentile_ci.",
+            DeprecationWarning,
+            stacklevel=2,
+        )
+        return self.per_scenario_percentile_mean_ci(q, level)
+
+    def pooled_percentile_ci(self, q: float, level: float = 0.95):
+        """Order-statistic (binomial) CI on the POOLED latency quantile.
+
+        Returns an :class:`asyncflow_tpu.analysis.IntervalEstimate` on the
+        percentile ``q`` of the pooled request population across all
+        scenarios — the statistically meaningful "system p95/p99 +/-"
+        interval (docs/guides/mc-inference.md).
         """
-        per = self.results.percentile(q)
-        return _mean_ci(per[np.isfinite(per)], level)
+        from asyncflow_tpu.analysis.estimators import pooled_quantile_ci
+
+        return pooled_quantile_ci(
+            self.results.latency_hist, self.results.hist_edges, q, level,
+        )
 
     def metric_ci(
         self,
@@ -391,7 +430,28 @@ class SweepReport:
             "latency_p50_s": self.aggregate_percentile(50),
             "latency_p95_s": self.aggregate_percentile(95),
             "latency_p99_s": self.aggregate_percentile(99),
+            # pooled order-statistic CIs (asyncflow_tpu.analysis): intervals
+            # on the POOLED tail quantiles the point fields above report —
+            # [lo, hi] at ci_level, NaN-pairs on empty sweeps
+            **self._percentile_ci_fields(),
         }
+
+    #: confidence level of the summary()'s interval fields
+    CI_LEVEL = 0.95
+
+    def _percentile_ci_fields(self) -> dict:
+        from asyncflow_tpu.analysis.estimators import pooled_quantile_ci
+
+        fields: dict = {"ci_level": self.CI_LEVEL}
+        for q in (50, 95, 99):
+            est = pooled_quantile_ci(
+                self.results.latency_hist,
+                self.results.hist_edges,
+                float(q),
+                self.CI_LEVEL,
+            )
+            fields[f"latency_p{q}_ci_s"] = [est.lo, est.hi]
+        return fields
 
 
 class SweepRunner:
@@ -408,6 +468,7 @@ class SweepRunner:
         scan_inner: int | None = None,
         gauge_series: tuple | None = None,
         telemetry: TelemetryConfig | None = None,
+        experiment: ExperimentConfig | None = None,
     ) -> None:
         """``engine``: "auto" picks the scan fast path when the plan is
         eligible (orders of magnitude faster), then the Pallas event kernel
@@ -437,7 +498,27 @@ class SweepRunner:
         mesh the scanned path is unavailable (its block reshape conflicts
         with the scenario-axis sharding); an explicit ``scan_inner`` is then
         ignored with a warning and per-device chunk sizes should stay at a
-        compile-safe scale."""
+        compile-safe scale.
+
+        ``experiment``: Monte-Carlo design
+        (:class:`asyncflow_tpu.schemas.experiment.ExperimentConfig`);
+        docs/guides/mc-inference.md.  Its variance-reduction switches
+        reshape :meth:`run`:
+
+        - ``antithetic``: scenarios run as reflected pairs — rows
+          ``(i, n/2 + i)`` share a PRNG key, the second half runs the
+          reflected-draw program (u -> 1-u, z -> -z).  ``n_scenarios`` must
+          be even, and per-scenario overrides carry one row per PAIR (n/2
+          rows; both pair members run the same scenario config).
+        - ``crn``: common-random-numbers keying on the event engine (draws
+          keyed by request identity, so paired A/B sweeps share per-request
+          substreams); the fast path already keys every draw by request
+          lane and needs no mode switch.
+
+        Both default off, and off is bit-identical to builds without the
+        hooks.  Neither is available on the ``pallas``/``native`` engines
+        (their draw paths don't route through the hook seam) — forcing the
+        combination is an explicit error."""
         if engine not in ("auto", "fast", "event", "pallas", "native"):
             msg = (
                 f"engine must be 'auto', 'fast', 'event', 'pallas' or "
@@ -448,6 +529,19 @@ class SweepRunner:
         #: run-record config for every :meth:`run` (overridable per run);
         #: docs/guides/observability.md
         self.telemetry = telemetry
+        #: Monte-Carlo design (variance reduction + precision targets)
+        self.experiment = experiment
+        vr = experiment.variance_reduction if experiment is not None else None
+        self._crn = bool(vr.crn) if vr is not None else False
+        self._antithetic = bool(vr.antithetic) if vr is not None else False
+        vr_coupled = self._crn or self._antithetic
+        if vr_coupled and engine in ("pallas", "native"):
+            msg = (
+                f"engine={engine!r} does not support variance-reduction "
+                "coupling (CRN / antithetic draws route through the "
+                "jaxsim sampling hooks); use engine='fast' or 'event'"
+            )
+            raise ValueError(msg)
         import time as _time
 
         t0 = _time.perf_counter()
@@ -529,6 +623,9 @@ class SweepRunner:
             engine == "auto"
             and jax.default_backend() == "tpu"
             and not resilient
+            # VR coupling (CRN / antithetic) needs the jaxsim hook seam:
+            # auto routes coupled sweeps to the XLA event engine instead
+            and not vr_coupled
             # the VMEM kernel models the round-5 event-engine feature set
             # (overload policies, circuit breakers, DB pools, cache
             # mixtures, LLM dynamics, weighted endpoints, multi-generator
@@ -550,6 +647,7 @@ class SweepRunner:
                 collect_gauges=False,
                 collect_clocks=False,
                 n_hist_bins=n_hist_bins,
+                crn=self._crn,
             )
             self.engine_kind = "event"
         if self._gauge_sel is not None and self.engine_kind != "fast":
@@ -595,6 +693,10 @@ class SweepRunner:
         # chunks computed under different capacities must never be merged
         digest.update(str(self.plan.pool_size).encode())
         digest.update(str(self.plan.max_requests).encode())
+        # CRN re-keys every event-engine draw: coupled and uncoupled chunks
+        # are different result streams and must never be merged
+        if self._crn:
+            digest.update(b"crn")
         # the streaming-series spec changes the per-chunk npz contents
         if self._gauge_sel is not None:
             digest.update(b"gauge-series")
@@ -658,27 +760,48 @@ class SweepRunner:
             telemetry if telemetry is not None else self.telemetry,
             kind="sweep",
         )
-        if tel is None:
-            return self._run_impl(
-                n_scenarios,
-                seed=seed,
-                overrides=overrides,
-                chunk_size=chunk_size,
-                checkpoint_dir=checkpoint_dir,
-                first_scenario=first_scenario,
-                tel=None,
+
+        def _go(tel) -> SweepReport:
+            kw = {
+                "seed": seed,
+                "overrides": overrides,
+                "chunk_size": chunk_size,
+                "checkpoint_dir": checkpoint_dir,
+                "first_scenario": first_scenario,
+                "tel": tel,
+            }
+            if not self._antithetic:
+                return self._run_impl(n_scenarios, **kw)
+            # antithetic split-run: rows [0, n/2) are the primary half,
+            # rows [n/2, n) rerun the SAME keys (and the same per-pair
+            # override rows) through the reflected-draw program
+            if n_scenarios % 2:
+                msg = (
+                    "antithetic sweeps pair scenarios: n_scenarios must be "
+                    f"even, got {n_scenarios}"
+                )
+                raise ValueError(msg)
+            half = n_scenarios // 2
+            rep_a = self._run_impl(half, **kw)
+            rep_b = self._run_impl(half, **kw, antithetic=True)
+            return SweepReport(
+                results=_concat_sweeps([rep_a.results, rep_b.results]),
+                n_scenarios=n_scenarios,
+                wall_seconds=rep_a.wall_seconds + rep_b.wall_seconds,
+                plan=self.plan,
+                gauge_series_ids=self._gauge_series_ids,
+                downshifts=(
+                    (rep_a.downshifts or []) + (rep_b.downshifts or [])
+                )
+                or None,
+                antithetic=True,
             )
+
+        if tel is None:
+            return _go(None)
         with tel:
             tel.timer.record("build_plan", self._build_plan_s)
-            report = self._run_impl(
-                n_scenarios,
-                seed=seed,
-                overrides=overrides,
-                chunk_size=chunk_size,
-                checkpoint_dir=checkpoint_dir,
-                first_scenario=first_scenario,
-                tel=tel,
-            )
+            report = _go(tel)
         tel.add_meta(
             engine=self.engine_kind,
             backend=(
@@ -695,6 +818,10 @@ class SweepRunner:
             wall_seconds=round(report.wall_seconds, 6),
             scenarios_per_second=round(report.scenarios_per_second, 3),
             chunk_downshifts=report.downshifts or [],
+            variance_reduction={
+                "antithetic": self._antithetic,
+                "crn": self._crn,
+            },
         )
         tel.finalize(counters=report.results.counters())
         return report
@@ -709,6 +836,7 @@ class SweepRunner:
         checkpoint_dir: str | None,
         first_scenario: int,
         tel,
+        antithetic: bool = False,
     ) -> SweepReport:
         import time
 
@@ -729,7 +857,8 @@ class SweepRunner:
                 seed,
                 n_scenarios,
                 chunk,
-                identity=self._checkpoint_identity(overrides),
+                identity=self._checkpoint_identity(overrides)
+                + ("-anti" if antithetic else ""),
                 settings=self.payload.sim_settings,
                 first_scenario=first_scenario,
             )
@@ -738,11 +867,12 @@ class SweepRunner:
         )
 
         t0 = time.time()
-        # one key-grid derivation for the whole run (jax.random.split is
-        # prefix-stable in n, so slicing the full grid per chunk is
-        # bit-identical to deriving each chunk's prefix separately); n_dev-1
-        # extra rows cover the tail chunk's round-up to a device multiple
-        # (the native engine derives its own host-side per-scenario seeds)
+        # one key-grid derivation for the whole run (scenario_keys is
+        # prefix-stable in n — key i is a pure function of (seed, i) — so
+        # slicing the full grid per chunk is bit-identical to deriving each
+        # chunk's block separately); n_dev-1 extra rows cover the tail
+        # chunk's round-up to a device multiple (the native engine derives
+        # its own host-side per-scenario seeds)
         all_keys = (
             None
             if self.engine_kind == "native"
@@ -798,9 +928,13 @@ class SweepRunner:
             with _ph(tel, "execute", chunk=chunk_idx, meta={"take": take}):
                 if self.engine_kind == "fast" and getattr(self, "_scan_inner", 0):
                     return self.engine.run_batch_scanned(
-                        keys, ov, inner=self._scan_inner, total=chunk,
+                        keys,
+                        ov,
+                        inner=self._scan_inner,
+                        total=chunk,
+                        antithetic=antithetic,
                     )
-                return self.engine.run_batch(keys, ov)
+                return self.engine.run_batch(keys, ov, antithetic=antithetic)
 
         def _run_range_sync(
             done_local: int, take: int, size: int, chunk_idx: int,

@@ -5,30 +5,83 @@ Behavioral contract mirrors the reference dispatch
 uniform is U(0,1) ignoring the mean; poisson returns integers; normal is
 truncated at zero; log-normal passes (mean, variance) straight through as the
 underlying normal's parameters.
+
+Variance-reduction hook (docs/guides/mc-inference.md): the host-side mirror
+of the JAX engines'
+:func:`asyncflow_tpu.engines.jaxsim.sampling.antithetic_trace`.  numpy's
+native continuous draws (ziggurat) cannot be reflected, so an antithetic
+pair on the host runs BOTH members through an explicit inverse-CDF path in
+lockstep: the primary with ``antithetic=False`` (one uniform u per draw),
+the reflected partner with ``antithetic=True`` (1 - u).  Poisson draws stay
+native in every mode (counting draws are shared, not reflected, across a
+pair; lockstep stream consumption keeps them bit-identical between
+members).  ``antithetic=None`` — the default — is exactly the historical
+draw path: bit-identical streams.
 """
 
 from __future__ import annotations
+
+from statistics import NormalDist
 
 import numpy as np
 
 from asyncflow_tpu.config.constants import Distribution
 from asyncflow_tpu.schemas.random_variables import RVConfig
 
+_NORMAL = NormalDist()
 
-def sample_rv(rv: RVConfig, rng: np.random.Generator) -> float:
-    """Draw one sample from the distribution described by ``rv``."""
+
+def _u(rng: np.random.Generator, *, antithetic: bool) -> float:
+    """One uniform, reflected in antithetic mode; clamped off {0, 1} so the
+    inverse CDFs below stay finite."""
+    u = float(rng.random())
+    if antithetic:
+        u = 1.0 - u
+    return min(max(u, 1e-12), 1.0 - 1e-12)
+
+
+def sample_rv(
+    rv: RVConfig,
+    rng: np.random.Generator,
+    *,
+    antithetic: bool | None = None,
+) -> float:
+    """Draw one sample from the distribution described by ``rv``.
+
+    ``antithetic=None`` (default) is the historical numpy draw path.
+    ``False`` / ``True`` are the two members of an antithetic couple: both
+    route continuous draws through the inverse CDF of one uniform, the
+    ``True`` member reflecting it (u -> 1-u), so matched-seed generators
+    consume their streams in lockstep and produce anti-correlated draws
+    with the exact same marginal law.
+    """
     dist = rv.distribution
-    if dist == Distribution.UNIFORM:
-        return float(rng.random())
     if dist == Distribution.POISSON:
+        # counting draws are shared, never reflected, across a pair
         return float(rng.poisson(rv.mean))
+    if antithetic is None:
+        if dist == Distribution.UNIFORM:
+            return float(rng.random())
+        if dist == Distribution.EXPONENTIAL:
+            return float(rng.exponential(rv.mean))
+        if dist == Distribution.NORMAL:
+            assert rv.variance is not None
+            return max(0.0, float(rng.normal(rv.mean, rv.variance)))
+        if dist == Distribution.LOG_NORMAL:
+            assert rv.variance is not None
+            return float(rng.lognormal(rv.mean, rv.variance))
+        msg = f"Unsupported distribution: {dist}"
+        raise ValueError(msg)
+    u = _u(rng, antithetic=antithetic)
+    if dist == Distribution.UNIFORM:
+        return u
     if dist == Distribution.EXPONENTIAL:
-        return float(rng.exponential(rv.mean))
+        return float(-rv.mean * np.log1p(-u))
     if dist == Distribution.NORMAL:
         assert rv.variance is not None
-        return max(0.0, float(rng.normal(rv.mean, rv.variance)))
+        return max(0.0, rv.mean + rv.variance * _NORMAL.inv_cdf(u))
     if dist == Distribution.LOG_NORMAL:
         assert rv.variance is not None
-        return float(rng.lognormal(rv.mean, rv.variance))
+        return float(np.exp(rv.mean + rv.variance * _NORMAL.inv_cdf(u)))
     msg = f"Unsupported distribution: {dist}"
     raise ValueError(msg)

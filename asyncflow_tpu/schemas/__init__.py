@@ -8,6 +8,11 @@ validation here, mirroring the reference's validation-first design
 from asyncflow_tpu.schemas.edges import Edge
 from asyncflow_tpu.schemas.endpoint import Endpoint, Step
 from asyncflow_tpu.schemas.events import End, EventInjection, Start
+from asyncflow_tpu.schemas.experiment import (
+    ExperimentConfig,
+    PrecisionTarget,
+    VarianceReduction,
+)
 from asyncflow_tpu.schemas.graph import TopologyGraph
 from asyncflow_tpu.schemas.nodes import (
     Client,
@@ -28,11 +33,14 @@ __all__ = [
     "End",
     "Endpoint",
     "EventInjection",
+    "ExperimentConfig",
     "FaultEvent",
     "FaultTimeline",
     "LoadBalancer",
+    "PrecisionTarget",
     "RVConfig",
     "RetryPolicy",
+    "VarianceReduction",
     "RqsGenerator",
     "Server",
     "ServerResources",

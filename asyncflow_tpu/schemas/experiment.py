@@ -1,0 +1,116 @@
+"""Monte-Carlo experiment design schema: variance reduction + precision.
+
+The inference subsystem (``asyncflow_tpu/analysis/``) is configured here,
+validation-first like every other input contract:
+
+- :class:`VarianceReduction` gates the engine-level coupling hooks —
+  antithetic scenario pairing and common-random-numbers (CRN) keying.  Both
+  default OFF, and OFF is guaranteed bit-identical to builds without the
+  hooks (tests/unit/analysis/test_vr.py pins this).
+- :class:`PrecisionTarget` names a summary metric and the confidence-interval
+  half-width at which its estimate counts as "resolved".
+- :class:`ExperimentConfig` bundles them with the sequential-stopping budget
+  used by :class:`asyncflow_tpu.analysis.AdaptiveSweep`.
+
+See docs/guides/mc-inference.md for semantics and worked examples.
+"""
+
+from __future__ import annotations
+
+from pydantic import (
+    BaseModel,
+    ConfigDict,
+    Field,
+    PositiveFloat,
+    PositiveInt,
+    model_validator,
+)
+
+#: metrics the adaptive driver / compare() know how to interval-estimate
+#: (each maps to an estimator in ``analysis/estimators.py``)
+SUPPORTED_METRICS = (
+    "latency_mean_s",
+    "latency_p50_s",
+    "latency_p90_s",
+    "latency_p95_s",
+    "latency_p99_s",
+    "goodput_fraction",
+)
+
+
+class VarianceReduction(BaseModel):
+    """Engine-coupling switches for variance reduction.
+
+    ``antithetic``: run scenarios as reflected pairs — pair member B reruns
+    member A's PRNG key through the reflected-draw program (every uniform
+    u -> 1-u, every standard normal z -> -z; counting draws shared).  The
+    sweep's scenario count must be even; pair (i, n/2 + i) share a key.
+
+    ``crn``: common-random-numbers keying on the event engine — draws keyed
+    by request identity instead of the global iteration counter, so two
+    sweeps differing only in :class:`ScenarioOverrides` share per-request
+    substreams (the fast path already keys per request lane and needs no
+    mode switch).  Used by :func:`asyncflow_tpu.analysis.compare`.
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    antithetic: bool = False
+    crn: bool = False
+
+
+class PrecisionTarget(BaseModel):
+    """One metric's stopping criterion for adaptive sweeps.
+
+    ``half_width`` is the target CI half-width in the metric's own units
+    (seconds for latencies, a fraction for goodput); with ``relative=True``
+    it is a fraction of the point estimate instead (0.05 = +/-5%).
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    metric: str
+    half_width: PositiveFloat
+    relative: bool = False
+
+    @model_validator(mode="after")
+    def _known_metric(self) -> PrecisionTarget:
+        if self.metric not in SUPPORTED_METRICS:
+            msg = (
+                f"unknown precision metric {self.metric!r}; supported: "
+                f"{', '.join(SUPPORTED_METRICS)}"
+            )
+            raise ValueError(msg)
+        return self
+
+
+class ExperimentConfig(BaseModel):
+    """Design of a Monte-Carlo inference experiment.
+
+    ``confidence_level`` applies to every interval the subsystem reports;
+    ``initial_scenarios`` / ``growth_factor`` / ``max_scenarios`` shape the
+    adaptive driver's round schedule (each round grows the ensemble by
+    ``growth_factor`` until every :class:`PrecisionTarget` is met or the
+    budget is exhausted).
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    variance_reduction: VarianceReduction = Field(
+        default_factory=VarianceReduction,
+    )
+    precision: list[PrecisionTarget] = Field(default_factory=list)
+    confidence_level: float = Field(default=0.95, gt=0.0, lt=1.0)
+    initial_scenarios: PositiveInt = 256
+    growth_factor: float = Field(default=2.0, ge=1.1)
+    max_scenarios: PositiveInt = 16384
+
+    @model_validator(mode="after")
+    def _budget_covers_first_round(self) -> ExperimentConfig:
+        if self.max_scenarios < self.initial_scenarios:
+            msg = (
+                f"max_scenarios ({self.max_scenarios}) must be >= "
+                f"initial_scenarios ({self.initial_scenarios})"
+            )
+            raise ValueError(msg)
+        return self

@@ -45,8 +45,13 @@ def _parse_args(argv: list[str]) -> dict:
     ``--telemetry out.jsonl``: record the measured sweep's structured run
     telemetry (phases, compile ledger, counters + a Chrome-trace timeline
     beside it) and compare the headline against the newest ``BENCH_*.json``.
+
+    ``--repeats N``: measure the sweep N times (distinct seeds, identical
+    compiled shape) and report scen/s as the repeat mean with a bootstrap
+    confidence interval (asyncflow_tpu.analysis) instead of a single-shot
+    number; the interval lands in the BENCH JSON under ``detail.repeats``.
     """
-    opts = {"telemetry": None}
+    opts = {"telemetry": None, "repeats": None}
     it = iter(argv)
     for arg in it:
         if arg == "--telemetry":
@@ -55,8 +60,21 @@ def _parse_args(argv: list[str]) -> dict:
                 raise SystemExit("--telemetry needs an output path")
         elif arg.startswith("--telemetry="):
             opts["telemetry"] = arg.split("=", 1)[1]
+        elif arg == "--repeats":
+            opts["repeats"] = next(it, None)
+            if opts["repeats"] is None:
+                raise SystemExit("--repeats needs a count")
+        elif arg.startswith("--repeats="):
+            opts["repeats"] = arg.split("=", 1)[1]
         else:
             raise SystemExit(f"unknown argument {arg!r}")
+    if opts["repeats"] is not None:
+        try:
+            opts["repeats"] = int(opts["repeats"])
+        except ValueError:
+            raise SystemExit("--repeats needs an integer count") from None
+        if opts["repeats"] < 1:
+            raise SystemExit("--repeats needs a count >= 1")
     return opts
 
 # On an accelerator the sweep targets the north star (10k-scenario sweep,
@@ -318,9 +336,35 @@ def run_measurement() -> None:
             trace_path=telemetry_out + ".trace.json",
             label="bench",
         )
+    repeats = int(os.environ.get("BENCH_REPEATS", "1"))
     report = runner.run(
         n_scenarios, seed=SEED, chunk_size=chunk, telemetry=telemetry_cfg,
     )
+    rates = [report.scenarios_per_second]
+    for i in range(1, repeats):
+        # distinct seeds, identical compiled shape: only the wall varies
+        rep_i = runner.run(n_scenarios, seed=SEED + 100 + i, chunk_size=chunk)
+        rates.append(rep_i.scenarios_per_second)
+    repeat_detail = None
+    if repeats > 1:
+        from asyncflow_tpu.analysis import bootstrap_mean_ci
+
+        est = bootstrap_mean_ci(rates, n_boot=2000, seed=0)
+        repeat_detail = {
+            "n": repeats,
+            "rates": [round(r, 3) for r in rates],
+            "mean": round(est.point, 3),
+            "ci_lo": round(est.lo, 3),
+            "ci_hi": round(est.hi, 3),
+            "ci_level": est.level,
+            "method": est.method,
+        }
+        print(
+            f"repeats: {repeats} x {n_scenarios} scenarios -> "
+            f"{est.point:.1f} scen/s [{est.lo:.1f}, {est.hi:.1f}] "
+            f"({int(est.level * 100)}% bootstrap CI)",
+            file=sys.stderr,
+        )
     summary = report.summary()
 
     if summary["overflow_total"] > 0:
@@ -329,7 +373,9 @@ def run_measurement() -> None:
             file=sys.stderr,
         )
 
-    value = report.scenarios_per_second
+    value = (
+        repeat_detail["mean"] if repeat_detail else report.scenarios_per_second
+    )
     detail = {
         **detail_base,
         "sweep_wall_s": round(report.wall_seconds, 3),
@@ -337,6 +383,8 @@ def run_measurement() -> None:
         "completed_total": summary["completed_total"],
         "overflow_total": summary["overflow_total"],
     }
+    if repeat_detail:
+        detail["repeats"] = repeat_detail
     if telemetry_out:
         detail["telemetry"] = telemetry_out
     if on_accel:
@@ -519,6 +567,8 @@ def main() -> None:
     opts = _parse_args(sys.argv[1:])
     if opts["telemetry"]:
         os.environ["BENCH_TELEMETRY"] = opts["telemetry"]
+    if opts["repeats"]:
+        os.environ["BENCH_REPEATS"] = str(opts["repeats"])
 
     if os.path.exists(PARTIAL_PATH):
         os.unlink(PARTIAL_PATH)

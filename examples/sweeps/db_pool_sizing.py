@@ -52,7 +52,8 @@ def main() -> None:
         runner = SweepRunner(payload_with_pool(pool), engine="native")
         report = runner.run(N_SCENARIOS, seed=11)
         s = report.summary()
-        p95_point, p95_lo, p95_hi = report.percentile_ci(95)
+        est = report.pooled_percentile_ci(95)
+        p95_point, p95_lo, p95_hi = est.point, est.lo, est.hi
         rows.append((pool, s["latency_p50_s"], p95_point, p95_lo, p95_hi))
         label = pool if pool is not None else "unlimited"
         print(

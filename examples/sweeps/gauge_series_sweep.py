@@ -50,13 +50,13 @@ def main() -> None:
     )
     report = runner.run(N_SCENARIOS, seed=7)
     times, p10, p50, p90 = report.gauge_series_band("srv-1", 10, 90)
-    point, lo, hi = report.percentile_ci(95)
+    est = report.pooled_percentile_ci(95)
     print(
         f"{N_SCENARIOS} scenarios, {report.scenarios_per_second:.1f} scen/s; "
         f"ready-queue median {p50.mean():.2f}, "
         f"10-90% band width {np.mean(p90 - p10):.2f}; "
-        f"p95 latency {point * 1e3:.2f} ms "
-        f"(95% CI [{lo * 1e3:.2f}, {hi * 1e3:.2f}])",
+        f"p95 latency {est.point * 1e3:.2f} ms "
+        f"(95% CI [{est.lo * 1e3:.2f}, {est.hi * 1e3:.2f}])",
     )
 
     import matplotlib

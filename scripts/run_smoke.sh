@@ -20,4 +20,12 @@ python -m pytest \
   tests/parity/test_resilience.py::test_outage_fault_is_not_a_rotation_removal \
   tests/parity/test_resilience.py::test_retry_budget_exhaustion_parity \
   -q -p no:cacheprovider
+# analysis slice: one tiny adaptive run + one CRN compare through the
+# event engine, plus the substream contract they depend on
+# (docs/guides/mc-inference.md)
+python -m pytest \
+  tests/unit/analysis/test_adaptive.py::test_stops_when_targets_met \
+  tests/unit/analysis/test_compare.py::test_event_engine_crn_compare_smoke \
+  tests/parity/test_sweep_determinism.py::test_scenario_keys_prefix_stable_in_n \
+  -q -p no:cacheprovider
 python -m pytest tests/ -m smoke -q "$@"

@@ -161,6 +161,12 @@ _SMOKE_TESTS = (
     "tests/parity/test_resilience.py::test_fastpath_refuses_resilience_plans",
     "tests/parity/test_resilience.py::test_retry_budget_exhaustion_parity",
     "tests/unit/test_sweep_resilience.py::test_sweep_survives_injected_oom_with_downshift",
+    # MC-inference tier (asyncflow_tpu.analysis): substream determinism,
+    # a tiny adaptive run, and one event-engine CRN compare
+    "tests/parity/test_sweep_determinism.py::test_scenario_keys_prefix_stable_in_n",
+    "tests/parity/test_sweep_determinism.py::test_split_and_chunk_compose",
+    "tests/unit/analysis/test_adaptive.py::test_stops_when_targets_met",
+    "tests/unit/analysis/test_compare.py::test_event_engine_crn_compare_smoke",
 )
 
 

@@ -1,0 +1,140 @@
+"""Adaptive sequential sweeps: round schedule, stopping, continuation
+parity, and the telemetry trace (docs/guides/mc-inference.md)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from asyncflow_tpu.analysis import (
+    AdaptiveSweep,
+    ExperimentConfig,
+    PrecisionTarget,
+    VarianceReduction,
+)
+from asyncflow_tpu.observability.telemetry import TelemetryConfig
+from asyncflow_tpu.parallel.sweep import SweepRunner
+from asyncflow_tpu.runtime.runner import SimulationRunner
+
+
+@pytest.fixture(scope="module")
+def payload():
+    return SimulationRunner.from_yaml(
+        "tests/integration/data/single_server.yml",
+    ).simulation_input
+
+
+def _exp(**kw) -> ExperimentConfig:
+    base = {
+        "precision": [
+            PrecisionTarget(
+                metric="latency_mean_s", half_width=0.05, relative=True,
+            ),
+        ],
+        "initial_scenarios": 16,
+        "growth_factor": 2.0,
+        "max_scenarios": 64,
+    }
+    base.update(kw)
+    return ExperimentConfig(**base)
+
+
+def test_requires_precision_targets(payload) -> None:
+    with pytest.raises(ValueError, match="PrecisionTarget"):
+        AdaptiveSweep(payload, ExperimentConfig())
+
+
+def test_stops_when_targets_met(payload) -> None:
+    out = AdaptiveSweep(payload, _exp(), use_mesh=False, n_boot=400).run(
+        seed=3,
+    )
+    assert out.stop_reason == "targets_met"
+    assert out.rounds[-1].unmet == ()
+    est = out.intervals["latency_mean_s"]
+    assert est.meets(0.05, relative=True)
+    assert out.report.n_scenarios == out.n_scenarios <= 64
+
+
+def test_budget_exhaustion_runs_the_full_schedule(payload) -> None:
+    exp = _exp(
+        precision=[
+            PrecisionTarget(
+                metric="latency_p95_s", half_width=1e-9,
+            ),
+        ],
+    )
+    out = AdaptiveSweep(payload, exp, use_mesh=False, n_boot=300).run(seed=3)
+    assert out.stop_reason == "budget_exhausted"
+    assert [r.n_total for r in out.rounds] == [16, 32, 64]
+    assert out.rounds[-1].unmet == ("latency_p95_s",)
+    # every round re-estimates on the merged ensemble
+    assert [r.n_new for r in out.rounds] == [16, 16, 32]
+    hw = [r.intervals["latency_p95_s"].half_width for r in out.rounds]
+    assert all(np.isfinite(hw))
+
+
+def test_rounds_match_uninterrupted_sweep(payload) -> None:
+    """first_scenario continuation: the union of the rounds is
+    bit-identical to one sweep of the same total."""
+    exp = _exp(
+        precision=[
+            PrecisionTarget(metric="latency_p99_s", half_width=1e-9),
+        ],
+        max_scenarios=32,
+    )
+    out = AdaptiveSweep(payload, exp, use_mesh=False, n_boot=200).run(seed=9)
+    assert out.stop_reason == "budget_exhausted"
+    assert out.n_scenarios == 32
+    plain = SweepRunner(payload, use_mesh=False).run(32, seed=9)
+    np.testing.assert_array_equal(
+        np.asarray(out.report.results.latency_hist),
+        np.asarray(plain.results.latency_hist),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.report.results.completed),
+        np.asarray(plain.results.completed),
+    )
+
+
+def test_telemetry_records_the_stopping_trace(payload, tmp_path) -> None:
+    path = tmp_path / "adaptive.jsonl"
+    sweep = AdaptiveSweep(
+        payload,
+        _exp(),
+        use_mesh=False,
+        n_boot=300,
+        telemetry=TelemetryConfig(jsonl_path=path, label="test"),
+    )
+    out = sweep.run(seed=3)
+    records = [json.loads(line) for line in path.read_text().splitlines()]
+    adaptive = [r for r in records if r["kind"] == "adaptive"]
+    assert len(adaptive) == 1
+    meta = adaptive[0]["meta"]
+    assert meta["stop_reason"] == out.stop_reason == "targets_met"
+    assert meta["n_rounds"] == len(out.rounds)
+    assert meta["n_scenarios"] == out.n_scenarios
+    assert [r["n_total"] for r in meta["rounds"]] == [
+        r.n_total for r in out.rounds
+    ]
+    # per-round sweep records land beside the adaptive summary
+    assert sum(r["kind"] == "sweep" for r in records) == len(out.rounds)
+
+
+def test_antithetic_schedule_stays_even(payload) -> None:
+    exp = _exp(
+        variance_reduction=VarianceReduction(antithetic=True),
+        initial_scenarios=15,
+        max_scenarios=61,
+    )
+    sweep = AdaptiveSweep(payload, exp, use_mesh=False)
+    totals = sweep._schedule()
+    assert totals[0] == 16
+    assert all(t % 2 == 0 for t in totals)
+    assert totals[-1] <= 61
+
+
+def test_report_serializes(payload) -> None:
+    out = AdaptiveSweep(payload, _exp(), use_mesh=False, n_boot=200).run(
+        seed=3,
+    )
+    json.dumps(out.as_dict())

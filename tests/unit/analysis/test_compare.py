@@ -1,0 +1,96 @@
+"""CRN-paired A/B comparison: the delta CIs, their tightening over
+independent seeds, and the report surface (docs/guides/mc-inference.md)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from asyncflow_tpu.analysis import compare
+from asyncflow_tpu.runtime.runner import SimulationRunner
+
+N = 48
+
+
+@pytest.fixture(scope="module")
+def payload():
+    return SimulationRunner.from_yaml(
+        "tests/integration/data/single_server.yml",
+    ).simulation_input
+
+
+def _candidate(n: int = N) -> dict:
+    return {"edge_mean_scale": np.full(n, 1.3)}
+
+
+@pytest.fixture(scope="module")
+def coupled(payload):
+    return compare(
+        payload, None, _candidate(), n_scenarios=N, seed=5,
+        use_mesh=False, n_boot=500,
+    )
+
+
+@pytest.fixture(scope="module")
+def independent(payload):
+    return compare(
+        payload, None, _candidate(), n_scenarios=N, seed=5,
+        candidate_seed=999, use_mesh=False, n_boot=500,
+    )
+
+
+def test_crn_detects_the_regression(coupled) -> None:
+    assert coupled.coupled
+    est = coupled.deltas["latency_p95_s"]
+    # candidate scales every edge latency 1.3x: slower, decisively
+    assert est.point > 0
+    assert coupled.decisive("latency_p95_s")
+    assert est.lo <= est.point <= est.hi
+    # the arms share the key grid: per-scenario metrics strongly coupled
+    assert coupled.coupling["latency_p95_s"]["correlation"] > 0.9
+
+
+def test_crn_is_3x_tighter_than_independent_seeds(
+    coupled, independent,
+) -> None:
+    """The acceptance bar: at EQUAL scenario count the CRN-paired
+    delta-p95 interval beats independently-seeded arms >= 3x."""
+    assert not independent.coupled
+    hw_crn = coupled.deltas["latency_p95_s"].half_width
+    hw_ind = independent.deltas["latency_p95_s"].half_width
+    assert hw_ind >= 3.0 * hw_crn
+    # and the independent arms really are uncoupled
+    assert abs(independent.coupling["latency_p95_s"]["correlation"]) < 0.5
+
+
+def test_report_surface(coupled) -> None:
+    assert coupled.n_scenarios == N
+    assert set(coupled.deltas) == {
+        "latency_p50_s",
+        "latency_p95_s",
+        "latency_p99_s",
+        "goodput_fraction",
+    }
+    d = coupled.as_dict()
+    assert d["coupled"] is True
+    assert set(d["decisive"]) == set(coupled.deltas)
+    json.dumps(d)  # telemetry/JSONL-ready
+
+
+def test_unknown_metric_raises(payload) -> None:
+    with pytest.raises(ValueError, match="unknown comparison metrics"):
+        compare(payload, metrics=("latency_p95_s", "nope"), use_mesh=False)
+
+
+def test_event_engine_crn_compare_smoke(payload) -> None:
+    """The CI smoke slice: one tiny CRN compare through the event engine
+    (request-identity keying, scripts/run_smoke.sh)."""
+    rep = compare(
+        payload, None, _candidate(12), n_scenarios=12, seed=3,
+        engine="event", use_mesh=False, n_boot=300,
+        metrics=("latency_p95_s", "goodput_fraction"),
+    )
+    assert rep.engine == "event"
+    assert rep.deltas["latency_p95_s"].point > 0
+    # CRN request-identity keying survives divergent event interleavings
+    assert rep.coupling["latency_p95_s"]["correlation"] > 0.9

@@ -1,0 +1,235 @@
+"""Interval estimators: order-statistic pooled-quantile CIs + the
+scenario-resampling bootstrap family (docs/guides/mc-inference.md)."""
+
+import numpy as np
+import pytest
+
+from asyncflow_tpu.analysis.estimators import (
+    IntervalEstimate,
+    binomial_rank_bounds,
+    bootstrap_mean_ci,
+    bootstrap_quantile_ci,
+    bootstrap_ratio_ci,
+    interval_for_metric,
+    paired_delta_for_metric,
+    paired_delta_quantile_ci,
+    paired_delta_ratio_ci,
+    pooled_quantile_ci,
+    resample_weights,
+)
+
+RNG = np.random.default_rng(42)
+
+
+def _hist(samples: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    return np.histogram(samples, bins=edges)[0].astype(np.float64)
+
+
+def _edges(n_bins: int = 512) -> np.ndarray:
+    # log-spaced like the engines' latency histograms
+    return np.concatenate([[0.0], np.geomspace(1e-4, 10.0, n_bins)])
+
+
+class TestBinomialRankBounds:
+    def test_bracket_the_quantile_rank(self) -> None:
+        r, s = binomial_rank_bounds(100, 0.5, 0.95)
+        assert 1 <= r < 50 < s <= 100
+        # the classic n=100 median interval is roughly ranks 40..60
+        assert 35 <= r <= 45
+        assert 55 <= s <= 65
+
+    def test_tail_quantile_clamps_into_range(self) -> None:
+        r, s = binomial_rank_bounds(50, 0.99, 0.95)
+        assert 1 <= r < s <= 50
+
+    def test_exact_and_normal_regimes_agree_at_the_crossover(self) -> None:
+        # n=2000 runs the exact inversion, n=2001 the normal approximation;
+        # the rank bounds may differ by at most a couple of positions
+        r_e, s_e = binomial_rank_bounds(2000, 0.95, 0.95)
+        r_n, s_n = binomial_rank_bounds(2001, 0.95, 0.95)
+        assert abs(r_e - r_n) <= 3
+        assert abs(s_e - s_n) <= 3
+
+    def test_rejects_bad_inputs(self) -> None:
+        with pytest.raises(ValueError, match="confidence level"):
+            binomial_rank_bounds(10, 0.5, 1.5)
+        with pytest.raises(ValueError, match="at least one"):
+            binomial_rank_bounds(0, 0.5, 0.95)
+
+
+class TestPooledQuantileCI:
+    def test_brackets_the_true_quantile(self) -> None:
+        edges = _edges()
+        true_p95 = -np.log(0.05) * 0.01  # exponential(mean=0.01)
+        counts = _hist(RNG.exponential(0.01, 20_000), edges)
+        est = pooled_quantile_ci(counts, edges, 95.0)
+        assert est.method == "order-statistic"
+        assert est.n == 20_000
+        assert est.lo <= est.point <= est.hi
+        assert est.lo < true_p95 < est.hi
+        # the interval is tight at n=20k: a few percent of the value
+        assert est.half_width < 0.2 * true_p95
+
+    def test_stacked_rows_pool(self) -> None:
+        edges = _edges()
+        samples = RNG.exponential(0.01, 8_000)
+        stacked = np.stack([_hist(s, edges) for s in samples.reshape(8, -1)])
+        est_stacked = pooled_quantile_ci(stacked, edges, 99.0)
+        est_pooled = pooled_quantile_ci(stacked.sum(axis=0), edges, 99.0)
+        assert est_stacked.as_dict() == est_pooled.as_dict()
+
+    def test_interval_shrinks_with_n(self) -> None:
+        edges = _edges()
+        small = pooled_quantile_ci(
+            _hist(RNG.exponential(0.01, 500), edges), edges, 95.0,
+        )
+        big = pooled_quantile_ci(
+            _hist(RNG.exponential(0.01, 50_000), edges), edges, 95.0,
+        )
+        assert big.half_width < small.half_width
+
+    def test_empty_ensemble_is_nan(self) -> None:
+        edges = _edges(16)
+        est = pooled_quantile_ci(np.zeros(16), edges, 95.0)
+        assert est.n == 0
+        assert np.isnan(est.point)
+        assert not est.meets(1.0)
+
+
+class TestIntervalEstimate:
+    def test_meets_absolute_and_relative(self) -> None:
+        est = IntervalEstimate(10.0, 9.0, 11.0, 0.95, 100, "x")
+        assert est.half_width == 1.0
+        assert est.meets(1.0)
+        assert not est.meets(0.5)
+        assert est.meets(0.1, relative=True)  # 1.0 <= 0.1 * 10
+        assert not est.meets(0.05, relative=True)
+
+
+class TestBootstrap:
+    def test_resample_weights_rows_sum_to_n(self) -> None:
+        w = resample_weights(37, 100, seed=1)
+        assert w.shape == (100, 37)
+        np.testing.assert_array_equal(w.sum(axis=1), np.full(100, 37.0))
+
+    def test_deterministic_in_seed(self) -> None:
+        vals = RNG.normal(5.0, 1.0, 200)
+        a = bootstrap_mean_ci(vals, seed=7)
+        b = bootstrap_mean_ci(vals, seed=7)
+        c = bootstrap_mean_ci(vals, seed=8)
+        assert a.as_dict() == b.as_dict()
+        assert a.as_dict() != c.as_dict()
+
+    def test_mean_ci_brackets_the_mean(self) -> None:
+        vals = RNG.normal(5.0, 1.0, 400)
+        est = bootstrap_mean_ci(vals)
+        assert est.method == "bootstrap-mean"
+        assert est.lo < 5.0 < est.hi
+        assert est.lo <= est.point <= est.hi
+        # roughly the CLT width: 1.96 / sqrt(400) = 0.098
+        assert 0.05 < est.half_width < 0.2
+
+    def test_ratio_ci(self) -> None:
+        num = RNG.poisson(80, 300).astype(float)
+        den = np.full(300, 100.0)
+        est = bootstrap_ratio_ci(num, den)
+        assert est.lo < 0.8 < est.hi
+        with pytest.raises(ValueError, match="shape mismatch"):
+            bootstrap_ratio_ci(num, den[:-1])
+
+    def test_quantile_ci_brackets(self) -> None:
+        edges = _edges()
+        counts = np.stack(
+            [_hist(RNG.exponential(0.01, 500), edges) for _ in range(64)],
+        )
+        true_p95 = -np.log(0.05) * 0.01
+        est = bootstrap_quantile_ci(counts, edges, 95.0)
+        # the interval resolves sampling noise, not histogram binning —
+        # allow one log-bin step of discretisation slack on each side
+        bin_step = (edges[-1] / edges[1]) ** (1.0 / (edges.size - 2))
+        assert est.lo / bin_step < true_p95 < est.hi * bin_step
+        assert est.lo <= est.point <= est.hi
+
+    def test_paired_delta_of_identical_arms_is_zero(self) -> None:
+        edges = _edges()
+        counts = np.stack(
+            [_hist(RNG.exponential(0.01, 500), edges) for _ in range(16)],
+        )
+        est = paired_delta_quantile_ci(counts, counts, edges, 95.0)
+        assert est.point == 0.0
+        assert est.lo == est.hi == 0.0
+        num = counts.sum(axis=1)
+        est_r = paired_delta_ratio_ci(num, num + 1, num, num + 1)
+        assert est_r.point == 0.0
+        assert est_r.lo == est_r.hi == 0.0
+
+    def test_paired_delta_shape_guard(self) -> None:
+        edges = _edges(16)
+        with pytest.raises(ValueError, match="matching"):
+            paired_delta_quantile_ci(
+                np.ones((4, 16)), np.ones((5, 16)), edges, 95.0,
+            )
+
+
+class _FakeResults:
+    """The slice of SweepResults the metric dispatch reads."""
+
+    def __init__(self, scen_samples: list[np.ndarray], edges: np.ndarray):
+        self.hist_edges = edges
+        self.latency_hist = np.stack([_hist(s, edges) for s in scen_samples])
+        self.latency_sum = np.array([s.sum() for s in scen_samples])
+        self.completed = np.array([len(s) for s in scen_samples], float)
+        self.total_generated = self.completed + 5.0
+        self.total_retries = None
+
+    def percentile(self, q):
+        from asyncflow_tpu.engines.results import hist_percentile
+
+        return hist_percentile(self.latency_hist, self.hist_edges, q)
+
+
+class TestMetricDispatch:
+    def _results(self, scale: float = 1.0) -> _FakeResults:
+        rng = np.random.default_rng(3)
+        return _FakeResults(
+            [rng.exponential(0.01 * scale, 400) for _ in range(32)],
+            _edges(),
+        )
+
+    def test_quantile_metric_routes_to_order_statistic(self) -> None:
+        est = interval_for_metric(self._results(), "latency_p95_s")
+        assert est.method == "order-statistic"
+        assert est.lo < est.point < est.hi
+
+    def test_ratio_metrics_route_to_bootstrap(self) -> None:
+        res = self._results()
+        mean = interval_for_metric(res, "latency_mean_s")
+        goodput = interval_for_metric(res, "goodput_fraction")
+        assert mean.method == "bootstrap-ratio"
+        assert abs(mean.point - 0.01) < 0.002
+        assert abs(goodput.point - 400.0 / 405.0) < 1e-9
+
+    def test_unknown_metric_raises(self) -> None:
+        with pytest.raises(ValueError, match="unknown ratio metric"):
+            interval_for_metric(self._results(), "nope")
+
+    def test_paired_delta_detects_the_shift(self) -> None:
+        a, b = self._results(1.0), self._results(1.5)
+        est = paired_delta_for_metric(a, b, "latency_p95_s")
+        assert est.lo > 0  # decisive: arm B is slower
+
+
+@pytest.mark.slow
+def test_order_statistic_coverage() -> None:
+    """The nominal 95% interval covers the true quantile at >= ~90% over
+    repeated ensembles (histogram discretisation costs a little)."""
+    edges = _edges(1024)
+    true_p95 = -np.log(0.05) * 0.01
+    rng = np.random.default_rng(11)
+    hits = 0
+    trials = 200
+    for _ in range(trials):
+        counts = _hist(rng.exponential(0.01, 2_000), edges)
+        est = pooled_quantile_ci(counts, edges, 95.0)
+        hits += est.lo <= true_p95 <= est.hi
+    assert hits / trials >= 0.9

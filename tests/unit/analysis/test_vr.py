@@ -1,0 +1,229 @@
+"""Variance reduction: OFF is bit-identical, ON couples as designed.
+
+The load-bearing contract (docs/guides/mc-inference.md): with
+``ExperimentConfig`` variance reduction OFF, every draw helper reduces to
+the raw ``jax.random`` call and every engine's streams are bit-identical
+to a build without the hooks; with antithetic/CRN ON, the coupling is
+strong enough to be worth the machinery.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from asyncflow_tpu.analysis.vr import (
+    antithetic_halves,
+    antithetic_mean_ci,
+    antithetic_pair_means,
+    coupling_diagnostics,
+)
+from asyncflow_tpu.engines.jaxsim.sampling import (
+    antithetic_active,
+    antithetic_trace,
+    draw_normal,
+    draw_uniform,
+)
+from asyncflow_tpu.parallel.sweep import SweepRunner, make_overrides
+from asyncflow_tpu.runtime.runner import SimulationRunner
+from asyncflow_tpu.schemas.experiment import ExperimentConfig, VarianceReduction
+
+
+@pytest.fixture(scope="module")
+def payload():
+    return SimulationRunner.from_yaml(
+        "tests/integration/data/single_server.yml",
+    ).simulation_input
+
+
+# ---------------------------------------------------------------------------
+# draw-helper contract
+# ---------------------------------------------------------------------------
+
+
+def test_hooks_off_are_bitwise_raw_jax_random() -> None:
+    key = jax.random.PRNGKey(7)
+    assert not antithetic_active()
+    np.testing.assert_array_equal(
+        np.asarray(draw_uniform(key, (64,))),
+        np.asarray(jax.random.uniform(key, (64,))),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(draw_normal(key, (64,))),
+        np.asarray(jax.random.normal(key, (64,))),
+    )
+
+
+def test_antithetic_trace_reflects_draws() -> None:
+    key = jax.random.PRNGKey(7)
+    u = np.asarray(jax.random.uniform(key, (64,)))
+    z = np.asarray(jax.random.normal(key, (64,)))
+    with antithetic_trace():
+        assert antithetic_active()
+        u_r = np.asarray(draw_uniform(key, (64,)))
+        z_r = np.asarray(draw_normal(key, (64,)))
+    assert not antithetic_active()
+    np.testing.assert_allclose(u_r, 1.0 - u, rtol=0, atol=0)
+    np.testing.assert_array_equal(z_r, -z)
+
+
+def test_oracle_sampler_reflection_preserves_law() -> None:
+    from asyncflow_tpu.samplers.variates import sample_rv
+    from asyncflow_tpu.schemas.random_variables import RVConfig
+
+    rv = RVConfig(mean=0.02, distribution="exponential")
+    n = 4000
+    rng_a = np.random.default_rng(5)
+    rng_b = np.random.default_rng(5)
+    primary = np.array(
+        [sample_rv(rv, rng_a, antithetic=False) for _ in range(n)],
+    )
+    refl = np.array(
+        [sample_rv(rv, rng_b, antithetic=True) for _ in range(n)],
+    )
+    # lockstep substreams, anti-correlated draws, same marginal law
+    assert np.corrcoef(primary, refl)[0, 1] < -0.5
+    assert abs(primary.mean() - 0.02) < 0.002
+    assert abs(refl.mean() - 0.02) < 0.002
+    # the default path is the historical one: repeatable bit-for-bit
+    rng_c = np.random.default_rng(5)
+    rng_d = np.random.default_rng(5)
+    np.testing.assert_array_equal(
+        np.array([sample_rv(rv, rng_c) for _ in range(n)]),
+        np.array([sample_rv(rv, rng_d) for _ in range(n)]),
+    )
+
+
+# ---------------------------------------------------------------------------
+# vr.py helpers
+# ---------------------------------------------------------------------------
+
+
+def test_antithetic_halves_layout() -> None:
+    vals = np.arange(8.0)
+    a, b = antithetic_halves(vals)
+    np.testing.assert_array_equal(a, [0, 1, 2, 3])
+    np.testing.assert_array_equal(b, [4, 5, 6, 7])
+    np.testing.assert_array_equal(antithetic_pair_means(vals), [2, 3, 4, 5])
+    with pytest.raises(ValueError, match="even"):
+        antithetic_halves(np.arange(7.0))
+
+
+def test_coupling_diagnostics() -> None:
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=500)
+    d = coupling_diagnostics(a, a + 0.1 * rng.normal(size=500))
+    assert d["correlation"] > 0.9
+    assert d["variance_ratio_vs_independent"] < 0.1
+    d_ind = coupling_diagnostics(a, rng.normal(size=500))
+    assert abs(d_ind["correlation"]) < 0.2
+    with pytest.raises(ValueError, match="matching shapes"):
+        coupling_diagnostics(a, a[:-1])
+    degenerate = coupling_diagnostics(np.ones(5), np.ones(5))
+    assert np.isnan(degenerate["correlation"])
+
+
+# ---------------------------------------------------------------------------
+# engine-level parity (OFF) and coupling (ON)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("engine", ["fast", "event"])
+def test_vr_off_is_bit_identical(payload, engine) -> None:
+    base = SweepRunner(payload, use_mesh=False, engine=engine)
+    off = SweepRunner(
+        payload, use_mesh=False, engine=engine, experiment=ExperimentConfig(),
+    )
+    rep_base = base.run(8, seed=3, chunk_size=4)
+    rep_off = off.run(8, seed=3, chunk_size=4)
+    np.testing.assert_array_equal(
+        rep_base.results.latency_hist, rep_off.results.latency_hist,
+    )
+    np.testing.assert_array_equal(
+        rep_base.results.completed, rep_off.results.completed,
+    )
+
+
+def test_antithetic_sweep_layout_and_coupling(payload) -> None:
+    exp = ExperimentConfig(
+        variance_reduction=VarianceReduction(antithetic=True),
+    )
+    runner = SweepRunner(payload, use_mesh=False, experiment=exp)
+    rep = runner.run(64, seed=3)
+    assert rep.antithetic
+    # primary half is bit-identical to an uncoupled sweep of the same keys
+    plain = SweepRunner(payload, use_mesh=False).run(32, seed=3)
+    np.testing.assert_array_equal(
+        rep.results.latency_hist[:32], plain.results.latency_hist,
+    )
+    # the reflection anti-correlates the pair's mean latency
+    m = rep.results.latency_sum / np.maximum(rep.results.completed, 1)
+    a, b = antithetic_halves(m)
+    assert np.corrcoef(a, b)[0, 1] < -0.2
+    # so pair means carry less variance than independent pairs would
+    assert antithetic_pair_means(m).var(ddof=1) < 0.75 * m.var(ddof=1) / 2
+    est = antithetic_mean_ci(m)
+    assert est.n == 32
+    assert est.lo < est.point < est.hi
+
+
+def test_antithetic_requires_even_count(payload) -> None:
+    exp = ExperimentConfig(
+        variance_reduction=VarianceReduction(antithetic=True),
+    )
+    runner = SweepRunner(payload, use_mesh=False, experiment=exp)
+    with pytest.raises(ValueError, match="even"):
+        runner.run(7, seed=3)
+
+
+def test_vr_refused_on_unhooked_engines(payload) -> None:
+    exp = ExperimentConfig(variance_reduction=VarianceReduction(crn=True))
+    with pytest.raises(ValueError, match="variance-reduction"):
+        SweepRunner(payload, use_mesh=False, engine="native", experiment=exp)
+    with pytest.raises(ValueError, match="variance-reduction"):
+        SweepRunner(payload, use_mesh=False, engine="pallas", experiment=exp)
+
+
+def test_event_crn_couples_override_arms(payload) -> None:
+    """CRN keying holds per-request substreams fixed across override arms:
+    the cross-arm correlation must beat the iteration-keyed default."""
+    rho = {}
+    for crn in (False, True):
+        exp = ExperimentConfig(variance_reduction=VarianceReduction(crn=crn))
+        runner = SweepRunner(
+            payload, use_mesh=False, engine="event", experiment=exp,
+        )
+        ov = make_overrides(runner.plan, 16, edge_mean_scale=np.full(16, 1.3))
+        rep_a = runner.run(16, seed=9)
+        rep_b = runner.run(16, seed=9, overrides=ov)
+        ma = rep_a.results.latency_sum / np.maximum(rep_a.results.completed, 1)
+        mb = rep_b.results.latency_sum / np.maximum(rep_b.results.completed, 1)
+        rho[crn] = coupling_diagnostics(ma, mb)["correlation"]
+    assert rho[True] > 0.99
+    assert rho[True] > rho[False]
+
+
+def test_antithetic_jit_cache_keyed_by_flag(payload) -> None:
+    """One engine instance serving both halves must compile two program
+    variants — a cache hit across the flag would silently drop the
+    reflection."""
+    from asyncflow_tpu.engines.jaxsim.engine import scenario_keys
+    from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+
+    runner = SweepRunner(payload, use_mesh=False, scan_inner=0)
+    assert isinstance(runner.engine, FastEngine)
+    keys = scenario_keys(3, 4)
+    plain = runner.engine.run_batch(keys)
+    refl = runner.engine.run_batch(keys, antithetic=True)
+    plain2 = runner.engine.run_batch(keys)
+    sigs = {sig for sig in runner.engine._compiled}
+    assert {s[-1] for s in sigs} == {False, True}
+    np.testing.assert_array_equal(
+        np.asarray(plain.hist), np.asarray(plain2.hist),
+    )
+    assert not np.array_equal(
+        np.asarray(plain.hist), np.asarray(refl.hist),
+    )
+
+
+_ = jnp  # keep the jax.numpy import referenced under minimal configs

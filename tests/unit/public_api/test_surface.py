@@ -60,7 +60,47 @@ def test_workload_surface() -> None:
 
 
 def test_analysis_surface() -> None:
-    assert set(analysis.__all__) == {"ResultsAnalyzer"}
+    assert set(analysis.__all__) == {
+        # legacy single-run analyzer
+        "ResultsAnalyzer",
+        # experiment design (also re-exported from asyncflow_tpu.schemas)
+        "ExperimentConfig",
+        "PrecisionTarget",
+        "VarianceReduction",
+        # interval estimators
+        "IntervalEstimate",
+        "binomial_rank_bounds",
+        "pooled_quantile_ci",
+        "bootstrap_mean_ci",
+        "bootstrap_quantile_ci",
+        "bootstrap_ratio_ci",
+        "paired_delta_quantile_ci",
+        "paired_delta_ratio_ci",
+        "interval_for_metric",
+        "paired_delta_for_metric",
+        # variance reduction helpers
+        "antithetic_mean_ci",
+        "antithetic_pair_means",
+        "coupling_diagnostics",
+        # A/B comparison + adaptive sequential sweeps
+        "compare",
+        "ComparisonReport",
+        "AdaptiveSweep",
+        "AdaptiveReport",
+        "AdaptiveRound",
+    }
+    for name in analysis.__all__:
+        assert getattr(analysis, name) is not None
+
+
+def test_schemas_export_experiment_config() -> None:
+    import asyncflow_tpu.schemas as schemas
+
+    assert {
+        "ExperimentConfig",
+        "PrecisionTarget",
+        "VarianceReduction",
+    } <= set(schemas.__all__)
 
 
 def test_parallel_surface() -> None:

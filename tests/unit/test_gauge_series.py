@@ -108,12 +108,18 @@ def test_confidence_intervals_and_bands() -> None:
     )
     report = runner.run(32, seed=2, chunk_size=16)
 
-    point, lo, hi = report.percentile_ci(95)
+    point, lo, hi = report.per_scenario_percentile_mean_ci(95)
     assert lo < point < hi
     assert np.isfinite(lo) and hi - lo < point  # a meaningful interval
     # wider confidence -> wider interval
-    _, lo99, hi99 = report.percentile_ci(95, level=0.99)
+    _, lo99, hi99 = report.per_scenario_percentile_mean_ci(95, level=0.99)
     assert hi99 - lo99 > hi - lo
+    # the legacy name still answers, but warns about its misleading reading
+    import pytest as _pytest
+
+    with _pytest.warns(DeprecationWarning, match="per_scenario_percentile"):
+        legacy = report.percentile_ci(95)
+    assert legacy == (point, lo, hi)
 
     c_point, c_lo, c_hi = report.metric_ci(report.results.completed)
     assert c_lo < c_point < c_hi
@@ -125,7 +131,7 @@ def test_confidence_intervals_and_bands() -> None:
     import pytest as _pytest
 
     with _pytest.raises(ValueError, match="confidence level"):
-        report.percentile_ci(95, level=1.5)
+        report.per_scenario_percentile_mean_ci(95, level=1.5)
 
 
 def test_series_requires_fast_path() -> None:
