@@ -70,6 +70,25 @@ from asyncflow_tpu.engines.jaxsim.sampling import (
     truncated_normal,
 )
 from asyncflow_tpu.engines.jaxsim.sortutil import searchsorted_small
+from asyncflow_tpu.observability.simtrace import (
+    FR_ABANDON,
+    FR_ARRIVE_LB,
+    FR_ARRIVE_SRV,
+    FR_COMPLETE,
+    FR_DROP,
+    FR_REJECT,
+    FR_RETRY,
+    FR_RUN,
+    FR_SPAWN,
+    FR_TIMEOUT,
+    FR_TRANSIT,
+    FR_WAIT_CPU,
+    FR_WAIT_DB,
+    FR_WAIT_RAM,
+    TraceConfig,
+    decode_breaker,
+    decode_flight,
+)
 from asyncflow_tpu.observability.telemetry import instrument_jit
 from asyncflow_tpu.engines.results import SimulationResults, SweepResults
 from asyncflow_tpu.schemas.payload import SimulationPayload
@@ -121,6 +140,7 @@ class Engine:
         pool_size: int | None = None,
         max_requests: int | None = None,
         crn: bool = False,
+        trace: TraceConfig | None = None,
     ) -> None:
         """``crn``: common-random-numbers keying — every draw is keyed by
         the REQUEST's identity (spawn sequence + per-request event counter)
@@ -129,6 +149,18 @@ class Engine:
         still hand request r's k-th event the same substream (the coupling
         :func:`asyncflow_tpu.analysis.compare` relies on).  Off by default:
         streams stay bit-identical to pre-CRN builds.
+
+        ``trace``: the simulation-domain flight recorder
+        (:class:`asyncflow_tpu.observability.simtrace.TraceConfig`) — the
+        first ``sample_requests`` spawned requests per scenario record
+        their lifecycle transitions into fixed-size on-device ring buffers
+        written inside the vmapped loop; breaker state transitions go to a
+        per-scenario ring.  Recording consumes no random draws, so every
+        non-trace output is unchanged with it on or off — bit-identical
+        for all discrete outputs (histograms, clocks, counters; pinned by
+        tests/parity/test_flight_recorder.py), to one float32 ulp for the
+        running latency sums (the traced program is a separate XLA
+        compilation, so sum fusion may differ; bench.py --trace-guard).
         """
         if collect_traces and not collect_clocks:
             msg = "collect_traces requires collect_clocks (traces index rows)"
@@ -191,6 +223,12 @@ class Engine:
             raise ValueError(msg)
         self._n_gen = plan.n_generators
         self._crn = crn
+        #: flight recorder (None = statically pruned; the compiled program
+        #: is then bit-identical to pre-trace builds)
+        self.trace = trace
+        self._fr_k = trace.sample_requests if trace is not None else 1
+        self._fr_slots = trace.event_slots if trace is not None else 1
+        self._bk_cap = trace.breaker_slots if trace is not None else 1
         self._compiled: dict = {}
 
     # hop codes (decoded by run_single against the payload's ids)
@@ -215,6 +253,64 @@ class Engine:
                 jnp.where(pred, t, st.req_hop_t[i, j]),
             ),
             req_hop_n=st.req_hop_n.at[i].add(jnp.where(pred, 1, 0)),
+        )
+
+    # ==================================================================
+    # flight recorder (no-ops unless ``trace`` was given; recording never
+    # consumes a draw, so the event stream is identical with it on or off)
+    # ==================================================================
+
+    def _fr_row(self, st: EngineState, row, code, node, t, pred) -> EngineState:
+        """Append one lifecycle event to ring row ``row`` (device-side).
+
+        ``fr_n`` keeps counting past the slot budget — the overflow IS the
+        explicit dropped-events counter surfaced in results."""
+        if self.trace is None:
+            return st
+        ok = pred & (row >= 0)
+        r = jnp.clip(row, 0, self._fr_k - 1)
+        j = st.fr_n[r]
+        write = ok & (j < self._fr_slots)
+        jj = jnp.clip(j, 0, self._fr_slots - 1)
+        code = jnp.int32(code)
+        node = jnp.int32(node)
+        return st._replace(
+            fr_ev=st.fr_ev.at[r, jj].set(
+                jnp.where(write, code, st.fr_ev[r, jj]),
+            ),
+            fr_node=st.fr_node.at[r, jj].set(
+                jnp.where(write, node, st.fr_node[r, jj]),
+            ),
+            fr_t=st.fr_t.at[r, jj].set(
+                jnp.where(write, jnp.float32(t), st.fr_t[r, jj]),
+            ),
+            fr_n=st.fr_n.at[r].add(jnp.where(ok, 1, 0)),
+        )
+
+    def _fr(self, st: EngineState, i, code, node, t, pred) -> EngineState:
+        """Record for pool slot ``i``'s request (untraced slots no-op)."""
+        if self.trace is None:
+            return st
+        return self._fr_row(st, st.req_fr[i], code, node, t, pred)
+
+    def _bk(self, st: EngineState, slot, state, t, pred) -> EngineState:
+        """Append one circuit-breaker state transition to the scenario ring."""
+        if self.trace is None:
+            return st
+        j = st.bk_n
+        write = pred & (j < self._bk_cap)
+        jj = jnp.clip(j, 0, self._bk_cap - 1)
+        return st._replace(
+            bk_t=st.bk_t.at[jj].set(
+                jnp.where(write, jnp.float32(t), st.bk_t[jj]),
+            ),
+            bk_slot=st.bk_slot.at[jj].set(
+                jnp.where(write, jnp.int32(slot), st.bk_slot[jj]),
+            ),
+            bk_state=st.bk_state.at[jj].set(
+                jnp.where(write, jnp.int32(state), st.bk_state[jj]),
+            ),
+            bk_n=st.bk_n + jnp.where(write, 1, 0),
         )
 
     # ==================================================================
@@ -437,6 +533,9 @@ class Engine:
             ),
             n_retries=st.n_retries + jnp.where(can, 1, 0),
         )
+        if self.trace is not None:
+            st = self._fr(st, i, FR_RETRY, attempt, now, can)
+            st = self._fr(st, i, FR_ABANDON, attempt, now, tracked & ~can)
         return self._record_attempts(st, attempt, tracked & ~can)
 
     def _timeout_branch(self, st: EngineState, i, now, key, ov, pred) -> EngineState:
@@ -479,6 +578,24 @@ class Engine:
         )
         if self._has_llm:
             st = st._replace(req_llm=st.req_llm.at[idx].set(0.0, mode="drop"))
+        if self.trace is not None:
+            # the logical request's record rides its ring row: the orphaned
+            # slot stops recording (oracle contract: orphan completions are
+            # invisible) and the backoff re-issue slot inherits the row
+            row0 = st.req_fr[i]
+            st = self._fr_row(st, row0, FR_TIMEOUT, attempt, now, pred)
+            st = self._fr_row(st, row0, FR_RETRY, attempt, now, place)
+            st = self._fr_row(st, row0, FR_ABANDON, attempt, now, pred & ~place)
+            st = st._replace(
+                req_fr=st.req_fr.at[idx].set(row0, mode="drop"),
+            )
+            # the orphaned slot always detaches (the re-issue slot, when
+            # placed, is a different — free — slot, so this never undoes it)
+            st = st._replace(
+                req_fr=st.req_fr.at[i].set(
+                    jnp.where(pred, -1, st.req_fr[i]),
+                ),
+            )
         # gave up: attempt cap, budget denial, or pool overflow
         return self._record_attempts(st, attempt, pred & ~place)
 
@@ -491,6 +608,8 @@ class Engine:
         plan = self.plan
         alive = pred
         t_cur = now
+        if self.trace is not None:
+            st = self._fr(st, i, FR_SPAWN, 0, now, pred)
         for j, eidx in enumerate(plan.entry_edges.tolist()):
             e = jnp.int32(eidx)
             dropped, delay = self._sample_edge(
@@ -501,6 +620,11 @@ class Engine:
             st = st._replace(
                 n_dropped=st.n_dropped + jnp.where(alive & dropped, 1, 0),
             )
+            if self.trace is not None:
+                st = self._fr(st, i, FR_DROP, e, t_cur, alive & dropped)
+                st = self._fr(
+                    st, i, FR_TRANSIT, e, t_cur + delay, survives,
+                )
             t_cur = jnp.where(survives, t_cur + delay, t_cur)
             alive = survives
         ev0 = (
@@ -572,6 +696,8 @@ class Engine:
                     mode="drop",
                 ),
             )
+        if self.trace is not None:
+            st = self._fr(st, i, FR_COMPLETE, -1, now, done)
         st = self._complete(st, st.req_start[i], now, done)
         return st._replace(
             req_ev=st.req_ev.at[i].set(jnp.where(pred, EV_IDLE, st.req_ev[i])),
@@ -795,6 +921,13 @@ class Engine:
         a pool slot at the first stateful node, schedule the next arrival."""
         plan = self.plan
         st = st._replace(n_generated=st.n_generated + jnp.where(pred, 1, 0))
+        fr_row = jnp.int32(-1)
+        if self.trace is not None:
+            # deterministic sampling: the first K spawns own ring rows
+            # (n_generated was just incremented, so the 0-based spawn
+            # sequence of this lane is n_generated - 1)
+            seq = st.n_generated - 1
+            fr_row = jnp.where(pred & (seq < self._fr_k), seq, jnp.int32(-1))
 
         if self._n_gen > 1:
             # multi-generator: the spawning stream is the earliest
@@ -820,6 +953,8 @@ class Engine:
             key_gi = (
                 jax.random.fold_in(key, 100000 + gi) if len(chains) > 1 else key
             )
+            if self.trace is not None:
+                st = self._fr_row(st, fr_row, FR_SPAWN, gi, now, pred_gi)
             for j, eidx in enumerate(chain):
                 e = jnp.int32(eidx)
                 dropped, delay = self._sample_edge(
@@ -833,6 +968,13 @@ class Engine:
                 st = st._replace(
                     n_dropped=st.n_dropped + jnp.where(pred_gi & dropped, 1, 0),
                 )
+                if self.trace is not None:
+                    st = self._fr_row(
+                        st, fr_row, FR_DROP, e, t_gi, pred_gi & dropped,
+                    )
+                    st = self._fr_row(
+                        st, fr_row, FR_TRANSIT, e, t_gi + delay, survives,
+                    )
                 t_gi = jnp.where(survives, t_gi + delay, t_gi)
                 pred_gi = survives
                 hop_chain.append((gi, eidx, t_gi))
@@ -897,6 +1039,17 @@ class Engine:
             req_ticket=st.req_ticket.at[idx].set(NO_TICKET, mode="drop"),
             n_overflow=st.n_overflow + jnp.where(overflow, 1, 0),
         )
+        if self.trace is not None:
+            # claim (or reset, on slot reuse) the placed slot's ring row
+            st = st._replace(
+                req_fr=st.req_fr.at[idx].set(fr_row, mode="drop"),
+            )
+            if self._has_retry:
+                st = self._fr_row(st, fr_row, FR_RETRY, 1, now, place_retry)
+                st = self._fr_row(
+                    st, fr_row, FR_ABANDON, 1, now, failed & ~place_retry,
+                )
+            st = self._fr_row(st, fr_row, FR_REJECT, -1, now, overflow)
         if self._crn:
             # the slot's request identity: the arrival counter at spawn
             # (already incremented for this iteration, so values are >= 1)
@@ -1052,6 +1205,12 @@ class Engine:
             ),
             req_seg=st.req_seg.at[i].set(jnp.where(pred, seg, st.req_seg[i])),
         )
+        if self.trace is not None:
+            st = self._fr(st, i, FR_WAIT_CPU, s, now, cpu_wait)
+            if self._has_db:
+                st = self._fr(st, i, FR_WAIT_DB, s, now, db_wait)
+            if self._has_shed:
+                st = self._fr(st, i, FR_REJECT, s, now, shed)
         st = self._gauge_add(st, now, self._g_ready(s), 1.0, cpu_wait)
         st = self._gauge_add(st, now, self._g_io(s), 1.0, is_io)
         if self._has_shed:
@@ -1173,6 +1332,9 @@ class Engine:
             # ``arrive`` instead of being folded into this exit event
             if self.collect_traces:
                 st = self._hop(st, i, self.HOP_EDGE + e, arrive, pred & ~dropped)
+            if self.trace is not None:
+                st = self._fr(st, i, FR_TRANSIT, e, arrive, pred & ~dropped)
+                st = self._fr(st, i, FR_DROP, e, now, drop_here)
             st = st._replace(
                 req_ev=st.req_ev.at[i].set(
                     jnp.where(
@@ -1240,6 +1402,10 @@ class Engine:
                     mode="drop",
                 ),
             )
+        if self.trace is not None:
+            st = self._fr(st, i, FR_TRANSIT, e, arrive, pred & ~dropped)
+            st = self._fr(st, i, FR_DROP, e, now, drop_here)
+            st = self._fr(st, i, FR_COMPLETE, -1, arrive, done)
         st = self._complete(
             st,
             st.req_start[i],
@@ -1306,6 +1472,8 @@ class Engine:
         consec = st.cb_consec[slot] + jnp.where(c_fail, 1, 0)
         trips = c_fail & (consec >= plan.breaker_threshold)
         opens = p_fail | trips
+        if self.trace is not None:
+            st = self._bk(st, slot, 1, now, opens)
         st = st._replace(
             cb_consec=st.cb_consec.at[slot].set(
                 jnp.where(
@@ -1329,6 +1497,8 @@ class Engine:
         p_ok = probe & ~failed
         probe_ok = st.cb_probe_ok[slot] + jnp.where(p_ok, 1, 0)
         closes = p_ok & (stt == 2) & (probe_ok >= plan.breaker_probes)
+        if self.trace is not None:
+            st = self._bk(st, slot, 0, now, closes)
         return st._replace(
             cb_probe_ok=st.cb_probe_ok.at[slot].set(probe_ok),
             cb_state=st.cb_state.at[slot].set(
@@ -1378,6 +1548,10 @@ class Engine:
                 cb_probes_out=jnp.where(wake, 0, st.cb_probes_out),
                 cb_probe_ok=jnp.where(wake, 0, st.cb_probe_ok),
             )
+            if self.trace is not None:
+                # lazy open -> half-open wakes, one ring entry per slot
+                for k in range(max(self.plan.n_lb_edges, 1)):
+                    st = self._bk(st, k, 2, now, wake[k])
             admits = (st.cb_state == 0) | (
                 (st.cb_state == 2)
                 & (st.cb_probes_out < self.plan.breaker_probes)
@@ -1435,6 +1609,13 @@ class Engine:
         st = self._hop(st, i, self.HOP_LB, now, pred)
         st = self._hop(st, i, self.HOP_EDGE + p.lb_edge_index[slot], arrive, ok)
         st = self._edge_interval(st, e, now, arrive, ok)
+        if self.trace is not None:
+            st = self._fr(st, i, FR_ARRIVE_LB, -1, now, pred)
+            if self._has_breaker:
+                st = self._fr(st, i, FR_REJECT, -1, now, reject)
+            st = self._fr(st, i, FR_DROP, -1, now, drop_empty)
+            st = self._fr(st, i, FR_DROP, e, now, drop_edge)
+            st = self._fr(st, i, FR_TRANSIT, e, arrive, ok)
         free = drop_empty | drop_edge
         client_fail = (free | reject) if self._has_breaker else free
         st = st._replace(
@@ -1490,6 +1671,8 @@ class Engine:
                 ),
                 n_rejected=st.n_rejected + jnp.where(dark, 1, 0),
             )
+            if self.trace is not None:
+                st = self._fr(st, i, FR_REJECT, s, now, dark)
             st = self._breaker_server_report(
                 st, i, now, jnp.bool_(True), dark,
             )
@@ -1525,6 +1708,8 @@ class Engine:
                 ),
                 n_rejected=st.n_rejected + jnp.where(limited, 1, 0),
             )
+            if self.trace is not None:
+                st = self._fr(st, i, FR_REJECT, s, now, limited)
             st = self._breaker_server_report(
                 st, i, now, jnp.bool_(True), limited,
             )
@@ -1543,6 +1728,8 @@ class Engine:
                 ),
                 n_rejected=st.n_rejected + jnp.where(refuse, 1, 0),
             )
+            if self.trace is not None:
+                st = self._fr(st, i, FR_REJECT, s, now, refuse)
             st = self._breaker_server_report(
                 st, i, now, jnp.bool_(True), refuse,
             )
@@ -1553,6 +1740,8 @@ class Engine:
             )
 
         st = self._hop(st, i, self.HOP_SERVER + s, now, pred)
+        if self.trace is not None:
+            st = self._fr(st, i, FR_ARRIVE_SRV, s, now, pred)
         u = draw_uniform(jax.random.fold_in(key, 16))
         # weighted endpoint pick (uniform weights lower to the evenly
         # spaced cumulative table, preserving the reference's behavior)
@@ -1588,6 +1777,8 @@ class Engine:
                 jnp.where(blocked, st.ram_ticket[s], st.req_ticket[i]),
             ),
         )
+        if self.trace is not None:
+            st = self._fr(st, i, FR_WAIT_RAM, s, now, blocked)
         st = self._gauge_add(st, now, self._g_ram(s), need, granted & (need > 0))
         return self._seg_start(st, i, s, ep, jnp.int32(0), now, key, ov, granted)
 
@@ -1604,6 +1795,8 @@ class Engine:
             st.req_ram[i],
             pred & (st.req_ram[i] > 0),
         )
+        if self.trace is not None:
+            st = self._fr(st, i, FR_RUN, s, now, pred)
         return self._seg_start(st, i, s, ep, jnp.int32(0), now, key, ov, pred)
 
     def _cpu_handoff(self, st, s, now, was_cpu) -> EngineState:
@@ -1638,6 +1831,8 @@ class Engine:
             req_t=st.req_t.at[jidx].set(t_next, mode="drop"),
             req_ticket=st.req_ticket.at[jidx].set(NO_TICKET, mode="drop"),
         )
+        if self.trace is not None:
+            st = self._fr(st, j, FR_RUN, s, now, grant)
         return self._gauge_add(st, now, self._g_ready(s), -1.0, grant)
 
     def _abandon_branch(self, st, i, now, key, ov, pred) -> EngineState:
@@ -1658,6 +1853,8 @@ class Engine:
             req_ram=st.req_ram.at[i].set(jnp.where(pred, 0.0, st.req_ram[i])),
             n_rejected=st.n_rejected + jnp.where(pred, 1, 0),
         )
+        if self.trace is not None:
+            st = self._fr(st, i, FR_REJECT, s, now, pred)
         st = self._breaker_server_report(st, i, now, jnp.bool_(True), pred)
         return self._client_fail(st, i, now, key, pred)
 
@@ -1694,6 +1891,8 @@ class Engine:
                 req_t=st.req_t.at[djidx].set(now + djdur, mode="drop"),
                 req_ticket=st.req_ticket.at[djidx].set(NO_TICKET, mode="drop"),
             )
+            if self.trace is not None:
+                st = self._fr(st, dj, FR_RUN, s, now, dgrant)
 
         # leave the IO queue
         st = self._gauge_add(st, now, self._g_io(s), -1.0, was_io)
@@ -1864,6 +2063,42 @@ class Engine:
             req_seq=jnp.zeros(pool if self._crn else 1, jnp.int32),
             req_draws=jnp.zeros(pool if self._crn else 1, jnp.int32),
             arr_ctr=jnp.int32(0),
+            req_fr=(
+                jnp.full(pool, -1, jnp.int32)
+                if self.trace is not None
+                else jnp.zeros(1, jnp.int32)
+            ),
+            fr_ev=jnp.zeros(
+                (self._fr_k, self._fr_slots)
+                if self.trace is not None
+                else (1, 1),
+                jnp.int32,
+            ),
+            fr_node=jnp.zeros(
+                (self._fr_k, self._fr_slots)
+                if self.trace is not None
+                else (1, 1),
+                jnp.int32,
+            ),
+            fr_t=jnp.zeros(
+                (self._fr_k, self._fr_slots)
+                if self.trace is not None
+                else (1, 1),
+                jnp.float32,
+            ),
+            fr_n=jnp.zeros(
+                self._fr_k if self.trace is not None else 1, jnp.int32,
+            ),
+            bk_t=jnp.zeros(
+                self._bk_cap if self.trace is not None else 1, jnp.float32,
+            ),
+            bk_slot=jnp.zeros(
+                self._bk_cap if self.trace is not None else 1, jnp.int32,
+            ),
+            bk_state=jnp.zeros(
+                self._bk_cap if self.trace is not None else 1, jnp.int32,
+            ),
+            bk_n=jnp.int32(0),
         )
         # first arrival (gap from t=0), per generator stream
         if self._n_gen > 1:
@@ -2192,6 +2427,20 @@ def run_single(
     if tracing and engine == "fast":
         msg = "collect_traces needs the event engine (engine='event'/'auto')"
         raise ValueError(msg)
+    # the flight recorder records per-event lifecycle state the closed-form
+    # fast path never materializes; 'auto' routes traced runs to the event
+    # engine, forcing 'fast' is an explicit error
+    trace = engine_kw.pop("trace", None)
+    if trace is not None and not isinstance(trace, TraceConfig):
+        trace = TraceConfig.model_validate(trace)
+    if trace is not None and engine == "fast":
+        msg = (
+            "the flight recorder (trace=TraceConfig) needs the event "
+            "engine: the scan fast path computes request trajectories in "
+            "closed form and has no per-event state to record — use "
+            "engine='event' (or 'auto', which routes traced runs there)"
+        )
+        raise ValueError(msg)
     # Gauge recording is gated on the settings like the oracle's collector —
     # unless the caller explicitly forced it, in which case everything
     # recorded is also returned.
@@ -2205,7 +2454,11 @@ def run_single(
     # engine rather than silently discarding the tuning on the fast path
     pool_tuned = "pool_size" in engine_kw
     use_fast = engine == "fast" or (
-        engine == "auto" and plan.fastpath_ok and not pool_tuned and not tracing
+        engine == "auto"
+        and plan.fastpath_ok
+        and not pool_tuned
+        and not tracing
+        and trace is None
     )
     if use_fast:
         from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
@@ -2215,7 +2468,9 @@ def run_single(
             raise ValueError(msg)
         sim_engine: Engine | FastEngine = FastEngine(plan, **engine_kw)
     else:
-        sim_engine = Engine(plan, collect_traces=tracing, **engine_kw)
+        sim_engine = Engine(
+            plan, collect_traces=tracing, trace=trace, **engine_kw,
+        )
     final = sim_engine.run_batch(scenario_keys(seed, 1))
     state = jax.tree.map(lambda x: np.asarray(x[0]), final)
 
@@ -2298,6 +2553,15 @@ def run_single(
         traces = decode_hop_traces(
             plan, payload, state.tr_code, state.tr_t, state.tr_n, n_tr,
         )
+    flight = None
+    breaker_timeline = None
+    if trace is not None:
+        flight = decode_flight(
+            state.fr_ev, state.fr_node, state.fr_t, state.fr_n,
+        )
+        breaker_timeline = decode_breaker(
+            state.bk_t, state.bk_slot, state.bk_state, state.bk_n,
+        )
 
     llm_cost = None
     if plan.has_llm and sim_engine.collect_clocks and hasattr(state, "llm_store"):
@@ -2314,6 +2578,8 @@ def run_single(
         server_ids=plan.server_ids,
         edge_ids=plan.edge_ids,
         traces=traces,
+        flight=flight,
+        breaker_timeline=breaker_timeline,
         llm_cost=llm_cost,
         total_timed_out=int(getattr(state, "n_timed_out", 0)),
         total_retries=int(getattr(state, "n_retries", 0)),
@@ -2451,4 +2717,24 @@ def sweep_results(
             else None
         ),
         truncated=engine_truncated(engine, final),
+        flight_ev=(
+            np.asarray(final.fr_ev)
+            if getattr(engine, "trace", None) is not None
+            else None
+        ),
+        flight_node=(
+            np.asarray(final.fr_node)
+            if getattr(engine, "trace", None) is not None
+            else None
+        ),
+        flight_t=(
+            np.asarray(final.fr_t)
+            if getattr(engine, "trace", None) is not None
+            else None
+        ),
+        flight_n=(
+            np.asarray(final.fr_n)
+            if getattr(engine, "trace", None) is not None
+            else None
+        ),
     )

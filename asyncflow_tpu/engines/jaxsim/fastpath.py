@@ -400,7 +400,17 @@ class FastEngine:
         relax_sweeps: int | None = None,
         relax_damping: float = 0.0,
         gauge_series_stride: int = 0,
+        trace=None,
     ) -> None:
+        if trace is not None:
+            msg = (
+                "the flight recorder (trace=TraceConfig) needs per-event "
+                "request state; the scan fast path computes trajectories "
+                "in closed form and records none — run the event engine "
+                "(SimulationRunner engine_options/SweepRunner with "
+                "engine='event', or 'auto', which routes traced runs there)"
+            )
+            raise ValueError(msg)
         """``gauge_series_stride``: with ``collect_gauges=False``, a stride
         k > 0 collects every gauge on a grid coarsened k-fold
         (period ``sample_period * k``) — the sweep-scale streaming series:

@@ -329,12 +329,21 @@ class PallasEngine:
         block: int = 128,
         interpret: bool | None = None,
         mesh=None,
+        trace=None,
     ) -> None:
         """``mesh``: an optional 1-D scenario mesh; when given, ``run_batch``
         wraps the kernel in :func:`jax.shard_map` so each device runs the
         kernel on its scenario shard (the kernel itself is a single-device
         program — GSPMD cannot partition a ``pallas_call``, so the sharding
         seam has to be explicit)."""
+        if trace is not None:
+            msg = (
+                "the flight recorder (trace=TraceConfig) is not carried by "
+                "the Pallas VMEM kernel (its state must fit VMEM; per-"
+                "request event rings do not) — use the XLA event engine "
+                "(engine='event')"
+            )
+            raise ValueError(msg)
         if plan.has_faults or plan.has_retry:
             msg = (
                 "the Pallas VMEM kernel does not model fault windows / "
@@ -1622,7 +1631,15 @@ class PallasEngine:
         self,
         keys: jnp.ndarray,
         overrides: ScenarioOverrides | None = None,
+        *,
+        antithetic: bool = False,
     ) -> PallasState:
+        # accepted for sweep-dispatch signature compatibility only: the
+        # constructor already refuses VR coupling, so this can never be
+        # reached with True (SweepRunner raises at construction)
+        if antithetic:  # pragma: no cover - double fence
+            msg = "the Pallas kernel does not trace antithetic draw variants"
+            raise ValueError(msg)
         args, sig, s = self._prepare(keys, overrides)
         call = self._get_call(sig)
         try:

@@ -226,6 +226,22 @@ class EngineState(NamedTuple):
     req_seq: jnp.ndarray  # (P,) i32 (or (1,))
     req_draws: jnp.ndarray  # (P,) i32 (or (1,))
     arr_ctr: jnp.ndarray  # scalar i32
+    # flight recorder (observability/simtrace.py) — size (1, 1)/(1,)
+    # placeholders unless the engine was built with ``trace=TraceConfig``.
+    # The first K spawned logical requests each own one ring row of
+    # ``event_slots`` (code, node, t) entries; ``fr_n`` keeps counting past
+    # the budget so truncation is explicit.  ``req_fr`` maps a pool slot to
+    # its ring row (-1 = untraced / orphaned).  ``bk_*`` is the scenario's
+    # circuit-breaker state-transition ring.
+    req_fr: jnp.ndarray  # (P,) i32 ring row or -1
+    fr_ev: jnp.ndarray  # (K, S) i32 lifecycle codes (simtrace.FR_*)
+    fr_node: jnp.ndarray  # (K, S) i32 component index / attempt number
+    fr_t: jnp.ndarray  # (K, S) f32 sim timestamps
+    fr_n: jnp.ndarray  # (K,) i32 events recorded (may exceed S)
+    bk_t: jnp.ndarray  # (C,) f32 breaker transition times
+    bk_slot: jnp.ndarray  # (C,) i32 LB rotation slot
+    bk_state: jnp.ndarray  # (C,) i32 new state (0/1/2)
+    bk_n: jnp.ndarray  # scalar i32
 
 
 class ScenarioOverrides(NamedTuple):

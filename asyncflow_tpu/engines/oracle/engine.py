@@ -46,6 +46,24 @@ from asyncflow_tpu.engines.oracle.kernel import (
     Timeout,
 )
 from asyncflow_tpu.engines.results import SimulationResults
+from asyncflow_tpu.observability.simtrace import (
+    FR_ABANDON,
+    FR_ARRIVE_LB,
+    FR_ARRIVE_SRV,
+    FR_COMPLETE,
+    FR_DROP,
+    FR_REJECT,
+    FR_RETRY,
+    FR_RUN,
+    FR_SPAWN,
+    FR_TIMEOUT,
+    FR_TRANSIT,
+    FR_WAIT_CPU,
+    FR_WAIT_DB,
+    FR_WAIT_RAM,
+    FlightRecord,
+    TraceConfig,
+)
 from asyncflow_tpu.samplers.arrivals import arrival_gaps
 from asyncflow_tpu.samplers.variates import sample_rv
 from asyncflow_tpu.schemas.edges import Edge
@@ -84,6 +102,10 @@ class Request:
     attempt: int = 1
     orphan: bool = False
     settled: bool = False
+    #: flight-recorder ring of the logical request (None = untraced or
+    #: orphaned; the record survives client retries — the re-issue carries
+    #: the same object)
+    fr: FlightRecord | None = None
 
     def record_hop(self, kind: str, component_id: str, now: float) -> None:
         self.history.append(Hop(kind, component_id, now))
@@ -116,6 +138,13 @@ class _EdgeRuntime:
                 engine.sim.now,
             )
             engine.total_dropped += 1
+            if engine.trace is not None:
+                engine._fr(
+                    req,
+                    FR_DROP,
+                    engine._edge_idx[self.cfg.id],
+                    engine.sim.now,
+                )
             if req.lb_edge_id == self.cfg.id:
                 # a dropped send on the routing edge is a connection
                 # failure to the breaker
@@ -134,6 +163,13 @@ class _EdgeRuntime:
                 self.cfg.id,
                 engine.sim.now,
             )
+            if engine.trace is not None:
+                engine._fr(
+                    req,
+                    FR_TRANSIT,
+                    engine._edge_idx[self.cfg.id],
+                    engine.sim.now,
+                )
             self.concurrent -= 1
             assert self.deliver_to is not None
             self.deliver_to(req)
@@ -206,6 +242,9 @@ class _ServerRuntime:
                 SystemNodes.SERVER, f"{self.cfg.id}-outage", engine.sim.now,
             )
             engine.total_rejected += 1
+            engine._fr(
+                req, FR_REJECT, engine._server_idx[self.cfg.id], engine.sim.now,
+            )
             engine.breaker_failure(req)
             engine.client_fail(req)
             return
@@ -223,6 +262,9 @@ class _ServerRuntime:
                     SystemNodes.SERVER, f"{self.cfg.id}-rate-limited", now,
                 )
                 engine.total_rejected += 1
+                engine._fr(
+                    req, FR_REJECT, engine._server_idx[self.cfg.id], now,
+                )
                 engine.breaker_failure(req)
                 engine.client_fail(req)
                 return
@@ -236,6 +278,9 @@ class _ServerRuntime:
                 engine.sim.now,
             )
             engine.total_rejected += 1
+            engine._fr(
+                req, FR_REJECT, engine._server_idx[self.cfg.id], engine.sim.now,
+            )
             engine.breaker_failure(req)
             engine.client_fail(req)
             return
@@ -251,6 +296,10 @@ class _ServerRuntime:
     def _run_endpoint(self, req: Request):
         engine = self.engine
         req.record_hop(SystemNodes.SERVER, self.cfg.id, engine.sim.now)
+        tracing = engine.trace is not None
+        srv_idx = engine._server_idx[self.cfg.id] if tracing else -1
+        if tracing:
+            engine._fr(req, FR_ARRIVE_SRV, srv_idx, engine.sim.now)
 
         endpoints = self.cfg.endpoints
         endpoint = endpoints[
@@ -262,7 +311,14 @@ class _ServerRuntime:
         total_ram = sum(step.quantity for step in endpoint.steps if step.is_ram)
 
         if total_ram:
+            ram_waits = tracing and (
+                self.ram.would_block or self.ram.level < total_ram
+            )
+            if ram_waits:
+                engine._fr(req, FR_WAIT_RAM, srv_idx, engine.sim.now)
             yield AcquireAmount(self.ram, total_ram)
+            if ram_waits:
+                engine._fr(req, FR_RUN, srv_idx, engine.sim.now)
             self.ram_in_use += total_ram
 
         core_locked = False
@@ -292,16 +348,25 @@ class _ServerRuntime:
                                 engine.sim.now,
                             )
                             engine.total_rejected += 1
+                            engine._fr(
+                                req, FR_REJECT, srv_idx, engine.sim.now,
+                            )
                             engine.breaker_failure(req)
                             engine.client_fail(req)
                             return
                         waiting_cpu = True
                         self.ready_queue_len += 1
+                        if tracing:
+                            engine._fr(
+                                req, FR_WAIT_CPU, srv_idx, engine.sim.now,
+                            )
                     wait_started = engine.sim.now
                     yield AcquireToken(self.cpu)
                     if waiting_cpu:
                         waiting_cpu = False
                         self.ready_queue_len -= 1
+                        if tracing:
+                            engine._fr(req, FR_RUN, srv_idx, engine.sim.now)
                         if (
                             self.queue_timeout is not None
                             and engine.sim.now - wait_started > self.queue_timeout
@@ -320,6 +385,9 @@ class _ServerRuntime:
                                 engine.sim.now,
                             )
                             engine.total_rejected += 1
+                            engine._fr(
+                                req, FR_REJECT, srv_idx, engine.sim.now,
+                            )
                             engine.breaker_failure(req)
                             engine.client_fail(req)
                             return
@@ -338,7 +406,12 @@ class _ServerRuntime:
                 if self.db is not None and step.kind == EndpointStepIO.DB:
                     # hold one of K FIFO connections for the query; the
                     # wait (if any) parks in the event loop like any await
+                    db_waits = tracing and self.db.would_block
+                    if db_waits:
+                        engine._fr(req, FR_WAIT_DB, srv_idx, engine.sim.now)
                     yield AcquireToken(self.db)
+                    if db_waits:
+                        engine._fr(req, FR_RUN, srv_idx, engine.sim.now)
                     yield Timeout(step.quantity)
                     self.db.release()
                 elif step.is_stochastic_cache:
@@ -382,6 +455,7 @@ class OracleEngine:
         *,
         seed: int | None = None,
         collect_traces: bool = False,
+        trace: TraceConfig | None = None,
     ) -> None:
         self.payload = payload
         self.settings = payload.sim_settings
@@ -389,6 +463,15 @@ class OracleEngine:
         self.rng = np.random.default_rng(seed)
         self.collect_traces = collect_traces
         self.traces: dict[int, list[tuple[str, str, float]]] = {}
+        #: flight recorder (observability/simtrace.py): same sampling rule
+        #: and record layout as the jax event engine, emitted from this
+        #: heap loop — the streams are diffable event-by-event.  Recording
+        #: consumes no draws, so results are identical with it on or off.
+        if trace is not None and not isinstance(trace, TraceConfig):
+            trace = TraceConfig.model_validate(trace)
+        self.trace = trace
+        self.flight: dict[int, FlightRecord] = {}
+        self.breaker_timeline: list[tuple[float, int, int]] = []
 
         self.total_generated = 0
         self.total_dropped = 0
@@ -458,6 +541,42 @@ class OracleEngine:
         self._entry_gen_id: str | None = None
 
         self._wire()
+        #: generator index (FR_SPAWN node field) in payload order — the
+        #: same indexing the jax engine's chains use
+        self._gen_fr_idx = {g.id: i for i, g in enumerate(payload.generators)}
+        #: LB rotation slot of each out-edge in topology order (the jax
+        #: engine's static slot indexing; rotation mutations don't renumber)
+        self._lb_slot_idx = {
+            eid: k for k, eid in enumerate(self.lb_out_edges)
+        }
+
+    # ------------------------------------------------------------------
+    # flight recorder (no-ops unless ``trace`` was given; identical record
+    # layout to the jax event engine — see observability/simtrace.py)
+    # ------------------------------------------------------------------
+
+    def _fr_rec(
+        self, rec: FlightRecord | None, code: int, node: int, t: float,
+    ) -> None:
+        if rec is None or self.trace is None:
+            return
+        if len(rec.events) < self.trace.event_slots:
+            rec.events.append((code, node, t))
+        else:
+            rec.dropped += 1
+
+    def _fr(self, req: Request, code: int, node: int, t: float) -> None:
+        if self.trace is not None:
+            self._fr_rec(req.fr, code, node, t)
+
+    def _bk_rec(self, edge_id: str, state: int, t: float) -> None:
+        """One circuit-breaker state transition (bounded like the ring)."""
+        if self.trace is None:
+            return
+        if len(self.breaker_timeline) < self.trace.breaker_slots:
+            self.breaker_timeline.append(
+                (t, self._lb_slot_idx.get(edge_id, -1), state),
+            )
 
     # ------------------------------------------------------------------
     # build phase
@@ -513,6 +632,14 @@ class OracleEngine:
                 workload.id,
                 self.sim.now,
             )
+            if self.trace is not None:
+                # deterministic sampling: the first K spawns are traced
+                seq = self.total_generated - 1
+                if seq < self.trace.sample_requests:
+                    req.fr = self.flight.setdefault(seq, FlightRecord(req=seq))
+                self._fr(
+                    req, FR_SPAWN, self._gen_fr_idx[workload.id], self.sim.now,
+                )
             if self.retry.enabled:
                 self.sim.after(
                     self.retry.timeout,
@@ -532,6 +659,7 @@ class OracleEngine:
                 req.settled = True
                 return
             req.settled = True
+            self._fr(req, FR_COMPLETE, -1, self.sim.now)
             if self.retry.enabled:
                 self._record_attempts(req.attempt)
             self.rqs_clock.append((req.initial_time, req.finish_time))
@@ -548,11 +676,13 @@ class OracleEngine:
     def _lb_receive(self, req: Request) -> None:
         assert self.lb is not None
         req.record_hop(SystemNodes.LOAD_BALANCER, self.lb.id, self.sim.now)
+        self._fr(req, FR_ARRIVE_LB, -1, self.sim.now)
         if not self.lb_out_edges:
             # Every covered server is down (possible when the LB covers a
             # subset of the declared servers): the request has nowhere to go.
             req.finish_time = self.sim.now
             self.total_dropped += 1
+            self._fr(req, FR_DROP, -1, self.sim.now)
             self.client_fail(req)
             return
         out = self._pick_lb_edge()
@@ -567,6 +697,7 @@ class OracleEngine:
                 self.sim.now,
             )
             self.total_rejected += 1
+            self._fr(req, FR_REJECT, -1, self.sim.now)
             self.client_fail(req)
             return
         if self.breaker is not None:
@@ -597,6 +728,7 @@ class OracleEngine:
             st["state"] = 2
             st["probes_out"] = 0
             st["probe_ok"] = 0
+            self._bk_rec(edge_id, 2, now)
         if st["state"] == 2:
             return st["probes_out"] < self.breaker.half_open_probes
         return True
@@ -634,7 +766,8 @@ class OracleEngine:
     def breaker_failure(self, req: Request) -> None:
         if self.breaker is None or req.lb_edge_id is None:
             return
-        st = self._breaker_st(req.lb_edge_id)
+        edge_id = req.lb_edge_id
+        st = self._breaker_st(edge_id)
         req.lb_edge_id = None
         now = self.sim.now
         if req.probe:
@@ -643,6 +776,7 @@ class OracleEngine:
             # a probe failure re-opens immediately
             st["state"] = 1
             st["open_until"] = now + self.breaker.cooldown_s
+            self._bk_rec(edge_id, 1, now)
             return
         if st["state"] == 0:
             st["consec"] += 1
@@ -650,11 +784,13 @@ class OracleEngine:
                 st["state"] = 1
                 st["open_until"] = now + self.breaker.cooldown_s
                 st["consec"] = 0
+                self._bk_rec(edge_id, 1, now)
 
     def breaker_success(self, req: Request) -> None:
         if self.breaker is None or req.lb_edge_id is None:
             return
-        st = self._breaker_st(req.lb_edge_id)
+        edge_id = req.lb_edge_id
+        st = self._breaker_st(edge_id)
         req.lb_edge_id = None
         if req.probe:
             req.probe = False
@@ -663,6 +799,7 @@ class OracleEngine:
             if st["state"] == 2 and st["probe_ok"] >= self.breaker.half_open_probes:
                 st["state"] = 0
                 st["consec"] = 0
+                self._bk_rec(edge_id, 0, self.sim.now)
             return
         if st["state"] == 0:
             st["consec"] = 0
@@ -735,7 +872,13 @@ class OracleEngine:
             return
         req.orphan = True
         self.total_timed_out += 1
-        self._maybe_reissue(req)
+        # the logical request's record detaches from the orphaned attempt
+        # (its server-side tail is invisible, like its completion) and
+        # rides any re-issue instead
+        fr = req.fr
+        self._fr_rec(fr, FR_TIMEOUT, req.attempt, self.sim.now)
+        req.fr = None
+        self._maybe_reissue(req, fr)
 
     def client_fail(self, req: Request) -> None:
         """A tracked attempt failed (drop / refusal / shed / abandon /
@@ -750,11 +893,17 @@ class OracleEngine:
         req.settled = True
         self._maybe_reissue(req)
 
-    def _maybe_reissue(self, req: Request) -> None:
+    def _maybe_reissue(
+        self, req: Request, fr: FlightRecord | None = None,
+    ) -> None:
+        if fr is None:
+            fr = req.fr
         if req.attempt >= self.retry.max_attempts or not self._retry_token():
+            self._fr_rec(fr, FR_ABANDON, req.attempt, self.sim.now)
             self._record_attempts(req.attempt)
             return
         self.total_retries += 1
+        self._fr_rec(fr, FR_RETRY, req.attempt, self.sim.now)
         delay = self._backoff(req.attempt)
         attempt = req.attempt + 1
 
@@ -763,11 +912,13 @@ class OracleEngine:
                 id=req.id,
                 initial_time=self.sim.now,
                 attempt=attempt,
+                fr=fr,
             )
             if self._entry_gen_id is not None:
                 new_req.record_hop(
                     SystemNodes.GENERATOR, self._entry_gen_id, self.sim.now,
                 )
+            self._fr(new_req, FR_SPAWN, 0, self.sim.now)
             self.issue(new_req)
 
         self.sim.after(delay, reissue)
@@ -914,6 +1065,10 @@ class OracleEngine:
             server_ids=list(self.servers),
             edge_ids=list(self.edges),
             traces=self.traces if self.collect_traces else None,
+            flight=self.flight if self.trace is not None else None,
+            breaker_timeline=(
+                self.breaker_timeline if self.trace is not None else None
+            ),
             llm_cost=(
                 np.asarray(self.llm_costs, dtype=np.float64)
                 if self._has_llm

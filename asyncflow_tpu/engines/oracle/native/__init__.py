@@ -185,6 +185,7 @@ def run_native(
     collect_traces: bool = False,
     payload=None,
     settings=None,
+    trace=None,
 ) -> SimulationResults:
     """Run one scenario on the native core -> :class:`SimulationResults`.
 
@@ -193,6 +194,13 @@ def run_native(
     (component type, component id, timestamp); ``payload`` is then
     required to decode generator/client/LB ids, which the compiled plan
     does not carry."""
+    if trace is not None:
+        msg = (
+            "the flight recorder (trace=TraceConfig) is not wired through "
+            "the native C++ core's ABI; use backend='oracle' (Python "
+            "oracle) or the JAX event engine for simulation-domain tracing"
+        )
+        raise ValueError(msg)
     if collect_traces and payload is None:
         msg = "collect_traces=True needs the payload to decode component ids"
         raise ValueError(msg)

@@ -74,6 +74,14 @@ class SimulationResults:
     #: the OpenTelemetry-style span record of the reference's RequestState
     #: history (`/root/reference/src/asyncflow/runtime/rqs_state.py:12-41`).
     traces: dict[int, list[tuple[str, str, float]]] | None = None
+    #: flight recorder (``trace=TraceConfig``): spawn sequence -> the
+    #: request's bounded lifecycle record, identical layout on the oracle
+    #: and the jax event engine (observability/simtrace.py).  Truncation is
+    #: explicit: ``FlightRecord.dropped`` counts events past the ring.
+    flight: dict[int, object] | None = None
+    #: circuit-breaker state transitions ``(sim_time, lb_slot, new_state)``
+    #: in event order (flight recorder only; empty without a breaker).
+    breaker_timeline: list[tuple[float, int, int]] | None = None
     #: optional (n_completed,) per-request LLM cost units aligned with
     #: ``rqs_clock`` rows (io_llm steps with call dynamics; the
     #: reference's reserved ``llm_cost`` event metric, activated).
@@ -168,6 +176,15 @@ class SweepResults:
     total_retries: np.ndarray | None = None
     retry_budget_exhausted: np.ndarray | None = None
     attempts_hist: np.ndarray | None = None
+    #: flight-recorder ring buffers (event-engine sweeps with a
+    #: ``trace=TraceConfig``; None otherwise): ``(S, K, slots)`` lifecycle
+    #: codes / node indices / sim timestamps and the ``(S, K)`` event
+    #: counts (counts past ``slots`` are the explicit truncation signal).
+    #: Decode per scenario with :meth:`SweepReport.flight_records`.
+    flight_ev: np.ndarray | None = None
+    flight_node: np.ndarray | None = None
+    flight_t: np.ndarray | None = None
+    flight_n: np.ndarray | None = None
 
     def __getitem__(self, idx) -> SweepResults:
         """Slice along the scenario axis."""
@@ -225,6 +242,12 @@ class SweepResults:
                 if self.llm_cost_sumsq is not None
                 else None
             ),
+            flight_ev=self.flight_ev[idx] if self.flight_ev is not None else None,
+            flight_node=(
+                self.flight_node[idx] if self.flight_node is not None else None
+            ),
+            flight_t=self.flight_t[idx] if self.flight_t is not None else None,
+            flight_n=self.flight_n[idx] if self.flight_n is not None else None,
         )
 
     def percentile(self, q: float) -> np.ndarray:

@@ -20,11 +20,23 @@ See docs/guides/observability.md for the workflow.
 from asyncflow_tpu.observability.export import (
     load_chrome_trace,
     read_run_records,
+    sim_trace_events,
     validate_run_record,
+    validate_sim_trace,
     write_chrome_trace,
+    write_sim_trace,
 )
 from asyncflow_tpu.observability.ledger import CompileLedger, default_ledger_path
 from asyncflow_tpu.observability.phases import PHASES, PhaseRecord, PhaseTimer
+from asyncflow_tpu.observability.simtrace import (
+    FR_NAMES,
+    FlightRecord,
+    TraceConfig,
+    canonical_spans,
+    decode_breaker,
+    decode_flight,
+    flight_dropped_events,
+)
 from asyncflow_tpu.observability.telemetry import (
     RUN_RECORD_SCHEMA,
     RunTelemetry,
@@ -36,20 +48,30 @@ from asyncflow_tpu.observability.telemetry import (
 )
 
 __all__ = [
+    "FR_NAMES",
     "PHASES",
     "RUN_RECORD_SCHEMA",
     "CompileLedger",
+    "FlightRecord",
     "PhaseRecord",
     "PhaseTimer",
     "RunTelemetry",
     "TelemetryConfig",
+    "TraceConfig",
+    "canonical_spans",
     "current_telemetry",
+    "decode_breaker",
+    "decode_flight",
     "default_ledger_path",
+    "flight_dropped_events",
     "instrument_jit",
     "load_chrome_trace",
     "maybe_phase",
     "read_run_records",
+    "sim_trace_events",
     "telemetry_session",
     "validate_run_record",
+    "validate_sim_trace",
     "write_chrome_trace",
+    "write_sim_trace",
 ]

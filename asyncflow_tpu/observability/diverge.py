@@ -1,0 +1,449 @@
+"""Oracle <-> JAX divergence finder: localize the first disagreement.
+
+Parity bugs used to be binary-searchable only: a percentile drifted, and
+the offending mechanism had to be guessed from the topology.  This module
+turns the flight recorder (:mod:`~asyncflow_tpu.observability.simtrace`)
+into a diff tool:
+
+- **flight mode** (:func:`find_first_divergence`): run the Python oracle
+  and the JAX *event* engine on the same payload/seed with tracing on,
+  canonicalize both event streams (per-request RELATIVE timelines — the
+  engines' RNG families differ, so absolute times are incomparable; on
+  deterministic-latency scenarios like
+  ``examples/yaml_input/data/trace_parity.yml`` the relative timelines
+  must agree exactly), and report the first differing event with an
+  aligned context window.  Zero divergence on the parity scenario is a
+  smoke-tier gate.
+- **stats mode** (:func:`stat_divergence`): for engines with no event
+  stream (the scan fast path) or stochastic scenarios, compare seed
+  ensembles statistic-by-statistic in lifecycle order (count, mean, then
+  quantiles) against an oracle-vs-oracle split-half noise floor — the
+  first statistic whose deviation exceeds both the tolerance AND the
+  noise floor is the localized divergence; deviations inside the noise
+  floor are the seed lottery, not an engine bug.
+
+CLI::
+
+    python -m asyncflow_tpu.observability.diverge scenario.yml \
+        [--mode flight|stats] [--seed N] [--seeds N] [--engine event|fast]
+        [--requests K] [--slots N] [--tol-us 50] [--tol 0.05] [--json]
+
+Exit status: 0 = no divergence, 2 = divergence found (1 = usage error).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from asyncflow_tpu.observability.simtrace import (
+    FlightRecord,
+    TraceConfig,
+    canonical_spans,
+)
+
+
+@dataclass
+class Divergence:
+    """First differing event between two canonicalized streams."""
+
+    request: int
+    index: int  #: event index within the request's span record
+    kind: str  #: "code" | "node" | "time" | "length"
+    oracle_event: tuple | None
+    jax_event: tuple | None
+    #: aligned context windows (formatted lines, divergence marked)
+    context_oracle: list[str] = field(default_factory=list)
+    context_jax: list[str] = field(default_factory=list)
+
+
+@dataclass
+class DivergenceReport:
+    """Outcome of one flight-mode comparison."""
+
+    equal: bool
+    requests_compared: int
+    divergence: Divergence | None = None
+    #: request indices present on only one side (arrival-count tail
+    #: mismatch near the horizon — reported, but not a divergence)
+    only_oracle: list[int] = field(default_factory=list)
+    only_jax: list[int] = field(default_factory=list)
+
+    def summary(self) -> str:
+        if self.equal:
+            return (
+                f"no divergence: {self.requests_compared} request span "
+                "record(s) identical after canonicalization"
+            )
+        d = self.divergence
+        lines = [
+            f"first divergence at request {d.request}, event {d.index} "
+            f"({d.kind}):",
+            f"  oracle: {d.oracle_event}",
+            f"  jax:    {d.jax_event}",
+            "  context (oracle | jax), '>' marks the divergence:",
+        ]
+        width = max((len(s) for s in d.context_oracle), default=0)
+        for left, right in zip(d.context_oracle, d.context_jax):
+            lines.append(f"    {left:<{width}} | {right}")
+        extra = max(len(d.context_oracle), len(d.context_jax)) - min(
+            len(d.context_oracle), len(d.context_jax),
+        )
+        if extra:
+            longer = (
+                d.context_oracle
+                if len(d.context_oracle) > len(d.context_jax)
+                else d.context_jax
+            )
+            side = "oracle" if longer is d.context_oracle else "jax"
+            for line in longer[-extra:]:
+                lines.append(f"    ({side} only) {line}")
+        return "\n".join(lines)
+
+
+def _fmt_event(ev: tuple, mark: bool) -> str:
+    from asyncflow_tpu.observability.simtrace import FR_NAMES
+
+    code, node, t_us = ev
+    name = FR_NAMES.get(code, f"code{code}")
+    return f"{'>' if mark else ' '} +{t_us / 1e3:.3f}ms {name}[{node}]"
+
+
+def compare_flight(
+    flight_oracle: dict[int, FlightRecord],
+    flight_jax: dict[int, FlightRecord],
+    *,
+    horizon: float | None = None,
+    tol_us: float = 50.0,
+    context: int = 4,
+) -> DivergenceReport:
+    """Diff two flight-record sets after canonicalization.
+
+    Codes and node ids must match exactly; relative timestamps within
+    ``tol_us`` microseconds (the jax engine's float32 sim clock carries
+    ~8 us of rounding at a 120 s horizon — exact-quantization comparison
+    would flag pure precision noise).
+    """
+    spans_o = canonical_spans(flight_oracle, horizon=horizon)
+    spans_j = canonical_spans(flight_jax, horizon=horizon)
+    common = sorted(set(spans_o) & set(spans_j))
+    report = DivergenceReport(
+        equal=True,
+        requests_compared=len(common),
+        only_oracle=sorted(set(spans_o) - set(spans_j)),
+        only_jax=sorted(set(spans_j) - set(spans_o)),
+    )
+    for req in common:
+        a, b = spans_o[req], spans_j[req]
+        n = min(len(a), len(b))
+        diverged_at = None
+        kind = None
+        for k in range(n):
+            (ca, na, ta), (cb, nb, tb) = a[k], b[k]
+            if ca != cb:
+                diverged_at, kind = k, "code"
+            elif na != nb:
+                diverged_at, kind = k, "node"
+            elif abs(ta - tb) > tol_us:
+                diverged_at, kind = k, "time"
+            if diverged_at is not None:
+                break
+        if diverged_at is None and len(a) != len(b):
+            diverged_at, kind = n, "length"
+        if diverged_at is None:
+            continue
+        lo = max(0, diverged_at - context)
+        hi = diverged_at + context + 1
+        report.equal = False
+        report.divergence = Divergence(
+            request=req,
+            index=diverged_at,
+            kind=kind,
+            oracle_event=a[diverged_at] if diverged_at < len(a) else None,
+            jax_event=b[diverged_at] if diverged_at < len(b) else None,
+            context_oracle=[
+                _fmt_event(a[k], k == diverged_at)
+                for k in range(lo, min(hi, len(a)))
+            ],
+            context_jax=[
+                _fmt_event(b[k], k == diverged_at)
+                for k in range(lo, min(hi, len(b)))
+            ],
+        )
+        return report
+    return report
+
+
+def find_first_divergence(
+    payload,
+    *,
+    seed: int = 0,
+    trace: TraceConfig | None = None,
+    tol_us: float = 50.0,
+    context: int = 4,
+) -> DivergenceReport:
+    """Run the oracle and the JAX event engine on ``payload``/``seed`` with
+    the flight recorder on and diff the canonicalized streams."""
+    from asyncflow_tpu.engines.jaxsim.engine import run_single
+    from asyncflow_tpu.engines.oracle.engine import OracleEngine
+
+    trace = trace or TraceConfig()
+    horizon = float(payload.sim_settings.total_simulation_time)
+    res_o = OracleEngine(payload, seed=seed, trace=trace).run()
+    res_j = run_single(payload, seed=seed, engine="event", trace=trace)
+    return compare_flight(
+        res_o.flight,
+        res_j.flight,
+        horizon=horizon,
+        tol_us=tol_us,
+        context=context,
+    )
+
+
+# ---------------------------------------------------------------------------
+# stats mode: ensembles vs the oracle's own noise floor
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class StatRow:
+    stat: str
+    oracle: float
+    jax: float
+    rel_delta: float  #: |jax - oracle| / |oracle|
+    noise_floor: float  #: oracle split-half |delta| on the same stat
+    exceeds: bool  #: rel_delta > tol AND rel_delta > noise floor
+
+
+@dataclass
+class StatReport:
+    engine: str
+    seeds: int
+    tol: float
+    rows: list[StatRow]
+    first_exceeding: str | None
+
+    @property
+    def equal(self) -> bool:
+        return self.first_exceeding is None
+
+    def summary(self) -> str:
+        lines = [
+            f"ensemble comparison: oracle vs {self.engine} engine "
+            f"({self.seeds} seeds, tol {self.tol:.1%}):",
+        ]
+        for r in self.rows:
+            mark = ">" if r.exceeds else " "
+            lines.append(
+                f" {mark} {r.stat:>6}: oracle {r.oracle:.6f}  "
+                f"{self.engine} {r.jax:.6f}  delta {r.rel_delta:+.2%}  "
+                f"(oracle split-half noise {r.noise_floor:.2%})",
+            )
+        if self.first_exceeding is None:
+            lines.append(
+                "no statistic exceeds both the tolerance and the oracle's "
+                "own split-half noise floor: deviations are seed lottery, "
+                "not a localized engine bug",
+            )
+        else:
+            lines.append(
+                f"first diverging statistic: {self.first_exceeding}",
+            )
+        return "\n".join(lines)
+
+
+def _stats(lat: np.ndarray, quantiles) -> dict[str, float]:
+    out = {"count": float(lat.size), "mean": float(lat.mean())}
+    for q in quantiles:
+        out[f"p{q:g}"] = float(np.percentile(lat, q))
+    return out
+
+
+def stat_divergence(
+    payload,
+    *,
+    engine: str = "fast",
+    seeds: int = 8,
+    tol: float = 0.05,
+    quantiles=(50, 90, 95),
+) -> StatReport:
+    """Compare oracle and JAX-engine latency ensembles stat-by-stat.
+
+    The reference point for "diverged" is the oracle's own split-half
+    deviation on the same statistic: a delta inside that noise floor is
+    what disjoint same-engine ensembles produce at these settings (the
+    seed lottery), so only deltas exceeding BOTH the tolerance and the
+    noise floor localize a real divergence.
+    """
+    from asyncflow_tpu.compiler import compile_payload
+    from asyncflow_tpu.engines.jaxsim.engine import (
+        Engine,
+        scenario_keys,
+    )
+    from asyncflow_tpu.engines.oracle.engine import OracleEngine
+
+    per_seed = [
+        OracleEngine(payload, seed=s).run().latencies for s in range(seeds)
+    ]
+    lat_o = np.concatenate(per_seed)
+    half = max(1, seeds // 2)
+    lat_a = np.concatenate(per_seed[:half])
+    lat_b = np.concatenate(per_seed[half:]) if seeds > 1 else lat_a
+
+    plan = compile_payload(payload)
+    if engine == "fast":
+        from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+
+        if not plan.fastpath_ok:
+            msg = (
+                f"this plan is not fast-path eligible "
+                f"({plan.fastpath_reason}); use engine='event'"
+            )
+            raise ValueError(msg)
+        eng = FastEngine(plan, collect_clocks=True)
+    elif engine == "event":
+        eng = Engine(plan, collect_clocks=True)
+    else:
+        msg = f"engine must be 'fast' or 'event', got {engine!r}"
+        raise ValueError(msg)
+    final = eng.run_batch(scenario_keys(11, seeds))
+    clock = np.asarray(final.clock)
+    counts = np.asarray(final.clock_n)
+    lat_j = np.concatenate(
+        [
+            clock[i, : min(counts[i], clock.shape[1]), 1]
+            - clock[i, : min(counts[i], clock.shape[1]), 0]
+            for i in range(seeds)
+        ],
+    )
+
+    s_o = _stats(lat_o, quantiles)
+    s_j = _stats(lat_j, quantiles)
+    s_a = _stats(lat_a, quantiles)
+    s_b = _stats(lat_b, quantiles)
+    rows = []
+    first = None
+    for stat in s_o:
+        o, j = s_o[stat], s_j[stat]
+        rel = abs(j - o) / abs(o) if o else float("inf")
+        noise = (
+            abs(s_a[stat] - s_b[stat]) / abs(s_o[stat]) if s_o[stat] else 0.0
+        )
+        exceeds = rel > tol and rel > noise
+        if exceeds and first is None:
+            first = stat
+        rows.append(
+            StatRow(
+                stat=stat,
+                oracle=o,
+                jax=j,
+                rel_delta=rel,
+                noise_floor=noise,
+                exceeds=exceeds,
+            ),
+        )
+    return StatReport(
+        engine=engine, seeds=seeds, tol=tol, rows=rows, first_exceeding=first,
+    )
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m asyncflow_tpu.observability.diverge",
+        description=(
+            "Run the oracle and the JAX engine on one scenario and report "
+            "the first divergence between their event streams (flight "
+            "mode) or latency ensembles (stats mode)."
+        ),
+    )
+    parser.add_argument("scenario", help="YAML scenario file")
+    parser.add_argument(
+        "--mode", choices=("flight", "stats"), default="flight",
+    )
+    parser.add_argument("--seed", type=int, default=0, help="flight mode seed")
+    parser.add_argument(
+        "--seeds", type=int, default=8, help="stats mode ensemble size",
+    )
+    parser.add_argument(
+        "--engine",
+        choices=("event", "fast"),
+        default="fast",
+        help="stats mode JAX engine (flight mode always diffs the event engine)",
+    )
+    parser.add_argument(
+        "--requests", type=int, default=8, help="traced requests per scenario",
+    )
+    parser.add_argument(
+        "--slots", type=int, default=48, help="event slots per traced request",
+    )
+    parser.add_argument(
+        "--tol-us",
+        type=float,
+        default=50.0,
+        help="flight mode: relative-timestamp tolerance (microseconds)",
+    )
+    parser.add_argument(
+        "--tol",
+        type=float,
+        default=0.05,
+        help="stats mode: relative-deviation tolerance",
+    )
+    parser.add_argument("--context", type=int, default=4)
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable report",
+    )
+    args = parser.parse_args(argv)
+
+    import yaml
+
+    from asyncflow_tpu.schemas.payload import SimulationPayload
+
+    payload = SimulationPayload.model_validate(
+        yaml.safe_load(open(args.scenario).read()),
+    )
+
+    if args.mode == "flight":
+        report = find_first_divergence(
+            payload,
+            seed=args.seed,
+            trace=TraceConfig(
+                sample_requests=args.requests, event_slots=args.slots,
+            ),
+            tol_us=args.tol_us,
+            context=args.context,
+        )
+        if args.json:
+            from dataclasses import asdict
+
+            print(json.dumps(asdict(report), default=str))
+        else:
+            print(report.summary())
+        return 0 if report.equal else 2
+
+    report = stat_divergence(
+        payload,
+        engine=args.engine,
+        seeds=args.seeds,
+        tol=args.tol,
+    )
+    if args.json:
+        from dataclasses import asdict
+
+        print(json.dumps(asdict(report), default=str))
+    else:
+        print(report.summary())
+    return 0 if report.equal else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

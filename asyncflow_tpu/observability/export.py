@@ -1,10 +1,20 @@
-"""Exporters: JSONL run records and Chrome-trace/Perfetto host timelines.
+"""Exporters: JSONL run records and Chrome-trace/Perfetto timelines.
 
 The Chrome trace format (the ``traceEvents`` JSON that Perfetto,
 ``chrome://tracing``, and ``scripts/trace_summary.py`` all read) is the
 lingua franca of this repo's profiling work; the host phase timeline is
 emitted in the same format so one UI shows both the XLA device trace
 (``jax.profiler``) and the library's own phase spans.
+
+Two time domains share the format:
+
+- **host time** (:func:`write_chrome_trace`): wall-clock phases, compiles,
+  device counters — what the machine did;
+- **simulated time** (:func:`write_sim_trace`): the flight recorder's
+  request spans, per-server/per-edge gauge timelines, breaker state, and
+  fault-window occupancy — what happened inside the simulated world, with
+  one simulated microsecond per trace microsecond.  One track group per
+  server/edge, one thread per traced request (docs/guides/observability.md).
 """
 
 from __future__ import annotations
@@ -14,6 +24,23 @@ import json
 from pathlib import Path
 
 from asyncflow_tpu.observability.phases import PHASES, PhaseTimer
+from asyncflow_tpu.observability.simtrace import (
+    FR_ABANDON,
+    FR_ARRIVE_LB,
+    FR_ARRIVE_SRV,
+    FR_COMPLETE,
+    FR_DROP,
+    FR_NAMES,
+    FR_REJECT,
+    FR_RETRY,
+    FR_RUN,
+    FR_SPAWN,
+    FR_TIMEOUT,
+    FR_TRANSIT,
+    FR_WAIT_CPU,
+    FR_WAIT_DB,
+    FR_WAIT_RAM,
+)
 
 #: synthetic pid/tid for the host phase track (Chrome traces need both)
 HOST_PID = 1
@@ -107,6 +134,310 @@ def write_chrome_trace(
     else:
         path.write_bytes(data)
     return path
+
+
+# ---------------------------------------------------------------------------
+# simulated-time export (flight recorder + gauge timelines)
+# ---------------------------------------------------------------------------
+
+#: pid layout of the simulated-time trace (one "process" per track group)
+SIM_PID_REQUESTS = 10
+SIM_PID_BREAKER = 20
+SIM_PID_SERVER = 100  # + server index
+SIM_PID_EDGE = 300  # + edge index
+
+_WAIT_NAMES = {
+    FR_WAIT_CPU: "wait cpu",
+    FR_WAIT_RAM: "wait ram",
+    FR_WAIT_DB: "wait db",
+}
+_INSTANT_CODES = frozenset(
+    {FR_SPAWN, FR_ARRIVE_LB, FR_ARRIVE_SRV, FR_RUN, FR_TIMEOUT, FR_DROP,
+     FR_REJECT, FR_COMPLETE, FR_ABANDON, FR_RETRY},
+)
+
+
+def _meta(pid: int, name: str, tid: int | None = None) -> dict:
+    ev: dict = {
+        "ph": "M",
+        "pid": pid,
+        "name": "process_name" if tid is None else "thread_name",
+        "args": {"name": name},
+    }
+    if tid is not None:
+        ev["tid"] = tid
+    return ev
+
+
+def _span(pid: int, tid: int, name: str, t0: float, t1: float, **args) -> dict:
+    return {
+        "ph": "X",
+        "pid": pid,
+        "tid": tid,
+        "name": name,
+        "ts": t0 * 1e6,
+        "dur": max(t1 - t0, 0.0) * 1e6,
+        "args": args,
+    }
+
+
+def _request_events(results, events_out: list) -> None:
+    """One thread per traced request: activity spans between lifecycle
+    transitions plus instant markers for the transitions themselves."""
+    server_ids = results.server_ids
+    edge_ids = results.edge_ids
+    events_out.append(_meta(SIM_PID_REQUESTS, "simulated requests"))
+    for req in sorted(results.flight):
+        rec = results.flight[req]
+        tid = req + 1
+        label = f"request {req}"
+        if rec.dropped:
+            label += f" (+{rec.dropped} events dropped)"
+        events_out.append(_meta(SIM_PID_REQUESTS, label, tid))
+        prev = None
+        for code, node, t in rec.events:
+            # activity span ending at this transition
+            if prev is not None:
+                p_code, p_node, p_t = prev
+                name = None
+                if code == FR_TRANSIT:
+                    edge = edge_ids[node] if 0 <= node < len(edge_ids) else "?"
+                    name = f"transit {edge}"
+                elif code == FR_RUN and p_code in _WAIT_NAMES:
+                    srv = (
+                        server_ids[node]
+                        if 0 <= node < len(server_ids)
+                        else "?"
+                    )
+                    name = f"{_WAIT_NAMES[p_code]} {srv}"
+                elif code == FR_SPAWN and p_code == FR_RETRY:
+                    name = "backoff"
+                if name is not None and t > p_t:
+                    events_out.append(
+                        _span(SIM_PID_REQUESTS, tid, name, p_t, t),
+                    )
+            # instant marker for the transition itself
+            if code in _INSTANT_CODES or code in _WAIT_NAMES:
+                name = FR_NAMES.get(code, f"code{code}")
+                if code in (FR_ARRIVE_SRV, FR_RUN, FR_REJECT) and (
+                    0 <= node < len(server_ids)
+                ):
+                    name += f" {server_ids[node]}"
+                elif code == FR_DROP and 0 <= node < len(edge_ids):
+                    name += f" {edge_ids[node]}"
+                elif code in (FR_RETRY, FR_TIMEOUT, FR_ABANDON):
+                    name += f" (attempt {node})"
+                events_out.append(
+                    {
+                        "ph": "i",
+                        "pid": SIM_PID_REQUESTS,
+                        "tid": tid,
+                        "name": name,
+                        "ts": t * 1e6,
+                        "s": "t",
+                    },
+                )
+            prev = (code, node, t)
+
+
+def _gauge_events(results, resolution_s: float | None, events_out: list) -> None:
+    """Per-server / per-edge counter tracks from the sampled gauge series,
+    resampled to ``resolution_s`` (stride over the native sample grid)."""
+    import numpy as np
+
+    sampled = results.sampled or {}
+    period = float(results.settings.sample_period_s)
+    stride = 1
+    if resolution_s is not None:
+        stride = max(1, round(float(resolution_s) / period))
+
+    server_metrics = {
+        "ready_queue_len": "queue depth",
+        "event_loop_io_sleep": "io inflight",
+        "ram_in_use": "ram held (mb)",
+    }
+    declared: set[int] = set()
+    for metric, series_by_id in sampled.items():
+        for comp_id, series in series_by_id.items():
+            series = np.asarray(series)
+            if metric in server_metrics and comp_id in results.server_ids:
+                pid = SIM_PID_SERVER + results.server_ids.index(comp_id)
+                group = f"server {comp_id}"
+                name = server_metrics[metric]
+            elif comp_id in results.edge_ids:
+                pid = SIM_PID_EDGE + results.edge_ids.index(comp_id)
+                group = f"edge {comp_id}"
+                name = "inflight"
+            else:  # pragma: no cover - unknown component id
+                continue
+            if pid not in declared:
+                declared.add(pid)
+                events_out.append(_meta(pid, group))
+            for k in range(0, series.shape[0], stride):
+                events_out.append(
+                    {
+                        "ph": "C",
+                        "pid": pid,
+                        "name": name,
+                        "ts": (k + 1) * period * 1e6,
+                        "args": {name: float(series[k])},
+                    },
+                )
+
+
+def _breaker_events(results, horizon: float, events_out: list) -> None:
+    """Breaker state as a stepped counter per LB rotation slot."""
+    timeline = results.breaker_timeline or []
+    if not timeline:
+        return
+    events_out.append(_meta(SIM_PID_BREAKER, "circuit breakers"))
+    slots = sorted({slot for _t, slot, _s in timeline})
+    for slot in slots:
+        name = f"breaker slot {slot}"
+        steps = [(0.0, 0)] + [
+            (t, state) for t, s, state in timeline if s == slot
+        ]
+        for t, state in steps:
+            events_out.append(
+                {
+                    "ph": "C",
+                    "pid": SIM_PID_BREAKER,
+                    "name": name,
+                    "ts": t * 1e6,
+                    "args": {"state(0=closed,1=open,2=half)": int(state)},
+                },
+            )
+
+
+def _fault_events(results, payload, events_out: list) -> None:
+    """Fault-window occupancy spans on the owning server/edge track."""
+    timeline = getattr(payload, "fault_timeline", None) if payload else None
+    if timeline is None or not timeline.events:
+        return
+    for fault in timeline.events:
+        if fault.target_id in results.server_ids:
+            pid = SIM_PID_SERVER + results.server_ids.index(fault.target_id)
+        elif fault.target_id in results.edge_ids:
+            pid = SIM_PID_EDGE + results.edge_ids.index(fault.target_id)
+        else:  # pragma: no cover - schema validation forbids this
+            continue
+        events_out.append(_meta(pid, "faults", 99))
+        events_out.append(
+            _span(
+                pid,
+                99,
+                f"{fault.kind} ({fault.fault_id})",
+                float(fault.t_start),
+                float(fault.t_end),
+                latency_factor=fault.latency_factor,
+                dropout_boost=fault.dropout_boost,
+            ),
+        )
+
+
+def sim_trace_events(
+    results,
+    *,
+    payload=None,
+    resolution_s: float | None = None,
+    label: str = "asyncflow-sim",
+) -> list[dict]:
+    """SimulationResults -> simulated-time Chrome ``traceEvents``.
+
+    Timestamps are simulated microseconds (1 sim second = 1e6 ts units).
+    Track groups: one per server (queue depth / io inflight / RAM held +
+    fault windows), one per edge (inflight + fault windows), one thread
+    per traced request (flight-recorder spans), breaker state counters.
+    ``results`` needs a flight recorder and/or sampled gauges; ``payload``
+    (optional) contributes the fault-window occupancy spans.
+    """
+    events: list[dict] = [
+        {
+            "ph": "M",
+            "pid": SIM_PID_REQUESTS,
+            "name": "process_name",
+            "args": {"name": f"asyncflow simulated world ({label})"},
+        },
+    ]
+    horizon = float(results.settings.total_simulation_time)
+    if results.flight:
+        _request_events(results, events)
+    _gauge_events(results, resolution_s, events)
+    _breaker_events(results, horizon, events)
+    _fault_events(results, payload, events)
+    return events
+
+
+def write_sim_trace(
+    path: str | Path,
+    results,
+    *,
+    payload=None,
+    resolution_s: float | None = None,
+    label: str = "asyncflow-sim",
+) -> Path:
+    """Write the simulated-world timeline as a Chrome-trace file
+    (``.json`` or ``.json.gz``; open in Perfetto / ``chrome://tracing``)."""
+    path = Path(path)
+    doc = {
+        "displayTimeUnit": "ms",
+        "traceEvents": sim_trace_events(
+            results, payload=payload, resolution_s=resolution_s, label=label,
+        ),
+    }
+    path.parent.mkdir(parents=True, exist_ok=True)
+    data = json.dumps(doc).encode()
+    if path.suffix == ".gz":
+        with gzip.open(path, "wb") as fh:
+            fh.write(data)
+    else:
+        path.write_bytes(data)
+    return path
+
+
+def validate_sim_trace(doc: dict) -> list[str]:
+    """Schema check for a simulated-time trace document; [] = valid.
+
+    The smoke tier writes a tiny traced scenario and runs this so format
+    drift (Perfetto compatibility) is caught per-commit.
+    """
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return ["trace document is not an object"]
+    if "traceEvents" not in doc or not isinstance(doc["traceEvents"], list):
+        return ["missing traceEvents list"]
+    seen_request_thread = False
+    for i, ev in enumerate(doc["traceEvents"]):
+        for key in ("ph", "pid", "name"):
+            if key not in ev:
+                problems.append(f"traceEvents[{i}] missing {key!r}")
+        ph = ev.get("ph")
+        if ph not in ("M", "X", "C", "i"):
+            problems.append(f"traceEvents[{i}] unknown phase {ph!r}")
+        if ph in ("X", "C", "i") and not isinstance(
+            ev.get("ts"), (int, float),
+        ):
+            problems.append(f"traceEvents[{i}] non-numeric ts")
+        if ph == "X":
+            if not isinstance(ev.get("dur"), (int, float)):
+                problems.append(f"traceEvents[{i}] span without dur")
+            elif ev["dur"] < 0:
+                problems.append(f"traceEvents[{i}] negative dur")
+        if ph == "C":
+            args = ev.get("args")
+            if not isinstance(args, dict) or not all(
+                isinstance(v, (int, float)) for v in args.values()
+            ):
+                problems.append(f"traceEvents[{i}] non-numeric counter args")
+        if (
+            ph == "M"
+            and ev.get("pid") == SIM_PID_REQUESTS
+            and ev.get("name") == "thread_name"
+        ):
+            seen_request_thread = True
+    if not seen_request_thread:
+        problems.append("no traced-request thread present")
+    return problems
 
 
 def load_chrome_trace(path: str | Path) -> dict:

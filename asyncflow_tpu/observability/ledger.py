@@ -29,10 +29,16 @@ LEDGER_BASENAME = "compile_ledger.jsonl"
 
 
 def default_ledger_path() -> str:
-    """The ledger's home: beside the persistent XLA compile cache."""
+    """The ledger's home: INSIDE the persistent XLA compile-cache directory.
+
+    It used to sit beside ``.jax_cache`` — which, with the default cache
+    location, meant the repo root, where generated JSONL kept landing in
+    commits.  Inside the cache dir it shares the cache's lifecycle (moved
+    by ``ASYNCFLOW_COMPILE_CACHE``, wiped with the cache, ignored by git).
+    """
     from asyncflow_tpu.utils.compile_cache import cache_location
 
-    return os.path.join(os.path.dirname(cache_location()), LEDGER_BASENAME)
+    return os.path.join(cache_location(), LEDGER_BASENAME)
 
 
 class CompileLedger:

@@ -1,0 +1,236 @@
+"""Simulation-domain tracing: the request flight recorder's shared layout.
+
+Host-side run telemetry (:mod:`asyncflow_tpu.observability.telemetry`) shows
+what the *host* did — compiles, transfers, kernel walls.  This module is the
+vocabulary for what happened inside the *simulated world*: a bounded
+per-request event record (the "flight recorder") that the JAX event engine
+writes as fixed-size on-device ring buffers inside its vmapped loop and the
+Python oracle emits from its heap loop — one layout, two producers, so the
+streams can be diffed event-by-event (:mod:`~asyncflow_tpu.observability.
+diverge`) and rendered as simulated-time Perfetto tracks
+(:func:`~asyncflow_tpu.observability.export.write_sim_trace`).
+
+Record layout (identical across engines):
+
+- a scenario traces its first ``sample_requests`` spawned logical requests
+  (deterministic sampling — no draw is consumed picking them);
+- each traced request owns ``event_slots`` ring entries of
+  ``(code, node, sim-time)``; writes past the budget are counted, not
+  stored, so truncation is always explicit (:attr:`FlightRecord.dropped`);
+- a logical request keeps its record across client retries (the re-issue
+  appends to the same ring); orphaned attempts stop recording at the
+  client timeout, mirroring the oracle's "orphan completions are
+  invisible" contract.
+
+``node`` is an integer whose meaning depends on the code: generator index
+for :data:`FR_SPAWN`, edge index for :data:`FR_TRANSIT`/:data:`FR_DROP`,
+server index for the server-side codes, the failed attempt number for the
+retry-machinery codes, and ``-1`` where no component applies (LB, client).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+from pydantic import BaseModel, Field
+
+# ---------------------------------------------------------------------------
+# lifecycle event codes (shared verbatim by the jax event engine, the
+# oracle, and every decoder — renumbering breaks recorded artifacts)
+# ---------------------------------------------------------------------------
+
+FR_SPAWN = 1  #: generator emitted (or client re-issued) the request
+FR_TRANSIT = 2  #: an edge traversal DELIVERED (t = delivery time)
+FR_ARRIVE_LB = 3  #: arrived at the load balancer
+FR_ARRIVE_SRV = 4  #: accepted by a server (refusals are FR_REJECT)
+FR_WAIT_RAM = 5  #: parked in the RAM admission FIFO
+FR_WAIT_CPU = 6  #: joined a ready queue (core busy or waiters ahead)
+FR_WAIT_DB = 7  #: parked in a DB connection-pool FIFO
+FR_RUN = 8  #: a wait resolved — service granted (core/RAM/connection)
+FR_RETRY = 9  #: client scheduled a backoff re-issue (node = failed attempt)
+FR_TIMEOUT = 10  #: client deadline fired; the attempt is orphaned
+FR_DROP = 11  #: lost to edge dropout / an empty LB rotation
+FR_REJECT = 12  #: refused (outage, rate limit, socket cap, shed, abandon,
+#: fully-open breaker rotation, pool overflow)
+FR_COMPLETE = 13  #: delivered back to the client — the request is done
+FR_ABANDON = 14  #: client gave the logical request up (node = last attempt)
+
+FR_NAMES: dict[int, str] = {
+    FR_SPAWN: "spawn",
+    FR_TRANSIT: "transit",
+    FR_ARRIVE_LB: "arrive_lb",
+    FR_ARRIVE_SRV: "arrive_srv",
+    FR_WAIT_RAM: "wait_ram",
+    FR_WAIT_CPU: "wait_cpu",
+    FR_WAIT_DB: "wait_db",
+    FR_RUN: "run",
+    FR_RETRY: "retry",
+    FR_TIMEOUT: "timeout",
+    FR_DROP: "drop",
+    FR_REJECT: "reject",
+    FR_COMPLETE: "complete",
+    FR_ABANDON: "abandon",
+}
+
+#: codes whose ``node`` field is an edge index
+_EDGE_CODES = frozenset({FR_TRANSIT, FR_DROP})
+#: codes whose ``node`` field is a server index
+_SERVER_CODES = frozenset(
+    {FR_ARRIVE_SRV, FR_WAIT_RAM, FR_WAIT_CPU, FR_WAIT_DB, FR_RUN},
+)
+
+
+class TraceConfig(BaseModel):
+    """What the flight recorder samples and how much it may store.
+
+    The budgets are STATIC: they size the on-device ring buffers baked into
+    the jax engine's compiled program, so changing them re-specializes the
+    kernel (same rule as ``pool_size``).  Tracing never consumes a random
+    draw and never changes simulation results — with ``trace=None`` the
+    engines compile the exact pre-trace program (a test pins
+    bit-identity).
+    """
+
+    #: trace the first K spawned logical requests of every scenario
+    sample_requests: int = Field(default=8, ge=1, le=4096)
+    #: ring entries per traced request; writes past this are counted in
+    #: :attr:`FlightRecord.dropped` instead of stored
+    event_slots: int = Field(default=48, ge=4, le=4096)
+    #: circuit-breaker state-transition ring entries per scenario
+    breaker_slots: int = Field(default=64, ge=1, le=4096)
+    #: gauge-timeline resample resolution for the Perfetto export (seconds);
+    #: ``None`` keeps the scenario's native ``sample_period_s``
+    resolution_s: float | None = Field(default=None, gt=0.0)
+
+
+@dataclass
+class FlightRecord:
+    """One traced request's lifecycle, in event order.
+
+    ``events`` entries are ``(code, node, sim_time_s)``; ``dropped`` counts
+    lifecycle transitions that happened after the ring filled (explicit
+    truncation — the record covers the FIRST ``event_slots`` transitions).
+    """
+
+    req: int  #: spawn sequence number within the scenario (0-based)
+    events: list[tuple[int, int, float]] = field(default_factory=list)
+    dropped: int = 0
+
+    def codes(self) -> list[int]:
+        return [code for code, _node, _t in self.events]
+
+    def describe(self, *, server_ids=None, edge_ids=None) -> list[str]:
+        """Human-readable event lines (component ids resolved when given)."""
+        out = []
+        for code, node, t in self.events:
+            name = FR_NAMES.get(code, f"code{code}")
+            comp = ""
+            if code in _EDGE_CODES and edge_ids and 0 <= node < len(edge_ids):
+                comp = f" {edge_ids[node]}"
+            elif (
+                code in _SERVER_CODES
+                and server_ids
+                and 0 <= node < len(server_ids)
+            ):
+                comp = f" {server_ids[node]}"
+            elif code in (FR_RETRY, FR_TIMEOUT, FR_ABANDON):
+                comp = f" attempt={node}"
+            elif node >= 0:
+                comp = f" #{node}"
+            out.append(f"t={t:.6f}s {name}{comp}")
+        if self.dropped:
+            out.append(f"... {self.dropped} later event(s) dropped (ring full)")
+        return out
+
+
+def decode_flight(
+    fr_ev: np.ndarray,
+    fr_node: np.ndarray,
+    fr_t: np.ndarray,
+    fr_n: np.ndarray,
+) -> dict[int, FlightRecord]:
+    """Ring arrays ``(K, slots)`` + counts ``(K,)`` -> per-request records.
+
+    Rows that never spawned (count 0) are omitted; ``fr_n`` keeps counting
+    past the slot budget, so the overflow IS the dropped-events counter.
+    """
+    fr_ev = np.asarray(fr_ev)
+    fr_node = np.asarray(fr_node)
+    fr_t = np.asarray(fr_t)
+    fr_n = np.asarray(fr_n)
+    slots = fr_ev.shape[1]
+    out: dict[int, FlightRecord] = {}
+    for row in range(fr_ev.shape[0]):
+        n = int(fr_n[row])
+        if n <= 0:
+            continue
+        stored = min(n, slots)
+        out[row] = FlightRecord(
+            req=row,
+            events=[
+                (int(fr_ev[row, j]), int(fr_node[row, j]), float(fr_t[row, j]))
+                for j in range(stored)
+            ],
+            dropped=n - stored,
+        )
+    return out
+
+
+def flight_dropped_events(flight: dict[int, FlightRecord] | None) -> int:
+    """Total lifecycle transitions lost to full rings (0 without tracing)."""
+    if not flight:
+        return 0
+    return sum(rec.dropped for rec in flight.values())
+
+
+def decode_breaker(
+    bk_t: np.ndarray,
+    bk_slot: np.ndarray,
+    bk_state: np.ndarray,
+    bk_n,
+) -> list[tuple[float, int, int]]:
+    """Breaker ring -> ``[(sim_time, lb_slot, new_state), ...]`` in order.
+
+    ``new_state`` uses the engine encoding: 0 closed, 1 open, 2 half-open.
+    """
+    n = min(int(bk_n), np.asarray(bk_t).shape[0])
+    return [
+        (float(bk_t[j]), int(bk_slot[j]), int(bk_state[j])) for j in range(n)
+    ]
+
+
+def canonical_spans(
+    flight: dict[int, FlightRecord],
+    *,
+    horizon: float | None = None,
+    resolution_us: float = 1.0,
+    relative: bool = True,
+) -> dict[int, tuple[tuple[int, int, int], ...]]:
+    """Canonicalize records for cross-engine comparison.
+
+    Two engines with independent RNG families cannot share absolute event
+    times, but a request's *relative* timeline is deterministic whenever its
+    path is (fixed service times, variance-0 edges, no contention).  So the
+    canonical form is per request: events with ``t >= horizon`` dropped
+    (the oracle heap never executes them; the jax engine records some
+    forward-dated deliveries), timestamps taken relative to the request's
+    first event, and quantized to ``resolution_us`` microseconds (float32
+    device times vs float64 host times agree at micro-resolution, which is
+    also Perfetto's display unit).
+    """
+    out: dict[int, tuple[tuple[int, int, int], ...]] = {}
+    for req, rec in flight.items():
+        events = [
+            (code, node, t)
+            for code, node, t in rec.events
+            if horizon is None or t < horizon
+        ]
+        if not events:
+            continue
+        t0 = events[0][2] if relative else 0.0
+        out[req] = tuple(
+            (code, node, int(round((t - t0) * 1e6 / resolution_us)))
+            for code, node, t in events
+        )
+    return out

@@ -24,6 +24,7 @@ from asyncflow_tpu.engines.jaxsim.params import (
     fill_overrides,
 )
 from asyncflow_tpu.engines.results import SweepResults
+from asyncflow_tpu.observability.simtrace import TraceConfig, decode_flight
 from asyncflow_tpu.observability.telemetry import (
     TelemetryConfig,
     telemetry_session,
@@ -238,6 +239,34 @@ class SweepReport:
     #: metrics through :func:`asyncflow_tpu.analysis.antithetic_pair_means`
     #: before any mean CI
     antithetic: bool = False
+
+    def flight_records(self, scenario: int) -> dict:
+        """Decode one scenario's flight-recorder rings (sweeps run with
+        ``SweepRunner(..., trace=TraceConfig)``): spawn sequence ->
+        :class:`~asyncflow_tpu.observability.simtrace.FlightRecord`."""
+        if self.results.flight_ev is None:
+            msg = (
+                "no flight records were collected: construct "
+                "SweepRunner(..., trace=TraceConfig(...)) — the recorder "
+                "runs on the event engine"
+            )
+            raise ValueError(msg)
+        return decode_flight(
+            self.results.flight_ev[scenario],
+            self.results.flight_node[scenario],
+            self.results.flight_t[scenario],
+            self.results.flight_n[scenario],
+        )
+
+    def flight_dropped_events(self) -> np.ndarray:
+        """(S,) lifecycle events lost to full rings per scenario — the
+        explicit truncation signal (raise ``TraceConfig.event_slots`` when
+        nonzero)."""
+        if self.results.flight_n is None:
+            msg = "no flight records were collected (trace=TraceConfig)"
+            raise ValueError(msg)
+        slots = self.results.flight_ev.shape[2]
+        return np.maximum(self.results.flight_n - slots, 0).sum(axis=1)
 
     def mean_gauge(self, metric: str, component_id: str) -> np.ndarray:
         """(S,) per-scenario time-average of one gauge (fast path sweeps).
@@ -469,6 +498,7 @@ class SweepRunner:
         gauge_series: tuple | None = None,
         telemetry: TelemetryConfig | None = None,
         experiment: ExperimentConfig | None = None,
+        trace: TraceConfig | None = None,
     ) -> None:
         """``engine``: "auto" picks the scan fast path when the plan is
         eligible (orders of magnitude faster), then the Pallas event kernel
@@ -518,7 +548,17 @@ class SweepRunner:
         Both default off, and off is bit-identical to builds without the
         hooks.  Neither is available on the ``pallas``/``native`` engines
         (their draw paths don't route through the hook seam) — forcing the
-        combination is an explicit error."""
+        combination is an explicit error.
+
+        ``trace``: the simulation-domain flight recorder
+        (:class:`asyncflow_tpu.observability.simtrace.TraceConfig`): each
+        scenario records its first K spawned requests' lifecycle
+        transitions into fixed-size on-device rings, surfaced per scenario
+        via :meth:`SweepReport.flight_records`.  Only the event engine
+        carries the rings — ``engine='auto'`` routes traced sweeps there;
+        forcing ``fast``/``pallas``/``native`` is an explicit error.
+        Tracing consumes no draws: every non-trace output is bit-identical
+        with it on or off."""
         if engine not in ("auto", "fast", "event", "pallas", "native"):
             msg = (
                 f"engine must be 'auto', 'fast', 'event', 'pallas' or "
@@ -531,6 +571,25 @@ class SweepRunner:
         self.telemetry = telemetry
         #: Monte-Carlo design (variance reduction + precision targets)
         self.experiment = experiment
+        #: simulation-domain flight recorder (event engine only)
+        if trace is not None and not isinstance(trace, TraceConfig):
+            trace = TraceConfig.model_validate(trace)
+        self.trace = trace
+        if trace is not None and engine in ("fast", "pallas", "native"):
+            reasons = {
+                "fast": "computes request trajectories in closed form and "
+                "has no per-event state to record",
+                "pallas": "keeps its state in VMEM, which per-request "
+                "event rings do not fit",
+                "native": "does not wire the recorder through its C ABI",
+            }
+            msg = (
+                f"engine={engine!r} cannot run the flight recorder "
+                f"(trace=TraceConfig): it {reasons[engine]}; use "
+                "engine='event' (or 'auto', which routes traced sweeps "
+                "there)"
+            )
+            raise ValueError(msg)
         vr = experiment.variance_reduction if experiment is not None else None
         self._crn = bool(vr.crn) if vr is not None else False
         self._antithetic = bool(vr.antithetic) if vr is not None else False
@@ -594,7 +653,9 @@ class SweepRunner:
             self.engine = _NativeSweepEngine(self.plan, n_hist_bins=n_hist_bins)
             self.engine_kind = "native"
             self._scan_inner = 0
-        elif engine == "fast" or (engine == "auto" and self.plan.fastpath_ok):
+        elif engine == "fast" or (
+            engine == "auto" and self.plan.fastpath_ok and self.trace is None
+        ):
             from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
 
             self.engine = FastEngine(
@@ -626,6 +687,8 @@ class SweepRunner:
             # VR coupling (CRN / antithetic) needs the jaxsim hook seam:
             # auto routes coupled sweeps to the XLA event engine instead
             and not vr_coupled
+            # the flight recorder's rings live in the XLA event engine
+            and self.trace is None
             # the VMEM kernel models the round-5 event-engine feature set
             # (overload policies, circuit breakers, DB pools, cache
             # mixtures, LLM dynamics, weighted endpoints, multi-generator
@@ -648,6 +711,7 @@ class SweepRunner:
                 collect_clocks=False,
                 n_hist_bins=n_hist_bins,
                 crn=self._crn,
+                trace=self.trace,
             )
             self.engine_kind = "event"
         if self._gauge_sel is not None and self.engine_kind != "fast":
@@ -697,6 +761,13 @@ class SweepRunner:
         # are different result streams and must never be merged
         if self._crn:
             digest.update(b"crn")
+        # traced chunks carry flight arrays in their npz; budget changes
+        # change the array shapes
+        if self.trace is not None:
+            digest.update(b"trace")
+            digest.update(
+                f"{self.trace.sample_requests}/{self.trace.event_slots}".encode(),
+            )
         # the streaming-series spec changes the per-chunk npz contents
         if self._gauge_sel is not None:
             digest.update(b"gauge-series")
@@ -1241,6 +1312,11 @@ class _SweepCheckpoint:
             payload["retry_budget_exhausted"] = part.retry_budget_exhausted
         if part.attempts_hist is not None:
             payload["attempts_hist"] = part.attempts_hist
+        if part.flight_ev is not None:
+            payload["flight_ev"] = part.flight_ev
+            payload["flight_node"] = part.flight_node
+            payload["flight_t"] = part.flight_t
+            payload["flight_n"] = part.flight_n
         # atomic write so an interrupt never leaves a half-written chunk
         tmp = self.dir / f".chunk_{start:08d}.{os.getpid()}.tmp.npz"
         np.savez(tmp, **payload)
@@ -1289,6 +1365,12 @@ class _SweepCheckpoint:
                 attempts_hist=(
                     data["attempts_hist"] if "attempts_hist" in data else None
                 ),
+                flight_ev=data["flight_ev"] if "flight_ev" in data else None,
+                flight_node=(
+                    data["flight_node"] if "flight_node" in data else None
+                ),
+                flight_t=data["flight_t"] if "flight_t" in data else None,
+                flight_n=data["flight_n"] if "flight_n" in data else None,
                 **{name: data[name] for name in self._ARRAY_FIELDS},
             )
 
@@ -1634,6 +1716,26 @@ def _concat_sweeps(parts: list[SweepResults]) -> SweepResults:
             llm_cost_sumsq=(
                 np.concatenate([p.llm_cost_sumsq for p in parts])
                 if all(p.llm_cost_sumsq is not None for p in parts)
+                else None
+            ),
+            flight_ev=(
+                np.concatenate([p.flight_ev for p in parts])
+                if all(p.flight_ev is not None for p in parts)
+                else None
+            ),
+            flight_node=(
+                np.concatenate([p.flight_node for p in parts])
+                if all(p.flight_node is not None for p in parts)
+                else None
+            ),
+            flight_t=(
+                np.concatenate([p.flight_t for p in parts])
+                if all(p.flight_t is not None for p in parts)
+                else None
+            ),
+            flight_n=(
+                np.concatenate([p.flight_n for p in parts])
+                if all(p.flight_n is not None for p in parts)
                 else None
             ),
         )

@@ -104,9 +104,12 @@ class SimulationRunner:
         if backend == Backend.NATIVE:
             from asyncflow_tpu.engines.oracle.native import native_available
 
+            # "trace" passes through so run_native can refuse the flight
+            # recorder with its actionable diagnostic
             unsupported = set(self.engine_options) - {
                 "collect_gauges",
                 "collect_traces",
+                "trace",
             }
             if unsupported:
                 msg = (

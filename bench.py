@@ -50,11 +50,19 @@ def _parse_args(argv: list[str]) -> dict:
     compiled shape) and report scen/s as the repeat mean with a bootstrap
     confidence interval (asyncflow_tpu.analysis) instead of a single-shot
     number; the interval lands in the BENCH JSON under ``detail.repeats``.
+
+    ``--trace-guard``: run the flight-recorder overhead guard — assert the
+    event engine's outputs with tracing DISABLED are bit-identical to the
+    pre-trace program (same seeds, byte-compared histograms/counters) and
+    report the scen/s delta with tracing ENABLED under
+    ``detail.trace_guard``.
     """
-    opts = {"telemetry": None, "repeats": None}
+    opts = {"telemetry": None, "repeats": None, "trace_guard": False}
     it = iter(argv)
     for arg in it:
-        if arg == "--telemetry":
+        if arg == "--trace-guard":
+            opts["trace_guard"] = True
+        elif arg == "--telemetry":
             opts["telemetry"] = next(it, None)
             if opts["telemetry"] is None:
                 raise SystemExit("--telemetry needs an output path")
@@ -146,6 +154,95 @@ def _bench_shape() -> tuple[int, int]:
 
 def _emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
+
+
+def _trace_guard() -> dict:
+    """Flight-recorder overhead guard (BENCH_TRACE_GUARD=1 / --trace-guard).
+
+    Two contracts, on a small event-engine sweep of the bench topology:
+
+    1. **bit-identity**: every non-trace result array of the TRACED engine
+       byte-compares equal to the plain engine's across the same seeds —
+       recording consumes no draws and mutates no simulation state.  (The
+       plain engine being bit-identical to pre-trace builds is pinned
+       separately by tests/parity/test_flight_recorder.py's golden
+       digests.)
+    2. **measured overhead**: scen/s with the recorder enabled vs
+       disabled, reported (not gated — ring writes are masked scatters and
+       their cost is the number this detail exists to track).
+    """
+    import numpy as np
+
+    from asyncflow_tpu.observability.simtrace import TraceConfig
+    from asyncflow_tpu.parallel.sweep import SweepRunner
+
+    guard_payload = _payload()
+    # small horizon: the guard measures *relative* overhead, not throughput
+    guard_payload.sim_settings.total_simulation_time = int(
+        os.environ.get("BENCH_TRACE_GUARD_HORIZON", "60"),
+    )
+    n = int(os.environ.get("BENCH_TRACE_GUARD_SCENARIOS", "32"))
+    base = SweepRunner(guard_payload, engine="event", use_mesh=False)
+    traced = SweepRunner(
+        guard_payload,
+        engine="event",
+        use_mesh=False,
+        trace=TraceConfig(sample_requests=8, event_slots=48),
+    )
+    # warm both compiled shapes, then measure
+    base.run(n, seed=SEED, chunk_size=n)
+    traced.run(n, seed=SEED, chunk_size=n)
+    t0 = time.time()
+    rep_off = base.run(n, seed=SEED + 1, chunk_size=n)
+    wall_off = time.time() - t0
+    t0 = time.time()
+    rep_on = traced.run(n, seed=SEED + 1, chunk_size=n)
+    wall_on = time.time() - t0
+
+    # discrete outputs (counts, histograms, selections) must byte-compare;
+    # the float32 running SUMS may differ by one ulp because the traced
+    # program is a different XLA compilation (ring scatters move fusion
+    # boundaries, so `sum + x` may or may not contract) — every individual
+    # latency is pinned exactly through the histogram and min/max
+    mismatched = [
+        name
+        for name in (
+            "completed",
+            "latency_hist",
+            "latency_min",
+            "latency_max",
+            "throughput",
+            "total_generated",
+            "total_dropped",
+            "overflow_dropped",
+        )
+        if not np.array_equal(
+            np.asarray(getattr(rep_off.results, name)),
+            np.asarray(getattr(rep_on.results, name)),
+        )
+    ]
+    for name in ("latency_sum", "latency_sumsq"):
+        a = np.asarray(getattr(rep_off.results, name))
+        b = np.asarray(getattr(rep_on.results, name))
+        if not np.allclose(a, b, rtol=1e-6, atol=0.0):
+            mismatched.append(name)
+    if mismatched:
+        msg = (
+            "trace guard FAILED: enabling the flight recorder changed "
+            f"non-trace outputs {mismatched} — recording must never "
+            "consume a draw or mutate simulation state"
+        )
+        raise AssertionError(msg)
+    off_rate = n / max(wall_off, 1e-9)
+    on_rate = n / max(wall_on, 1e-9)
+    return {
+        "n_scenarios": n,
+        "horizon_s": int(guard_payload.sim_settings.total_simulation_time),
+        "bit_identical_outputs": True,
+        "scen_per_s_trace_off": round(off_rate, 3),
+        "scen_per_s_trace_on": round(on_rate, 3),
+        "overhead_pct": round((off_rate / max(on_rate, 1e-9) - 1) * 100, 2),
+    }
 
 
 def _result_json(
@@ -387,6 +484,15 @@ def run_measurement() -> None:
         detail["repeats"] = repeat_detail
     if telemetry_out:
         detail["telemetry"] = telemetry_out
+    if os.environ.get("BENCH_TRACE_GUARD") == "1":
+        detail["trace_guard"] = _trace_guard()
+        print(
+            "trace guard: outputs bit-identical; overhead "
+            f"{detail['trace_guard']['overhead_pct']:+.1f}% "
+            f"({detail['trace_guard']['scen_per_s_trace_on']:.1f} vs "
+            f"{detail['trace_guard']['scen_per_s_trace_off']:.1f} scen/s)",
+            file=sys.stderr,
+        )
     if on_accel:
         # Device-time breakdown.  One blocking dispatch costs
         # warm_chunk_wall_s = kernel time + tunnel round trip, and the RTT
@@ -569,6 +675,8 @@ def main() -> None:
         os.environ["BENCH_TELEMETRY"] = opts["telemetry"]
     if opts["repeats"]:
         os.environ["BENCH_REPEATS"] = str(opts["repeats"])
+    if opts["trace_guard"]:
+        os.environ["BENCH_TRACE_GUARD"] = "1"
 
     if os.path.exists(PARTIAL_PATH):
         os.unlink(PARTIAL_PATH)

@@ -28,4 +28,29 @@ python -m pytest \
   tests/unit/analysis/test_compare.py::test_event_engine_crn_compare_smoke \
   tests/parity/test_sweep_determinism.py::test_scenario_keys_prefix_stable_in_n \
   -q -p no:cacheprovider
+# simulation-domain tracing slice: a tiny traced scenario must export a
+# schema-valid simulated-time Perfetto trace, and the divergence CLI must
+# report zero divergence on the deterministic parity scenario
+# (docs/guides/observability.md §"Tracing the simulated world")
+python - <<'PY'
+import yaml
+from asyncflow_tpu.engines.oracle.engine import OracleEngine
+from asyncflow_tpu.observability import (
+    TraceConfig, load_chrome_trace, validate_sim_trace, write_sim_trace,
+)
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+payload = SimulationPayload.model_validate(
+    yaml.safe_load(open("examples/yaml_input/data/trace_parity.yml").read()),
+)
+res = OracleEngine(payload, seed=0, trace=TraceConfig(sample_requests=4)).run()
+path = write_sim_trace(
+    "/tmp/asyncflow_smoke.trace.json", res, payload=payload, resolution_s=0.5,
+)
+problems = validate_sim_trace(load_chrome_trace(path))
+assert not problems, problems
+print("sim-trace schema OK")
+PY
+python -m asyncflow_tpu.observability.diverge \
+  examples/yaml_input/data/trace_parity.yml --mode flight --seed 0
 python -m pytest tests/ -m smoke -q "$@"

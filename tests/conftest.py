@@ -167,6 +167,12 @@ _SMOKE_TESTS = (
     "tests/parity/test_sweep_determinism.py::test_split_and_chunk_compose",
     "tests/unit/analysis/test_adaptive.py::test_stops_when_targets_met",
     "tests/unit/analysis/test_compare.py::test_event_engine_crn_compare_smoke",
+    # simulation-domain tracing tier (flight recorder + divergence finder):
+    # pre-trace golden bit-identity, oracle<->jax span equality, and the
+    # engines-without-event-state refusal diagnostics
+    "tests/parity/test_flight_recorder.py::TestDisabledBitIdentity::test_event_engine_pre_trace_golden",
+    "tests/parity/test_flight_recorder.py::TestSpanEquality::test_zero_divergence_on_parity_scenario",
+    "tests/parity/test_flight_recorder.py::TestRefusals::test_sweep_auto_routes_traced_sweeps_to_event",
 )
 
 

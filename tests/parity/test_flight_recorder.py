@@ -1,0 +1,320 @@
+"""Flight-recorder contracts across engines.
+
+Four load-bearing guarantees of simulation-domain tracing
+(docs/guides/observability.md):
+
+1. **bit-identity off**: with no ``trace=``, the engines compile the exact
+   pre-trace program — golden digests pin the streams to pre-PR bytes;
+2. **bit-identity on**: enabling the recorder changes NO non-trace output
+   (recording consumes no draws);
+3. **span equality**: on the deterministic-latency parity scenario the
+   oracle and the jax event engine emit identical canonical span records
+   (the divergence finder reports zero divergence — the smoke-tier gate);
+4. **explicit truncation**: a traced request that exceeds its event-slot
+   budget keeps its FIRST ``event_slots`` events and surfaces the overflow
+   in ``FlightRecord.dropped`` on both engines.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+import pytest
+import yaml
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.engines.jaxsim.engine import Engine, run_single, scenario_keys
+from asyncflow_tpu.engines.oracle.engine import OracleEngine
+from asyncflow_tpu.observability.diverge import compare_flight, find_first_divergence
+from asyncflow_tpu.observability.simtrace import (
+    FR_ABANDON,
+    FR_RETRY,
+    FR_SPAWN,
+    FR_TIMEOUT,
+    TraceConfig,
+    flight_dropped_events,
+)
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+pytestmark = pytest.mark.integration
+
+BASE = "tests/integration/data/single_server.yml"
+PARITY = "examples/yaml_input/data/trace_parity.yml"
+
+
+def _payload(path: str = BASE, horizon: int = 60, mut=None) -> SimulationPayload:
+    data = yaml.safe_load(open(path).read())
+    data["sim_settings"]["total_simulation_time"] = horizon
+    if mut is not None:
+        mut(data)
+    return SimulationPayload.model_validate(data)
+
+
+# ---------------------------------------------------------------------------
+# 1. tracing disabled is bit-identical to pre-PR streams
+# ---------------------------------------------------------------------------
+
+
+def _event_digest(state) -> str:
+    h = hashlib.sha256()
+    for name in (
+        "hist",
+        "lat_count",
+        "lat_sum",
+        "thr",
+        "clock",
+        "clock_n",
+        "n_generated",
+        "n_dropped",
+        "n_overflow",
+        "n_rejected",
+    ):
+        h.update(np.asarray(getattr(state, name)).tobytes())
+    return h.hexdigest()[:16]
+
+
+class TestDisabledBitIdentity:
+    """Golden digests computed at the commit BEFORE the flight recorder
+    landed: any drift in the untraced engines' output bytes fails here."""
+
+    def test_event_engine_pre_trace_golden(self) -> None:
+        plan = compile_payload(_payload())
+        engine = Engine(plan, collect_clocks=True, collect_gauges=True)
+        final = engine.run_batch(scenario_keys(7, 4))
+        assert _event_digest(final) == "b49c8ed7c53437fe"
+
+    def test_fast_path_pre_trace_golden(self) -> None:
+        from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+
+        plan = compile_payload(_payload())
+        final = FastEngine(plan, collect_clocks=True).run_batch(
+            scenario_keys(7, 4),
+        )
+        h = hashlib.sha256()
+        for name in ("hist", "clock", "clock_n", "n_generated"):
+            h.update(np.asarray(getattr(final, name)).tobytes())
+        assert h.hexdigest()[:16] == "eb1ea937dddb3f73"
+
+    def test_oracle_pre_trace_golden(self) -> None:
+        res = OracleEngine(_payload(), seed=7).run()
+        digest = hashlib.sha256(res.rqs_clock.tobytes()).hexdigest()[:16]
+        assert digest == "a4f0058fd261c2a0"
+        assert res.total_generated == 1081
+
+
+# ---------------------------------------------------------------------------
+# 2. tracing enabled changes no non-trace output
+# ---------------------------------------------------------------------------
+
+
+class TestEnabledNeutrality:
+    def test_event_engine_outputs_identical_with_tracing(self) -> None:
+        plan = compile_payload(_payload())
+        keys = scenario_keys(7, 4)
+        plain = Engine(plan, collect_clocks=True).run_batch(keys)
+        traced = Engine(
+            plan,
+            collect_clocks=True,
+            trace=TraceConfig(sample_requests=4, event_slots=16),
+        ).run_batch(keys)
+        for name in ("hist", "clock", "clock_n", "n_generated", "n_dropped"):
+            assert np.array_equal(
+                np.asarray(getattr(plain, name)),
+                np.asarray(getattr(traced, name)),
+            ), name
+
+    def test_oracle_outputs_identical_with_tracing(self) -> None:
+        payload = _payload()
+        plain = OracleEngine(payload, seed=7).run()
+        traced = OracleEngine(
+            payload, seed=7, trace=TraceConfig(sample_requests=4),
+        ).run()
+        assert np.array_equal(plain.rqs_clock, traced.rqs_clock)
+        assert plain.total_generated == traced.total_generated
+        assert traced.flight and plain.flight is None
+
+
+# ---------------------------------------------------------------------------
+# 3. oracle <-> jax span equality on the parity scenario
+# ---------------------------------------------------------------------------
+
+
+class TestSpanEquality:
+    def test_zero_divergence_on_parity_scenario(self) -> None:
+        """The acceptance gate: identical span records, localized context
+        otherwise (the divergence-CLI smoke slice runs the same check)."""
+        payload = _payload(PARITY, horizon=120)
+        report = find_first_divergence(
+            payload, seed=0, trace=TraceConfig(sample_requests=8),
+        )
+        assert report.equal, report.summary()
+        assert report.requests_compared >= 6
+
+    def test_retry_lifecycle_spans_match(self) -> None:
+        """Timeout -> backoff re-issue -> abandon, deterministic end to
+        end (variance-0 edges, jitter-free backoff, service >> timeout):
+        the full client-retry lifecycle must canonicalize identically on
+        both engines — the record the resilience guide's debugging story
+        stands on."""
+
+        def mut(data):
+            srv = data["topology_graph"]["nodes"]["servers"][0]
+            srv["endpoints"][0]["steps"] = [
+                {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.004}},
+                {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.8}},
+            ]
+            data["retry_policy"] = {
+                "request_timeout_s": 0.05,
+                "max_attempts": 2,
+                "backoff_base_s": 0.1,
+                "jitter": 0.0,
+            }
+
+        payload = _payload(PARITY, horizon=90, mut=mut)
+        cfg = TraceConfig(sample_requests=6, event_slots=32)
+        res_o = OracleEngine(payload, seed=1, trace=cfg).run()
+        res_j = run_single(payload, seed=1, engine="event", trace=cfg)
+        report = compare_flight(
+            res_o.flight, res_j.flight, horizon=90.0,
+        )
+        assert report.equal, report.summary()
+        # the lifecycle actually exercises the retry machinery
+        codes = {
+            c for rec in res_o.flight.values() for c in rec.codes()
+        }
+        assert {FR_TIMEOUT, FR_RETRY, FR_SPAWN, FR_ABANDON} <= codes
+
+
+# ---------------------------------------------------------------------------
+# 4. explicit ring truncation
+# ---------------------------------------------------------------------------
+
+
+class TestTruncation:
+    def test_both_engines_surface_dropped_events(self) -> None:
+        payload = _payload(PARITY, horizon=120)
+        tiny = TraceConfig(sample_requests=4, event_slots=4)
+        full = TraceConfig(sample_requests=4, event_slots=32)
+
+        res_full = OracleEngine(payload, seed=0, trace=full).run()
+        for engine_res in (
+            OracleEngine(payload, seed=0, trace=tiny).run(),
+            run_single(payload, seed=0, engine="event", trace=tiny),
+        ):
+            assert flight_dropped_events(engine_res.flight) > 0
+            for req, rec in engine_res.flight.items():
+                assert len(rec.events) <= 4
+                assert rec.dropped >= 1  # each span has >= 5 transitions
+
+        # truncation keeps the FIRST ``event_slots`` transitions verbatim
+        res_tiny = OracleEngine(payload, seed=0, trace=tiny).run()
+        for req, rec in res_tiny.flight.items():
+            assert rec.events == res_full.flight[req].events[:4]
+
+    def test_sweep_surfaces_dropped_counts(self) -> None:
+        from asyncflow_tpu.parallel import SweepRunner
+
+        payload = _payload(PARITY, horizon=60)
+        runner = SweepRunner(
+            payload,
+            use_mesh=False,
+            trace=TraceConfig(sample_requests=3, event_slots=4),
+        )
+        assert runner.engine_kind == "event"
+        report = runner.run(3, seed=0, chunk_size=3)
+        dropped = report.flight_dropped_events()
+        assert dropped.shape == (3,)
+        assert np.all(dropped > 0)
+        records = report.flight_records(0)
+        assert records and all(
+            len(r.events) <= 4 and r.dropped >= 1 for r in records.values()
+        )
+
+
+# ---------------------------------------------------------------------------
+# refusals: engines without per-event state decline with a named reason
+# ---------------------------------------------------------------------------
+
+
+class TestRefusals:
+    def test_fast_engine_refuses(self) -> None:
+        from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+
+        with pytest.raises(ValueError, match="closed form"):
+            FastEngine(compile_payload(_payload()), trace=TraceConfig())
+
+    def test_pallas_engine_refuses(self) -> None:
+        from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
+
+        with pytest.raises(ValueError, match="VMEM"):
+            PallasEngine(compile_payload(_payload()), trace=TraceConfig())
+
+    def test_native_refuses(self) -> None:
+        from asyncflow_tpu.engines.oracle.native import run_native
+
+        with pytest.raises(ValueError, match="ABI"):
+            run_native(compile_payload(_payload()), trace=TraceConfig())
+
+    def test_run_single_forced_fast_refuses(self) -> None:
+        with pytest.raises(ValueError, match="event engine"):
+            run_single(_payload(), engine="fast", trace=TraceConfig())
+
+    def test_sweep_runner_forced_engines_refuse(self) -> None:
+        from asyncflow_tpu.parallel import SweepRunner
+
+        for engine in ("fast", "pallas", "native"):
+            with pytest.raises(ValueError, match="flight recorder"):
+                SweepRunner(
+                    _payload(), use_mesh=False, engine=engine,
+                    trace=TraceConfig(),
+                )
+
+    def test_sweep_auto_routes_traced_sweeps_to_event(self) -> None:
+        from asyncflow_tpu.parallel import SweepRunner
+
+        payload = _payload()
+        assert SweepRunner(payload, use_mesh=False).engine_kind == "fast"
+        assert (
+            SweepRunner(
+                payload, use_mesh=False, trace=TraceConfig(),
+            ).engine_kind
+            == "event"
+        )
+
+
+# ---------------------------------------------------------------------------
+# breaker timeline
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_timeline_records_state_transitions() -> None:
+    """A breaker tripped by a dead LB edge leaves the same transition
+    sequence in the oracle's list and the jax engine's on-device ring:
+    open (1) on threshold, half-open (2) after cooldown."""
+
+    def mut(data):
+        data["rqs_input"]["avg_active_users"]["mean"] = 60
+        # srv-2's edge drops everything: its breaker must trip
+        for edge in data["topology_graph"]["edges"]:
+            if edge["target"] == "srv-2":
+                edge["dropout_rate"] = 1.0
+        data["topology_graph"]["nodes"]["load_balancer"]["circuit_breaker"] = {
+            "failure_threshold": 3,
+            "cooldown_s": 5.0,
+            "half_open_probes": 1,
+        }
+
+    payload = _payload(
+        "examples/yaml_input/data/two_servers_lb.yml", horizon=60, mut=mut,
+    )
+    cfg = TraceConfig(sample_requests=1, breaker_slots=64)
+    res_o = OracleEngine(payload, seed=0, trace=cfg).run()
+    res_j = run_single(payload, seed=0, engine="event", trace=cfg)
+    for timeline in (res_o.breaker_timeline, res_j.breaker_timeline):
+        assert timeline, "breaker never tripped"
+        states = [state for _t, _slot, state in timeline]
+        assert 1 in states  # opened
+        assert 2 in states  # woke half-open after cooldown
+        times = [t for t, _slot, _state in timeline]
+        assert times == sorted(times)

@@ -1,0 +1,99 @@
+"""Divergence-finder comparator: first differing event, aligned context."""
+
+from __future__ import annotations
+
+from asyncflow_tpu.observability.diverge import compare_flight
+from asyncflow_tpu.observability.simtrace import (
+    FR_ARRIVE_SRV,
+    FR_COMPLETE,
+    FR_DROP,
+    FR_SPAWN,
+    FR_TRANSIT,
+    FR_WAIT_CPU,
+    FlightRecord,
+)
+
+
+def _flight(*event_lists) -> dict[int, FlightRecord]:
+    return {
+        i: FlightRecord(req=i, events=list(evs))
+        for i, evs in enumerate(event_lists)
+    }
+
+
+_BASE = [
+    (FR_SPAWN, 0, 0.0),
+    (FR_TRANSIT, 0, 0.003),
+    (FR_ARRIVE_SRV, 0, 0.003),
+    (FR_TRANSIT, 1, 0.020),
+    (FR_COMPLETE, -1, 0.020),
+]
+
+
+def test_identical_streams_report_equal() -> None:
+    report = compare_flight(_flight(_BASE), _flight(_BASE))
+    assert report.equal
+    assert report.requests_compared == 1
+    assert "no divergence" in report.summary()
+
+
+def test_time_tolerance_absorbs_float32_noise() -> None:
+    """A few microseconds of float32 sim-clock rounding is precision, not
+    divergence; past the tolerance it IS the first differing event."""
+    shifted = [(c, n, t + 10e-6) for c, n, t in _BASE[1:]]
+    near = _flight([_BASE[0], *shifted])
+    report = compare_flight(_flight(_BASE), near, tol_us=50.0)
+    assert report.equal
+    report = compare_flight(_flight(_BASE), near, tol_us=5.0)
+    assert not report.equal
+    assert report.divergence.kind == "time"
+    assert report.divergence.index == 1
+
+
+def test_code_divergence_localized_with_context() -> None:
+    diverged = list(_BASE)
+    diverged[3] = (FR_DROP, 1, 0.015)  # delivery became a drop
+    diverged[4] = (FR_SPAWN, 0, 0.1)
+    report = compare_flight(_flight(_BASE), _flight(diverged), context=2)
+    assert not report.equal
+    d = report.divergence
+    assert (d.request, d.index, d.kind) == (0, 3, "code")
+    # aligned windows with the divergence marked
+    assert any(line.startswith(">") for line in d.context_oracle)
+    assert any("drop" in line for line in d.context_jax)
+    assert "first divergence at request 0, event 3" in report.summary()
+
+
+def test_node_divergence() -> None:
+    diverged = list(_BASE)
+    diverged[2] = (FR_ARRIVE_SRV, 1, 0.003)  # routed to the wrong server
+    report = compare_flight(_flight(_BASE), _flight(diverged))
+    assert report.divergence.kind == "node"
+    assert report.divergence.index == 2
+
+
+def test_length_divergence_when_prefix_matches() -> None:
+    longer = [*_BASE[:3], (FR_WAIT_CPU, 0, 0.003), *_BASE[3:]]
+    report = compare_flight(_flight(_BASE), _flight(longer))
+    assert not report.equal
+    assert report.divergence.kind in ("code", "length")
+    assert report.divergence.index == 3
+
+
+def test_first_diverging_request_wins() -> None:
+    """Requests are compared in spawn order: the report localizes the
+    EARLIEST diverging request, not an arbitrary one."""
+    bad = list(_BASE)
+    bad[1] = (FR_TRANSIT, 0, 0.009)
+    report = compare_flight(
+        _flight(_BASE, _BASE), _flight(_BASE, bad),
+    )
+    assert report.divergence.request == 1
+
+
+def test_tail_mismatch_reported_not_diverged() -> None:
+    """A request present on one side only (arrival-count tail near the
+    horizon) is surfaced but is not a first-divergence."""
+    report = compare_flight(_flight(_BASE, _BASE), _flight(_BASE))
+    assert report.equal
+    assert report.only_oracle == [1]

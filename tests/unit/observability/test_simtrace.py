@@ -1,0 +1,179 @@
+"""Flight-recorder layout: TraceConfig, decode, canonicalization, export."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from asyncflow_tpu.observability.export import (
+    SIM_PID_REQUESTS,
+    sim_trace_events,
+    validate_sim_trace,
+)
+from asyncflow_tpu.observability.simtrace import (
+    FR_ARRIVE_SRV,
+    FR_COMPLETE,
+    FR_SPAWN,
+    FR_TRANSIT,
+    FlightRecord,
+    TraceConfig,
+    canonical_spans,
+    decode_breaker,
+    decode_flight,
+    flight_dropped_events,
+)
+
+
+class TestTraceConfig:
+    def test_defaults(self) -> None:
+        cfg = TraceConfig()
+        assert cfg.sample_requests == 8
+        assert cfg.event_slots == 48
+        assert cfg.resolution_s is None
+
+    def test_budgets_validated(self) -> None:
+        with pytest.raises(ValueError):
+            TraceConfig(sample_requests=0)
+        with pytest.raises(ValueError):
+            TraceConfig(event_slots=1)  # below the 4-slot floor
+        with pytest.raises(ValueError):
+            TraceConfig(resolution_s=0.0)
+
+
+class TestDecode:
+    def test_rows_without_spawns_omitted(self) -> None:
+        ev = np.zeros((3, 4), np.int32)
+        node = np.zeros((3, 4), np.int32)
+        t = np.zeros((3, 4), np.float32)
+        n = np.array([2, 0, 1], np.int32)
+        ev[0, :2] = [FR_SPAWN, FR_TRANSIT]
+        ev[2, 0] = FR_SPAWN
+        flight = decode_flight(ev, node, t, n)
+        assert sorted(flight) == [0, 2]
+        assert flight[0].codes() == [FR_SPAWN, FR_TRANSIT]
+
+    def test_overflow_is_the_dropped_counter(self) -> None:
+        """fr_n keeps counting past the slot budget: the overflow IS the
+        explicit truncation signal (ISSUE: no silent ring truncation)."""
+        ev = np.full((1, 4), FR_TRANSIT, np.int32)
+        ev[0, 0] = FR_SPAWN
+        node = np.zeros((1, 4), np.int32)
+        t = np.zeros((1, 4), np.float32)
+        n = np.array([9], np.int32)  # 9 transitions into 4 slots
+        flight = decode_flight(ev, node, t, n)
+        assert len(flight[0].events) == 4
+        assert flight[0].dropped == 5
+        assert flight_dropped_events(flight) == 5
+        assert "5 later event(s) dropped" in flight[0].describe()[-1]
+
+    def test_decode_breaker(self) -> None:
+        out = decode_breaker(
+            np.array([1.0, 2.0, 0.0]),
+            np.array([0, 1, 0]),
+            np.array([1, 2, 0]),
+            2,
+        )
+        assert out == [(1.0, 0, 1), (2.0, 1, 2)]
+
+
+class TestCanonicalSpans:
+    def _rec(self, events) -> dict[int, FlightRecord]:
+        return {0: FlightRecord(req=0, events=events)}
+
+    def test_relative_and_quantized(self) -> None:
+        spans = canonical_spans(
+            self._rec(
+                [(FR_SPAWN, 0, 10.0), (FR_TRANSIT, 1, 10.0035)],
+            ),
+        )
+        assert spans[0] == ((FR_SPAWN, 0, 0), (FR_TRANSIT, 1, 3500))
+
+    def test_horizon_filters_forward_dated_events(self) -> None:
+        """The jax engine records exit deliveries the oracle heap never
+        executes (t >= horizon): canonicalization drops them from both."""
+        spans = canonical_spans(
+            self._rec(
+                [(FR_SPAWN, 0, 59.0), (FR_COMPLETE, -1, 60.5)],
+            ),
+            horizon=60.0,
+        )
+        assert spans[0] == ((FR_SPAWN, 0, 0),)
+
+    def test_empty_after_filter_omitted(self) -> None:
+        spans = canonical_spans(
+            self._rec([(FR_SPAWN, 0, 61.0)]), horizon=60.0,
+        )
+        assert spans == {}
+
+
+class _Settings:
+    sample_period_s = 0.1
+    total_simulation_time = 10
+
+
+class _Results:
+    """Minimal SimulationResults stand-in for the exporter."""
+
+    settings = _Settings()
+    server_ids = ["srv-1"]
+    edge_ids = ["e-in", "e-out"]
+    breaker_timeline = [(1.5, 0, 1), (4.5, 0, 2)]
+    flight = {
+        0: FlightRecord(
+            req=0,
+            events=[
+                (FR_SPAWN, 0, 1.0),
+                (FR_TRANSIT, 0, 1.1),
+                (FR_ARRIVE_SRV, 0, 1.1),
+                (FR_TRANSIT, 1, 1.4),
+                (FR_COMPLETE, -1, 1.4),
+            ],
+        ),
+    }
+    sampled = {
+        "ready_queue_len": {"srv-1": np.array([0.0, 1.0, 2.0, 1.0])},
+        "edge_concurrent_connection": {"e-in": np.array([0.0, 1.0, 0.0, 0.0])},
+    }
+
+
+class TestSimTraceExport:
+    def test_roundtrip_validates(self) -> None:
+        events = sim_trace_events(_Results())
+        doc = {"displayTimeUnit": "ms", "traceEvents": events}
+        assert validate_sim_trace(doc) == []
+        # one thread per traced request, spans in simulated microseconds
+        spans = [e for e in events if e["ph"] == "X"]
+        assert any(e["name"] == "transit e-in" for e in spans)
+        tids = {
+            e["tid"]
+            for e in events
+            if e.get("pid") == SIM_PID_REQUESTS and e["ph"] == "X"
+        }
+        assert tids == {1}
+        counters = [e for e in events if e["ph"] == "C"]
+        assert any("queue depth" in e["name"] for e in counters)
+        assert any("breaker" in e["name"] for e in counters)
+
+    def test_resolution_strides_counters(self) -> None:
+        fine = [
+            e for e in sim_trace_events(_Results()) if e["ph"] == "C"
+            and "queue depth" in e["name"]
+        ]
+        coarse = [
+            e
+            for e in sim_trace_events(_Results(), resolution_s=0.2)
+            if e["ph"] == "C" and "queue depth" in e["name"]
+        ]
+        assert len(coarse) == (len(fine) + 1) // 2
+
+    def test_validator_rejects_malformed(self) -> None:
+        assert validate_sim_trace({}) == ["missing traceEvents list"]
+        bad = {
+            "traceEvents": [
+                {"ph": "X", "pid": 1, "name": "x", "ts": 0.0},  # no dur
+                {"ph": "C", "pid": 1, "name": "c", "ts": 0.0, "args": {"v": "s"}},
+            ],
+        }
+        problems = validate_sim_trace(bad)
+        assert any("without dur" in p for p in problems)
+        assert any("non-numeric counter" in p for p in problems)

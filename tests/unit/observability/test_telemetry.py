@@ -365,3 +365,22 @@ def test_instrument_jit_records_compile_under_telemetry(tmp_path) -> None:
     assert tel.compiles[0]["engine"] == "test"
     assert tel.compiles[0]["lower_s"] is not None
     assert tel.compiles[0]["compile_s"] is not None
+
+
+def test_default_ledger_path_lives_inside_the_cache_dir(monkeypatch) -> None:
+    """The ledger shares the compile cache's directory (and lifecycle): it
+    used to sit BESIDE .jax_cache — the repo root with the default cache —
+    where generated JSONL kept landing in commits."""
+    import os
+
+    from asyncflow_tpu.observability import default_ledger_path
+    from asyncflow_tpu.utils.compile_cache import ENV_VAR
+
+    monkeypatch.setenv(ENV_VAR, "/tmp/some_cache_dir")
+    assert default_ledger_path() == os.path.join(
+        "/tmp/some_cache_dir", "compile_ledger.jsonl",
+    )
+    monkeypatch.delenv(ENV_VAR)
+    from asyncflow_tpu.utils.compile_cache import cache_location
+
+    assert os.path.dirname(default_ledger_path()) == cache_location()

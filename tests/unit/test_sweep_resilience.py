@@ -131,12 +131,12 @@ def test_sweep_survives_injected_oom_with_downshift(monkeypatch) -> None:
     real_run_batch = runner2.engine.run_batch
     calls = {"n": 0}
 
-    def flaky_run_batch(keys, ov=None):
+    def flaky_run_batch(keys, ov=None, **kw):
         calls["n"] += 1
         if calls["n"] == 1:
             msg = "RESOURCE_EXHAUSTED: out of memory allocating 1.0GiB"
             raise _FakeOOM(msg)
-        return real_run_batch(keys, ov)
+        return real_run_batch(keys, ov, **kw)
 
     monkeypatch.setattr(runner2.engine, "run_batch", flaky_run_batch)
     report = runner2.run(n, seed=9, chunk_size=8)
@@ -151,7 +151,7 @@ def test_sweep_oom_at_floor_reraises_with_hint(monkeypatch) -> None:
     payload = _payload()
     runner = SweepRunner(payload, engine="event", use_mesh=False)
 
-    def always_oom(keys, ov=None):
+    def always_oom(keys, ov=None, **kw):
         raise _FakeOOM("RESOURCE_EXHAUSTED: out of memory")
 
     monkeypatch.setattr(runner.engine, "run_batch", always_oom)
