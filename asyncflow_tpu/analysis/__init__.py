@@ -18,6 +18,7 @@ from asyncflow_tpu.analysis.estimators import (
     bootstrap_mean_ci,
     bootstrap_quantile_ci,
     bootstrap_ratio_ci,
+    effective_results,
     interval_for_metric,
     paired_delta_for_metric,
     paired_delta_quantile_ci,
@@ -54,6 +55,7 @@ __all__ = [
     "bootstrap_ratio_ci",
     "compare",
     "coupling_diagnostics",
+    "effective_results",
     "interval_for_metric",
     "paired_delta_for_metric",
     "paired_delta_quantile_ci",

@@ -42,7 +42,12 @@ _DEVICE_RESAMPLE_MIN = 4_000_000
 
 @dataclass(frozen=True)
 class IntervalEstimate:
-    """A point estimate with a two-sided confidence interval."""
+    """A point estimate with a two-sided confidence interval.
+
+    ``n_excluded`` notes scenarios masked out by host-fault quarantine
+    (docs/guides/fault-tolerance.md) before estimation: the interval
+    describes the surviving (effective-n) population only.
+    """
 
     point: float
     lo: float
@@ -50,6 +55,7 @@ class IntervalEstimate:
     level: float
     n: int
     method: str
+    n_excluded: int = 0
 
     @property
     def half_width(self) -> float:
@@ -75,6 +81,7 @@ class IntervalEstimate:
             "n": self.n,
             "method": self.method,
             "half_width": self.half_width,
+            "n_excluded": self.n_excluded,
         }
 
 
@@ -391,6 +398,25 @@ def _ratio_components(results, metric: str) -> tuple[np.ndarray, np.ndarray]:
     raise ValueError(msg)
 
 
+def effective_results(results) -> tuple[object, int]:
+    """(results without quarantined rows, number excluded).
+
+    Host-fault quarantine (docs/guides/fault-tolerance.md) zeroes masked
+    rows, which is harmless to pooled-histogram reductions but poisons
+    anything that treats rows as i.i.d. replications (bootstrap resampling
+    would sample the zeros).  Every estimator dispatch drops them first
+    and notes the exclusion on the returned interval.
+    """
+    mask = getattr(results, "quarantined", None)
+    if mask is None:
+        return results, 0
+    mask = np.asarray(mask, bool)
+    n_excluded = int(np.count_nonzero(mask))
+    if n_excluded == 0:
+        return results, 0
+    return results[~mask], n_excluded
+
+
 def interval_for_metric(
     results,
     metric: str,
@@ -405,14 +431,23 @@ def interval_for_metric(
     metrics (mean latency, goodput) bootstrap over scenarios.  Metric names
     match ``SweepReport.summary()`` keys and
     :data:`asyncflow_tpu.schemas.experiment.SUPPORTED_METRICS`.
+    Quarantined scenarios are dropped before estimation; the interval
+    reports them as ``n_excluded``.
     """
+    import dataclasses
+
+    results, n_excluded = effective_results(results)
     if metric in _QUANTILE_METRICS:
-        return pooled_quantile_ci(
+        est = pooled_quantile_ci(
             results.latency_hist, results.hist_edges,
             _QUANTILE_METRICS[metric], level,
         )
-    num, den = _ratio_components(results, metric)
-    return bootstrap_ratio_ci(num, den, level, n_boot=n_boot, seed=seed)
+    else:
+        num, den = _ratio_components(results, metric)
+        est = bootstrap_ratio_ci(num, den, level, n_boot=n_boot, seed=seed)
+    if n_excluded:
+        est = dataclasses.replace(est, n_excluded=n_excluded)
+    return est
 
 
 def paired_delta_for_metric(
@@ -424,9 +459,37 @@ def paired_delta_for_metric(
     n_boot: int = 1000,
     seed: int = 0,
 ) -> IntervalEstimate:
-    """Paired-delta interval (arm B minus arm A) of one summary metric."""
+    """Paired-delta interval (arm B minus arm A) of one summary metric.
+
+    Quarantined scenarios break the pairing on the affected rows, so the
+    UNION of both arms' quarantine masks is dropped from both (keeping
+    surviving pairs aligned) and reported as ``n_excluded``.
+    """
+    import dataclasses
+
+    mask_a = getattr(results_a, "quarantined", None)
+    mask_b = getattr(results_b, "quarantined", None)
+    n_excluded = 0
+    if mask_a is not None or mask_b is not None:
+        n = np.asarray(results_a.completed).shape[0]
+        union = np.zeros(n, bool)
+        for mask in (mask_a, mask_b):
+            if mask is not None:
+                union |= np.asarray(mask, bool)
+        n_excluded = int(np.count_nonzero(union))
+        if n_excluded:
+            results_a = results_a[~union]
+            results_b = results_b[~union]
+
+    def _note(est: IntervalEstimate) -> IntervalEstimate:
+        return (
+            dataclasses.replace(est, n_excluded=n_excluded)
+            if n_excluded
+            else est
+        )
+
     if metric in _QUANTILE_METRICS:
-        return paired_delta_quantile_ci(
+        return _note(paired_delta_quantile_ci(
             results_a.latency_hist,
             results_b.latency_hist,
             results_a.hist_edges,
@@ -434,9 +497,9 @@ def paired_delta_for_metric(
             level,
             n_boot=n_boot,
             seed=seed,
-        )
+        ))
     num_a, den_a = _ratio_components(results_a, metric)
     num_b, den_b = _ratio_components(results_b, metric)
-    return paired_delta_ratio_ci(
+    return _note(paired_delta_ratio_ci(
         num_a, den_a, num_b, den_b, level, n_boot=n_boot, seed=seed,
-    )
+    ))

@@ -28,6 +28,8 @@ class DeviceCounters:
     client deadlines fired, ``retries`` re-issues performed,
     ``budget_exhausted`` retries denied by the token-bucket retry budget.
     Goodput is ``completed``; offered load is ``generated + retries``.
+    ``quarantined`` counts scenarios masked out by host-fault recovery
+    (sweeps only; docs/guides/fault-tolerance.md).
     """
 
     completed: int
@@ -39,6 +41,7 @@ class DeviceCounters:
     timed_out: int = 0
     retries: int = 0
     budget_exhausted: int = 0
+    quarantined: int = 0
 
     def as_dict(self) -> dict[str, int]:
         return asdict(self)
@@ -185,6 +188,34 @@ class SweepResults:
     flight_node: np.ndarray | None = None
     flight_t: np.ndarray | None = None
     flight_n: np.ndarray | None = None
+    #: (S,) bool host-fault quarantine mask: True rows produced non-finite
+    #: metrics (or deterministically crashed the engine) and were masked
+    #: out — their metric rows are zeroed, ``quarantine_reason`` names why.
+    #: None when recovery never fired (docs/guides/fault-tolerance.md).
+    quarantined: np.ndarray | None = None
+    #: (S,) per-scenario quarantine reason strings ('' for clean rows).
+    quarantine_reason: np.ndarray | None = None
+
+    @property
+    def n_quarantined(self) -> int:
+        """Scenarios masked out by host-fault quarantine (0 without)."""
+        return (
+            int(np.count_nonzero(self.quarantined))
+            if self.quarantined is not None
+            else 0
+        )
+
+    def effective(self) -> SweepResults:
+        """Drop quarantined rows — the estimator-facing effective sweep.
+
+        Per-scenario statistics (means of per-scenario percentiles,
+        bootstrap resampling) must not see the zeroed mask rows; pooled
+        histogram reductions are already unaffected (masked rows hold no
+        counts).
+        """
+        if self.quarantined is None or not np.any(self.quarantined):
+            return self
+        return self[~np.asarray(self.quarantined, bool)]
 
     def __getitem__(self, idx) -> SweepResults:
         """Slice along the scenario axis."""
@@ -248,6 +279,14 @@ class SweepResults:
             ),
             flight_t=self.flight_t[idx] if self.flight_t is not None else None,
             flight_n=self.flight_n[idx] if self.flight_n is not None else None,
+            quarantined=(
+                self.quarantined[idx] if self.quarantined is not None else None
+            ),
+            quarantine_reason=(
+                self.quarantine_reason[idx]
+                if self.quarantine_reason is not None
+                else None
+            ),
         )
 
     def percentile(self, q: float) -> np.ndarray:
@@ -284,6 +323,7 @@ class SweepResults:
                 if self.retry_budget_exhausted is not None
                 else 0
             ),
+            quarantined=self.n_quarantined,
         )
 
 

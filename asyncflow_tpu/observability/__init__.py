@@ -42,6 +42,7 @@ from asyncflow_tpu.observability.telemetry import (
     RunTelemetry,
     TelemetryConfig,
     current_telemetry,
+    emit_event_record,
     instrument_jit,
     maybe_phase,
     telemetry_session,
@@ -63,6 +64,7 @@ __all__ = [
     "decode_breaker",
     "decode_flight",
     "default_ledger_path",
+    "emit_event_record",
     "flight_dropped_events",
     "instrument_jit",
     "load_chrome_trace",

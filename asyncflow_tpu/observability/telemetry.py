@@ -238,6 +238,33 @@ def telemetry_session(
     return RunTelemetry(config, kind=kind)
 
 
+def emit_event_record(
+    config: TelemetryConfig | None,
+    *,
+    kind: str,
+    **meta,
+) -> dict | None:
+    """Write one self-contained auxiliary run record of ``kind``.
+
+    The seam for out-of-band events that deserve their own JSONL line
+    beside the main run record — e.g. the sweep layer's
+    ``kind="recovery"`` record (quarantines, retries, preemptions;
+    docs/guides/fault-tolerance.md).  Only the JSONL sink is used: trace /
+    profiler sinks belong to the main run and must not be clobbered by a
+    phase-less side record.  Returns the record (None when telemetry is
+    off).
+    """
+    import dataclasses
+
+    if config is None or not config.enabled:
+        return None
+    config = dataclasses.replace(config, trace_path=None, profile_dir=None)
+    tel = RunTelemetry(config, kind=kind)
+    with tel:
+        tel.add_meta(**meta)
+    return tel.finalize()
+
+
 # ---------------------------------------------------------------------------
 # the engine compile hook
 # ---------------------------------------------------------------------------
@@ -338,6 +365,7 @@ __all__ = [
     "RunTelemetry",
     "TelemetryConfig",
     "current_telemetry",
+    "emit_event_record",
     "instrument_jit",
     "maybe_phase",
     "telemetry_session",

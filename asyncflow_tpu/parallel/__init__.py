@@ -5,13 +5,27 @@ from asyncflow_tpu.parallel.multihost import (
     initialize_multihost,
     run_multihost_sweep,
 )
+from asyncflow_tpu.parallel.recovery import (
+    PREEMPTED_EXIT_CODE,
+    CorruptChunkError,
+    RecoveryPolicy,
+    RecoveryReport,
+    SweepPreempted,
+    read_manifest,
+)
 from asyncflow_tpu.parallel.sweep import SweepReport, SweepRunner, make_overrides
 
 __all__ = [
+    "PREEMPTED_EXIT_CODE",
+    "CorruptChunkError",
+    "RecoveryPolicy",
+    "RecoveryReport",
+    "SweepPreempted",
     "SweepReport",
     "SweepRunner",
     "initialize_multihost",
     "make_overrides",
+    "read_manifest",
     "run_multihost_sweep",
     "scenario_mesh",
     "scenario_sharding",

@@ -1,0 +1,573 @@
+"""Host-fault recovery for Monte-Carlo sweeps.
+
+PR 2 made the *simulated* system resilient (fault windows, client
+retries).  This module makes the *host running the simulation* resilient:
+a 100k-scenario sweep on preemptible accelerators must survive
+
+- **pathological scenarios** — one NaN-producing parameter combination
+  must cost one scenario (quarantined, with a reason), not the sweep;
+- **preemption** — SIGTERM/SIGINT drains the in-flight chunk, writes a
+  resume manifest, and exits with a distinct code instead of dying
+  mid-write;
+- **bitrot** — a chunk file truncated by a killed run is detected (digest
+  sidecar), named, discarded, and recomputed on resume;
+- **transient device faults** — a flaky tunnel/XLA error is retried with
+  capped backoff instead of aborting hours of finished work.
+
+Everything here is host-side policy: simulation results are bit-identical
+with recovery on or off (quarantine only ever *masks* rows, and the
+prefix-stable per-scenario keys make every re-run reproduce the original
+stream).  docs/guides/fault-tolerance.md is the narrative companion.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from dataclasses import dataclass, field, fields
+from pathlib import Path
+
+import numpy as np
+
+#: process exit code for a preemption-drained sweep (BSD EX_TEMPFAIL): the
+#: work is resumable, not failed — schedulers should re-run with --resume
+PREEMPTED_EXIT_CODE = 75
+
+#: resume-manifest schema (bump on breaking field changes)
+MANIFEST_SCHEMA = "asyncflow-sweep-manifest/1"
+
+
+@dataclass(frozen=True)
+class RecoveryPolicy:
+    """What the sweep does when the host (not the model) misbehaves.
+
+    The default policy is on for every :class:`SweepRunner`; pass
+    ``recovery=None`` to get the old fail-fast behavior everywhere.
+    """
+
+    #: isolate non-finite / deterministically-crashing scenarios instead of
+    #: aborting the sweep (bisect to the offender, mask it, continue)
+    quarantine: bool = True
+    #: abort anyway when more than this fraction of the sweep would be
+    #: quarantined — past it the problem is systemic (an engine numeric
+    #: bug, a poisoned override set), not a pathological scenario
+    max_quarantine_fraction: float = 0.25
+    #: re-dispatches of a chunk after a transient device/XLA error
+    #: (:func:`is_transient`); 0 disables retry
+    max_transient_retries: int = 2
+    #: capped exponential backoff between transient retries
+    backoff_base_s: float = 0.5
+    backoff_cap_s: float = 30.0
+    #: soft wall-clock watchdog on dispatch+fetch of one chunk: past this
+    #: budget a named diagnostic is printed and recorded (the phase is NOT
+    #: killed — XLA cannot be safely interrupted); None disables
+    watchdog_s: float | None = None
+    #: install SIGTERM/SIGINT drain handlers for the duration of the run
+    preemptible: bool = True
+
+    def backoff(self, attempt: int) -> float:
+        """Delay before retry ``attempt`` (0-based), capped."""
+        return float(min(self.backoff_base_s * (2.0**attempt), self.backoff_cap_s))
+
+
+#: the default-on policy (one shared frozen instance)
+DEFAULT_RECOVERY = RecoveryPolicy()
+
+
+class SweepPreempted(RuntimeError):  # noqa: N818 - a state, not an error
+    """A drain signal stopped the sweep after the in-flight chunk.
+
+    Completed chunks are already checkpointed (when a ``checkpoint_dir``
+    was given) and ``manifest_path`` names the resume manifest; re-running
+    the same sweep against the same checkpoint directory continues
+    bit-identically.  Carries :data:`PREEMPTED_EXIT_CODE` for CLI callers.
+    """
+
+    exit_code = PREEMPTED_EXIT_CODE
+
+    def __init__(
+        self,
+        msg: str,
+        *,
+        manifest_path: str | None = None,
+        scenarios_done: int = 0,
+        signal_name: str = "",
+    ) -> None:
+        super().__init__(msg)
+        self.manifest_path = manifest_path
+        self.scenarios_done = scenarios_done
+        self.signal_name = signal_name
+
+
+class CorruptChunkError(RuntimeError):
+    """A checkpoint chunk file failed its digest or could not be parsed.
+
+    Raised with the file, the scenario range it covered, and what to do —
+    never a bare ``zipfile.BadZipFile`` from deep inside ``np.load``.  The
+    sweep's recovery path discards the file and recomputes the range.
+    """
+
+
+class QuarantineCapExceeded(ValueError):  # noqa: N818 - matches the cap it names
+    """Too much of the sweep is non-finite for quarantine to be honest."""
+
+
+@dataclass
+class RecoveryLog:
+    """Recovery actions taken during one run, in order.
+
+    Each action is a dict with an ``action`` key (``quarantine`` /
+    ``retry`` / ``downshift`` / ``preempt`` / ``discard_chunk`` /
+    ``clean_tmp`` / ``recompute`` / ``watchdog``) plus action-specific
+    detail; the same list lands in the ``kind="recovery"`` telemetry
+    record and in :attr:`SweepReport.recovery`.
+    """
+
+    actions: list[dict] = field(default_factory=list)
+
+    def record(self, action: str, **detail) -> None:
+        self.actions.append({"action": action, **detail})
+
+    def quarantines(self) -> list[dict]:
+        return [a for a in self.actions if a["action"] == "quarantine"]
+
+    @property
+    def n_quarantined(self) -> int:
+        return len(self.quarantines())
+
+
+@dataclass(frozen=True)
+class RecoveryReport:
+    """The per-run recovery summary attached to a :class:`SweepReport`."""
+
+    actions: tuple[dict, ...] = ()
+
+    @property
+    def n_quarantined(self) -> int:
+        return sum(1 for a in self.actions if a["action"] == "quarantine")
+
+    def quarantined_scenarios(self) -> list[int]:
+        """Global scenario indices quarantined by THIS run (a resumed run
+        reads previously-quarantined rows from the checkpoint mask, which
+        is the authoritative record — see ``SweepReport.n_quarantined``)."""
+        return [a["scenario"] for a in self.actions if a["action"] == "quarantine"]
+
+    def as_dict(self) -> dict:
+        return {"actions": list(self.actions), "n_quarantined": self.n_quarantined}
+
+
+# ---------------------------------------------------------------------------
+# transient-error classification
+# ---------------------------------------------------------------------------
+
+#: substrings marking an error as plausibly transient: gRPC/absl status
+#: codes the TPU tunnel surfaces on worker hiccups, plus socket-level
+#: failures.  RESOURCE_EXHAUSTED is NOT here — OOM has its own recovery
+#: (chunk downshift), and INVALID_ARGUMENT-class errors are determinstic.
+_TRANSIENT_MARKERS = (
+    "DEADLINE_EXCEEDED",
+    "UNAVAILABLE",
+    "ABORTED",
+    "CANCELLED",
+    "DATA_LOSS",
+    "connection reset",
+    "connection refused",
+    "socket closed",
+    "failed to connect",
+    "transport is closing",
+)
+
+
+def is_transient(err: BaseException) -> bool:
+    """Does this look like a transient device/tunnel/XLA error worth a
+    capped-backoff retry (vs a deterministic failure worth bisecting)?"""
+    text = f"{type(err).__name__}: {err}".lower()
+    return any(m.lower() in text for m in _TRANSIENT_MARKERS)
+
+
+def error_text(err: BaseException, limit: int = 300) -> str:
+    """Compact one-line rendering of an exception for logs/reasons."""
+    text = f"{type(err).__name__}: {err}".replace("\n", " ")
+    return text[:limit]
+
+
+# ---------------------------------------------------------------------------
+# graceful preemption
+# ---------------------------------------------------------------------------
+
+
+class GracefulShutdown:
+    """SIGTERM/SIGINT drain handler for the duration of a sweep.
+
+    First signal: set :attr:`requested` so the chunk loop finishes the
+    in-flight chunk, writes the resume manifest, and raises
+    :class:`SweepPreempted`.  Second signal: restore the previous handlers
+    and raise ``KeyboardInterrupt`` immediately (the escape hatch when the
+    drain itself hangs).  Installing handlers is only possible from the
+    main thread; elsewhere this is a silent no-op (no drain, old behavior).
+    """
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self) -> None:
+        self.requested = False
+        self.signal_name = ""
+        self._prev: dict[int, object] = {}
+
+    def __enter__(self) -> GracefulShutdown:
+        try:
+            for sig in self.SIGNALS:
+                self._prev[sig] = signal.signal(sig, self._handle)
+        except ValueError:  # not the main thread: leave handlers alone
+            self._restore()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._restore()
+
+    def _restore(self) -> None:
+        for sig, prev in self._prev.items():
+            with contextlib.suppress(ValueError):
+                signal.signal(sig, prev)
+        self._prev = {}
+
+    def _handle(self, signum, _frame) -> None:
+        if self.requested:
+            self._restore()
+            raise KeyboardInterrupt
+        self.requested = True
+        self.signal_name = signal.Signals(signum).name
+        print(
+            f"asyncflow: caught {self.signal_name}; draining the in-flight "
+            "chunk, then writing the resume manifest (signal again to "
+            "abort immediately)",
+            file=sys.stderr,
+        )
+
+
+@contextlib.contextmanager
+def phase_watchdog(
+    phase: str,
+    budget_s: float | None,
+    *,
+    log: RecoveryLog | None = None,
+    **context,
+):
+    """Soft wall-clock watchdog: name the phase that blew its budget.
+
+    XLA compiles/executes cannot be interrupted safely, so on expiry this
+    prints a named diagnostic (phase, budget, context) and records a
+    ``watchdog`` action — the operator learns WHERE the run is stuck
+    (e.g. ``execute`` on chunk 12) while the phase keeps running.
+    """
+    if not budget_s:
+        yield
+        return
+    t0 = time.monotonic()
+
+    def fire() -> None:
+        ctx = ", ".join(f"{k}={v}" for k, v in context.items())
+        print(
+            f"asyncflow watchdog: phase {phase!r} exceeded its "
+            f"{budget_s:.0f}s budget and is still running"
+            + (f" ({ctx})" if ctx else "")
+            + " — a wedged accelerator worker or a pathological XLA "
+            "compile; the phase is NOT killed (interrupt to abandon)",
+            file=sys.stderr,
+        )
+        if log is not None:
+            log.record(
+                "watchdog", phase=phase, budget_s=float(budget_s), **context,
+            )
+
+    timer = threading.Timer(budget_s, fire)
+    timer.daemon = True
+    timer.start()
+    try:
+        yield
+    finally:
+        timer.cancel()
+        if log is not None and time.monotonic() - t0 > budget_s:
+            # make the overrun visible even if the timer thread lost the
+            # race with phase completion
+            fired = any(
+                a["action"] == "watchdog" and a.get("phase") == phase
+                and all(a.get(k) == v for k, v in context.items())
+                for a in log.actions
+            )
+            if not fired:
+                log.record(
+                    "watchdog",
+                    phase=phase,
+                    budget_s=float(budget_s),
+                    **context,
+                )
+
+
+# ---------------------------------------------------------------------------
+# scenario quarantine helpers
+# ---------------------------------------------------------------------------
+
+#: per-scenario metric fields scanned for non-finite rows (the row-level
+#: refinement of ``sweep._FINITE_FIELDS``)
+_ROW_FINITE_FIELDS = (
+    "latency_sum",
+    "latency_sumsq",
+    "latency_max",
+    "throughput",
+    "gauge_means",
+    "gauge_series",
+    "llm_cost_sum",
+    "llm_cost_sumsq",
+)
+
+
+def nonfinite_rows(part) -> list[tuple[int, str]]:
+    """(row, offending fields) pairs for every non-finite scenario row.
+
+    Mirrors the chunk-level isfinite gate but localizes the damage: the
+    quarantine path masks exactly these rows and keeps the rest.
+    """
+    n = int(np.asarray(part.completed).shape[0])
+    reasons: dict[int, list[str]] = {}
+    for name in _ROW_FINITE_FIELDS:
+        arr = getattr(part, name, None)
+        if arr is None:
+            continue
+        arr = np.asarray(arr, np.float64)
+        if not arr.size:
+            continue
+        flat = arr.reshape(arr.shape[0], -1)
+        for row in np.nonzero(~np.isfinite(flat).all(axis=1))[0].tolist():
+            reasons.setdefault(row, []).append(name)
+    lat_min = np.asarray(part.latency_min, np.float64)
+    completed = np.asarray(part.completed)
+    bad_min = ~np.isfinite(lat_min) & (completed > 0)
+    for row in np.nonzero(bad_min)[0].tolist():
+        reasons.setdefault(row, []).append("latency_min")
+    return [
+        (row, ", ".join(sorted(set(names))))
+        for row, names in sorted(reasons.items())
+        if row < n
+    ]
+
+
+def _zero_rows(part, rows: list[int], reasons: list[str]):
+    """Mask the given rows out of every per-scenario array (copying — the
+    arrays may be read-only views of device buffers) and set the
+    quarantine mask/reason columns.  Returns the same (mutated) part."""
+    n = int(np.asarray(part.completed).shape[0])
+    idx = np.asarray(rows, np.int64)
+    for f in fields(part):
+        if f.name in ("settings", "hist_edges", "gauge_series_period"):
+            continue
+        arr = getattr(part, f.name)
+        if arr is None:
+            continue
+        arr = np.array(arr)  # writable copy
+        if arr.ndim < 1 or arr.shape[0] != n:
+            continue
+        arr[idx] = 0
+        setattr(part, f.name, arr)
+    # a masked scenario completed nothing: the legal empty-row encoding is
+    # completed == 0 with latency_min untouched-at-+inf
+    lat_min = np.array(part.latency_min, np.float64)
+    lat_min[idx] = np.inf
+    part.latency_min = lat_min
+    mask = (
+        np.array(part.quarantined, bool)
+        if part.quarantined is not None
+        else np.zeros(n, bool)
+    )
+    reason = (
+        np.array(part.quarantine_reason, dtype=object)
+        if part.quarantine_reason is not None
+        else np.full(n, "", dtype=object)
+    )
+    for row, why in zip(rows, reasons):
+        mask[row] = True
+        reason[row] = why
+    part.quarantined = mask
+    part.quarantine_reason = np.asarray(reason, dtype=np.str_)
+    return part
+
+
+def apply_quarantine(part, rows_reasons: list[tuple[int, str]]):
+    """Quarantine ``(local row, reason)`` pairs inside one chunk part."""
+    if not rows_reasons:
+        return part
+    rows = [r for r, _ in rows_reasons]
+    reasons = [why for _, why in rows_reasons]
+    return _zero_rows(part, rows, reasons)
+
+
+def masked_like(template, n: int, reason: str):
+    """A fully-quarantined ``n``-row part shaped like ``template``.
+
+    Used when a scenario crashes the engine so hard no results exist for
+    its rows at all (bisect leaf) — the template (any healthy chunk of the
+    same run) supplies dtypes and trailing shapes.
+    """
+    import copy
+    import dataclasses
+
+    zero = {}
+    n_t = int(np.asarray(template.completed).shape[0])
+    for f in fields(template):
+        arr = getattr(template, f.name)
+        if f.name in ("settings", "hist_edges", "gauge_series_period"):
+            zero[f.name] = copy.copy(arr) if f.name != "settings" else arr
+            continue
+        if arr is None:
+            zero[f.name] = None
+            continue
+        arr = np.asarray(arr)
+        if arr.ndim < 1 or arr.shape[0] != n_t:
+            zero[f.name] = np.array(arr)
+            continue
+        zero[f.name] = np.zeros((n,) + arr.shape[1:], dtype=arr.dtype)
+    part = dataclasses.replace(template, **zero)
+    return _zero_rows(part, list(range(n)), [reason] * n)
+
+
+def splice_row(part, row: int, single) -> None:
+    """Replace ``part``'s scenario ``row`` with row 0 of ``single`` (an
+    isolated bit-identical re-run that came back clean)."""
+    n = int(np.asarray(part.completed).shape[0])
+    for f in fields(part):
+        if f.name in ("settings", "hist_edges", "gauge_series_period"):
+            continue
+        dst = getattr(part, f.name)
+        src = getattr(single, f.name, None)
+        if dst is None or src is None:
+            continue
+        dst_arr = np.array(dst)
+        src_arr = np.asarray(src)
+        if dst_arr.ndim < 1 or dst_arr.shape[0] != n or src_arr.ndim < 1:
+            continue
+        dst_arr[row] = src_arr[0]
+        setattr(part, f.name, dst_arr)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity: digest sidecars + stale-tmp hygiene + manifest
+# ---------------------------------------------------------------------------
+
+
+def file_digest(path: Path | str) -> str:
+    """sha256 hex digest of a file's bytes (streamed)."""
+    h = hashlib.sha256()
+    with Path(path).open("rb") as fh:
+        for block in iter(lambda: fh.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def sidecar_path(chunk_path: Path) -> Path:
+    return chunk_path.with_name(chunk_path.name + ".sha256")
+
+
+def write_digest_sidecar(chunk_path: Path) -> Path:
+    """Atomically record the chunk file's digest beside it."""
+    side = sidecar_path(chunk_path)
+    tmp = side.with_name(f".{side.name}.{os.getpid()}.tmp")
+    tmp.write_text(file_digest(chunk_path) + "\n")
+    tmp.replace(side)
+    return side
+
+
+def verify_chunk_file(chunk_path: Path, *, scenario_range: str = "") -> None:
+    """Raise :class:`CorruptChunkError` unless the chunk file is intact.
+
+    Checks the digest sidecar when present (catches silent truncation and
+    bitrot that still parses), then that the npz actually parses.  The
+    diagnostic names the file and the fix — delete the file, or re-run
+    with the same checkpoint dir (``--resume``) and let the sweep discard
+    and recompute the range.
+    """
+    where = f" (scenarios {scenario_range})" if scenario_range else ""
+    hint = (
+        "delete the file, or re-run against the same checkpoint directory "
+        "(bench.py --resume) and the sweep will discard and recompute it"
+    )
+    side = sidecar_path(chunk_path)
+    if side.exists():
+        expected = side.read_text().strip()
+        actual = file_digest(chunk_path)
+        if expected and actual != expected:
+            msg = (
+                f"checkpoint chunk {chunk_path}{where} failed its digest "
+                f"check (sidecar {side.name}: expected {expected[:12]}…, "
+                f"got {actual[:12]}…) — the file was truncated or "
+                f"corrupted, likely by a killed run; {hint}"
+            )
+            raise CorruptChunkError(msg)
+    try:
+        with np.load(chunk_path) as data:
+            data.files  # force the zip directory read
+    except Exception as err:
+        msg = (
+            f"checkpoint chunk {chunk_path}{where} is corrupt or truncated "
+            f"and cannot be parsed ({error_text(err, 120)}); {hint}"
+        )
+        raise CorruptChunkError(msg) from err
+
+
+def sweep_stale_tmps(run_dir: Path) -> list[str]:
+    """Remove tmp files leaked by killed runs; returns the removed names.
+
+    The atomic-rename protocol writes ``.chunk_*.<pid>.tmp.npz`` (and
+    digest/manifest tmps) before ``os.replace`` — a process killed
+    mid-``np.savez`` leaks the tmp forever.  Any hidden tmp present when a
+    checkpoint store OPENS is by definition stale: live writers create
+    them strictly between open and replace.
+    """
+    removed: list[str] = []
+    for pattern in (".chunk_*", ".manifest.*"):
+        for path in run_dir.glob(pattern):
+            with contextlib.suppress(OSError):
+                path.unlink()
+                removed.append(path.name)
+    return sorted(removed)
+
+
+def read_manifest(run_dir: Path | str) -> dict | None:
+    """Parse a sweep run directory's resume manifest, if one exists."""
+    path = Path(run_dir) / "manifest.json"
+    if not path.exists():
+        return None
+    try:
+        return json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+__all__ = [
+    "DEFAULT_RECOVERY",
+    "MANIFEST_SCHEMA",
+    "PREEMPTED_EXIT_CODE",
+    "CorruptChunkError",
+    "GracefulShutdown",
+    "QuarantineCapExceeded",
+    "RecoveryLog",
+    "RecoveryPolicy",
+    "RecoveryReport",
+    "SweepPreempted",
+    "apply_quarantine",
+    "error_text",
+    "is_transient",
+    "masked_like",
+    "nonfinite_rows",
+    "phase_watchdog",
+    "read_manifest",
+    "splice_row",
+    "sweep_stale_tmps",
+    "verify_chunk_file",
+    "write_digest_sidecar",
+]

@@ -27,9 +27,31 @@ from asyncflow_tpu.engines.results import SweepResults
 from asyncflow_tpu.observability.simtrace import TraceConfig, decode_flight
 from asyncflow_tpu.observability.telemetry import (
     TelemetryConfig,
+    emit_event_record,
     telemetry_session,
 )
 from asyncflow_tpu.parallel.mesh import scenario_mesh, scenario_sharding
+from asyncflow_tpu.parallel.recovery import (
+    DEFAULT_RECOVERY,
+    MANIFEST_SCHEMA,
+    CorruptChunkError,
+    GracefulShutdown,
+    QuarantineCapExceeded,
+    RecoveryLog,
+    RecoveryPolicy,
+    RecoveryReport,
+    SweepPreempted,
+    apply_quarantine,
+    error_text,
+    is_transient,
+    masked_like,
+    nonfinite_rows,
+    phase_watchdog,
+    splice_row,
+    sweep_stale_tmps,
+    verify_chunk_file,
+    write_digest_sidecar,
+)
 from asyncflow_tpu.schemas.experiment import ExperimentConfig
 from asyncflow_tpu.schemas.payload import SimulationPayload
 
@@ -239,6 +261,24 @@ class SweepReport:
     #: metrics through :func:`asyncflow_tpu.analysis.antithetic_pair_means`
     #: before any mean CI
     antithetic: bool = False
+    #: host-fault recovery actions taken by THIS run (quarantines, retries,
+    #: downshifts, discarded chunks; None when nothing fired) — the same
+    #: list lands in the ``kind="recovery"`` telemetry record.  The
+    #: authoritative quarantine record (which survives checkpoint resume)
+    #: is ``results.quarantined``; docs/guides/fault-tolerance.md.
+    recovery: RecoveryReport | None = None
+
+    @property
+    def n_quarantined(self) -> int:
+        """Scenarios masked out by host-fault quarantine (0 without)."""
+        return self.results.n_quarantined
+
+    def quarantined_scenarios(self) -> list[int]:
+        """Row indices of quarantined scenarios, with their reasons
+        available via ``results.quarantine_reason``."""
+        if self.results.quarantined is None:
+            return []
+        return np.nonzero(np.asarray(self.results.quarantined, bool))[0].tolist()
 
     def flight_records(self, scenario: int) -> dict:
         """Decode one scenario's flight-recorder rings (sweeps run with
@@ -335,7 +375,7 @@ class SweepReport:
         :meth:`pooled_percentile_ci` (the former ``percentile_ci`` name
         invited exactly that misreading; docs/guides/mc-inference.md).
         """
-        per = self.results.percentile(q)
+        per = self.results.effective().percentile(q)
         return _mean_ci(per[np.isfinite(per)], level)
 
     def percentile_ci(
@@ -362,13 +402,19 @@ class SweepReport:
         Returns an :class:`asyncflow_tpu.analysis.IntervalEstimate` on the
         percentile ``q`` of the pooled request population across all
         scenarios — the statistically meaningful "system p95/p99 +/-"
-        interval (docs/guides/mc-inference.md).
+        interval (docs/guides/mc-inference.md).  Quarantined scenarios
+        hold no pooled counts; the estimate notes them as ``n_excluded``.
         """
+        import dataclasses
+
         from asyncflow_tpu.analysis.estimators import pooled_quantile_ci
 
-        return pooled_quantile_ci(
+        est = pooled_quantile_ci(
             self.results.latency_hist, self.results.hist_edges, q, level,
         )
+        if self.n_quarantined:
+            est = dataclasses.replace(est, n_excluded=self.n_quarantined)
+        return est
 
     def metric_ci(
         self,
@@ -402,6 +448,10 @@ class SweepReport:
         mean = res.latency_sum.sum() / max(completed, 1)
         return {
             "n_scenarios": self.n_scenarios,
+            # host-fault quarantine (docs/guides/fault-tolerance.md): the
+            # effective-n every aggregate below actually pools over
+            "n_quarantined": self.n_quarantined,
+            "effective_n_scenarios": self.n_scenarios - self.n_quarantined,
             "scenarios_per_second": self.scenarios_per_second,
             "completed_total": int(completed),
             "dropped_total": int(res.total_dropped.sum()),
@@ -472,6 +522,10 @@ class SweepReport:
         from asyncflow_tpu.analysis.estimators import pooled_quantile_ci
 
         fields: dict = {"ci_level": self.CI_LEVEL}
+        if self.n_quarantined:
+            # CIs note exclusions: the pooled population the intervals
+            # describe is missing these scenarios' requests entirely
+            fields["ci_excluded_scenarios"] = self.n_quarantined
         for q in (50, 95, 99):
             est = pooled_quantile_ci(
                 self.results.latency_hist,
@@ -499,6 +553,7 @@ class SweepRunner:
         telemetry: TelemetryConfig | None = None,
         experiment: ExperimentConfig | None = None,
         trace: TraceConfig | None = None,
+        recovery: RecoveryPolicy | None = DEFAULT_RECOVERY,
     ) -> None:
         """``engine``: "auto" picks the scan fast path when the plan is
         eligible (orders of magnitude faster), then the Pallas event kernel
@@ -558,7 +613,21 @@ class SweepRunner:
         carries the rings — ``engine='auto'`` routes traced sweeps there;
         forcing ``fast``/``pallas``/``native`` is an explicit error.
         Tracing consumes no draws: every non-trace output is bit-identical
-        with it on or off."""
+        with it on or off.
+
+        ``recovery``: host-fault recovery policy
+        (:class:`asyncflow_tpu.parallel.recovery.RecoveryPolicy`;
+        docs/guides/fault-tolerance.md), default ON.  Governs scenario
+        quarantine (a non-finite or deterministically-crashing scenario
+        is bisected to, masked out with a reason, and the sweep
+        continues), capped-backoff retry of transient device errors, the
+        soft wall-clock watchdog, and SIGTERM/SIGINT preemption draining
+        (finish the in-flight chunk, write a resume manifest, raise
+        :class:`~asyncflow_tpu.parallel.recovery.SweepPreempted`).
+        ``recovery=None`` restores strict fail-fast behavior.  Recovery
+        never changes surviving results: re-runs reproduce the original
+        per-scenario streams bit-exactly (prefix-stable keys), and
+        quarantine only masks rows."""
         if engine not in ("auto", "fast", "event", "pallas", "native"):
             msg = (
                 f"engine must be 'auto', 'fast', 'event', 'pallas' or "
@@ -571,6 +640,8 @@ class SweepRunner:
         self.telemetry = telemetry
         #: Monte-Carlo design (variance reduction + precision targets)
         self.experiment = experiment
+        #: host-fault recovery policy (None = strict fail-fast)
+        self.recovery = recovery
         #: simulation-domain flight recorder (event engine only)
         if trace is not None and not isinstance(trace, TraceConfig):
             trace = TraceConfig.model_validate(trace)
@@ -742,8 +813,9 @@ class SweepRunner:
 
         digest = hashlib.sha256()
         # bump when the per-chunk npz schema changes so stale chunks are
-        # never silently merged (e.g. pre-gauge_means chunks)
-        digest.update(b"chunk-schema-v5")
+        # never silently merged (e.g. pre-gauge_means chunks); v6 added
+        # the quarantine mask/reason arrays and the digest sidecars
+        digest.update(b"chunk-schema-v6")
         digest.update(self.payload.model_dump_json().encode())
         # the LOWERED plan arrays, not just the payload: any plan-level
         # field (fault tables, retry scalars, capacity estimates — and
@@ -855,6 +927,10 @@ class SweepRunner:
             half = n_scenarios // 2
             rep_a = self._run_impl(half, **kw)
             rep_b = self._run_impl(half, **kw, antithetic=True)
+            actions = tuple(
+                (rep_a.recovery.actions if rep_a.recovery else ())
+                + (rep_b.recovery.actions if rep_b.recovery else ()),
+            )
             return SweepReport(
                 results=_concat_sweeps([rep_a.results, rep_b.results]),
                 n_scenarios=n_scenarios,
@@ -866,13 +942,52 @@ class SweepRunner:
                 )
                 or None,
                 antithetic=True,
+                recovery=RecoveryReport(actions=actions) if actions else None,
             )
 
+        cfg = telemetry if telemetry is not None else self.telemetry
+
+        def _emit_recovery(log: RecoveryLog | None, *, preempted: bool) -> None:
+            """The ``kind="recovery"`` run record: every quarantine /
+            retry / downshift / preemption / discarded chunk this run took
+            (docs/guides/fault-tolerance.md) — emitted even when the run
+            ends in :class:`SweepPreempted`, so the drain is on record."""
+            if log is None or not log.actions:
+                return
+            emit_event_record(
+                cfg,
+                kind="recovery",
+                actions=list(log.actions),
+                n_quarantined=log.n_quarantined,
+                preempted=preempted,
+                engine=self.engine_kind,
+                seed=seed,
+                n_scenarios=n_scenarios,
+                first_scenario=first_scenario,
+            )
+
+        def _go_recorded(tel) -> SweepReport:
+            self._last_recovery = None
+            try:
+                report = _go(tel)
+            except SweepPreempted:
+                _emit_recovery(self._last_recovery, preempted=True)
+                raise
+            log = self._last_recovery
+            if (
+                self._antithetic
+                and report.recovery is not None
+                and len(report.recovery.actions) > (len(log.actions) if log else 0)
+            ):
+                log = RecoveryLog(actions=list(report.recovery.actions))
+            _emit_recovery(log, preempted=False)
+            return report
+
         if tel is None:
-            return _go(None)
+            return _go_recorded(None)
         with tel:
             tel.timer.record("build_plan", self._build_plan_s)
-            report = _go(tel)
+            report = _go_recorded(tel)
         tel.add_meta(
             engine=self.engine_kind,
             backend=(
@@ -889,6 +1004,10 @@ class SweepRunner:
             wall_seconds=round(report.wall_seconds, 6),
             scenarios_per_second=round(report.scenarios_per_second, 3),
             chunk_downshifts=report.downshifts or [],
+            n_quarantined=report.n_quarantined,
+            recovery_actions=(
+                len(report.recovery.actions) if report.recovery else 0
+            ),
             variance_reduction={
                 "antithetic": self._antithetic,
                 "crn": self._crn,
@@ -950,6 +1069,19 @@ class SweepRunner:
             else scenario_keys(seed, first_scenario + n_scenarios + n_dev - 1)
         )
         downshifts: list[dict] = []
+        policy = self.recovery
+        rlog = RecoveryLog()
+        self._last_recovery = rlog
+        quarantined_total = 0
+        # first healthy chunk of the run: supplies dtypes/shapes when a
+        # bisect leaf must materialize fully-masked rows for a scenario
+        # that crashed the engine outright
+        template_part: list = [None]
+
+        if ckpt and ckpt.stale_tmps:
+            rlog.record(
+                "clean_tmp", files=ckpt.stale_tmps, directory=str(ckpt.dir),
+            )
 
         def _downshift(failed_take: int, err: Exception, start: int) -> int:
             """Halve the chunk after an accelerator OOM, floored at one
@@ -966,18 +1098,118 @@ class SweepRunner:
             downshifts.append(
                 {"scenario_start": start, "from": failed_take, "to": new},
             )
+            rlog.record(
+                "downshift",
+                scenario_start=first_scenario + start,
+                chunk_from=failed_take,
+                chunk_to=new,
+                error=error_text(err),
+            )
             return new
 
-        def _fetch(final, slot: int, start: int) -> SweepResults:
+        def _cap_guard(n_new: int, reason_src: str) -> None:
+            """Abort when quarantine stops being honest: masking a large
+            fraction of the sweep hides a systemic failure, not a
+            pathological scenario."""
+            nonlocal quarantined_total
+            if policy is None:
+                return
+            frac = (quarantined_total + n_new) / max(n_scenarios, 1)
+            if frac > policy.max_quarantine_fraction:
+                msg = (
+                    f"{reason_src}; quarantining would mask "
+                    f"{quarantined_total + n_new} of {n_scenarios} "
+                    "scenarios, past the policy cap "
+                    f"({policy.max_quarantine_fraction:.0%}) — a failure "
+                    "this broad is systemic (engine numeric bug, poisoned "
+                    "override set), so the sweep aborts instead of "
+                    "silently shrinking to a sliver"
+                )
+                raise QuarantineCapExceeded(msg)
+            quarantined_total += n_new
+
+        def _fetch_raw(final, slot: int) -> SweepResults:
             with _ph(tel, "fetch", chunk=slot):
-                part = sweep_results(
+                return sweep_results(
                     self.engine,
                     final,
                     self.payload.sim_settings,
                     gauge_sel=self._gauge_sel,
                 )
-            _check_finite(part, self.engine_kind, slot, start)
+
+        def _rerun_single(row_local: int, slot: int) -> SweepResults | None:
+            """Isolated re-run of one scenario — bit-identical to its row
+            in any chunk (prefix-stable keys); None when the re-run itself
+            fails (the caller then quarantines on the original evidence)."""
+            try:
+                if self.engine_kind == "native":
+                    ov1 = (
+                        _slice_overrides(
+                            overrides, base_overrides(self.plan),
+                            row_local, n_dev,
+                        )
+                        if overrides
+                        else None
+                    )
+                    return self.engine.run_chunk(
+                        seed, first_scenario + row_local, n_dev, ov1,
+                        self.payload.sim_settings,
+                    )
+                return _fetch_raw(_dispatch(row_local, n_dev, slot), slot)
+            except Exception:  # noqa: BLE001 - diagnostic path only
+                return None
+
+        def _screen(part: SweepResults, slot: int, start: int) -> SweepResults:
+            """The finite gate, upgraded from tripwire to triage: localize
+            non-finite rows, confirm each by an isolated bit-identical
+            re-run, quarantine the confirmed ones, keep the rest."""
+            try:
+                _check_finite(part, self.engine_kind, slot, start)
+            except ValueError as gate_err:
+                if policy is None or not policy.quarantine:
+                    raise
+                bad = nonfinite_rows(part)
+                if not bad:
+                    raise  # non-finite somewhere no row owns: stay loud
+                confirmed: list[tuple[int, str]] = []
+                for row, bad_fields in bad:
+                    single = _rerun_single(start + row, slot)
+                    if single is not None and not nonfinite_rows(single):
+                        # poisoned only in chunk context (a transient
+                        # device flaw, not the scenario): keep the clean
+                        # isolated value
+                        splice_row(part, row, single)
+                        rlog.record(
+                            "recompute",
+                            scenario=first_scenario + start + row,
+                            chunk=slot,
+                            fields=bad_fields,
+                        )
+                        continue
+                    confirmed.append((
+                        row,
+                        f"non-finite {bad_fields} from the "
+                        f"'{self.engine_kind}' engine; reproduced in an "
+                        "isolated re-run",
+                    ))
+                if confirmed:
+                    _cap_guard(len(confirmed), str(gate_err))
+                    part = apply_quarantine(part, confirmed)
+                    for row, why in confirmed:
+                        rlog.record(
+                            "quarantine",
+                            scenario=first_scenario + start + row,
+                            reason=why,
+                            chunk=slot,
+                        )
+                # quarantine must leave only clean rows behind
+                _check_finite(part, self.engine_kind, slot, start)
+            if template_part[0] is None:
+                template_part[0] = part
             return part
+
+        def _fetch(final, slot: int, start: int) -> SweepResults:
+            return _screen(_fetch_raw(final, slot), slot, start)
 
         def _dispatch(done_local: int, take: int, chunk_idx: int):
             lo = first_scenario + done_local
@@ -1007,11 +1239,119 @@ class SweepRunner:
                     )
                 return self.engine.run_batch(keys, ov, antithetic=antithetic)
 
+        def _can_bisect(err: Exception) -> bool:
+            """Is this failure worth bisecting toward a scenario
+            quarantine?  Policy violations, the quarantine cap, and
+            preemption are not scenario-local and must propagate."""
+            return (
+                policy is not None
+                and policy.quarantine
+                and not isinstance(
+                    err,
+                    QuarantineCapExceeded
+                    | SweepPreempted
+                    | _FastpathOverrideError
+                    | KeyboardInterrupt,
+                )
+            )
+
+        def _attempt_range(start: int, take: int, idx: int) -> SweepResults:
+            """One protected run of [start, start + take): dispatch, fetch,
+            screen — transient device errors retry with capped backoff and
+            the soft watchdog names a phase that blows its budget."""
+            attempt = 0
+            while True:
+                try:
+                    with phase_watchdog(
+                        "execute",
+                        policy.watchdog_s if policy else None,
+                        log=rlog,
+                        engine=self.engine_kind,
+                        chunk=idx,
+                        scenario_start=first_scenario + start,
+                    ):
+                        if self.engine_kind == "native":
+                            ov1 = (
+                                _slice_overrides(
+                                    overrides, base_overrides(self.plan),
+                                    start, take,
+                                )
+                                if overrides
+                                else None
+                            )
+                            with _ph(
+                                tel, "execute", chunk=idx, meta={"take": take},
+                            ):
+                                part = self.engine.run_chunk(
+                                    seed, first_scenario + start, take, ov1,
+                                    self.payload.sim_settings,
+                                )
+                        else:
+                            part = _fetch_raw(_dispatch(start, take, idx), idx)
+                    return _screen(part, idx, start)
+                except Exception as err:  # noqa: BLE001 - filtered below
+                    if (
+                        policy is None
+                        or _is_oom(err)
+                        or not is_transient(err)
+                        or attempt >= policy.max_transient_retries
+                    ):
+                        raise
+                    delay = policy.backoff(attempt)
+                    attempt += 1
+                    rlog.record(
+                        "retry",
+                        scenario_start=first_scenario + start,
+                        take=take,
+                        attempt=attempt,
+                        backoff_s=round(delay, 3),
+                        error=error_text(err),
+                    )
+                    time.sleep(delay)
+
+        def _bisect_range(
+            start: int, take: int, idx: int, err: Exception,
+        ) -> SweepResults:
+            """A deterministic chunk-killer: halve the range — prefix-stable
+            keys make every sub-chunk re-run bit-identical to its rows in
+            the full chunk — until the offending scenario(s) are isolated,
+            quarantine them with the error as reason, keep everything else."""
+            if take <= n_dev:
+                if template_part[0] is None:
+                    # no healthy chunk exists to shape masked rows from; a
+                    # sweep whose first scenarios all crash is systemic
+                    raise err
+                _cap_guard(take, error_text(err))
+                reason = (
+                    "engine failure reproduced down to this scenario: "
+                    f"{error_text(err)}"
+                )
+                for g in range(start, start + take):
+                    rlog.record(
+                        "quarantine",
+                        scenario=first_scenario + g,
+                        reason=reason,
+                        chunk=idx,
+                    )
+                return masked_like(template_part[0], take, reason)
+            half = max(n_dev, ((take // 2) // n_dev) * n_dev)
+            parts: list[SweepResults] = []
+            for s, t in ((start, half), (start + half, take - half)):
+                try:
+                    parts.append(_attempt_range(s, t, idx))
+                except Exception as sub_err:  # noqa: BLE001 - filtered below
+                    if _is_oom(sub_err) or not _can_bisect(sub_err):
+                        raise
+                    parts.append(_bisect_range(s, t, idx, sub_err))
+            return _concat_sweeps(parts)
+
         def _run_range_sync(
             done_local: int, take: int, size: int, chunk_idx: int,
         ) -> tuple[SweepResults, int]:
             """Run scenarios [done_local, done_local + take) synchronously
-            in sub-chunks of ``size``, downshifting further on OOM; returns
+            in sub-chunks of ``size``: OOM halves the sub-chunk, transient
+            errors retry with backoff (inside ``_attempt_range``), and
+            deterministic failures bisect to quarantine; returns
             (merged results, final sub-chunk size)."""
             parts: list[SweepResults] = []
             off = 0
@@ -1019,100 +1359,173 @@ class SweepRunner:
                 sub = min(size, take - off)
                 sub = max(n_dev, (sub // n_dev) * n_dev)
                 try:
-                    final = _dispatch(done_local + off, sub, chunk_idx)
-                    parts.append(_fetch(final, chunk_idx, done_local + off))
+                    parts.append(_attempt_range(done_local + off, sub, chunk_idx))
                 except Exception as err:  # noqa: BLE001 - filtered below
-                    if not _is_oom(err):
-                        raise
-                    size = _downshift(sub, err, done_local + off)
-                    continue
+                    if _is_oom(err):
+                        size = _downshift(sub, err, done_local + off)
+                        continue
+                    if _can_bisect(err):
+                        parts.append(
+                            _bisect_range(done_local + off, sub, chunk_idx, err),
+                        )
+                        off += sub
+                        continue
+                    raise
                 off += sub
             return _concat_sweeps(parts), size
+
+        def _recover_range(
+            start: int, itake: int, slot: int, err: Exception,
+        ) -> SweepResults:
+            """Pipelined-path fallback: turn a failed dispatch/fetch into a
+            protected synchronous re-run of the range (or re-raise)."""
+            nonlocal chunk
+            if _is_oom(err):
+                chunk = _downshift(itake, err, start)
+            elif (
+                policy is not None
+                and is_transient(err)
+                and policy.max_transient_retries > 0
+            ):
+                delay = policy.backoff(0)
+                rlog.record(
+                    "retry",
+                    scenario_start=first_scenario + start,
+                    take=itake,
+                    attempt=1,
+                    backoff_s=round(delay, 3),
+                    error=error_text(err),
+                )
+                time.sleep(delay)
+            elif not _can_bisect(err):
+                raise err
+            part, chunk = _run_range_sync(start, itake, chunk, slot)
+            return part
+
+        def _load_cached(start: int) -> SweepResults | None:
+            try:
+                return ckpt.load(start)
+            except CorruptChunkError as err:
+                if policy is None:
+                    raise
+                import warnings
+
+                warnings.warn(f"{err}; discarding and recomputing", stacklevel=2)
+                rlog.record(
+                    "discard_chunk",
+                    scenario_start=first_scenario + start,
+                    error=error_text(err),
+                )
+                ckpt.discard(start)
+                return None
+
+        shutdown = (
+            GracefulShutdown()
+            if policy is not None and policy.preemptible
+            else None
+        )
+
+        def _preempt(done_now: int) -> None:
+            """The drain endpoint: completed chunks are checkpointed, the
+            manifest marks where to resume, and the distinct exception /
+            exit code tells schedulers this is resumable, not failed."""
+            name = shutdown.signal_name or "signal"
+            manifest = None
+            if ckpt:
+                manifest = str(
+                    ckpt.write_manifest(
+                        status="preempted",
+                        scenarios_done=done_now,
+                        signal=name,
+                    ),
+                )
+            rlog.record(
+                "preempt",
+                signal=name,
+                scenarios_done=done_now,
+                manifest=manifest,
+            )
+            msg = (
+                f"sweep preempted by {name} after {done_now}/{n_scenarios} "
+                "scenarios"
+                + (
+                    f"; resume manifest at {manifest} — re-run with the "
+                    "same checkpoint_dir to continue bit-identically"
+                    if manifest
+                    else "; no checkpoint_dir was set, so completed chunks "
+                    "were discarded"
+                )
+            )
+            raise SweepPreempted(
+                msg,
+                manifest_path=manifest,
+                scenarios_done=done_now,
+                signal_name=name,
+            )
 
         partials: list[SweepResults] = []
         #: (slot, scenario start, take, device state) pipelining window
         inflight: list[tuple[int, int, int, object]] = []
         done = 0
         chunk_idx = 0
-        while done < n_scenarios:
-            take = min(chunk, n_scenarios - done)
-            take = max(n_dev, (take // n_dev) * n_dev)  # pad to device multiple
-            cached = ckpt.load(done) if ckpt else None
-            if cached is not None:
-                partials.append(cached)
-                # advance by the CACHED chunk's actual row count: a prior
-                # run may have saved downshifted (smaller) chunks
-                done += int(cached.completed.shape[0])
-                chunk_idx += 1
-                continue
-            if self.engine_kind == "native":
-                lo = first_scenario + done
-                ov = (
-                    _slice_overrides(
-                        overrides, base_overrides(self.plan), done, take,
-                    )
-                    if overrides
-                    else None
-                )
-                with _ph(tel, "execute", chunk=chunk_idx, meta={"take": take}):
-                    part = self.engine.run_chunk(
-                        seed, lo, take, ov, self.payload.sim_settings,
-                    )
-                _check_finite(part, self.engine_kind, chunk_idx, done)
-                if ckpt:
-                    ckpt.save(done, part)
-                partials.append(part)
+        with shutdown if shutdown is not None else contextlib.nullcontext():
+            while done < n_scenarios:
+                if shutdown is not None and shutdown.requested:
+                    _preempt(done)
+                take = min(chunk, n_scenarios - done)
+                take = max(n_dev, (take // n_dev) * n_dev)  # device multiple
+                cached = _load_cached(done) if ckpt else None
+                if cached is not None:
+                    partials.append(cached)
+                    if template_part[0] is None:
+                        template_part[0] = cached
+                    # advance by the CACHED chunk's actual row count: a
+                    # prior run may have saved downshifted (smaller) chunks
+                    done += int(cached.completed.shape[0])
+                    chunk_idx += 1
+                    continue
+                if ckpt or self.engine_kind == "native":
+                    # checkpointing persists chunks as numpy -> sync run
+                    # (the native engine is host-side and sync by nature)
+                    part, chunk = _run_range_sync(done, take, chunk, chunk_idx)
+                    if ckpt:
+                        ckpt.save(done, part)
+                    partials.append(part)
+                    done += take
+                    chunk_idx += 1
+                    continue
+                try:
+                    final = _dispatch(done, take, chunk_idx)
+                except Exception as err:  # noqa: BLE001 - filtered below
+                    partials.append(_recover_range(done, take, chunk_idx, err))
+                    done += take
+                    chunk_idx += 1
+                    continue
+                # pipeline: jax dispatch is async, so keep a small window
+                # of chunks in flight and convert the oldest to host
+                # arrays as new ones are dispatched — device compute
+                # overlaps the host merge while device memory stays
+                # bounded by the window
+                partials.append(None)  # ordered placeholder
+                inflight.append((len(partials) - 1, done, take, final))
+                while len(inflight) > self.INFLIGHT_CHUNKS:
+                    slot, start, itake, oldest = inflight.pop(0)
+                    try:
+                        partials[slot] = _fetch(oldest, slot, start)
+                    except Exception as err:  # noqa: BLE001
+                        partials[slot] = _recover_range(start, itake, slot, err)
                 done += take
                 chunk_idx += 1
-                continue
-            try:
-                final = _dispatch(done, take, chunk_idx)
-                if ckpt:
-                    # checkpointing persists chunks as numpy -> sync fetch
-                    part = _fetch(final, chunk_idx, done)
-                    ckpt.save(done, part)
-                    partials.append(part)
-                else:
-                    # pipeline: jax dispatch is async, so keep a small
-                    # window of chunks in flight and convert the oldest to
-                    # host arrays as new ones are dispatched — device
-                    # compute overlaps the host merge while device memory
-                    # stays bounded by the window
-                    partials.append(None)  # ordered placeholder
-                    inflight.append((len(partials) - 1, done, take, final))
-                    while len(inflight) > self.INFLIGHT_CHUNKS:
-                        slot, start, itake, oldest = inflight.pop(0)
-                        try:
-                            partials[slot] = _fetch(oldest, slot, start)
-                        except Exception as err:  # noqa: BLE001
-                            if not _is_oom(err):
-                                raise
-                            # an earlier in-flight chunk OOMed at fetch:
-                            # re-run just that range at the smaller size
-                            chunk = _downshift(itake, err, start)
-                            partials[slot], chunk = _run_range_sync(
-                                start, itake, chunk, slot,
-                            )
-            except Exception as err:  # noqa: BLE001 - filtered below
-                if not _is_oom(err):
-                    raise
-                chunk = _downshift(take, err, done)
-                continue  # re-run this chunk at the smaller size
-            done += take
-            chunk_idx += 1
-        for slot, start, itake, final in inflight:
-            try:
-                partials[slot] = _fetch(final, slot, start)
-            except Exception as err:  # noqa: BLE001 - filtered below
-                if not _is_oom(err):
-                    raise
-                chunk = _downshift(itake, err, start)
-                partials[slot], chunk = _run_range_sync(
-                    start, itake, chunk, slot,
-                )
+            for slot, start, itake, final in inflight:
+                try:
+                    partials[slot] = _fetch(final, slot, start)
+                except Exception as err:  # noqa: BLE001 - filtered below
+                    partials[slot] = _recover_range(start, itake, slot, err)
         wall = time.time() - t0
         self._last_downshifts = downshifts
 
+        if ckpt:
+            ckpt.write_manifest(status="complete", scenarios_done=n_scenarios)
         with _ph(tel, "postprocess"):
             merged = _concat_sweeps(partials)[:n_scenarios]
         return SweepReport(
@@ -1122,6 +1535,11 @@ class SweepRunner:
             plan=self.plan,
             gauge_series_ids=self._gauge_series_ids,
             downshifts=downshifts or None,
+            recovery=(
+                RecoveryReport(actions=tuple(rlog.actions))
+                if rlog.actions
+                else None
+            ),
         )
 
 
@@ -1249,7 +1667,17 @@ class _NativeSweepEngine:
 
 
 class _SweepCheckpoint:
-    """Per-chunk npz persistence keyed by the sweep's deterministic grid."""
+    """Per-chunk npz persistence keyed by the sweep's deterministic grid.
+
+    Hardened against killed runs (docs/guides/fault-tolerance.md): stale
+    ``.chunk_*.tmp.npz`` files are swept on open (the atomic-rename
+    protocol leaks them when a process dies mid-``np.savez``), every chunk
+    carries a sha256 digest sidecar, and a corrupt/truncated chunk raises
+    a named :class:`CorruptChunkError` instead of a bare
+    ``zipfile.BadZipFile`` — the sweep's recovery path discards and
+    recomputes it.  ``manifest.json`` records run progress (``preempted``
+    or ``complete``) for operators and schedulers.
+    """
 
     _ARRAY_FIELDS = (
         "completed",
@@ -1285,9 +1713,55 @@ class _SweepCheckpoint:
         )
         self.dir.mkdir(parents=True, exist_ok=True)
         self._settings = settings
+        self._grid = {
+            "seed": int(seed),
+            "n_scenarios": int(n_scenarios),
+            "chunk": int(chunk),
+            "first_scenario": int(first_scenario),
+            "identity": identity,
+        }
+        #: tmp files leaked by killed runs, removed at open (the sweep
+        #: records them as a ``clean_tmp`` recovery action)
+        self.stale_tmps = sweep_stale_tmps(self.dir)
 
     def _path(self, start: int):
         return self.dir / f"chunk_{start:08d}.npz"
+
+    def discard(self, start: int) -> None:
+        """Drop a (corrupt) chunk and its digest sidecar for recompute."""
+        import contextlib as _ctx
+
+        path = self._path(start)
+        for victim in (path, path.with_name(path.name + ".sha256")):
+            with _ctx.suppress(OSError):
+                victim.unlink()
+
+    def write_manifest(
+        self,
+        *,
+        status: str,
+        scenarios_done: int,
+        signal: str = "",
+    ) -> Path:
+        """Atomically (re)write the run dir's resume manifest."""
+        import json as _json
+        import os
+        import time as _time
+
+        path = self.dir / "manifest.json"
+        data = {
+            "schema": MANIFEST_SCHEMA,
+            "status": status,
+            "scenarios_done": int(scenarios_done),
+            "signal": signal,
+            "ts": _time.time(),
+            **self._grid,
+            "chunks": sorted(p.name for p in self.dir.glob("chunk_*.npz")),
+        }
+        tmp = self.dir / f".manifest.{os.getpid()}.tmp"
+        tmp.write_text(_json.dumps(data, indent=2) + "\n")
+        os.replace(tmp, path)
+        return path
 
     def save(self, start: int, part: SweepResults) -> None:
         import os
@@ -1317,15 +1791,46 @@ class _SweepCheckpoint:
             payload["flight_node"] = part.flight_node
             payload["flight_t"] = part.flight_t
             payload["flight_n"] = part.flight_n
+        if part.quarantined is not None:
+            payload["quarantined"] = np.asarray(part.quarantined, bool)
+            payload["quarantine_reason"] = np.asarray(
+                part.quarantine_reason, dtype=np.str_,
+            )
         # atomic write so an interrupt never leaves a half-written chunk
         tmp = self.dir / f".chunk_{start:08d}.{os.getpid()}.tmp.npz"
         np.savez(tmp, **payload)
         os.replace(tmp, self._path(start))
+        # digest sidecar AFTER the rename: a chunk without a sidecar is a
+        # legal legacy/mid-crash state (parse still validates it); a chunk
+        # that MISMATCHES its sidecar is corruption, caught at load
+        write_digest_sidecar(self._path(start))
 
     def load(self, start: int) -> SweepResults | None:
         path = self._path(start)
         if not path.exists():
             return None
+        # digest + parse validation first: a truncated/corrupted file must
+        # surface as a named CorruptChunkError (file, range, remedy), never
+        # as a bare zipfile.BadZipFile from inside np.load
+        n_rows = self._grid["chunk"]
+        verify_chunk_file(
+            path,
+            scenario_range=f"local rows {start}..{start + n_rows - 1} at most",
+        )
+        try:
+            return self._parse(path)
+        except CorruptChunkError:
+            raise
+        except Exception as err:
+            msg = (
+                f"checkpoint chunk {path} parsed but its contents are "
+                f"unreadable ({error_text(err, 120)}); delete the file, or "
+                "re-run against the same checkpoint directory and the "
+                "sweep will discard and recompute it"
+            )
+            raise CorruptChunkError(msg) from err
+
+    def _parse(self, path) -> SweepResults:
         with np.load(path) as data:
             return SweepResults(
                 settings=self._settings,
@@ -1371,6 +1876,14 @@ class _SweepCheckpoint:
                 ),
                 flight_t=data["flight_t"] if "flight_t" in data else None,
                 flight_n=data["flight_n"] if "flight_n" in data else None,
+                quarantined=(
+                    data["quarantined"] if "quarantined" in data else None
+                ),
+                quarantine_reason=(
+                    data["quarantine_reason"]
+                    if "quarantine_reason" in data
+                    else None
+                ),
                 **{name: data[name] for name in self._ARRAY_FIELDS},
             )
 
@@ -1654,6 +2167,20 @@ def _concat_sweeps(parts: list[SweepResults]) -> SweepResults:
     if len(parts) == 1:
         merged = first
     else:
+        # quarantine is sparse: normalize missing masks to all-clean so a
+        # single quarantined chunk doesn't erase the sweep-level record
+        any_quarantine = any(p.quarantined is not None for p in parts)
+
+        def _qmask(p: SweepResults) -> np.ndarray:
+            if p.quarantined is not None:
+                return np.asarray(p.quarantined, bool)
+            return np.zeros(np.asarray(p.completed).shape[0], bool)
+
+        def _qreason(p: SweepResults) -> np.ndarray:
+            if p.quarantine_reason is not None:
+                return np.asarray(p.quarantine_reason, dtype=np.str_)
+            return np.full(np.asarray(p.completed).shape[0], "", dtype=np.str_)
+
         merged = SweepResults(
             settings=first.settings,
             completed=np.concatenate([p.completed for p in parts]),
@@ -1736,6 +2263,18 @@ def _concat_sweeps(parts: list[SweepResults]) -> SweepResults:
             flight_n=(
                 np.concatenate([p.flight_n for p in parts])
                 if all(p.flight_n is not None for p in parts)
+                else None
+            ),
+            quarantined=(
+                np.concatenate([_qmask(p) for p in parts])
+                if any_quarantine
+                else None
+            ),
+            quarantine_reason=(
+                np.concatenate(
+                    [_qreason(p).astype(np.str_) for p in parts],
+                )
+                if any_quarantine
                 else None
             ),
         )

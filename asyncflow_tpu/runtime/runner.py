@@ -12,6 +12,12 @@ the batched JAX engine (single scenario); Monte-Carlo sweeps live in
 (phase timers, compile ledger, unified device counters) described in
 docs/guides/observability.md.  Telemetry never changes simulation results:
 with it on or off the metrics are bit-identical (a test locks this).
+
+``recovery=RecoveryPolicy(...)`` adds host-fault hardening to the execute
+phase: transient device/XLA errors retry with capped backoff, and the
+soft wall-clock watchdog names a phase that blows its budget
+(docs/guides/fault-tolerance.md).  Like telemetry, recovery never changes
+results — retried runs replay the same seed.
 """
 
 from __future__ import annotations
@@ -25,7 +31,15 @@ from asyncflow_tpu.config.constants import Backend
 from asyncflow_tpu.metrics.analyzer import ResultsAnalyzer
 from asyncflow_tpu.observability.telemetry import (
     TelemetryConfig,
+    emit_event_record,
     telemetry_session,
+)
+from asyncflow_tpu.parallel.recovery import (
+    RecoveryLog,
+    RecoveryPolicy,
+    error_text,
+    is_transient,
+    phase_watchdog,
 )
 from asyncflow_tpu.schemas.payload import SimulationPayload
 
@@ -41,12 +55,16 @@ class SimulationRunner:
         seed: int | None = None,
         engine_options: dict | None = None,
         telemetry: TelemetryConfig | None = None,
+        recovery: RecoveryPolicy | None = None,
     ) -> None:
         self.simulation_input = simulation_input
         self.backend = Backend(backend)
         self.seed = seed
         self.engine_options = engine_options or {}
         self.telemetry = telemetry
+        #: host-fault recovery for the execute phase (transient retry +
+        #: watchdog); None keeps strict fail-fast behavior
+        self.recovery = recovery
         #: validation wall seconds, when this runner came through a parsing
         #: front door (from_yaml) that could actually measure it
         self._validate_s: float | None = None
@@ -83,6 +101,56 @@ class SimulationRunner:
                 tel.timer.record("validate", self._validate_s)
             analyzer = self._run(tel)
         return analyzer
+
+    def _execute(self, fn, tel):
+        """Run one engine callable under the telemetry execute span and
+        the host-fault recovery policy: transient device/XLA errors retry
+        with capped backoff (the callable rebuilds its engine, replaying
+        the same seed), and the soft watchdog names a blown budget.  Any
+        recovery actions land in a ``kind="recovery"`` run record."""
+
+        def timed():
+            if tel is not None:
+                with tel.phase("execute"):
+                    return fn()
+            return fn()
+
+        pol = self.recovery
+        if pol is None:
+            return timed()
+        log = RecoveryLog()
+        attempt = 0
+        while True:
+            try:
+                with phase_watchdog(
+                    "execute",
+                    pol.watchdog_s,
+                    log=log,
+                    backend=str(self.backend),
+                ):
+                    out = timed()
+                break
+            except Exception as err:  # noqa: BLE001 - filtered below
+                if not is_transient(err) or attempt >= pol.max_transient_retries:
+                    raise
+                delay = pol.backoff(attempt)
+                attempt += 1
+                log.record(
+                    "retry",
+                    attempt=attempt,
+                    backoff_s=round(delay, 3),
+                    error=error_text(err),
+                )
+                time.sleep(delay)
+        if log.actions:
+            emit_event_record(
+                self.telemetry,
+                kind="recovery",
+                actions=list(log.actions),
+                backend=str(self.backend),
+                seed=self.seed,
+            )
+        return out
 
     def _run(self, tel) -> ResultsAnalyzer:
         backend = self.backend
@@ -128,21 +196,16 @@ class SimulationRunner:
                     # plan does not carry
                     opts["payload"] = self.simulation_input
                 plan = compile_payload(self.simulation_input)
-                if tel is not None:
-                    with tel.phase("execute"):
-                        results = run_native(
-                            plan,
-                            seed=self._effective_seed(),
-                            settings=self.simulation_input.sim_settings,
-                            **opts,
-                        )
-                else:
-                    results = run_native(
+                seed = self._effective_seed()
+                results = self._execute(
+                    lambda: run_native(
                         plan,
-                        seed=self._effective_seed(),
+                        seed=seed,
                         settings=self.simulation_input.sim_settings,
                         **opts,
-                    )
+                    ),
+                    tel,
+                )
                 return self._analyze(results, tel, engine="native")
             import warnings
 
@@ -156,36 +219,32 @@ class SimulationRunner:
         if backend == Backend.ORACLE:
             from asyncflow_tpu.engines.oracle.engine import OracleEngine
 
-            engine = OracleEngine(
-                self.simulation_input,
-                seed=self.seed,
-                **self.engine_options,
+            results = self._execute(
+                # engine construction inside the callable: a transient-retry
+                # re-run must replay a FRESH engine at the same seed
+                lambda: OracleEngine(
+                    self.simulation_input,
+                    seed=self.seed,
+                    **self.engine_options,
+                ).run(),
+                tel,
             )
-            if tel is not None:
-                with tel.phase("execute"):
-                    results = engine.run()
-            else:
-                results = engine.run()
             return self._analyze(results, tel, engine="oracle")
 
         from asyncflow_tpu.engines.jaxsim.engine import run_single
 
-        if tel is not None:
-            # build_plan / lower / compile spans are recorded by the
-            # compiler hook and the engines' instrumented jits, nested
-            # inside this execute span
-            with tel.phase("execute"):
-                results = run_single(
-                    self.simulation_input,
-                    seed=self._effective_seed(),
-                    **self.engine_options,
-                )
-        else:
-            results = run_single(
+        # build_plan / lower / compile spans are recorded by the compiler
+        # hook and the engines' instrumented jits, nested inside the
+        # execute span _execute opens
+        seed = self._effective_seed()
+        results = self._execute(
+            lambda: run_single(
                 self.simulation_input,
-                seed=self._effective_seed(),
+                seed=seed,
                 **self.engine_options,
-            )
+            ),
+            tel,
+        )
         return self._analyze(results, tel, engine="jax")
 
     def _analyze(self, results, tel, *, engine: str) -> ResultsAnalyzer:
@@ -213,6 +272,7 @@ class SimulationRunner:
         seed: int | None = None,
         engine_options: dict | None = None,
         telemetry: TelemetryConfig | None = None,
+        recovery: RecoveryPolicy | None = None,
     ) -> SimulationRunner:
         """Load, validate, and wrap a YAML scenario file."""
         t0 = time.perf_counter()
@@ -225,6 +285,7 @@ class SimulationRunner:
             seed=seed,
             engine_options=engine_options,
             telemetry=telemetry,
+            recovery=recovery,
         )
         runner._validate_s = validate_s
         return runner

@@ -56,12 +56,38 @@ def _parse_args(argv: list[str]) -> dict:
     pre-trace program (same seeds, byte-compared histograms/counters) and
     report the scen/s delta with tracing ENABLED under
     ``detail.trace_guard``.
+
+    ``--checkpoint-dir DIR``: checkpoint the measured sweep's chunks under
+    ``DIR`` so a preempted/killed benchmark is resumable.  A SIGTERM/SIGINT
+    during the measured sweep drains the in-flight chunk, writes a resume
+    manifest, and exits with the distinct code 75 (EX_TEMPFAIL;
+    docs/guides/fault-tolerance.md).  Without ``--resume`` the directory is
+    cleared first (a fresh measurement must not splice stale chunks).
+
+    ``--resume``: keep existing chunks in ``--checkpoint-dir`` and continue
+    from the last completed chunk — results are bit-identical to an
+    uninterrupted run (corrupt/truncated chunks are discarded and
+    recomputed automatically).
     """
-    opts = {"telemetry": None, "repeats": None, "trace_guard": False}
+    opts = {
+        "telemetry": None,
+        "repeats": None,
+        "trace_guard": False,
+        "checkpoint_dir": None,
+        "resume": False,
+    }
     it = iter(argv)
     for arg in it:
         if arg == "--trace-guard":
             opts["trace_guard"] = True
+        elif arg == "--resume":
+            opts["resume"] = True
+        elif arg == "--checkpoint-dir":
+            opts["checkpoint_dir"] = next(it, None)
+            if opts["checkpoint_dir"] is None:
+                raise SystemExit("--checkpoint-dir needs a directory path")
+        elif arg.startswith("--checkpoint-dir="):
+            opts["checkpoint_dir"] = arg.split("=", 1)[1]
         elif arg == "--telemetry":
             opts["telemetry"] = next(it, None)
             if opts["telemetry"] is None:
@@ -83,6 +109,8 @@ def _parse_args(argv: list[str]) -> dict:
             raise SystemExit("--repeats needs an integer count") from None
         if opts["repeats"] < 1:
             raise SystemExit("--repeats needs a count >= 1")
+    if opts["resume"] and not opts["checkpoint_dir"]:
+        raise SystemExit("--resume needs --checkpoint-dir (where to resume from)")
     return opts
 
 # On an accelerator the sweep targets the north star (10k-scenario sweep,
@@ -107,6 +135,10 @@ PREWARM_WATCHDOG_S = int(os.environ.get("BENCH_PREWARM_WATCHDOG_S", "900"))
 PARTIAL_PATH = os.environ.get(
     "BENCH_PARTIAL_PATH", os.path.join(REPO, ".bench_partial.json"),
 )
+# mirror of asyncflow_tpu.parallel.recovery.PREEMPTED_EXIT_CODE (BSD
+# EX_TEMPFAIL), duplicated as a literal because the parent process stays
+# import-light on purpose while the tunnel may be wedged
+_PREEMPTED_EXIT_CODE = 75
 # Quiet gap between consecutive tunnel clients.  Round-5 incident: the
 # measurement child attached ~15 s after the pre-warm client detached and the
 # worker wedged at backend init (three rapid attach/detach cycles in ~3 min);
@@ -433,10 +465,30 @@ def run_measurement() -> None:
             trace_path=telemetry_out + ".trace.json",
             label="bench",
         )
+    ckpt_dir = os.environ.get("BENCH_CHECKPOINT_DIR") or None
+    if ckpt_dir and os.environ.get("BENCH_RESUME") != "1":
+        # a fresh (non --resume) measurement must never splice chunks left
+        # by an earlier run of a different shape into its results
+        import shutil
+
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
     repeats = int(os.environ.get("BENCH_REPEATS", "1"))
-    report = runner.run(
-        n_scenarios, seed=SEED, chunk_size=chunk, telemetry=telemetry_cfg,
-    )
+    from asyncflow_tpu.parallel.recovery import SweepPreempted
+
+    try:
+        report = runner.run(
+            n_scenarios,
+            seed=SEED,
+            chunk_size=chunk,
+            telemetry=telemetry_cfg,
+            checkpoint_dir=ckpt_dir,
+        )
+    except SweepPreempted as preempted:
+        # distinct exit code: the sweep is resumable, not failed — rerun
+        # with --resume to continue from the manifest bit-identically
+        print(f"bench: {preempted}", file=sys.stderr)
+        raise SystemExit(preempted.exit_code) from None
     rates = [report.scenarios_per_second]
     for i in range(1, repeats):
         # distinct seeds, identical compiled shape: only the wall varies
@@ -677,6 +729,10 @@ def main() -> None:
         os.environ["BENCH_REPEATS"] = str(opts["repeats"])
     if opts["trace_guard"]:
         os.environ["BENCH_TRACE_GUARD"] = "1"
+    if opts["checkpoint_dir"]:
+        os.environ["BENCH_CHECKPOINT_DIR"] = opts["checkpoint_dir"]
+    if opts["resume"]:
+        os.environ["BENCH_RESUME"] = "1"
 
     if os.path.exists(PARTIAL_PATH):
         os.unlink(PARTIAL_PATH)
@@ -733,6 +789,16 @@ def main() -> None:
             )
         except subprocess.TimeoutExpired:
             proc = None
+        if proc is not None and proc.returncode == _PREEMPTED_EXIT_CODE:
+            # the measured sweep was preemption-drained: propagate the
+            # distinct resumable code instead of falling back to CPU
+            sys.stderr.write(proc.stderr)
+            print(
+                "benchmark preempted; re-run with --checkpoint-dir "
+                f"{opts['checkpoint_dir'] or '<dir>'} --resume to continue",
+                file=sys.stderr,
+            )
+            raise SystemExit(_PREEMPTED_EXIT_CODE)
         if proc is not None and proc.returncode == 0 and proc.stdout.strip():
             sys.stderr.write(proc.stderr)
             line = proc.stdout.strip().splitlines()[-1]

@@ -28,6 +28,51 @@ python -m pytest \
   tests/unit/analysis/test_compare.py::test_event_engine_crn_compare_smoke \
   tests/parity/test_sweep_determinism.py::test_scenario_keys_prefix_stable_in_n \
   -q -p no:cacheprovider
+# host-fault recovery slice: a checkpointed sweep is SIGTERM-killed after
+# chunk 2, resumed, and must be byte-identical to an uninterrupted run,
+# with the preemption on record as a kind="recovery" run record
+# (docs/guides/fault-tolerance.md)
+python - <<'PY'
+import json, shutil, signal
+import numpy as np, yaml
+from asyncflow_tpu.observability import TelemetryConfig
+from asyncflow_tpu.parallel.recovery import SweepPreempted
+from asyncflow_tpu.parallel.sweep import SweepRunner, _SweepCheckpoint
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+data = yaml.safe_load(open("tests/integration/data/single_server.yml").read())
+data["sim_settings"]["total_simulation_time"] = 15
+data["sim_settings"]["enabled_sample_metrics"] = []
+payload = SimulationPayload.model_validate(data)
+runner = SweepRunner(payload, use_mesh=False)
+clean = runner.run(12, seed=5, chunk_size=4)
+
+ck, tel = "/tmp/asyncflow_smoke_ck", "/tmp/asyncflow_smoke_recovery.jsonl"
+shutil.rmtree(ck, ignore_errors=True)
+open(tel, "w").close()
+orig, calls = _SweepCheckpoint.save, {"n": 0}
+def killing_save(self, start, part):
+    orig(self, start, part)
+    calls["n"] += 1
+    if calls["n"] == 2:
+        signal.raise_signal(signal.SIGTERM)
+_SweepCheckpoint.save = killing_save
+try:
+    runner.run(12, seed=5, chunk_size=4, checkpoint_dir=ck,
+               telemetry=TelemetryConfig(jsonl_path=tel))
+    raise SystemExit("expected SweepPreempted")
+except SweepPreempted as p:
+    assert p.scenarios_done == 8 and p.exit_code == 75, p
+finally:
+    _SweepCheckpoint.save = orig
+resumed = runner.run(12, seed=5, chunk_size=4, checkpoint_dir=ck)
+assert np.array_equal(resumed.results.latency_hist, clean.results.latency_hist)
+assert np.array_equal(resumed.results.completed, clean.results.completed)
+recs = [json.loads(line) for line in open(tel)]
+rec = [r for r in recs if r.get("kind") == "recovery"]
+assert rec and rec[0]["meta"]["actions"], recs
+print("kill/resume bit-identity + recovery record OK")
+PY
 # simulation-domain tracing slice: a tiny traced scenario must export a
 # schema-valid simulated-time Perfetto trace, and the divergence CLI must
 # report zero divergence on the deterministic parity scenario

@@ -167,6 +167,12 @@ _SMOKE_TESTS = (
     "tests/parity/test_sweep_determinism.py::test_split_and_chunk_compose",
     "tests/unit/analysis/test_adaptive.py::test_stops_when_targets_met",
     "tests/unit/analysis/test_compare.py::test_event_engine_crn_compare_smoke",
+    # host-fault recovery tier (quarantine / preemption / checkpoint
+    # integrity): the NaN-quarantine acceptance loop, the SIGTERM
+    # drain-and-resume bit-identity loop, and corrupt-chunk recompute
+    "tests/unit/test_sweep_recovery.py::test_nan_scenario_quarantined_rest_bit_identical",
+    "tests/unit/test_sweep_recovery.py::test_sigterm_drain_manifest_and_resume_bit_identical",
+    "tests/unit/test_sweep_recovery.py::test_truncated_chunk_discarded_and_recomputed",
     # simulation-domain tracing tier (flight recorder + divergence finder):
     # pre-trace golden bit-identity, oracle<->jax span equality, and the
     # engines-without-event-state refusal diagnostics

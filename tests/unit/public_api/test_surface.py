@@ -78,6 +78,8 @@ def test_analysis_surface() -> None:
         "paired_delta_ratio_ci",
         "interval_for_metric",
         "paired_delta_for_metric",
+        # host-fault quarantine: drop masked rows, note the exclusion
+        "effective_results",
         # variance reduction helpers
         "antithetic_mean_ci",
         "antithetic_pair_means",
@@ -112,6 +114,13 @@ def test_parallel_surface() -> None:
         "run_multihost_sweep",
         "scenario_mesh",
         "scenario_sharding",
+        # host-fault recovery (docs/guides/fault-tolerance.md)
+        "PREEMPTED_EXIT_CODE",
+        "CorruptChunkError",
+        "RecoveryPolicy",
+        "RecoveryReport",
+        "SweepPreempted",
+        "read_manifest",
     }
 
 
