@@ -1,0 +1,69 @@
+"""Static analysis for asyncflow-tpu scenarios and for the repo itself.
+
+Layer 1 — scenario/plan diagnostics (docs/guides/diagnostics.md):
+:func:`check_payload` runs a pass pipeline over a validated
+:class:`~asyncflow_tpu.schemas.payload.SimulationPayload` (and its lowered
+plan) and returns a :class:`CheckReport` of stable ``AF###`` diagnostics;
+``python -m asyncflow_tpu.checker scenario.yml`` is the CLI (exit 0 clean /
+1 warnings / 2 errors); :func:`run_preflight` is the default-on hook in
+``SimulationRunner``/``SweepRunner``.
+
+The fence registry (:data:`FENCES`, :func:`predict_routing`) is the single
+source of truth for "engine X refuses feature Y": runtime refusal sites
+raise through it, the checker predicts routing from it.
+
+Layer 2 — repo-invariant AST lint (:mod:`asyncflow_tpu.checker.internal`,
+``scripts/lint_invariants.py``) enforcing the codebase's own JAX
+invariants in CI.
+"""
+
+from asyncflow_tpu.checker.diagnostics import CheckReport, Diagnostic, Severity
+from asyncflow_tpu.checker.fences import (
+    ENGINE_OPTION_SUPPORT,
+    FENCES,
+    Fence,
+    RoutingPrediction,
+    TrippedFence,
+    fence_message,
+    predict_routing,
+    raise_fence,
+    tripped_fences,
+)
+from asyncflow_tpu.checker.preflight import (
+    PREFLIGHT_MODES,
+    PreflightError,
+    PreflightWarning,
+    run_preflight,
+)
+
+__all__ = [
+    "ENGINE_OPTION_SUPPORT",
+    "FENCES",
+    "PREFLIGHT_MODES",
+    "CheckReport",
+    "Diagnostic",
+    "Fence",
+    "PreflightError",
+    "PreflightWarning",
+    "RoutingPrediction",
+    "Severity",
+    "TrippedFence",
+    "check_payload",
+    "fence_message",
+    "predict_routing",
+    "raise_fence",
+    "run_preflight",
+    "tripped_fences",
+]
+
+
+def __getattr__(name: str):
+    # check_payload pulls in the compiler (and with it jax); load lazily so
+    # `from asyncflow_tpu.checker import raise_fence` stays feather-weight
+    # on the engine import paths.
+    if name == "check_payload":
+        from asyncflow_tpu.checker.passes import check_payload
+
+        return check_payload
+    msg = f"module {__name__!r} has no attribute {name!r}"
+    raise AttributeError(msg)

@@ -1,0 +1,98 @@
+"""CLI front door: ``python -m asyncflow_tpu.checker scenario.yml``.
+
+Validates the scenario, runs every diagnostic pass, prints the report, and
+exits 0 (clean — info findings allowed), 1 (warnings), or 2 (errors or an
+invalid scenario).  ``--json`` emits machine-readable findings for CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m asyncflow_tpu.checker",
+        description="Static scenario analyzer: stability, graph shape, "
+        "time-domain contradictions, resource sanity, and engine-routing "
+        "prediction (docs/guides/diagnostics.md).",
+    )
+    parser.add_argument("scenario", help="scenario YAML file to analyze")
+    parser.add_argument(
+        "--engine",
+        default="auto",
+        choices=("auto", "fast", "event", "pallas", "native"),
+        help="engine the run would request (default: auto)",
+    )
+    parser.add_argument(
+        "--backend",
+        default=None,
+        help="assume this jax backend for routing (default: probe)",
+    )
+    parser.add_argument(
+        "--trace", action="store_true",
+        help="predict routing with the flight recorder attached",
+    )
+    parser.add_argument(
+        "--crn", action="store_true",
+        help="predict routing with CRN coupling enabled",
+    )
+    parser.add_argument(
+        "--antithetic", action="store_true",
+        help="predict routing with antithetic coupling enabled",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit findings as JSON",
+    )
+    args = parser.parse_args(argv)
+
+    import yaml
+
+    from asyncflow_tpu.checker.passes import check_payload
+    from asyncflow_tpu.schemas.payload import SimulationPayload
+
+    try:
+        with open(args.scenario) as fh:
+            data = yaml.safe_load(fh.read())
+        payload = SimulationPayload.model_validate(data)
+    except Exception as err:  # noqa: BLE001 - CLI boundary
+        print(f"invalid scenario {args.scenario!r}: {err}", file=sys.stderr)
+        return 2
+
+    report = check_payload(
+        payload,
+        engine=args.engine,
+        backend=args.backend,
+        trace=args.trace,
+        crn=args.crn,
+        antithetic=args.antithetic,
+    )
+    if args.json:
+        print(json.dumps(
+            {
+                "scenario": args.scenario,
+                "exit_code": report.exit_code,
+                "summary": report.summary(),
+                "findings": [
+                    {
+                        "code": d.code,
+                        "severity": d.severity.value,
+                        "message": d.message,
+                        "path": d.path,
+                        "remedy": d.remedy,
+                    }
+                    for d in report.diagnostics
+                ],
+            },
+            indent=2,
+        ))
+    else:
+        print(f"== {args.scenario}")
+        print(report.render())
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

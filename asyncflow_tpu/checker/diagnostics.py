@@ -1,0 +1,121 @@
+"""Diagnostic records for the static scenario/plan analyzer.
+
+Every finding the checker can emit is a :class:`Diagnostic` with a stable
+``AF###`` code, a severity, a payload-path location, and a remedy.  Codes
+are a public contract (docs/guides/diagnostics.md catalogs them): scripts
+may grep for them, tests assert on them, and renumbering one is a breaking
+change.
+
+Code blocks:
+
+- ``AF1xx`` — queueing stability (offered load rho per station)
+- ``AF2xx`` — topology graph shape (unreachable nodes, dangling edges)
+- ``AF3xx`` — time-domain contradictions (timeouts, fault windows, backoff)
+- ``AF4xx`` — resource sanity (RAM, capacity rescale, breakpoint tables)
+- ``AF5xx`` — engine routing and feature fences
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+
+
+class Severity(str, Enum):
+    """Diagnostic severity; orders ``INFO < WARNING < ERROR``."""
+
+    INFO = "info"
+    WARNING = "warning"
+    ERROR = "error"
+
+    @property
+    def rank(self) -> int:
+        return {"info": 0, "warning": 1, "error": 2}[self.value]
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One structured finding from the static analyzer."""
+
+    code: str  #: stable ``AF###`` identifier
+    severity: Severity
+    message: str  #: what is wrong, with the numbers that prove it
+    path: str  #: payload path, e.g. ``topology_graph.nodes.servers[0]``
+    remedy: str  #: the concrete change that clears the finding
+
+    def render(self) -> str:
+        return (
+            f"{self.code} {self.severity.value}: {self.message}"
+            f"\n    at: {self.path}"
+            f"\n    remedy: {self.remedy}"
+        )
+
+
+@dataclass
+class CheckReport:
+    """The full output of one :func:`~asyncflow_tpu.checker.check_payload`.
+
+    ``exit_code`` is the CLI contract: 0 clean (info-only counts as
+    clean), 1 when the worst finding is a warning, 2 on any error.
+    """
+
+    diagnostics: list[Diagnostic] = field(default_factory=list)
+
+    def __iter__(self):
+        return iter(self.diagnostics)
+
+    def __len__(self) -> int:
+        return len(self.diagnostics)
+
+    @property
+    def errors(self) -> list[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity is Severity.ERROR]
+
+    @property
+    def warnings(self) -> list[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity is Severity.WARNING]
+
+    @property
+    def infos(self) -> list[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity is Severity.INFO]
+
+    @property
+    def clean(self) -> bool:
+        """No warnings and no errors (informational findings are fine)."""
+        return not self.errors and not self.warnings
+
+    @property
+    def exit_code(self) -> int:
+        if self.errors:
+            return 2
+        if self.warnings:
+            return 1
+        return 0
+
+    def codes(self) -> list[str]:
+        return [d.code for d in self.diagnostics]
+
+    def summary(self) -> str:
+        """One line: counts plus the codes found, worst first."""
+        ordered = sorted(
+            self.diagnostics, key=lambda d: -d.severity.rank,
+        )
+        codes = ", ".join(dict.fromkeys(d.code for d in ordered))
+        return (
+            f"{len(self.errors)} error(s), {len(self.warnings)} warning(s), "
+            f"{len(self.infos)} info"
+            + (f" [{codes}]" if codes else "")
+        )
+
+    def render(self) -> str:
+        if not self.diagnostics:
+            return "preflight clean: no findings"
+        lines = [
+            d.render()
+            for d in sorted(
+                self.diagnostics,
+                key=lambda d: (-d.severity.rank, d.code),
+            )
+        ]
+        lines.append(self.summary())
+        return "\n".join(lines)

@@ -1,0 +1,359 @@
+"""The shared feature-fence registry and static engine-routing predictor.
+
+One source of truth for every "engine X refuses feature Y" decision in the
+codebase.  The runtime refusal sites (``parallel/sweep.py``,
+``runtime/runner.py``, ``engines/jaxsim/fastpath.py``,
+``engines/jaxsim/pallas_engine.py``, ``engines/oracle/native``) raise
+through :func:`raise_fence`, and the static checker predicts routing with
+:func:`predict_routing` from the SAME table — the runtime message and the
+preflight prediction can never drift apart.
+
+This module is deliberately light: no jax, no pydantic, no compiler
+imports at module scope, so ``from asyncflow_tpu.checker.fences import
+raise_fence`` costs nothing on the engine hot paths.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = [
+    "FENCES",
+    "Fence",
+    "RoutingPrediction",
+    "TrippedFence",
+    "fence_message",
+    "predict_routing",
+    "raise_fence",
+    "tripped_fences",
+]
+
+
+@dataclass(frozen=True)
+class Fence:
+    """One (feature, engine) refusal: why the engine declines the feature."""
+
+    id: str  #: stable ``feature.engine`` identifier
+    feature: str  #: human name of the feature tripping the fence
+    engine: str  #: the engine that refuses ("fast" | "pallas" | "native")
+    message: str  #: the full runtime refusal text (``{detail}`` slot ok)
+    exc: type[Exception] = ValueError  #: what the runtime site raises
+
+
+_TRACE_REMEDY = (
+    "use the event engine (engine='event', or 'auto', which routes "
+    "traced runs there)"
+)
+
+FENCES: dict[str, Fence] = {
+    f.id: f
+    for f in (
+        # -- flight recorder (trace=TraceConfig) ---------------------------
+        Fence(
+            id="trace.fast",
+            feature="flight recorder (trace=TraceConfig)",
+            engine="fast",
+            message=(
+                "engine='fast' cannot run the flight recorder "
+                "(trace=TraceConfig): the scan fast path computes request "
+                "trajectories in closed form and has no per-event state to "
+                "record; " + _TRACE_REMEDY
+            ),
+        ),
+        Fence(
+            id="trace.pallas",
+            feature="flight recorder (trace=TraceConfig)",
+            engine="pallas",
+            message=(
+                "engine='pallas' cannot run the flight recorder "
+                "(trace=TraceConfig): the Pallas kernel keeps its state in "
+                "VMEM, which per-request event rings do not fit; "
+                + _TRACE_REMEDY
+            ),
+        ),
+        Fence(
+            id="trace.native",
+            feature="flight recorder (trace=TraceConfig)",
+            engine="native",
+            message=(
+                "engine='native' cannot run the flight recorder "
+                "(trace=TraceConfig): the recorder is not wired through "
+                "the native core's C ABI; " + _TRACE_REMEDY
+            ),
+        ),
+        # -- variance-reduction coupling (CRN / antithetic) ----------------
+        Fence(
+            id="vr.pallas",
+            feature="variance-reduction coupling (CRN / antithetic)",
+            engine="pallas",
+            message=(
+                "engine='pallas' does not support variance-reduction "
+                "coupling (CRN / antithetic draws route through the jaxsim "
+                "sampling hooks); use engine='fast' or 'event'"
+            ),
+        ),
+        Fence(
+            id="vr.native",
+            feature="variance-reduction coupling (CRN / antithetic)",
+            engine="native",
+            message=(
+                "engine='native' does not support variance-reduction "
+                "coupling (CRN / antithetic draws route through the jaxsim "
+                "sampling hooks); use engine='fast' or 'event'"
+            ),
+        ),
+        # -- resilience plans (fault windows / client retries) -------------
+        Fence(
+            id="resilience.pallas",
+            feature="resilience plan (fault windows / client retries)",
+            engine="pallas",
+            message=(
+                "engine='pallas' does not model fault windows / client "
+                "retries; use engine='event' (or 'auto', which routes "
+                "resilience plans to the event engine)"
+            ),
+        ),
+        Fence(
+            id="resilience.native",
+            feature="resilience plan (fault windows / client retries)",
+            engine="native",
+            message=(
+                "engine='native' does not model fault windows / client "
+                "retries; use engine='event' (or 'auto', which routes "
+                "resilience plans to the event engine)"
+            ),
+        ),
+        # -- fast-path eligibility -----------------------------------------
+        Fence(
+            id="fastpath.ineligible",
+            feature="closed-form fast path",
+            engine="fast",
+            message="plan not eligible for the fast path: {detail}",
+        ),
+        Fence(
+            id="fastpath.poisson_edge",
+            feature="poisson edge latency",
+            engine="fast",
+            message="poisson edge latency is not supported on the fast path",
+            exc=NotImplementedError,
+        ),
+        # -- auxiliary runtime fences ---------------------------------------
+        Fence(
+            id="native.unavailable",
+            feature="native C++ core",
+            engine="native",
+            message=(
+                "native sweep engine requested but the C++ core is "
+                "unavailable"
+            ),
+            exc=RuntimeError,
+        ),
+        Fence(
+            id="gauge_series.requires_fast",
+            feature="streaming gauge series",
+            engine="event",
+            message=(
+                "gauge_series needs the fast-path engine (streaming series "
+                "ride its interval-endpoint grid); this plan runs on "
+                "'{detail}'"
+            ),
+        ),
+    )
+}
+
+#: which engine_options each SimulationRunner backend understands;
+#: runner.py names these in its unsupported-option error so the message
+#: carries a routing hint instead of a bare option list.
+ENGINE_OPTION_SUPPORT: dict[str, tuple[str, ...]] = {
+    "collect_gauges": ("jax", "native"),
+    "collect_traces": ("oracle", "jax", "native"),
+    "collect_clocks": ("jax",),
+    "trace": ("oracle", "jax", "native"),
+    "engine": ("jax",),
+    "n_hist_bins": ("jax",),
+    "max_requests": ("jax",),
+    "relax_sweeps": ("jax",),
+    "relax_damping": ("jax",),
+}
+
+
+def fence_message(fence_id: str, **fmt: object) -> str:
+    """The canonical refusal text for ``fence_id`` (KeyError on unknown)."""
+    return FENCES[fence_id].message.format(**fmt)
+
+
+def raise_fence(fence_id: str, **fmt: object):
+    """Raise the registered exception with the canonical refusal text.
+
+    Every runtime refusal site calls this instead of hand-writing its
+    message, so static predictions quote exactly what the runtime raises.
+    """
+    fence = FENCES[fence_id]
+    raise fence.exc(fence.message.format(**fmt))
+
+
+# ---------------------------------------------------------------------------
+# static routing prediction (mirror of SweepRunner.__init__'s dispatch)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TrippedFence:
+    """One fence this configuration trips, with the canonical reason."""
+
+    fence_id: str
+    feature: str
+    engine: str  #: the engine this config can NOT use because of the fence
+    message: str
+
+
+@dataclass(frozen=True)
+class RoutingPrediction:
+    """What ``SweepRunner(engine=...)`` will do with this plan, statically."""
+
+    requested: str  #: the engine argument ("auto" or a forced engine)
+    engine: str | None  #: the engine kind that will actually run (None if refused)
+    backend: str  #: jax default backend the prediction assumed
+    why: str  #: one sentence explaining the routing decision
+    fences: tuple[TrippedFence, ...]  #: every fence the config trips
+    refusal: TrippedFence | None = None  #: set when the forced engine raises
+
+    @property
+    def ok(self) -> bool:
+        return self.refusal is None
+
+
+def _trip(fence_id: str, **fmt: object) -> TrippedFence:
+    fence = FENCES[fence_id]
+    return TrippedFence(
+        fence_id=fence.id,
+        feature=fence.feature,
+        engine=fence.engine,
+        message=fence.message.format(**fmt),
+    )
+
+
+def tripped_fences(
+    plan,
+    *,
+    trace: bool = False,
+    crn: bool = False,
+    antithetic: bool = False,
+) -> tuple[TrippedFence, ...]:
+    """Every fence this (plan, features) combination trips.
+
+    ``plan`` is a :class:`~asyncflow_tpu.compiler.plan.StaticPlan`; only
+    ``fastpath_ok`` / ``fastpath_reason`` / ``has_faults`` / ``has_retry``
+    are read, so any duck-typed stand-in works in tests.
+    """
+    out: list[TrippedFence] = []
+    if trace:
+        out += [_trip("trace.fast"), _trip("trace.pallas"), _trip("trace.native")]
+    if crn or antithetic:
+        out += [_trip("vr.pallas"), _trip("vr.native")]
+    if plan.has_faults or plan.has_retry:
+        out += [_trip("resilience.pallas"), _trip("resilience.native")]
+    if not plan.fastpath_ok:
+        out.append(_trip("fastpath.ineligible", detail=plan.fastpath_reason))
+    return tuple(out)
+
+
+def predict_routing(
+    plan,
+    *,
+    engine: str = "auto",
+    backend: str | None = None,
+    trace: bool = False,
+    crn: bool = False,
+    antithetic: bool = False,
+    gauge_series: bool = False,
+    native_ok: bool | None = None,
+) -> RoutingPrediction:
+    """Predict the engine :class:`SweepRunner` dispatch will pick.
+
+    This mirrors ``SweepRunner.__init__`` exactly (the fence-prediction
+    parity test locks the two together): forced engines refuse tripped
+    fences with the registry message; ``engine='auto'`` routes fast if the
+    plan is fastpath-eligible and untraced, else pallas on TPU when the
+    plan is neither resilient nor VR-coupled nor traced, else the XLA
+    event engine.
+
+    ``backend`` defaults to ``jax.default_backend()`` (the only jax touch,
+    resolved lazily); ``native_ok`` defaults to probing the C++ core only
+    when the answer matters.
+    """
+    if engine not in ("auto", "fast", "event", "pallas", "native"):
+        msg = (
+            f"engine must be 'auto', 'fast', 'event', 'pallas' or "
+            f"'native', got {engine!r}"
+        )
+        raise ValueError(msg)
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    vr_coupled = crn or antithetic
+    resilient = plan.has_faults or plan.has_retry
+    fences = tripped_fences(plan, trace=trace, crn=crn, antithetic=antithetic)
+
+    def refused(fence_id: str, **fmt: object) -> RoutingPrediction:
+        return RoutingPrediction(
+            requested=engine,
+            engine=None,
+            backend=backend,
+            why=f"engine={engine!r} is refused at construction time",
+            fences=fences,
+            refusal=_trip(fence_id, **fmt),
+        )
+
+    # forced engines: the constructor raises on a tripped fence
+    if trace and engine in ("fast", "pallas", "native"):
+        return refused(f"trace.{engine}")
+    if vr_coupled and engine in ("pallas", "native"):
+        return refused(f"vr.{engine}")
+    if resilient and engine in ("pallas", "native"):
+        return refused(f"resilience.{engine}")
+    if engine == "fast" and not plan.fastpath_ok:
+        return refused("fastpath.ineligible", detail=plan.fastpath_reason)
+    if engine == "native":
+        if native_ok is None:
+            from asyncflow_tpu.engines.oracle.native import native_available
+
+            native_ok = native_available()
+        if not native_ok:
+            return refused("native.unavailable")
+
+    if engine == "auto":
+        if plan.fastpath_ok and not trace:
+            kind = "fast"
+            why = "plan is fastpath-eligible and untraced"
+        elif (
+            backend == "tpu"
+            and not resilient
+            and not vr_coupled
+            and not trace
+        ):
+            kind = "pallas"
+            why = "TPU backend, no resilience/VR/trace fences tripped"
+        else:
+            kind = "event"
+            blockers = [f.feature for f in fences if f.engine == "fast"]
+            why = (
+                "routed to the XLA event engine"
+                + (f" ({'; '.join(blockers)})" if blockers else
+                   f" (backend={backend!r} has no pallas route)")
+            )
+    else:
+        kind = engine
+        why = f"engine={engine!r} was forced and trips no fence"
+
+    if gauge_series and kind != "fast":
+        return refused("gauge_series.requires_fast", detail=kind)
+
+    return RoutingPrediction(
+        requested=engine,
+        engine=kind,
+        backend=backend,
+        why=why,
+        fences=fences,
+    )

@@ -1,0 +1,287 @@
+"""Repo-invariant AST lint (Layer 2 of the checker).
+
+The test suite can only catch these probabilistically; the lint catches
+them mechanically, per commit (CI job ``lint-invariants``, driver
+``scripts/lint_invariants.py``):
+
+- ``IN901`` — ``jax.random.split`` is forbidden on scenario-key paths.
+  Scenario substreams must be derived with prefix-stable
+  ``jax.random.fold_in`` chains: ``split`` renumbers every sibling stream
+  when one is added, silently changing all results of a grown sweep.
+  Statistical consumers that legitimately split a *bootstrap* key are
+  allowlisted by file.
+- ``IN902`` — no host-sync calls inside device loop bodies: a function
+  passed to ``lax.scan`` / ``lax.while_loop`` / ``lax.fori_loop`` must not
+  call ``.item()`` / ``float()`` / ``np.asarray`` / ``np.array`` /
+  ``.block_until_ready()`` on traced values; each forces a device->host
+  transfer per iteration and destroys the fused program.
+- ``IN903`` — every ``EngineState`` field must be initialized (registered
+  in the placeholder-pruning table) in ``engine.py``'s ``_init_state``:
+  a field added to the NamedTuple but not to the constructor call is a
+  guaranteed TypeError at trace time on some untested branch, or worse, a
+  silently default-shaped carry.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from pathlib import Path
+
+__all__ = ["Violation", "lint_file", "lint_source", "lint_tree"]
+
+#: files allowed to call jax.random.split: they key bootstrap resamples /
+#: synthetic benchmarks, not scenario substreams.
+SPLIT_ALLOWLIST = (
+    "analysis/estimators.py",
+    "utils/program_size.py",
+)
+
+_LOOP_PRIMITIVES = {"scan", "while_loop", "fori_loop"}
+_HOST_SYNC_METHODS = {"item", "block_until_ready"}
+_HOST_SYNC_NP_FUNCS = {"asarray", "array"}
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression ('' when not a name chain)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+# ---------------------------------------------------------------------------
+# IN901: jax.random.split on scenario-key paths
+# ---------------------------------------------------------------------------
+
+
+def _split_aliases(tree: ast.AST) -> tuple[set[str], set[str]]:
+    """(module aliases naming jax.random, function aliases naming split)."""
+    random_mods = {"jax.random"}
+    split_funcs: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax.random" and alias.asname:
+                    random_mods.add(alias.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for alias in node.names:
+                    if alias.name == "random":
+                        random_mods.add(alias.asname or "random")
+            elif node.module == "jax.random":
+                for alias in node.names:
+                    if alias.name == "split":
+                        split_funcs.add(alias.asname or "split")
+    return random_mods, split_funcs
+
+
+def _check_split(tree: ast.AST, path: str, out: list[Violation]) -> None:
+    random_mods, split_funcs = _split_aliases(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        hit = name in split_funcs or (
+            name.endswith(".split")
+            and name.rsplit(".", 1)[0] in random_mods
+        )
+        if hit:
+            out.append(Violation(
+                rule="IN901", path=path, line=node.lineno,
+                message=f"jax.random.split ({name or 'split'}) on a "
+                "scenario-key path: use prefix-stable jax.random.fold_in "
+                "chains (split renumbers sibling streams when one is "
+                "added)",
+            ))
+
+
+# ---------------------------------------------------------------------------
+# IN902: host sync inside device loop bodies
+# ---------------------------------------------------------------------------
+
+
+def _loop_body_functions(tree: ast.AST) -> list[ast.AST]:
+    """Functions (defs or lambdas) passed to lax.scan/while_loop/fori_loop."""
+    defs: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    bodies: list[ast.AST] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = _dotted(node.func)
+        if fn.rsplit(".", 1)[-1] not in _LOOP_PRIMITIVES:
+            continue
+        # the body argument's position varies by primitive: scan(body, ...),
+        # while_loop(cond, body, init), fori_loop(lo, hi, body, init);
+        # sweep the first three to cover all conventions
+        for arg in node.args[:3]:
+            if isinstance(arg, ast.Lambda):
+                bodies.append(arg)
+            elif isinstance(arg, ast.Name):
+                bodies.extend(defs.get(arg.id, []))
+    return bodies
+
+
+def _fn_params(fn: ast.AST) -> set[str]:
+    args = fn.args
+    names = [a.arg for a in (*args.posonlyargs, *args.args, *args.kwonlyargs)]
+    return set(names)
+
+
+def _check_host_sync(tree: ast.AST, path: str, out: list[Violation]) -> None:
+    for body in _loop_body_functions(tree):
+        params = _fn_params(body)
+        for node in ast.walk(body):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            leaf = name.rsplit(".", 1)[-1]
+            arg0 = node.args[0] if node.args else None
+            # unwrap attribute/subscript chains (s.t, s[1], s.q[0]) down to
+            # the base name: any projection of a loop parameter is traced
+            base = arg0
+            while isinstance(base, (ast.Attribute, ast.Subscript)):
+                base = base.value
+            touches_param = isinstance(base, ast.Name) and base.id in params
+            if isinstance(node.func, ast.Attribute) and (
+                node.func.attr in _HOST_SYNC_METHODS
+            ):
+                out.append(Violation(
+                    rule="IN902", path=path, line=node.lineno,
+                    message=f".{node.func.attr}() inside a device loop "
+                    "body forces a device->host sync every iteration",
+                ))
+            elif leaf in _HOST_SYNC_NP_FUNCS and name.startswith(
+                ("np.", "numpy."),
+            ) and touches_param:
+                out.append(Violation(
+                    rule="IN902", path=path, line=node.lineno,
+                    message=f"{name}() on a traced loop-carry inside a "
+                    "device loop body materializes it on the host every "
+                    "iteration",
+                ))
+            elif name == "float" and touches_param:
+                out.append(Violation(
+                    rule="IN902", path=path, line=node.lineno,
+                    message="float() on a traced loop-carry inside a "
+                    "device loop body is a per-iteration host sync",
+                ))
+
+
+# ---------------------------------------------------------------------------
+# IN903: EngineState fields registered in the _init_state pruning table
+# ---------------------------------------------------------------------------
+
+
+def _namedtuple_fields(tree: ast.AST, cls_name: str) -> list[str]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            return [
+                stmt.target.id
+                for stmt in node.body
+                if isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            ]
+    return []
+
+
+def _check_engine_state(
+    params_tree: ast.AST,
+    engine_tree: ast.AST,
+    engine_path: str,
+    out: list[Violation],
+) -> None:
+    fields = _namedtuple_fields(params_tree, "EngineState")
+    if not fields:
+        return
+    init_kwargs: set[str] = set()
+    line = 1
+    for node in ast.walk(engine_tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name != "_init_state":
+            continue
+        for call in ast.walk(node):
+            if (
+                isinstance(call, ast.Call)
+                and _dotted(call.func).rsplit(".", 1)[-1] == "EngineState"
+            ):
+                init_kwargs |= {
+                    kw.arg for kw in call.keywords if kw.arg is not None
+                }
+                line = call.lineno
+    if not init_kwargs:
+        return
+    for field in fields:
+        if field not in init_kwargs:
+            out.append(Violation(
+                rule="IN903", path=engine_path, line=line,
+                message=f"EngineState field {field!r} is not initialized "
+                "in _init_state's placeholder-pruning table: every field "
+                "needs an explicit (possibly (1,)-placeholder) entry or "
+                "tracing breaks on the first branch that carries it",
+            ))
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+
+def lint_source(
+    src: str,
+    path: str = "<string>",
+    *,
+    allow_split: bool = False,
+) -> list[Violation]:
+    """Lint one source string (IN901 + IN902)."""
+    out: list[Violation] = []
+    tree = ast.parse(src, filename=path)
+    if not allow_split:
+        _check_split(tree, path, out)
+    _check_host_sync(tree, path, out)
+    return out
+
+
+def lint_file(path: str | Path, *, root: str | Path | None = None) -> list[Violation]:
+    path = Path(path)
+    rel = str(path.relative_to(root) if root else path)
+    allow = any(rel.endswith(a) for a in SPLIT_ALLOWLIST)
+    return lint_source(path.read_text(), rel, allow_split=allow)
+
+
+def lint_tree(pkg_dir: str | Path) -> list[Violation]:
+    """Lint every ``.py`` under ``pkg_dir`` (IN901/IN902) plus the
+    cross-file IN903 EngineState registration check."""
+    pkg_dir = Path(pkg_dir)
+    out: list[Violation] = []
+    for path in sorted(pkg_dir.rglob("*.py")):
+        out.extend(lint_file(path, root=pkg_dir.parent))
+    params = pkg_dir / "engines" / "jaxsim" / "params.py"
+    engine = pkg_dir / "engines" / "jaxsim" / "engine.py"
+    if params.exists() and engine.exists():
+        _check_engine_state(
+            ast.parse(params.read_text()),
+            ast.parse(engine.read_text()),
+            str(engine.relative_to(pkg_dir.parent)),
+            out,
+        )
+    return out

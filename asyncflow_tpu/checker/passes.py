@@ -1,0 +1,605 @@
+"""The scenario/plan diagnostic passes behind :func:`check_payload`.
+
+Each pass reads the validated :class:`SimulationPayload` (and, where
+noted, the lowered :class:`StaticPlan`) and appends
+:class:`~asyncflow_tpu.checker.diagnostics.Diagnostic` records.  Passes
+are pure and ordered; none raises on a bad scenario — the report does the
+talking.
+
+The load math deliberately reuses the compiler's own models
+(``_server_entry_rates``, ``_server_db_hold``) so the checker and the
+capacity estimator can never disagree about offered load.
+"""
+
+from __future__ import annotations
+
+from asyncflow_tpu.checker.diagnostics import CheckReport, Diagnostic, Severity
+from asyncflow_tpu.checker.fences import predict_routing
+from asyncflow_tpu.config.constants import EndpointStepIO, EventDescription
+
+# rho thresholds (offered load per station): the published contract of the
+# AF1xx block — see docs/guides/diagnostics.md before changing any.
+RHO_ERROR = 1.0  #: unstable: queue grows without bound, AF102
+RHO_WARNING = 0.9  #: near saturation (retry-amplified counts), AF101
+RHO_NOISE = 0.6  #: ensemble-noise regime: parity/CI seed lottery, AF103
+
+
+# ---------------------------------------------------------------------------
+# payload arithmetic helpers (schemas only, no compiler import)
+# ---------------------------------------------------------------------------
+
+
+def _step_io_mean(step) -> float:
+    """Expected wall seconds of one I/O step (cache/LLM dynamics included)."""
+    base = float(step.quantity)
+    if step.cache_hit_probability is not None:
+        p = float(step.cache_hit_probability)
+        return p * base + (1.0 - p) * float(step.cache_miss_time)
+    if step.llm_tokens_mean is not None:
+        return base + float(step.llm_tokens_mean) * float(step.llm_time_per_token)
+    return base
+
+
+def _step_io_floor(step) -> float:
+    """Minimum achievable wall seconds of one I/O step."""
+    base = float(step.quantity)
+    if step.cache_hit_probability is not None:
+        return min(base, float(step.cache_miss_time))
+    return base  # LLM floor: Poisson token draw can be 0
+
+
+def _ep_cpu(ep) -> float:
+    return sum(float(s.quantity) for s in ep.steps if s.is_cpu)
+
+
+def _ep_io_mean(ep) -> float:
+    return sum(_step_io_mean(s) for s in ep.steps if s.is_io)
+
+
+def _ep_io_floor(ep) -> float:
+    return sum(_step_io_floor(s) for s in ep.steps if s.is_io)
+
+
+def _ep_ram(ep) -> float:
+    return sum(float(s.quantity) for s in ep.steps if s.is_ram)
+
+
+def _ep_db(ep) -> float:
+    return sum(
+        float(s.quantity)
+        for s in ep.steps
+        if s.is_io and s.kind == EndpointStepIO.DB
+    )
+
+
+def _weighted(server, per_ep) -> float:
+    """selection_weight-weighted mean of ``per_ep(endpoint)`` over a server."""
+    eps = server.endpoints
+    total = sum(float(ep.selection_weight) for ep in eps)
+    if total <= 0.0:
+        return 0.0
+    return sum(per_ep(ep) * float(ep.selection_weight) for ep in eps) / total
+
+
+def _service_floor(server) -> float:
+    """Minimum achievable service seconds over the server's endpoints."""
+    return min(
+        (_ep_cpu(ep) + _ep_io_floor(ep) for ep in server.endpoints),
+        default=0.0,
+    )
+
+
+def _entry_walk(payload, start_id: str):
+    """(edges, terminal) walking ``start_id``'s out-edge chain to the first
+    server or LB — the request's one-way trip, mirroring the lowering."""
+    servers = {s.id for s in payload.topology_graph.nodes.servers}
+    lb = payload.topology_graph.nodes.load_balancer
+    out_edge = {e.source: e for e in payload.topology_graph.edges}
+    node, hops = start_id, []
+    for _ in range(len(payload.topology_graph.edges) + 1):
+        e = out_edge.get(node)
+        if e is None:
+            return hops, None
+        hops.append(e)
+        if e.target in servers or (lb is not None and e.target == lb.id):
+            return hops, e.target
+        node = e.target
+    return hops, None
+
+
+def _retry_amplification(payload) -> float:
+    """Worst-case offered-load multiplier from the client retry ladder."""
+    rp = payload.retry_policy
+    return float(rp.max_attempts) if rp is not None else 1.0
+
+
+def _outage_windows(payload) -> dict[str, list[tuple[float, float]]]:
+    """Per-server outage windows from BOTH what-if sources: the fault
+    timeline (``server_outage``) and scheduled event injections
+    (``server_down`` .. ``server_up``)."""
+    wins: dict[str, list[tuple[float, float]]] = {}
+    tl = payload.fault_timeline
+    if tl is not None:
+        for ev in tl.events:
+            if str(ev.kind) == "server_outage":
+                wins.setdefault(ev.target_id, []).append(
+                    (float(ev.t_start), float(ev.t_end)),
+                )
+    for ev in payload.events or []:
+        if ev.start.kind == EventDescription.SERVER_DOWN:
+            wins.setdefault(ev.target_id, []).append(
+                (float(ev.start.t_start), float(ev.end.t_end)),
+            )
+    return wins
+
+
+def _covered(windows: list[tuple[float, float]], horizon: float) -> float:
+    """Fraction of ``[0, horizon)`` covered by the union of the windows."""
+    if not windows or horizon <= 0.0:
+        return 0.0
+    total, hi = 0.0, 0.0
+    for a, b in sorted(w for w in windows):
+        a, b = max(a, hi), min(b, horizon)
+        if b > a:
+            total += b - a
+            hi = b
+        hi = max(hi, min(b, horizon))
+    return total / horizon
+
+
+# ---------------------------------------------------------------------------
+# passes
+# ---------------------------------------------------------------------------
+
+
+def stability_pass(payload, plan, out: list[Diagnostic]) -> None:
+    """AF101/AF102/AF103: per-station offered load rho.
+
+    rho = arrival rate x mean service demand / servers-at-station, with the
+    client retry ladder amplifying arrivals by up to ``max_attempts``.  The
+    two stations with finite capacity are the CPU core pool and the DB
+    connection pool (plain I/O waits are unbounded-concurrency sleeps).
+    """
+    from asyncflow_tpu.compiler.plan import _server_entry_rates
+
+    rates = _server_entry_rates(payload)
+    if rates is None:  # cyclic server chain: rates undefined, graph pass reports
+        return
+    amp = _retry_amplification(payload)
+    servers = payload.topology_graph.nodes.servers
+    for s, server in enumerate(servers):
+        lam = float(rates[s])
+        if lam <= 0.0:
+            continue
+        path = f"topology_graph.nodes.servers[{s}] (id={server.id!r})"
+        ov = server.overload
+        # an explicit shedding control turns saturation into a loss
+        # system: the queue is bounded by design and the excess lands in
+        # total_rejected, so rho >= 1 is a regime note, not an error
+        sheds = ov is not None and any(
+            getattr(ov, f, None) is not None
+            for f in (
+                "max_ready_queue",
+                "max_connections",
+                "rate_limit_rps",
+                "queue_timeout_s",
+            )
+        )
+        stations = [(
+            "cpu",
+            _weighted(server, _ep_cpu),
+            int(server.server_resources.cpu_cores),
+            "add cpu_cores, add servers behind the load balancer, or "
+            "lower the offered rate (users x req/min)",
+        )]
+        pool = server.server_resources.db_connection_pool
+        if pool:
+            stations.append((
+                "db_connection_pool",
+                _weighted(server, _ep_db),
+                int(pool),
+                "raise db_connection_pool or shorten the io_db holds",
+            ))
+        for station, demand, k, remedy in stations:
+            if demand <= 0.0 or k <= 0:
+                continue
+            rho = lam * demand / k
+            rho_amp = rho * amp
+            detail = (
+                f"server {server.id!r} {station} station: offered load "
+                f"rho={rho:.2f} (rate {lam:.1f} rq/s x demand {demand:.3f} s"
+                f" / {k} slot(s))"
+            )
+            if rho_amp >= RHO_WARNING and sheds:
+                out.append(Diagnostic(
+                    code="AF104", severity=Severity.INFO,
+                    message=detail + " is at/over saturation but the "
+                    "server's overload policy sheds the excess "
+                    "(bounded-loss system): latency stays bounded and the "
+                    "signal moves to the total_rejected counters",
+                    path=path,
+                    remedy="intentional overload studies need no change; "
+                    "otherwise " + remedy,
+                ))
+            elif rho >= RHO_ERROR:
+                out.append(Diagnostic(
+                    code="AF102", severity=Severity.ERROR,
+                    message=detail + " >= 1.0: the queue grows without "
+                    "bound and latency percentiles depend on the horizon, "
+                    "not the system",
+                    path=path, remedy=remedy,
+                ))
+            elif rho_amp >= RHO_WARNING:
+                ampnote = (
+                    f"; retry amplification x{amp:.0f} "
+                    f"(retry_policy.max_attempts) lifts it to "
+                    f"{rho_amp:.2f}" if amp > 1.0 and rho < RHO_WARNING
+                    else ""
+                )
+                out.append(Diagnostic(
+                    code="AF101", severity=Severity.WARNING,
+                    message=detail + ampnote + ": near saturation — small "
+                    "input changes produce large output swings",
+                    path=path,
+                    remedy=remedy + (
+                        "; or lower retry_policy.max_attempts"
+                        if amp > 1.0 else ""
+                    ),
+                ))
+            elif rho >= RHO_NOISE:
+                out.append(Diagnostic(
+                    code="AF103", severity=Severity.INFO,
+                    message=detail + f" >= {RHO_NOISE}: queueing noise "
+                    "dominates — single-seed comparisons (parity "
+                    "tolerances, A/B deltas) become a seed lottery",
+                    path=path,
+                    remedy="average more seeds (SweepRunner Monte-Carlo) "
+                    "or lengthen the horizon before trusting point "
+                    "estimates",
+                ))
+
+
+def graph_pass(payload, out: list[Diagnostic]) -> None:
+    """AF201/AF202/AF203: reachability of nodes and edges under traffic."""
+    g = payload.topology_graph
+    servers = {s.id for s in g.nodes.servers}
+    lb = g.nodes.load_balancer
+    by_source: dict[str, list] = {}
+    for e in g.edges:
+        by_source.setdefault(e.source, []).append(e)
+
+    visited: set[str] = set()
+    traversed: set[str] = set()
+    frontier = [w.id for w in payload.generators]
+    while frontier:
+        node = frontier.pop()
+        if node in visited:
+            continue
+        visited.add(node)
+        if lb is not None and node == lb.id:
+            # the LB reaches its whole cover even when an edge is implicit
+            frontier.extend(lb.server_covered)
+        for e in by_source.get(node, []):
+            traversed.add(e.id)
+            frontier.append(e.target)
+
+    for s, server in enumerate(g.nodes.servers):
+        if server.id not in visited:
+            out.append(Diagnostic(
+                code="AF201", severity=Severity.WARNING,
+                message=f"server {server.id!r} receives no traffic: no "
+                "generator entry chain or load-balancer cover reaches it",
+                path=f"topology_graph.nodes.servers[{s}]",
+                remedy="wire an edge (or load-balancer cover) to the "
+                "server, or remove it from the topology",
+            ))
+    for i, e in enumerate(g.edges):
+        if e.id not in traversed:
+            out.append(Diagnostic(
+                code="AF202", severity=Severity.WARNING,
+                message=f"edge {e.id!r} ({e.source} -> {e.target}) is "
+                "never traversed by any request path",
+                path=f"topology_graph.edges[{i}]",
+                remedy="connect its source to the traffic graph or delete "
+                "the edge",
+            ))
+    # a reachable server must eventually route back to the client, or
+    # every request that enters it never completes
+    client = g.nodes.client.id
+    for s, server in enumerate(g.nodes.servers):
+        if server.id not in visited:
+            continue
+        node, ok = server.id, False
+        for _ in range(len(g.edges) + 1):
+            nxt = by_source.get(node, [])
+            if not nxt:
+                break
+            node = nxt[0].target
+            if node == client:
+                ok = True
+                break
+            if node not in servers:
+                ok = True  # LB / client-adjacent component closes the loop
+                break
+        if not ok:
+            out.append(Diagnostic(
+                code="AF203", severity=Severity.WARNING,
+                message=f"server {server.id!r} has no edge chain back to "
+                f"the client {client!r}: responses from it never complete",
+                path=f"topology_graph.nodes.servers[{s}]",
+                remedy="add the server -> client (response) edge",
+            ))
+
+
+def time_pass(payload, out: list[Diagnostic]) -> None:
+    """AF301-AF304: timeout vs achievable RTT, fault blackouts, backoff."""
+    horizon = float(payload.sim_settings.total_simulation_time)
+    servers = {s.id: s for s in payload.topology_graph.nodes.servers}
+    lb = payload.topology_graph.nodes.load_balancer
+    rp = payload.retry_policy
+
+    if rp is not None:
+        timeout = float(rp.request_timeout_s)
+        for workload in payload.generators:
+            hops, terminal = _entry_walk(payload, workload.id)
+            if terminal is None:
+                continue
+            targets = (
+                sorted(lb.server_covered)
+                if lb is not None and terminal == lb.id
+                else [terminal]
+            )
+            floor = min(_service_floor(servers[t]) for t in targets)
+            # stochastic edge draws all reach 0, so the deterministic floor
+            # is the endpoint service time; edge MEANS bound the typical trip
+            edge_mean = 2.0 * sum(float(e.latency.mean) for e in hops)
+            if timeout < floor:
+                out.append(Diagnostic(
+                    code="AF301", severity=Severity.ERROR,
+                    message=f"request_timeout_s={timeout:g} is below the "
+                    f"minimum achievable service time {floor:g}s: every "
+                    "attempt times out, goodput is zero, and each logical "
+                    f"request re-offers up to x{rp.max_attempts} load (a "
+                    "certain retry storm)",
+                    path="retry_policy.request_timeout_s",
+                    remedy=f"raise request_timeout_s above {floor:g}s or "
+                    "shorten the endpoint's cpu/io steps",
+                ))
+            elif timeout < floor + edge_mean:
+                out.append(Diagnostic(
+                    code="AF302", severity=Severity.WARNING,
+                    message=f"request_timeout_s={timeout:g} is below the "
+                    f"typical round trip (~{floor + edge_mean:g}s = service "
+                    f"floor {floor:g}s + mean edge latency {edge_mean:g}s): "
+                    "most attempts will time out",
+                    path="retry_policy.request_timeout_s",
+                    remedy="raise request_timeout_s comfortably above the "
+                    "typical RTT, or speed up the slow path it measures",
+                ))
+
+        # the full retry ladder must fit the horizon, or late logical
+        # requests are truncated mid-ladder and retry metrics are biased
+        backoffs = sum(
+            min(
+                float(rp.backoff_cap_s),
+                float(rp.backoff_base_s)
+                * float(rp.backoff_multiplier) ** (k - 1),
+            )
+            for k in range(1, int(rp.max_attempts))
+        )
+        ladder = int(rp.max_attempts) * float(rp.request_timeout_s) + backoffs
+        if ladder > horizon:
+            out.append(Diagnostic(
+                code="AF304", severity=Severity.WARNING,
+                message=f"the worst-case retry ladder takes {ladder:g}s "
+                f"({rp.max_attempts} x timeout {rp.request_timeout_s:g}s + "
+                f"{backoffs:g}s backoff) but the horizon is only "
+                f"{horizon:g}s: requests are cut off mid-ladder and "
+                "retry/timeout counters under-report",
+                path="retry_policy",
+                remedy="lengthen total_simulation_time, cap the backoff "
+                "lower, or reduce max_attempts",
+            ))
+
+    cover = {
+        sid: _covered(wins, horizon)
+        for sid, wins in _outage_windows(payload).items()
+        if sid in servers
+    }
+    full = [sid for sid, c in cover.items() if c >= 1.0]
+    for sid in full:
+        out.append(Diagnostic(
+            code="AF303",
+            severity=(
+                Severity.ERROR if set(full) >= set(servers)
+                else Severity.WARNING
+            ),
+            message=f"outage windows cover the entire horizon for server "
+            f"{sid!r}: it never serves a single request"
+            + (" — with every server dark the run has zero goodput"
+               if set(full) >= set(servers) else ""),
+            path="fault_timeline / events",
+            remedy="shrink the outage windows or lengthen "
+            "total_simulation_time past them",
+        ))
+
+
+def resource_pass(payload, plan, out: list[Diagnostic]) -> None:
+    """AF401-AF404: RAM feasibility, capacity rescale, table cliffs."""
+    from asyncflow_tpu.compiler.plan import _server_entry_rates
+
+    rates = _server_entry_rates(payload)
+    servers = payload.topology_graph.nodes.servers
+    amp = _retry_amplification(payload)
+    for s, server in enumerate(servers):
+        ram_mb = float(server.server_resources.ram_mb)
+        path = f"topology_graph.nodes.servers[{s}] (id={server.id!r})"
+        for e, ep in enumerate(server.endpoints):
+            need = _ep_ram(ep)
+            if need > ram_mb:
+                out.append(Diagnostic(
+                    code="AF401", severity=Severity.ERROR,
+                    message=f"endpoint {ep.endpoint_name!r} needs "
+                    f"{need:g} MB of RAM but server {server.id!r} only has "
+                    f"{ram_mb:g} MB: no request of this endpoint can ever "
+                    "be admitted",
+                    path=path + f".endpoints[{e}]",
+                    remedy="raise ram_mb above the endpoint's summed "
+                    "necessary_ram, or shrink the steps",
+                ))
+        if rates is None:
+            continue
+        lam = float(rates[s]) * amp
+        residence = _weighted(
+            server, lambda ep: _ep_cpu(ep) + _ep_io_mean(ep),
+        )
+        occupancy = lam * residence * _weighted(server, _ep_ram)
+        if ram_mb > 0.0 and occupancy >= RHO_WARNING * ram_mb:
+            out.append(Diagnostic(
+                code="AF402", severity=Severity.WARNING,
+                message=f"steady-state RAM occupancy on server "
+                f"{server.id!r} is ~{occupancy:.0f} MB "
+                f"({lam:.1f} rq/s x {residence:.3f} s residence x mean "
+                f"necessary_ram) against {ram_mb:g} MB: admission blocks "
+                "and the RAM queue becomes the bottleneck",
+                path=path,
+                remedy="raise ram_mb, lower the offered rate, or shorten "
+                "the residence (cpu/io) of RAM-holding requests",
+            ))
+
+    if len(payload.generators) > 1:
+        out.append(Diagnostic(
+            code="AF403", severity=Severity.INFO,
+            message=f"{len(payload.generators)} generators superpose: a "
+            "manual max_requests override is split across generators in "
+            "rate proportion, so a small cap can starve the low-rate "
+            "generator's lanes entirely",
+            path="rqs_input",
+            remedy="leave max_requests to the compiler's capacity "
+            "estimate, or size it per the combined rate",
+        ))
+
+    if plan is not None:
+        from asyncflow_tpu.engines.jaxsim.sortutil import DENSE_TABLE_MAX
+
+        tables = {
+            "spike_times (event injections)": len(plan.spike_times),
+            "fault_srv_times (fault timeline)": len(plan.fault_srv_times),
+            "fault_edge_times (fault timeline)": len(plan.fault_edge_times),
+        }
+        for name, n in tables.items():
+            if n > DENSE_TABLE_MAX:
+                out.append(Diagnostic(
+                    code="AF404", severity=Severity.WARNING,
+                    message=f"breakpoint table {name} has {n} entries, "
+                    f"over the {DENSE_TABLE_MAX}-entry dense-compare bound "
+                    "of searchsorted_small: every lookup falls back to a "
+                    "gather-heavy binary search on device",
+                    path="events / fault_timeline",
+                    remedy="merge adjacent windows or split the scenario; "
+                    f"keep breakpoint tables within {DENSE_TABLE_MAX} "
+                    "entries",
+                ))
+
+
+def routing_pass(
+    payload,
+    plan,
+    out: list[Diagnostic],
+    *,
+    engine: str = "auto",
+    backend: str | None = None,
+    trace: bool = False,
+    crn: bool = False,
+    antithetic: bool = False,
+) -> None:
+    """AF501-AF503: which engine runs this, and every fence on the way."""
+    pred = predict_routing(
+        plan,
+        engine=engine,
+        backend=backend,
+        trace=trace,
+        crn=crn,
+        antithetic=antithetic,
+        # availability probe only matters for a forced native engine; the
+        # static answer ("the constructor would raise") stays deterministic
+        native_ok=True if engine == "native" else None,
+    )
+    if pred.refusal is not None:
+        out.append(Diagnostic(
+            code="AF503", severity=Severity.ERROR,
+            message=f"engine={engine!r} will be refused at construction: "
+            + pred.refusal.message,
+            path="SweepRunner(engine=...)",
+            remedy="use engine='auto' or an engine outside the fence",
+        ))
+    else:
+        out.append(Diagnostic(
+            code="AF501", severity=Severity.INFO,
+            message=f"engine={pred.requested!r} runs this plan on the "
+            f"{pred.engine!r} engine (backend={pred.backend!r}): "
+            + pred.why,
+            path="SweepRunner(engine=...)",
+            remedy="no action needed; force engine='event' to override "
+            "routing",
+        ))
+    for f in pred.fences:
+        out.append(Diagnostic(
+            code="AF502", severity=Severity.INFO,
+            message=f"fence {f.fence_id}: this config cannot use the "
+            f"{f.engine!r} engine — {f.message}",
+            path="SweepRunner(engine=...)",
+            remedy="drop the feature to regain the fenced engine, or "
+            "accept the routed one",
+        ))
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def check_payload(
+    payload,
+    *,
+    plan=None,
+    engine: str = "auto",
+    backend: str | None = None,
+    trace: bool = False,
+    crn: bool = False,
+    antithetic: bool = False,
+) -> CheckReport:
+    """Run every static pass over a validated payload -> :class:`CheckReport`.
+
+    ``plan`` (a lowered :class:`StaticPlan`) is compiled on demand when not
+    provided; callers that already hold one (SweepRunner) pass it in so
+    preflight costs no second lowering.  ``engine``/``backend``/``trace``/
+    ``crn``/``antithetic`` describe the run being contemplated, for the
+    routing prediction; the payload-shape passes ignore them.
+    """
+    out: list[Diagnostic] = []
+    if plan is None:
+        from asyncflow_tpu.compiler import compile_payload
+
+        try:
+            plan = compile_payload(payload)
+        except Exception as exc:  # noqa: BLE001 - report, don't crash
+            out.append(Diagnostic(
+                code="AF001", severity=Severity.ERROR,
+                message="scenario does not lower to a StaticPlan: "
+                f"{type(exc).__name__}: {exc}",
+                path="compile_payload(payload)",
+                remedy="fix the scenario until compile_payload succeeds; "
+                "the graph diagnostics below usually name the culprit",
+            ))
+    stability_pass(payload, plan, out)
+    graph_pass(payload, out)
+    time_pass(payload, out)
+    resource_pass(payload, plan, out)
+    if plan is not None:
+        routing_pass(
+            payload, plan, out,
+            engine=engine, backend=backend,
+            trace=trace, crn=crn, antithetic=antithetic,
+        )
+    return CheckReport(diagnostics=out)

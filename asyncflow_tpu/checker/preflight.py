@@ -1,0 +1,109 @@
+"""Default-on preflight: the static analyzer wired into the runners.
+
+``SimulationRunner`` and ``SweepRunner`` call :func:`run_preflight` before
+touching an engine.  Modes:
+
+- ``"warn"`` (default) — findings become one :class:`PreflightWarning`
+  and, with telemetry enabled, a ``kind="preflight"`` JSONL run record;
+  the run proceeds (deliberately-saturated studies are legitimate).
+- ``"strict"`` — any warning-or-error finding raises
+  :class:`PreflightError` carrying the full report.
+- ``"off"`` — skip the analyzer entirely.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from asyncflow_tpu.checker.diagnostics import CheckReport
+
+PREFLIGHT_MODES = ("warn", "strict", "off")
+
+
+class PreflightWarning(UserWarning):
+    """A scenario shipped to an engine with static findings on record."""
+
+
+class PreflightError(RuntimeError):
+    """Strict preflight refused a scenario; ``.report`` has the findings."""
+
+    def __init__(self, report: CheckReport) -> None:
+        self.report = report
+        super().__init__(
+            "preflight failed (" + report.summary() + ")\n" + report.render(),
+        )
+
+
+def run_preflight(
+    payload,
+    *,
+    mode: str = "warn",
+    plan=None,
+    telemetry=None,
+    where: str = "run",
+    engine: str = "auto",
+    backend: str | None = None,
+    trace: bool = False,
+    crn: bool = False,
+    antithetic: bool = False,
+) -> CheckReport | None:
+    """Analyze ``payload`` and report per ``mode`` (None when ``"off"``).
+
+    Never raises in ``"warn"`` mode — not on findings, and not on an
+    analyzer bug either (a diagnostics pass must not be able to take down
+    a production run; such a failure becomes its own warning).
+    """
+    if mode not in PREFLIGHT_MODES:
+        msg = f"preflight must be one of {PREFLIGHT_MODES}, got {mode!r}"
+        raise ValueError(msg)
+    if mode == "off":
+        return None
+    from asyncflow_tpu.checker.passes import check_payload
+
+    try:
+        report = check_payload(
+            payload, plan=plan, engine=engine, backend=backend,
+            trace=trace, crn=crn, antithetic=antithetic,
+        )
+    except Exception as err:  # noqa: BLE001 - see docstring
+        if mode == "strict":
+            raise
+        warnings.warn(
+            f"preflight analyzer failed ({type(err).__name__}: {err}); "
+            "continuing without static checks",
+            PreflightWarning,
+            stacklevel=3,
+        )
+        return None
+    if report.clean:
+        return report
+    if mode == "strict":
+        raise PreflightError(report)
+    warnings.warn(
+        f"preflight found issues in this scenario ({where}): "
+        + report.summary()
+        + " — run `python -m asyncflow_tpu.checker` on it for the full "
+        "report, or pass preflight='off' to silence",
+        PreflightWarning,
+        stacklevel=3,
+    )
+    if telemetry is not None:
+        from asyncflow_tpu.observability.telemetry import emit_event_record
+
+        emit_event_record(
+            telemetry,
+            kind="preflight",
+            where=where,
+            summary=report.summary(),
+            codes=report.codes(),
+            findings=[
+                {
+                    "code": d.code,
+                    "severity": d.severity.value,
+                    "message": d.message,
+                    "path": d.path,
+                }
+                for d in report.diagnostics
+            ],
+        )
+    return report

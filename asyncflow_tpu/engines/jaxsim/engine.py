@@ -2434,13 +2434,11 @@ def run_single(
     if trace is not None and not isinstance(trace, TraceConfig):
         trace = TraceConfig.model_validate(trace)
     if trace is not None and engine == "fast":
-        msg = (
-            "the flight recorder (trace=TraceConfig) needs the event "
-            "engine: the scan fast path computes request trajectories in "
-            "closed form and has no per-event state to record — use "
-            "engine='event' (or 'auto', which routes traced runs there)"
-        )
-        raise ValueError(msg)
+        # canonical refusal from the shared fence registry (the static
+        # checker predicts this exact message)
+        from asyncflow_tpu.checker.fences import raise_fence
+
+        raise_fence("trace.fast")
     # Gauge recording is gated on the settings like the oracle's collector —
     # unless the caller explicitly forced it, in which case everything
     # recorded is also returned.

@@ -81,6 +81,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from asyncflow_tpu.checker.fences import raise_fence
 from asyncflow_tpu.compiler.plan import (
     CACHE_POST_DB,
     CACHE_PRE_DB,
@@ -403,14 +404,9 @@ class FastEngine:
         trace=None,
     ) -> None:
         if trace is not None:
-            msg = (
-                "the flight recorder (trace=TraceConfig) needs per-event "
-                "request state; the scan fast path computes trajectories "
-                "in closed form and records none — run the event engine "
-                "(SimulationRunner engine_options/SweepRunner with "
-                "engine='event', or 'auto', which routes traced runs there)"
-            )
-            raise ValueError(msg)
+            # canonical refusal from the shared fence registry (the static
+            # checker predicts this exact message)
+            raise_fence("trace.fast")
         """``gauge_series_stride``: with ``collect_gauges=False``, a stride
         k > 0 collects every gauge on a grid coarsened k-fold
         (period ``sample_period * k``) — the sweep-scale streaming series:
@@ -420,8 +416,7 @@ class FastEngine:
         same tick-inclusion rule on either grid).  Ignored when the exact
         grid is already being collected."""
         if not plan.fastpath_ok:
-            msg = f"plan not eligible for the fast path: {plan.fastpath_reason}"
-            raise ValueError(msg)
+            raise_fence("fastpath.ineligible", detail=plan.fastpath_reason)
         if relax_sweeps is not None and relax_sweeps < 1:
             msg = f"relax_sweeps must be >= 1, got {relax_sweeps}"
             raise ValueError(msg)
@@ -546,8 +541,7 @@ class FastEngine:
         if dist_id == _D_LOGNORMAL:
             return lognormal(mean, var, z)
         # unreachable: _fastpath_analysis rejects poisson-latency edges
-        msg = "poisson edge latency is not supported on the fast path"
-        raise NotImplementedError(msg)
+        raise_fence("fastpath.poisson_edge")
 
     @staticmethod
     def _fused_drop_rescale(u, p):

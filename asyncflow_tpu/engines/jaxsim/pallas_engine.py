@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from asyncflow_tpu.checker.fences import raise_fence
 from asyncflow_tpu.compiler.plan import (
     SEG_CACHE,
     SEG_CPU,
@@ -337,19 +338,11 @@ class PallasEngine:
         program — GSPMD cannot partition a ``pallas_call``, so the sharding
         seam has to be explicit)."""
         if trace is not None:
-            msg = (
-                "the flight recorder (trace=TraceConfig) is not carried by "
-                "the Pallas VMEM kernel (its state must fit VMEM; per-"
-                "request event rings do not) — use the XLA event engine "
-                "(engine='event')"
-            )
-            raise ValueError(msg)
+            # canonical refusals from the shared fence registry (the static
+            # checker predicts these exact messages)
+            raise_fence("trace.pallas")
         if plan.has_faults or plan.has_retry:
-            msg = (
-                "the Pallas VMEM kernel does not model fault windows / "
-                "client retries; use the XLA event engine"
-            )
-            raise ValueError(msg)
+            raise_fence("resilience.pallas")
         self.plan = plan
         self.mesh = mesh
         self.n_hist_bins = n_hist_bins

@@ -14,6 +14,7 @@ from pathlib import Path
 
 import numpy as np
 
+from asyncflow_tpu.checker.fences import raise_fence
 from asyncflow_tpu.compiler.plan import StaticPlan
 from asyncflow_tpu.config.constants import SampledMetricName
 from asyncflow_tpu.engines.results import SimulationResults
@@ -195,21 +196,14 @@ def run_native(
     required to decode generator/client/LB ids, which the compiled plan
     does not carry."""
     if trace is not None:
-        msg = (
-            "the flight recorder (trace=TraceConfig) is not wired through "
-            "the native C++ core's ABI; use backend='oracle' (Python "
-            "oracle) or the JAX event engine for simulation-domain tracing"
-        )
-        raise ValueError(msg)
+        # canonical refusals from the shared fence registry (the static
+        # checker predicts these exact messages)
+        raise_fence("trace.native")
     if collect_traces and payload is None:
         msg = "collect_traces=True needs the payload to decode component ids"
         raise ValueError(msg)
     if plan.has_faults or plan.has_retry:
-        msg = (
-            "the native core does not model fault windows / client "
-            "retries; use the oracle or the jax event engine"
-        )
-        raise ValueError(msg)
+        raise_fence("resilience.native")
     lib = load_library()
     if lib is None:
         msg = f"native core unavailable: {_lib_error}"

@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from asyncflow_tpu.checker.fences import fence_message, raise_fence
+from asyncflow_tpu.checker.preflight import run_preflight
 from asyncflow_tpu.compiler.plan import StaticPlan, compile_payload
 from asyncflow_tpu.engines.jaxsim.engine import Engine, scenario_keys, sweep_results
 from asyncflow_tpu.engines.jaxsim.params import (
@@ -554,6 +556,7 @@ class SweepRunner:
         experiment: ExperimentConfig | None = None,
         trace: TraceConfig | None = None,
         recovery: RecoveryPolicy | None = DEFAULT_RECOVERY,
+        preflight: str = "warn",
     ) -> None:
         """``engine``: "auto" picks the scan fast path when the plan is
         eligible (orders of magnitude faster), then the Pallas event kernel
@@ -627,7 +630,12 @@ class SweepRunner:
         ``recovery=None`` restores strict fail-fast behavior.  Recovery
         never changes surviving results: re-runs reproduce the original
         per-scenario streams bit-exactly (prefix-stable keys), and
-        quarantine only masks rows."""
+        quarantine only masks rows.
+
+        ``preflight``: static scenario analysis before any engine work
+        (docs/guides/diagnostics.md) — ``"warn"`` (default) surfaces
+        findings as a PreflightWarning plus a ``kind="preflight"`` run
+        record, ``"strict"`` raises PreflightError, ``"off"`` skips."""
         if engine not in ("auto", "fast", "event", "pallas", "native"):
             msg = (
                 f"engine must be 'auto', 'fast', 'event', 'pallas' or "
@@ -647,31 +655,15 @@ class SweepRunner:
             trace = TraceConfig.model_validate(trace)
         self.trace = trace
         if trace is not None and engine in ("fast", "pallas", "native"):
-            reasons = {
-                "fast": "computes request trajectories in closed form and "
-                "has no per-event state to record",
-                "pallas": "keeps its state in VMEM, which per-request "
-                "event rings do not fit",
-                "native": "does not wire the recorder through its C ABI",
-            }
-            msg = (
-                f"engine={engine!r} cannot run the flight recorder "
-                f"(trace=TraceConfig): it {reasons[engine]}; use "
-                "engine='event' (or 'auto', which routes traced sweeps "
-                "there)"
-            )
-            raise ValueError(msg)
+            # canonical refusal from the shared fence registry: the static
+            # checker predicts this exact message (docs/guides/diagnostics.md)
+            raise_fence(f"trace.{engine}")
         vr = experiment.variance_reduction if experiment is not None else None
         self._crn = bool(vr.crn) if vr is not None else False
         self._antithetic = bool(vr.antithetic) if vr is not None else False
         vr_coupled = self._crn or self._antithetic
         if vr_coupled and engine in ("pallas", "native"):
-            msg = (
-                f"engine={engine!r} does not support variance-reduction "
-                "coupling (CRN / antithetic draws route through the "
-                "jaxsim sampling hooks); use engine='fast' or 'event'"
-            )
-            raise ValueError(msg)
+            raise_fence(f"vr.{engine}")
         import time as _time
 
         t0 = _time.perf_counter()
@@ -703,12 +695,7 @@ class SweepRunner:
         # them is an explicit error, never a silent mis-model.
         resilient = self.plan.has_faults or self.plan.has_retry
         if resilient and engine in ("native", "pallas"):
-            msg = (
-                f"engine={engine!r} does not model fault windows / client "
-                "retries; use engine='event' (or 'auto', which routes "
-                "resilience plans to the event engine)"
-            )
-            raise ValueError(msg)
+            raise_fence(f"resilience.{engine}")
         if engine == "native":
             # the single-core C++ oracle, looped over the scenario grid:
             # no batching, but the lowest per-scenario constant of any
@@ -719,8 +706,7 @@ class SweepRunner:
             from asyncflow_tpu.engines.oracle.native import native_available
 
             if not native_available():
-                msg = "native sweep engine requested but the C++ core is unavailable"
-                raise RuntimeError(msg)
+                raise_fence("native.unavailable")
             self.engine = _NativeSweepEngine(self.plan, n_hist_bins=n_hist_bins)
             self.engine_kind = "native"
             self._scan_inner = 0
@@ -786,17 +772,29 @@ class SweepRunner:
             )
             self.engine_kind = "event"
         if self._gauge_sel is not None and self.engine_kind != "fast":
-            msg = (
-                "gauge_series needs the fast-path engine (streaming series "
-                f"ride its interval-endpoint grid); this plan runs on "
-                f"'{self.engine_kind}'"
-                + (
-                    f" because: {self.plan.fastpath_reason}"
-                    if self.plan.fastpath_reason
-                    else ""
-                )
+            msg = fence_message(
+                "gauge_series.requires_fast", detail=self.engine_kind,
+            ) + (
+                f" because: {self.plan.fastpath_reason}"
+                if self.plan.fastpath_reason
+                else ""
             )
             raise ValueError(msg)
+        # default-on static preflight: findings surface as one
+        # PreflightWarning (+ a kind="preflight" run record when telemetry
+        # is configured); "strict" raises PreflightError, "off" skips.
+        # Runs last so explicit fence refusals above keep their exceptions.
+        run_preflight(
+            payload,
+            mode=preflight,
+            plan=self.plan,
+            telemetry=self.telemetry,
+            where="SweepRunner",
+            engine=engine,
+            trace=self.trace is not None,
+            crn=self._crn,
+            antithetic=self._antithetic,
+        )
 
     def _guard_fastpath_overrides(self, overrides: ScenarioOverrides | None) -> None:
         if self.engine_kind == "fast":

@@ -56,12 +56,19 @@ class SimulationRunner:
         engine_options: dict | None = None,
         telemetry: TelemetryConfig | None = None,
         recovery: RecoveryPolicy | None = None,
+        preflight: str = "warn",
     ) -> None:
         self.simulation_input = simulation_input
         self.backend = Backend(backend)
         self.seed = seed
         self.engine_options = engine_options or {}
         self.telemetry = telemetry
+        #: static scenario analysis before the first run
+        #: (docs/guides/diagnostics.md): "warn" surfaces findings as a
+        #: PreflightWarning + kind="preflight" record, "strict" raises
+        #: PreflightError, "off" skips
+        self.preflight = preflight
+        self._preflighted = False
         #: host-fault recovery for the execute phase (transient retry +
         #: watchdog); None keeps strict fail-fast behavior
         self.recovery = recovery
@@ -87,6 +94,21 @@ class SimulationRunner:
 
         ``telemetry`` overrides the constructor-level config for this run.
         """
+        if not self._preflighted:
+            # once per runner, before any engine work: repeat runs of the
+            # same validated scenario can't change the static findings
+            self._preflighted = True
+            from asyncflow_tpu.checker.preflight import run_preflight
+
+            opts = self.engine_options
+            run_preflight(
+                self.simulation_input,
+                mode=self.preflight,
+                telemetry=telemetry if telemetry is not None else self.telemetry,
+                where="SimulationRunner",
+                engine="auto",
+                trace=opts.get("trace") is not None,
+            )
         tel = telemetry_session(
             telemetry if telemetry is not None else self.telemetry,
             kind="run",
@@ -180,9 +202,22 @@ class SimulationRunner:
                 "trace",
             }
             if unsupported:
+                from asyncflow_tpu.checker.fences import ENGINE_OPTION_SUPPORT
+
+                hints = "; ".join(
+                    f"{opt!r} is accepted by "
+                    + (
+                        " / ".join(
+                            f"backend={b!r}"
+                            for b in ENGINE_OPTION_SUPPORT.get(opt, ())
+                        )
+                        or "no backend"
+                    )
+                    for opt in sorted(unsupported)
+                )
                 msg = (
                     f"engine_options {sorted(unsupported)} are not supported "
-                    "by the native backend"
+                    f"by the native backend ({hints})"
                 )
                 raise ValueError(msg)
 

@@ -359,8 +359,18 @@ def run_measurement() -> None:
     else:
         chunk = min(chunk_cfg, n_scenarios)
 
+    # static preflight (docs/guides/diagnostics.md): the findings and the
+    # predicted engine route ride the benchmark detail so a saturated or
+    # mis-fenced scenario can't masquerade as an engine regression
+    from asyncflow_tpu.checker.passes import check_payload
+
+    pre = check_payload(payload, plan=runner.plan, engine=ENGINE)
+    if not pre.clean:
+        print(f"preflight: {pre.summary()}", file=sys.stderr)
+
     detail_base = {
         "engine": runner.engine_kind,
+        "preflight": {"summary": pre.summary(), "codes": pre.codes()},
         "platform": jax.default_backend(),
         "chunk": chunk,
         "scan_inner": getattr(runner, "_scan_inner", 0),

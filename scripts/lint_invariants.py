@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""CI driver for the repo-invariant AST lint (checker/internal.py).
+
+Usage: ``python scripts/lint_invariants.py [package_dir]`` — lints every
+``.py`` under the package (default ``asyncflow_tpu/`` next to this
+script's repo root) and exits 1 on any violation.  Pure stdlib + ast: no
+jax import, safe for a cold CI runner.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from asyncflow_tpu.checker.internal import lint_tree  # noqa: E402
+
+
+def main(argv: list[str]) -> int:
+    pkg = Path(argv[1]) if len(argv) > 1 else REPO / "asyncflow_tpu"
+    violations = lint_tree(pkg)
+    for v in violations:
+        print(v.render())
+    if violations:
+        print(
+            f"lint-invariants: {len(violations)} violation(s) "
+            "(rules IN901/IN902/IN903; see docs/guides/diagnostics.md)",
+        )
+        return 1
+    print(f"lint-invariants: clean ({pkg})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

@@ -98,4 +98,17 @@ print("sim-trace schema OK")
 PY
 python -m asyncflow_tpu.observability.diverge \
   examples/yaml_input/data/trace_parity.yml --mode flight --seed 0
+# static-checker slice: the repo must lint clean under the invariant AST
+# rules, the preflight CLI must pass a shipped example (exit 0) and call
+# a deliberately saturated scenario (exit 2) — docs/guides/diagnostics.md
+python scripts/lint_invariants.py
+python -m asyncflow_tpu.checker examples/yaml_input/data/trace_parity.yml \
+  --backend cpu
+rc=0
+python -m asyncflow_tpu.checker tests/integration/data/unstable_saturated.yml \
+  --backend cpu > /dev/null || rc=$?
+if [ "$rc" -ne 2 ]; then
+  echo "checker exit $rc on the unstable fixture (expected 2: AF102)" >&2
+  exit 1
+fi
 python -m pytest tests/ -m smoke -q "$@"

@@ -1,0 +1,43 @@
+"""Shared payload builders for the checker tests."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+import yaml
+
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+BASE = (
+    Path(__file__).resolve().parents[3]
+    / "tests" / "integration" / "data" / "single_server.yml"
+)
+
+
+def build_payload(mut=None, horizon: float = 40) -> SimulationPayload:
+    data = yaml.safe_load(BASE.read_text())
+    data["sim_settings"]["total_simulation_time"] = horizon
+    data["sim_settings"]["enabled_sample_metrics"] = []
+    if mut:
+        mut(data)
+    return SimulationPayload.model_validate(data)
+
+
+def set_cpu(data, cpu_s: float, io_s: float = 0.02) -> None:
+    """Replace the endpoint with a cpu+io program of known demand."""
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    srv["endpoints"][0]["steps"] = [
+        {"kind": "initial_parsing", "step_operation": {"cpu_time": cpu_s}},
+        {"kind": "io_wait", "step_operation": {"io_waiting_time": io_s}},
+    ]
+
+
+def set_rate(data, users: float, rpm: float = 20) -> None:
+    data["rqs_input"]["avg_active_users"]["mean"] = users
+    data["rqs_input"]["avg_request_per_minute_per_user"]["mean"] = rpm
+
+
+@pytest.fixture()
+def payload():
+    return build_payload()

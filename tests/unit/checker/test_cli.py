@@ -1,0 +1,42 @@
+"""`python -m asyncflow_tpu.checker scenario.yml` exit-code contract:
+0 clean, 1 warnings, 2 errors (or unloadable scenario)."""
+
+from __future__ import annotations
+
+import json
+
+from asyncflow_tpu.checker.__main__ import main
+
+CLEAN = "examples/yaml_input/data/trace_parity.yml"
+SATURATED = "tests/integration/data/unstable_saturated.yml"
+
+
+def test_clean_scenario_exits_zero(capsys) -> None:
+    assert main([CLEAN, "--backend", "cpu"]) == 0
+    out = capsys.readouterr().out
+    assert "AF501" in out  # routing prediction always reported
+
+
+def test_saturated_scenario_exits_two(capsys) -> None:
+    assert main([SATURATED, "--backend", "cpu"]) == 2
+    out = capsys.readouterr().out
+    assert "AF102" in out
+    assert "rho" in out
+
+
+def test_json_output_parses(capsys) -> None:
+    assert main([SATURATED, "--backend", "cpu", "--json"]) == 2
+    doc = json.loads(capsys.readouterr().out)
+    assert any(d["code"] == "AF102" for d in doc["findings"])
+    assert doc["exit_code"] == 2
+
+
+def test_forced_fast_with_trace_exits_two(capsys) -> None:
+    assert main([CLEAN, "--backend", "cpu", "--engine", "fast",
+                 "--trace"]) == 2
+    assert "AF503" in capsys.readouterr().out
+
+
+def test_missing_file_exits_two(capsys) -> None:
+    assert main(["/no/such/scenario.yml"]) == 2
+    assert capsys.readouterr().err

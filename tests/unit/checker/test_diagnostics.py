@@ -1,0 +1,371 @@
+"""One unit test per diagnostic code (the AF### catalog contract),
+including golden scenarios reproducing the rho regimes behind the two
+strict-xfailed saturation parity tests (test_fastpath_cpu_queueing,
+test_fast_path_k1_station_collapse_parity)."""
+
+from __future__ import annotations
+
+import pytest
+
+from asyncflow_tpu.checker import Severity, check_payload
+from tests.unit.checker.conftest import build_payload, set_cpu, set_rate
+
+
+def codes(report, severity=None):
+    return {
+        d.code
+        for d in report.diagnostics
+        if severity is None or d.severity is severity
+    }
+
+
+def find(report, code):
+    return [d for d in report.diagnostics if d.code == code]
+
+
+# ---------------------------------------------------------------------------
+# AF1xx: queueing stability
+# ---------------------------------------------------------------------------
+
+
+def test_af101_retry_amplified_warning() -> None:
+    """base rho 0.3, x3 retry attempts -> amplified 0.9: warning."""
+
+    def mut(data):
+        set_rate(data, 60)  # 20 rq/s
+        set_cpu(data, 0.02)  # rho = 0.40, x3 attempts -> 1.20 amplified
+        data["retry_policy"] = {"request_timeout_s": 1.0, "max_attempts": 3}
+
+    report = check_payload(build_payload(mut))
+    (diag,) = find(report, "AF101")
+    assert diag.severity is Severity.WARNING
+    assert "retry amplification" in diag.message
+    assert not find(report, "AF102")
+
+
+def test_af102_unstable_station_error() -> None:
+    """rho >= 1.0 with no shedding policy is an error."""
+
+    def mut(data):
+        set_rate(data, 60)  # 20 rq/s
+        set_cpu(data, 0.06)  # rho = 1.2
+
+    report = check_payload(build_payload(mut))
+    (diag,) = find(report, "AF102")
+    assert diag.severity is Severity.ERROR
+    assert "rho=1.20" in diag.message
+    assert report.exit_code == 2
+
+
+def test_af102_golden_k1_db_pool_collapse_regime() -> None:
+    """The xfailed K=1 db-pool parity regime (tests/parity/test_db_pool.py):
+    20 rq/s of 60 ms queries into a 1-connection pool, rho 1.2 -> error."""
+
+    def mut(data):
+        set_rate(data, 60)  # 20 rq/s
+        srv = data["topology_graph"]["nodes"]["servers"][0]
+        srv["server_resources"]["db_connection_pool"] = 1
+        srv["endpoints"][0]["steps"] = [
+            {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.002}},
+            {"kind": "io_db", "step_operation": {"io_waiting_time": 0.060}},
+        ]
+
+    report = check_payload(build_payload(mut))
+    diags = find(report, "AF102")
+    assert diags and "db_connection_pool" in diags[0].message
+    assert report.exit_code == 2
+
+
+def test_af103_golden_cpu_queueing_noise_regime() -> None:
+    """The xfailed cpu-queueing parity regime
+    (tests/parity/test_fastpath_parity.py): rho 0.6 on one core — flagged
+    as the ensemble-noise / seed-lottery regime."""
+
+    def mut(data):
+        set_rate(data, 60)  # 20 rq/s
+        set_cpu(data, 0.03)  # rho = 0.6
+
+    report = check_payload(build_payload(mut))
+    (diag,) = find(report, "AF103")
+    assert diag.severity is Severity.INFO
+    assert "seed lottery" in diag.message
+    assert report.exit_code == 0  # info-only stays clean
+
+
+def test_af104_saturation_with_shedding_policy_is_info() -> None:
+    """rho >= 1.0 behind an explicit overload policy is a loss system, not
+    an unbounded queue: informational, and the examples gate stays green
+    for intentional overload studies."""
+
+    def mut(data):
+        set_rate(data, 100)  # 33.3 rq/s
+        set_cpu(data, 0.03)  # rho = 1.0
+        srv = data["topology_graph"]["nodes"]["servers"][0]
+        srv["overload"] = {"max_ready_queue": 64}
+
+    report = check_payload(build_payload(mut))
+    (diag,) = find(report, "AF104")
+    assert diag.severity is Severity.INFO
+    assert "sheds" in diag.message
+    assert not find(report, "AF102")
+
+
+# ---------------------------------------------------------------------------
+# AF2xx: graph shape
+# ---------------------------------------------------------------------------
+
+
+def _add_orphan_server(data) -> None:
+    """A server with an out-edge back to the client but no in-edge: it is
+    unreachable (AF201) and its return edge is never traversed (AF202)."""
+    data["topology_graph"]["nodes"]["servers"].append({
+        "id": "srv-orphan",
+        "server_resources": {"cpu_cores": 1, "ram_mb": 1024},
+        "endpoints": [{
+            "endpoint_name": "ep-x",
+            "steps": [
+                {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.001}},
+            ],
+        }],
+    })
+    data["topology_graph"]["edges"].append({
+        "id": "orphan-to-client",
+        "source": "srv-orphan",
+        "target": "client-1",
+        "latency": {"mean": 0.003, "distribution": "exponential"},
+    })
+
+
+def test_af201_unreachable_server() -> None:
+    report = check_payload(build_payload(_add_orphan_server))
+    (diag,) = find(report, "AF201")
+    assert "srv-orphan" in diag.message
+    assert diag.severity is Severity.WARNING
+
+
+def test_af202_dangling_edge() -> None:
+    report = check_payload(build_payload(_add_orphan_server))
+    (diag,) = find(report, "AF202")
+    assert "orphan-to-client" in diag.message
+
+
+def test_af203_no_return_path() -> None:
+    def mut(data):
+        edges = data["topology_graph"]["edges"]
+        data["topology_graph"]["edges"] = [
+            e for e in edges if e["id"] != "srv-client"
+        ]
+
+    report = check_payload(build_payload(mut))
+    (diag,) = find(report, "AF203")
+    assert "srv-1" in diag.message
+
+
+# ---------------------------------------------------------------------------
+# AF3xx: time-domain contradictions
+# ---------------------------------------------------------------------------
+
+
+def test_af301_timeout_below_service_floor() -> None:
+    def mut(data):
+        set_cpu(data, 0.05, io_s=0.05)  # floor 0.1 s
+        data["retry_policy"] = {"request_timeout_s": 0.05, "max_attempts": 3}
+
+    report = check_payload(build_payload(mut))
+    (diag,) = find(report, "AF301")
+    assert diag.severity is Severity.ERROR
+    assert "retry storm" in diag.message
+
+
+def test_af302_timeout_below_typical_rtt() -> None:
+    def mut(data):
+        set_cpu(data, 0.05, io_s=0.0501)  # floor ~0.1001 s
+        # above the floor, below floor + 2 x (3 x 3 ms) mean edge latency
+        data["retry_policy"] = {"request_timeout_s": 0.105, "max_attempts": 2}
+
+    report = check_payload(build_payload(mut))
+    (diag,) = find(report, "AF302")
+    assert diag.severity is Severity.WARNING
+    assert not find(report, "AF301")
+
+
+def test_af303_outage_covers_horizon() -> None:
+    def mut(data):
+        data["fault_timeline"] = {
+            "events": [
+                {
+                    "fault_id": "dark",
+                    "kind": "server_outage",
+                    "target_id": "srv-1",
+                    "t_start": 0.0,
+                    "t_end": 40.0,
+                },
+            ],
+        }
+
+    # two-server cover so the never-all-servers-down validator admits it
+    def mut2(data):
+        _double_server(data)
+        mut(data)
+
+    report = check_payload(build_payload(mut2))
+    diags = find(report, "AF303")
+    assert diags and "entire horizon" in diags[0].message
+
+
+def _double_server(data) -> None:
+    import copy
+
+    srv2 = copy.deepcopy(data["topology_graph"]["nodes"]["servers"][0])
+    srv2["id"] = "srv-2"
+    data["topology_graph"]["nodes"]["servers"].append(srv2)
+    data["topology_graph"]["nodes"]["load_balancer"] = {
+        "id": "lb-1",
+        "server_covered": ["srv-1", "srv-2"],
+    }
+    data["topology_graph"]["edges"] = [
+        {"id": "gen-to-client", "source": "rqs-1", "target": "client-1",
+         "latency": {"mean": 0.003, "distribution": "exponential"}},
+        {"id": "client-to-lb", "source": "client-1", "target": "lb-1",
+         "latency": {"mean": 0.003, "distribution": "exponential"}},
+        {"id": "lb-srv1", "source": "lb-1", "target": "srv-1",
+         "latency": {"mean": 0.003, "distribution": "exponential"}},
+        {"id": "lb-srv2", "source": "lb-1", "target": "srv-2",
+         "latency": {"mean": 0.003, "distribution": "exponential"}},
+        {"id": "srv1-client", "source": "srv-1", "target": "client-1",
+         "latency": {"mean": 0.003, "distribution": "exponential"}},
+        {"id": "srv2-client", "source": "srv-2", "target": "client-1",
+         "latency": {"mean": 0.003, "distribution": "exponential"}},
+    ]
+
+
+def test_af304_retry_ladder_exceeds_horizon() -> None:
+    def mut(data):
+        data["sim_settings"]["total_simulation_time"] = 5
+        data["retry_policy"] = {
+            "request_timeout_s": 1.5,
+            "max_attempts": 3,
+            "backoff_base_s": 1.0,
+            "backoff_multiplier": 2.0,
+            "backoff_cap_s": 10.0,
+        }
+
+    report = check_payload(build_payload(mut, horizon=5))
+    (diag,) = find(report, "AF304")
+    assert "horizon" in diag.message
+
+
+# ---------------------------------------------------------------------------
+# AF4xx: resource sanity
+# ---------------------------------------------------------------------------
+
+
+def test_af401_ram_oversubscription_error() -> None:
+    def mut(data):
+        srv = data["topology_graph"]["nodes"]["servers"][0]
+        srv["endpoints"][0]["steps"] = [
+            {"kind": "ram", "step_operation": {"necessary_ram": 4096}},
+            {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.01}},
+        ]
+
+    report = check_payload(build_payload(mut))
+    (diag,) = find(report, "AF401")
+    assert diag.severity is Severity.ERROR
+    assert "ever be admitted" in diag.message
+
+
+def test_af402_steady_state_ram_saturation_warning() -> None:
+    def mut(data):
+        set_rate(data, 60)  # 20 rq/s
+        srv = data["topology_graph"]["nodes"]["servers"][0]
+        srv["endpoints"][0]["steps"] = [
+            {"kind": "ram", "step_operation": {"necessary_ram": 100}},
+            {"kind": "io_wait", "step_operation": {"io_waiting_time": 1.0}},
+        ]  # 20 x 1.0 x 100 = 2000 MB vs 2048 MB
+
+    report = check_payload(build_payload(mut))
+    (diag,) = find(report, "AF402")
+    assert diag.severity is Severity.WARNING
+
+
+def test_af403_multi_generator_rescale_info() -> None:
+    def mut(data):
+        gen = dict(data["rqs_input"])
+        gen2 = {**gen, "id": "rqs-2"}
+        data["rqs_input"] = [gen, gen2]
+        data["topology_graph"]["edges"].append({
+            "id": "gen2-to-client",
+            "source": "rqs-2",
+            "target": "client-1",
+            "latency": {"mean": 0.003, "distribution": "exponential"},
+        })
+
+    report = check_payload(build_payload(mut))
+    (diag,) = find(report, "AF403")
+    assert diag.severity is Severity.INFO
+    assert "max_requests" in diag.message
+
+
+def test_af404_breakpoint_table_cliff() -> None:
+    def mut(data):
+        data["sim_settings"]["total_simulation_time"] = 600
+        data["events"] = [
+            {
+                "event_id": f"spike-{i}",
+                "target_id": "client-srv",
+                "start": {
+                    "kind": "network_spike_start",
+                    "t_start": float(i),
+                    "spike_s": 0.01,
+                },
+                "end": {"kind": "network_spike_end", "t_end": i + 0.5},
+            }
+            for i in range(130)  # 261 breakpoints > 256
+        ]
+
+    report = check_payload(build_payload(mut, horizon=600))
+    diags = find(report, "AF404")
+    assert diags and "searchsorted_small" in diags[0].message
+
+
+# ---------------------------------------------------------------------------
+# AF5xx: engine routing / fences
+# ---------------------------------------------------------------------------
+
+
+def test_af501_routing_prediction_always_present(payload) -> None:
+    report = check_payload(payload, backend="cpu")
+    (diag,) = find(report, "AF501")
+    assert "'fast'" in diag.message
+
+
+def test_af502_tripped_fences_listed(payload) -> None:
+    report = check_payload(payload, backend="cpu", trace=True)
+    fences = find(report, "AF502")
+    assert {"trace.fast", "trace.pallas", "trace.native"} <= {
+        d.message.split()[1].rstrip(":") for d in fences
+    }
+    (route,) = find(report, "AF501")
+    assert "'event'" in route.message
+
+
+def test_af503_forced_engine_refusal_is_error(payload) -> None:
+    report = check_payload(payload, backend="cpu", engine="fast", trace=True)
+    (diag,) = find(report, "AF503")
+    assert diag.severity is Severity.ERROR
+    assert report.exit_code == 2
+
+
+# ---------------------------------------------------------------------------
+# report mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_report_exit_codes_and_render(payload) -> None:
+    clean = check_payload(payload, backend="cpu")
+    assert clean.exit_code == 0 and clean.clean
+    assert "AF501" in clean.render()
+
+    with pytest.raises(Exception):  # noqa: B017 - any severity order bug throws
+        _ = Severity("bogus")
+    assert Severity.ERROR.rank > Severity.WARNING.rank > Severity.INFO.rank

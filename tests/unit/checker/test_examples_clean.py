@@ -1,0 +1,84 @@
+"""Every shipped example must pass preflight clean — a scenario we hand to
+new users should never trip its own static checker.  The deliberately
+collapsing sweep arms (pool=1, no overload policy) are asserted to be
+FLAGGED instead: they exist to demonstrate the failure the checker warns
+about."""
+
+from __future__ import annotations
+
+import importlib
+import sys
+from pathlib import Path
+
+import pytest
+import yaml
+
+from asyncflow_tpu.checker import check_payload
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+REPO = Path(__file__).resolve().parents[3]
+YAML_DIR = REPO / "examples" / "yaml_input" / "data"
+SWEEPS_DIR = REPO / "examples" / "sweeps"
+
+YAML_EXAMPLES = sorted(YAML_DIR.glob("*.yml"))
+
+
+def _sweep_module(name: str):
+    if str(SWEEPS_DIR) not in sys.path:
+        sys.path.insert(0, str(SWEEPS_DIR))
+    return importlib.import_module(name)
+
+
+def _assert_clean(payload, label: str) -> None:
+    report = check_payload(payload, backend="cpu")
+    assert report.clean, f"{label} fails preflight:\n{report.render()}"
+
+
+@pytest.mark.parametrize(
+    "path", YAML_EXAMPLES, ids=[p.stem for p in YAML_EXAMPLES]
+)
+def test_yaml_examples_are_preflight_clean(path: Path) -> None:
+    payload = SimulationPayload.model_validate(
+        yaml.safe_load(path.read_text())
+    )
+    _assert_clean(payload, path.name)
+
+
+BASELINE_BUILDERS = [
+    ("capacity_sweep", lambda m: m.build_chain_payload()),
+    ("db_pool_sizing", lambda m: m.payload_with_pool(None)),
+    ("db_pool_sizing", lambda m: m.payload_with_pool(4)),
+    ("llm_cost_sweep", lambda m: m.build_payload()),
+    ("overload_policy", lambda m: m.payload_with(64)),
+    ("pooled_capacity_chain", lambda m: m.build_payload()),
+    ("resilience_controls", lambda m: m.build_payload("none")),
+    ("resilience_controls", lambda m: m.build_payload("deadline")),
+    ("resilience_controls", lambda m: m.build_payload("breaker")),
+    ("resilience_controls", lambda m: m.build_payload("all")),
+    ("mixed_fleet_sweep", lambda m: m.build_payload(heavy_need_mb=256)),
+]
+
+
+@pytest.mark.parametrize(
+    ("module", "build"),
+    BASELINE_BUILDERS,
+    ids=[f"{m}-{i}" for i, (m, _) in enumerate(BASELINE_BUILDERS)],
+)
+def test_sweep_example_baselines_are_clean(module, build) -> None:
+    mod = _sweep_module(module)
+    _assert_clean(build(mod), module)
+
+
+def test_db_pool_collapse_arm_is_flagged() -> None:
+    """The K=1 arm of the db-pool sizing study IS the golden saturated
+    regime behind the xfailed parity test — the checker must call it."""
+    mod = _sweep_module("db_pool_sizing")
+    report = check_payload(mod.payload_with_pool(1), backend="cpu")
+    assert "AF102" in report.codes()
+    assert report.exit_code == 2
+
+
+def test_overload_unprotected_arm_is_flagged() -> None:
+    mod = _sweep_module("overload_policy")
+    report = check_payload(mod.payload_with(None), backend="cpu")
+    assert "AF102" in report.codes()

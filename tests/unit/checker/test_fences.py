@@ -1,0 +1,189 @@
+"""The fence registry is the single source of truth: runtime refusals must
+raise its exact text, and predict_routing must agree with what SweepRunner
+actually does."""
+
+from __future__ import annotations
+
+import pytest
+
+from asyncflow_tpu.checker.fences import (
+    ENGINE_OPTION_SUPPORT,
+    FENCES,
+    fence_message,
+    predict_routing,
+    raise_fence,
+    tripped_fences,
+)
+from asyncflow_tpu.observability.simtrace import TraceConfig
+from asyncflow_tpu.parallel.sweep import SweepRunner
+from asyncflow_tpu.schemas.experiment import ExperimentConfig, VarianceReduction
+from tests.unit.checker.conftest import build_payload
+
+
+def _resilient(data) -> None:
+    data["retry_policy"] = {"request_timeout_s": 0.5, "max_attempts": 3}
+    data["fault_timeline"] = {
+        "events": [
+            {
+                "fault_id": "crash",
+                "kind": "server_outage",
+                "target_id": "srv-1",
+                "t_start": 10.0,
+                "t_end": 20.0,
+            },
+        ],
+    }
+
+
+# ---------------------------------------------------------------------------
+# registry shape
+# ---------------------------------------------------------------------------
+
+
+def test_registry_covers_every_known_fence() -> None:
+    assert set(FENCES) == {
+        "trace.fast", "trace.pallas", "trace.native",
+        "vr.pallas", "vr.native",
+        "resilience.pallas", "resilience.native",
+        "fastpath.ineligible", "fastpath.poisson_edge",
+        "native.unavailable", "gauge_series.requires_fast",
+    }
+    for fence in FENCES.values():
+        assert fence.message and fence.feature and fence.engine
+
+
+def test_raise_fence_uses_registered_exception_type() -> None:
+    with pytest.raises(NotImplementedError):
+        raise_fence("fastpath.poisson_edge")
+    with pytest.raises(RuntimeError):
+        raise_fence("native.unavailable")
+    with pytest.raises(ValueError):
+        raise_fence("trace.fast")
+    with pytest.raises(KeyError):
+        fence_message("no.such.fence")
+
+
+# ---------------------------------------------------------------------------
+# runtime refusals carry the registry text verbatim
+# ---------------------------------------------------------------------------
+
+
+def test_sweep_trace_refusals_match_registry() -> None:
+    payload = build_payload()
+    cfg = TraceConfig(sample_requests=4)
+    for engine in ("fast", "pallas", "native"):
+        with pytest.raises(ValueError) as err:
+            SweepRunner(payload, engine=engine, use_mesh=False, trace=cfg,
+                        preflight="off")
+        assert str(err.value) == fence_message(f"trace.{engine}")
+
+
+def test_sweep_vr_refusals_match_registry() -> None:
+    payload = build_payload()
+    exp = ExperimentConfig(variance_reduction=VarianceReduction(crn=True))
+    for engine in ("pallas", "native"):
+        with pytest.raises(ValueError) as err:
+            SweepRunner(payload, engine=engine, use_mesh=False,
+                        experiment=exp, preflight="off")
+        assert str(err.value) == fence_message(f"vr.{engine}")
+
+
+def test_sweep_resilience_refusals_match_registry() -> None:
+    payload = build_payload(_resilient)
+    for engine in ("pallas", "native"):
+        with pytest.raises(ValueError) as err:
+            SweepRunner(payload, engine=engine, use_mesh=False,
+                        preflight="off")
+        assert str(err.value) == fence_message(f"resilience.{engine}")
+
+
+# ---------------------------------------------------------------------------
+# prediction matches the actual SweepRunner dispatch
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    ("mut", "kwargs", "expected"),
+    [
+        (None, {}, "fast"),
+        (_resilient, {}, "event"),
+        (None, {"trace": TraceConfig(sample_requests=4)}, "event"),
+        (None,
+         {"experiment": ExperimentConfig(
+             variance_reduction=VarianceReduction(crn=True))},
+         "fast"),  # CRN does NOT block the fast path on auto
+    ],
+    ids=["plain", "faulted", "traced", "crn"],
+)
+def test_prediction_matches_actual_routing(mut, kwargs, expected) -> None:
+    payload = build_payload(mut)
+    runner = SweepRunner(payload, engine="auto", use_mesh=False,
+                         preflight="off", **kwargs)
+    assert runner.engine_kind == expected
+    exp = kwargs.get("experiment")
+    vr = exp.variance_reduction if exp is not None else None
+    pred = predict_routing(
+        runner.plan,
+        engine="auto",
+        backend="cpu",
+        trace=kwargs.get("trace") is not None,
+        crn=bool(vr.crn) if vr is not None else False,
+        antithetic=bool(vr.antithetic) if vr is not None else False,
+    )
+    assert pred.ok and pred.engine == expected
+
+
+def test_prediction_forced_fast_with_trace_is_refused() -> None:
+    payload = build_payload()
+    runner = SweepRunner(payload, engine="auto", use_mesh=False,
+                         preflight="off")
+    pred = predict_routing(runner.plan, engine="fast", backend="cpu",
+                           trace=True)
+    assert not pred.ok
+    assert pred.refusal.fence_id == "trace.fast"
+    assert pred.refusal.message == fence_message("trace.fast")
+
+
+def test_tripped_fences_for_traced_resilient_plan() -> None:
+    def mut(data):
+        _resilient(data)
+
+    runner = SweepRunner(build_payload(mut), engine="auto", use_mesh=False,
+                         preflight="off")
+    ids = {
+        f.fence_id
+        for f in tripped_fences(runner.plan, trace=True, crn=True)
+    }
+    assert {"trace.fast", "trace.pallas", "trace.native",
+            "vr.pallas", "vr.native",
+            "resilience.pallas", "resilience.native"} <= ids
+
+
+def test_prediction_rejects_unknown_engine() -> None:
+    runner = SweepRunner(build_payload(), engine="auto", use_mesh=False,
+                         preflight="off")
+    with pytest.raises(ValueError, match="engine must be"):
+        predict_routing(runner.plan, engine="warp")
+
+
+# ---------------------------------------------------------------------------
+# SimulationRunner engine_options rejection names the accepting backends
+# ---------------------------------------------------------------------------
+
+
+def test_runner_engine_options_error_names_accepting_backends() -> None:
+    from asyncflow_tpu.runtime.runner import SimulationRunner
+
+    runner = SimulationRunner(
+        simulation_input=build_payload(),
+        backend="native",
+        engine_options={"collect_clocks": True},
+        preflight="off",
+    )
+    with pytest.raises(ValueError) as err:
+        runner.run()
+    msg = str(err.value)
+    assert "collect_clocks" in msg
+    assert "native backend" in msg
+    assert "backend='jax'" in msg
+    assert ENGINE_OPTION_SUPPORT["collect_clocks"] == ("jax",)

@@ -1,0 +1,195 @@
+"""AST invariant lint: seeded violations must be caught, idiomatic code must
+pass, and the shipped package must be green."""
+
+from __future__ import annotations
+
+import ast
+import textwrap
+
+from asyncflow_tpu.checker.internal import (
+    SPLIT_ALLOWLIST,
+    _check_engine_state,
+    lint_source,
+    lint_tree,
+)
+
+
+def rules(src: str, **kw) -> list[str]:
+    return [v.rule for v in lint_source(textwrap.dedent(src), **kw)]
+
+
+# ---------------------------------------------------------------------------
+# IN901: jax.random.split forbidden on scenario-key paths
+# ---------------------------------------------------------------------------
+
+
+def test_in901_flags_plain_split() -> None:
+    src = """
+    import jax
+
+    def keys(k):
+        return jax.random.split(k, 8)
+    """
+    assert rules(src) == ["IN901"]
+
+
+def test_in901_flags_aliased_split() -> None:
+    src = """
+    import jax.random as jr
+
+    def keys(k):
+        return jr.split(k, 8)
+    """
+    assert rules(src) == ["IN901"]
+
+
+def test_in901_flags_from_import_split() -> None:
+    src = """
+    from jax import random
+
+    def keys(k):
+        return random.split(k)
+    """
+    assert rules(src) == ["IN901"]
+
+
+def test_in901_fold_in_is_clean() -> None:
+    src = """
+    import jax
+
+    def keys(k, i):
+        return jax.random.fold_in(k, i)
+    """
+    assert rules(src) == []
+
+
+def test_in901_allowlist_suppresses() -> None:
+    src = """
+    import jax
+
+    def keys(k):
+        return jax.random.split(k, 2)
+    """
+    assert rules(src, allow_split=True) == []
+    assert SPLIT_ALLOWLIST  # the estimator files stay exempt
+
+
+# ---------------------------------------------------------------------------
+# IN902: host-sync calls inside traced loop bodies
+# ---------------------------------------------------------------------------
+
+
+def test_in902_flags_item_in_scan_body() -> None:
+    src = """
+    from jax import lax
+
+    def run(xs):
+        def body(carry, x):
+            bad = carry.item()
+            return carry + x, bad
+        return lax.scan(body, 0.0, xs)
+    """
+    assert rules(src) == ["IN902"]
+
+
+def test_in902_flags_float_of_carry_in_while_body() -> None:
+    src = """
+    from jax import lax
+
+    def run(state):
+        def cond(s):
+            return s[0] < 10
+
+        def body(s):
+            t = float(s[1])
+            return (s[0] + 1, t)
+        return lax.while_loop(cond, body, state)
+    """
+    assert rules(src) == ["IN902"]
+
+
+def test_in902_flags_np_asarray_of_loop_param() -> None:
+    src = """
+    import numpy as np
+    from jax import lax
+
+    def run(xs):
+        def body(i, acc):
+            return acc + np.asarray(i)
+        return lax.fori_loop(0, 8, body, xs)
+    """
+    assert rules(src) == ["IN902"]
+
+
+def test_in902_host_code_outside_loops_is_clean() -> None:
+    src = """
+    import numpy as np
+
+    def summarize(result):
+        return float(result.mean()), np.asarray(result).item()
+    """
+    assert rules(src) == []
+
+
+def test_in902_float_of_nonparam_inside_body_is_clean() -> None:
+    src = """
+    from jax import lax
+
+    N_BINS = 1024
+
+    def run(xs):
+        def body(carry, x):
+            width = float(N_BINS)
+            return carry + x / width, x
+        return lax.scan(body, 0.0, xs)
+    """
+    assert rules(src) == []
+
+
+# ---------------------------------------------------------------------------
+# IN903: every EngineState field registered in the pruning table
+# ---------------------------------------------------------------------------
+
+PARAMS_SRC = """
+class EngineState:
+    t: object
+    ready: object
+    ram: object
+"""
+
+ENGINE_OK = """
+def _init_state():
+    return EngineState(t=0, ready=0, ram=0)
+"""
+
+ENGINE_MISSING = """
+def _init_state():
+    return EngineState(t=0, ready=0)
+"""
+
+
+def test_in903_flags_missing_field() -> None:
+    out: list = []
+    _check_engine_state(
+        ast.parse(PARAMS_SRC), ast.parse(ENGINE_MISSING), "engine.py", out
+    )
+    assert [v.rule for v in out] == ["IN903"]
+    assert "ram" in out[0].message
+
+
+def test_in903_complete_table_is_clean() -> None:
+    out: list = []
+    _check_engine_state(
+        ast.parse(PARAMS_SRC), ast.parse(ENGINE_OK), "engine.py", out
+    )
+    assert out == []
+
+
+# ---------------------------------------------------------------------------
+# the repo itself is green
+# ---------------------------------------------------------------------------
+
+
+def test_repo_lints_clean() -> None:
+    violations = lint_tree("asyncflow_tpu")
+    assert violations == [], "\n".join(v.render() for v in violations)

@@ -1,0 +1,108 @@
+"""Default-on preflight in the runners: warn surfaces a PreflightWarning and
+a kind="preflight" telemetry record, strict raises, off is silent."""
+
+from __future__ import annotations
+
+import json
+import warnings
+
+import pytest
+
+from asyncflow_tpu.checker import PreflightError, PreflightWarning, run_preflight
+from asyncflow_tpu.observability.telemetry import TelemetryConfig
+from asyncflow_tpu.parallel.sweep import SweepRunner
+from asyncflow_tpu.runtime.runner import SimulationRunner
+from tests.unit.checker.conftest import build_payload, set_cpu, set_rate
+
+
+def _saturate(data) -> None:
+    set_rate(data, 60)  # 20 rq/s
+    set_cpu(data, 0.06)  # rho = 1.2 -> AF102 error
+
+
+@pytest.fixture()
+def hot_payload():
+    return build_payload(_saturate)
+
+
+def test_warn_mode_emits_preflight_warning(hot_payload) -> None:
+    with pytest.warns(PreflightWarning, match="AF102"):
+        run_preflight(hot_payload, mode="warn")
+
+
+def test_warn_mode_never_raises_on_analyzer_crash() -> None:
+    with pytest.warns(PreflightWarning, match="analyzer failed"):
+        report = run_preflight(object(), mode="warn")
+    assert report is None
+
+
+def test_strict_mode_raises_with_report(hot_payload) -> None:
+    with pytest.raises(PreflightError) as err:
+        run_preflight(hot_payload, mode="strict")
+    assert "AF102" in err.value.report.codes()
+    assert err.value.report.exit_code == 2
+
+
+def test_off_mode_is_silent(hot_payload) -> None:
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert run_preflight(hot_payload, mode="off") is None
+
+
+def test_clean_payload_no_warning() -> None:
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        report = run_preflight(build_payload(), mode="warn")
+    assert report is not None and report.clean
+
+
+def test_invalid_mode_rejected(hot_payload) -> None:
+    with pytest.raises(ValueError, match="preflight"):
+        run_preflight(hot_payload, mode="loud")
+
+
+def test_warn_mode_writes_preflight_telemetry_record(
+    hot_payload, tmp_path
+) -> None:
+    jsonl = tmp_path / "runs.jsonl"
+    cfg = TelemetryConfig(jsonl_path=jsonl)
+    with pytest.warns(PreflightWarning):
+        run_preflight(hot_payload, mode="warn", telemetry=cfg, where="test")
+    records = [json.loads(line) for line in jsonl.read_text().splitlines()]
+    pre = [r for r in records if r.get("kind") == "preflight"]
+    assert len(pre) == 1
+    assert "AF102" in pre[0]["meta"]["codes"]
+    assert pre[0]["meta"]["where"] == "test"
+
+
+def test_sweep_runner_default_warn(hot_payload) -> None:
+    with pytest.warns(PreflightWarning, match="SweepRunner"):
+        SweepRunner(hot_payload, use_mesh=False)
+
+
+def test_sweep_runner_strict_raises(hot_payload) -> None:
+    with pytest.raises(PreflightError):
+        SweepRunner(hot_payload, use_mesh=False, preflight="strict")
+
+
+def test_sweep_runner_off_is_silent(hot_payload) -> None:
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        SweepRunner(hot_payload, use_mesh=False, preflight="off")
+
+
+def test_simulation_runner_preflights_once_per_runner(hot_payload) -> None:
+    runner = SimulationRunner(simulation_input=hot_payload, seed=0)
+    with pytest.warns(PreflightWarning, match="SimulationRunner"):
+        runner.run()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", PreflightWarning)
+        runner.run()  # second run: already preflighted
+
+
+def test_simulation_runner_strict(hot_payload) -> None:
+    runner = SimulationRunner(
+        simulation_input=hot_payload, seed=0, preflight="strict"
+    )
+    with pytest.raises(PreflightError):
+        runner.run()

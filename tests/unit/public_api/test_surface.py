@@ -159,3 +159,29 @@ def test_yaml_string_contract_is_stable() -> None:
     assert enums.SampledMetricName.EVENT_LOOP_IO_SLEEP.value == "event_loop_io_sleep"
     assert enums.EventMetricName.RQS_CLOCK.value == "rqs_clock"
     assert enums.LatencyKey.STD_DEV.value == "std_dev"
+
+
+def test_checker_surface() -> None:
+    import asyncflow_tpu.checker as checker
+
+    assert set(checker.__all__) == {
+        "ENGINE_OPTION_SUPPORT",
+        "FENCES",
+        "PREFLIGHT_MODES",
+        "CheckReport",
+        "Diagnostic",
+        "Fence",
+        "PreflightError",
+        "PreflightWarning",
+        "RoutingPrediction",
+        "Severity",
+        "TrippedFence",
+        "check_payload",
+        "fence_message",
+        "predict_routing",
+        "raise_fence",
+        "run_preflight",
+        "tripped_fences",
+    }
+    # the lazy check_payload attr resolves
+    assert callable(checker.check_payload)
