@@ -123,6 +123,29 @@ FENCES: dict[str, Fence] = {
                 "resilience plans to the event engine)"
             ),
         ),
+        # -- tail-tolerance plans (hedges / health gate / brownout) ---------
+        Fence(
+            id="tail_tolerance.pallas",
+            feature="tail-tolerance plan (hedges / health gate / brownout)",
+            engine="pallas",
+            message=(
+                "engine='pallas' does not model tail-tolerance policies "
+                "(hedged requests / LB health gating / server brownout); "
+                "use engine='event' (or 'auto', which routes tail-tolerance "
+                "plans to the event engine)"
+            ),
+        ),
+        Fence(
+            id="tail_tolerance.native",
+            feature="tail-tolerance plan (hedges / health gate / brownout)",
+            engine="native",
+            message=(
+                "engine='native' does not model tail-tolerance policies "
+                "(hedged requests / LB health gating / server brownout); "
+                "use engine='event' (or 'auto', which routes tail-tolerance "
+                "plans to the event engine)"
+            ),
+        ),
         # -- fast-path eligibility -----------------------------------------
         Fence(
             id="fastpath.ineligible",
@@ -244,7 +267,8 @@ def tripped_fences(
 
     ``plan`` is a :class:`~asyncflow_tpu.compiler.plan.StaticPlan`; only
     ``fastpath_ok`` / ``fastpath_reason`` / ``has_faults`` / ``has_retry``
-    are read, so any duck-typed stand-in works in tests.
+    / ``has_tail_tolerance`` are read, so any duck-typed stand-in works in
+    tests.
     """
     out: list[TrippedFence] = []
     if trace:
@@ -253,6 +277,11 @@ def tripped_fences(
         out += [_trip("vr.pallas"), _trip("vr.native")]
     if plan.has_faults or plan.has_retry:
         out += [_trip("resilience.pallas"), _trip("resilience.native")]
+    if getattr(plan, "has_tail_tolerance", False):
+        out += [
+            _trip("tail_tolerance.pallas"),
+            _trip("tail_tolerance.native"),
+        ]
     if not plan.fastpath_ok:
         out.append(_trip("fastpath.ineligible", detail=plan.fastpath_reason))
     return tuple(out)
@@ -293,7 +322,8 @@ def predict_routing(
 
         backend = jax.default_backend()
     vr_coupled = crn or antithetic
-    resilient = plan.has_faults or plan.has_retry
+    tail = getattr(plan, "has_tail_tolerance", False)
+    resilient = plan.has_faults or plan.has_retry or tail
     fences = tripped_fences(plan, trace=trace, crn=crn, antithetic=antithetic)
 
     def refused(fence_id: str, **fmt: object) -> RoutingPrediction:
@@ -311,8 +341,10 @@ def predict_routing(
         return refused(f"trace.{engine}")
     if vr_coupled and engine in ("pallas", "native"):
         return refused(f"vr.{engine}")
-    if resilient and engine in ("pallas", "native"):
+    if (plan.has_faults or plan.has_retry) and engine in ("pallas", "native"):
         return refused(f"resilience.{engine}")
+    if tail and engine in ("pallas", "native"):
+        return refused(f"tail_tolerance.{engine}")
     if engine == "fast" and not plan.fastpath_ok:
         return refused("fastpath.ineligible", detail=plan.fastpath_reason)
     if engine == "native":

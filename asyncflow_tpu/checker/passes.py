@@ -113,6 +113,13 @@ def _retry_amplification(payload) -> float:
     return float(rp.max_attempts) if rp is not None else 1.0
 
 
+def _hedge_amplification(payload) -> float:
+    """Worst-case offered-load multiplier from hedged requests: every
+    attempt can spawn up to ``max_hedges`` speculative duplicates."""
+    hp = getattr(payload, "hedge_policy", None)
+    return 1.0 + float(hp.max_hedges) if hp is not None else 1.0
+
+
 def _outage_windows(payload) -> dict[str, list[tuple[float, float]]]:
     """Per-server outage windows from BOTH what-if sources: the fault
     timeline (``server_outage``) and scheduled event injections
@@ -166,6 +173,7 @@ def stability_pass(payload, plan, out: list[Diagnostic]) -> None:
     if rates is None:  # cyclic server chain: rates undefined, graph pass reports
         return
     amp = _retry_amplification(payload)
+    hamp = _hedge_amplification(payload)
     servers = payload.topology_graph.nodes.servers
     for s, server in enumerate(servers):
         lam = float(rates[s])
@@ -173,9 +181,10 @@ def stability_pass(payload, plan, out: list[Diagnostic]) -> None:
             continue
         path = f"topology_graph.nodes.servers[{s}] (id={server.id!r})"
         ov = server.overload
-        # an explicit shedding control turns saturation into a loss
-        # system: the queue is bounded by design and the excess lands in
-        # total_rejected, so rho >= 1 is a regime note, not an error
+        # an explicit shedding (or brownout) control turns saturation into
+        # a loss/degraded system: the queue is bounded by design and the
+        # excess lands in total_rejected / degraded_completions, so
+        # rho >= 1 is a regime note, not an error
         sheds = ov is not None and any(
             getattr(ov, f, None) is not None
             for f in (
@@ -183,6 +192,7 @@ def stability_pass(payload, plan, out: list[Diagnostic]) -> None:
                 "max_connections",
                 "rate_limit_rps",
                 "queue_timeout_s",
+                "brownout_queue_threshold",
             )
         )
         stations = [(
@@ -256,6 +266,27 @@ def stability_pass(payload, plan, out: list[Diagnostic]) -> None:
                     remedy="average more seeds (SweepRunner Monte-Carlo) "
                     "or lengthen the horizon before trusting point "
                     "estimates",
+                ))
+            # hedge duplication is a separate amplification channel: in
+            # the worst case (every hedge timer fires) each attempt
+            # re-offers x(1 + max_hedges) load, on TOP of the retry ladder
+            if (
+                hamp > 1.0
+                and rho_amp < RHO_WARNING
+                and rho_amp * hamp >= RHO_WARNING
+                and not sheds
+            ):
+                out.append(Diagnostic(
+                    code="AF105", severity=Severity.WARNING,
+                    message=detail + f": hedge duplication "
+                    f"(hedge_policy.max_hedges={hamp - 1.0:.0f}) can "
+                    f"multiply the offered load by x{hamp:.0f}, lifting "
+                    f"rho to {rho_amp * hamp:.2f} when the tail is slow "
+                    "enough that every hedge timer fires — the hedge storm "
+                    "regime where duplicates cause the latency they chase",
+                    path=path,
+                    remedy="raise hedge_delay_s past the typical tail, "
+                    "lower max_hedges, or add headroom (" + remedy + ")",
                 ))
 
 
@@ -399,6 +430,25 @@ def time_pass(payload, out: list[Diagnostic]) -> None:
                 path="retry_policy",
                 remedy="lengthen total_simulation_time, cap the backoff "
                 "lower, or reduce max_attempts",
+            ))
+
+    hp = getattr(payload, "hedge_policy", None)
+    if hp is not None and rp is not None:
+        delay = float(hp.hedge_delay_s)
+        timeout = float(rp.request_timeout_s)
+        if delay >= timeout:
+            out.append(Diagnostic(
+                code="AF305", severity=Severity.ERROR,
+                message=f"hedge_delay_s={delay:g} is at/above "
+                f"request_timeout_s={timeout:g}: the client deadline "
+                "orphans every attempt before its hedge timer can fire, "
+                "so hedging never wins a race — it only duplicates load "
+                "behind requests the client already gave up on "
+                "(a self-defeating policy)",
+                path="hedge_policy.hedge_delay_s",
+                remedy="set hedge_delay_s well below request_timeout_s "
+                "(typically near the latency tail you want to cut, e.g. "
+                "the p95-p99 gap), or drop the hedge policy",
             ))
 
     cover = {

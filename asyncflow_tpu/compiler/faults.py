@@ -27,7 +27,11 @@ import numpy as np
 
 from asyncflow_tpu.config.constants import FaultKind
 from asyncflow_tpu.schemas.payload import SimulationPayload
-from asyncflow_tpu.schemas.resilience import RetryPolicy
+from asyncflow_tpu.schemas.resilience import (
+    HedgePolicy,
+    LbHealthPolicy,
+    RetryPolicy,
+)
 
 
 @dataclass
@@ -180,4 +184,55 @@ def lower_retry(policy: RetryPolicy | None) -> RetryScalars:
             else -1.0
         ),
         budget_refill=float(policy.budget_refill_per_s),
+    )
+
+
+@dataclass
+class HedgeScalars:
+    """The hedge policy lowered to plan scalars (inert defaults = none)."""
+
+    delay: float = -1.0  # < 0 = no hedge policy
+    max_hedges: int = 0
+    cancel: int = 1  # 1 = cancel losers at routing boundaries
+
+    @property
+    def enabled(self) -> bool:
+        return self.delay > 0
+
+
+def lower_hedge(policy: HedgePolicy | None) -> HedgeScalars:
+    if policy is None:
+        return HedgeScalars()
+    return HedgeScalars(
+        delay=float(policy.hedge_delay_s),
+        max_hedges=int(policy.max_hedges),
+        cancel=int(bool(policy.cancel_on_first)),
+    )
+
+
+@dataclass
+class HealthScalars:
+    """The LB health policy lowered to plan scalars (inert = none)."""
+
+    alpha: float = 0.0  # <= 0 = no health policy
+    threshold: float = 1.0
+    readmit: float = -1.0
+
+    @property
+    def enabled(self) -> bool:
+        return self.alpha > 0
+
+    def observe(self, h: float, failed: bool) -> float:
+        """One EWMA update — the single formula both engines share."""
+        x = 1.0 if failed else 0.0
+        return (1.0 - self.alpha) * h + self.alpha * x
+
+
+def lower_health(policy: LbHealthPolicy | None) -> HealthScalars:
+    if policy is None:
+        return HealthScalars()
+    return HealthScalars(
+        alpha=float(policy.ewma_alpha),
+        threshold=float(policy.ejection_threshold),
+        readmit=float(policy.readmit_s),
     )

@@ -368,9 +368,16 @@ class StaticPlan:
         for name in ("server_db_pool", "server_queue_cap", "server_conn_cap"):
             if not getattr(self, name).size:
                 setattr(self, name, np.full(self.n_servers, -1, np.int32))
-        for name in ("server_rate_limit", "server_queue_timeout"):
+        for name in (
+            "server_rate_limit",
+            "server_queue_timeout",
+            "server_brownout_q",
+        ):
             if not getattr(self, name).size:
                 setattr(self, name, np.full(self.n_servers, -1.0, np.float32))
+        for name in ("server_brownout_cpu", "server_brownout_ram"):
+            if not getattr(self, name).size:
+                setattr(self, name, np.ones(self.n_servers, np.float32))
         if not self.server_rate_burst.size:
             self.server_rate_burst = np.zeros(self.n_servers, np.int32)
         # hand-built plans: identity fault tables at the plan's own widths
@@ -544,6 +551,26 @@ class StaticPlan:
     retry_jitter: float = 0.0
     retry_budget_tokens: float = -1.0
     retry_budget_refill: float = 0.0
+    #: tail-tolerance scalars (compiler/faults.py HedgeScalars /
+    #: HealthScalars): client hedging (hedge_delay < 0 = none) and the
+    #: LB's per-target EWMA health gate (health_alpha <= 0 = none).
+    hedge_delay: float = -1.0
+    hedge_max: int = 0
+    hedge_cancel: int = 1
+    health_alpha: float = 0.0
+    health_threshold: float = 1.0
+    health_readmit: float = -1.0
+    #: (NS,) f32 brownout ready-queue threshold (-1 = no brownout) and
+    #: the degraded-profile scale factors served above it (1 elsewhere).
+    server_brownout_q: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.float32),
+    )
+    server_brownout_cpu: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.float32),
+    )
+    server_brownout_ram: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.float32),
+    )
 
     @property
     def has_weighted_endpoints(self) -> bool:
@@ -596,6 +623,28 @@ class StaticPlan:
     def has_retry(self) -> bool:
         """True when a client retry/timeout policy is modeled."""
         return self.retry_timeout > 0
+
+    @property
+    def has_hedge(self) -> bool:
+        """True when client-side hedged requests are modeled."""
+        return self.hedge_delay > 0
+
+    @property
+    def has_health(self) -> bool:
+        """True when the LB's EWMA health gate is modeled."""
+        return self.health_alpha > 0
+
+    @property
+    def has_brownout(self) -> bool:
+        """True when any server's brownout degraded mode is modeled."""
+        return bool(np.any(self.server_brownout_q >= 0))
+
+    @property
+    def has_tail_tolerance(self) -> bool:
+        """True when any tail-tolerance policy (hedge/health/brownout)
+        is modeled — the routing predicate behind the
+        ``tail_tolerance.*`` fences."""
+        return self.has_hedge or self.has_health or self.has_brownout
 
     def array_digest(self) -> str:
         """Stable hash of every lowered plan array and scalar — the part
@@ -763,6 +812,13 @@ def _estimate_capacity(payload: SimulationPayload) -> tuple[int, int]:
     # bounds by the attempt cap (an upper bound on the amplification)
     if payload.retry_policy is not None:
         amp = float(payload.retry_policy.max_attempts)
+        rate *= amp
+        count_var *= amp * amp
+    # hedging amplifies the same way: every attempt can spawn up to
+    # max_hedges racing duplicates, and uncancelled losers keep consuming
+    # server resources until they drain
+    if payload.hedge_policy is not None:
+        amp = 1.0 + float(payload.hedge_policy.max_hedges)
         rate *= amp
         count_var *= amp * amp
     expected = rate * horizon
@@ -1365,10 +1421,35 @@ def _compile_payload(
     )
 
     # ---- resilience: fault windows + client retry policy ----
-    from asyncflow_tpu.compiler.faults import lower_faults, lower_retry
+    from asyncflow_tpu.compiler.faults import (
+        lower_faults,
+        lower_health,
+        lower_hedge,
+        lower_retry,
+    )
 
     fault_arrays = lower_faults(payload)
     retry = lower_retry(payload.retry_policy)
+
+    # ---- tail tolerance: hedging, LB health gate, server brownout ----
+    # (hedging over a single target still helps when the primary is parked
+    # in retry backoff, so no LB requirement; the health gate is LB-only
+    # by schema shape)
+    hedge = lower_hedge(payload.hedge_policy)
+    health = lower_health(lb.health if lb is not None else None)
+    brownout_q_model = np.full(n_servers, -1.0, dtype=np.float32)
+    brownout_cpu_model = np.ones(n_servers, dtype=np.float32)
+    brownout_ram_model = np.ones(n_servers, dtype=np.float32)
+    for s_i, server in enumerate(servers):
+        b_ov = server.overload
+        if b_ov is None or b_ov.brownout_queue_threshold is None:
+            continue
+        # modeled whenever configured: the decision is per-request at
+        # endpoint start (ready-queue length vs threshold), so there is
+        # no non-binding proof — an unreachable threshold never fires
+        brownout_q_model[s_i] = float(b_ov.brownout_queue_threshold)
+        brownout_cpu_model[s_i] = float(b_ov.brownout_cpu_factor)
+        brownout_ram_model[s_i] = float(b_ov.brownout_ram_factor)
 
     # Circuit breaker (reference roadmap milestone 5): modeled only when a
     # failure channel exists on some covered target — a modeled refusal /
@@ -1606,6 +1687,15 @@ def _compile_payload(
         retry_jitter=retry.jitter,
         retry_budget_tokens=retry.budget_tokens,
         retry_budget_refill=retry.budget_refill,
+        hedge_delay=hedge.delay,
+        hedge_max=hedge.max_hedges,
+        hedge_cancel=hedge.cancel,
+        health_alpha=health.alpha,
+        health_threshold=health.threshold,
+        health_readmit=health.readmit,
+        server_brownout_q=brownout_q_model,
+        server_brownout_cpu=brownout_cpu_model,
+        server_brownout_ram=brownout_ram_model,
     )
 
 
@@ -1711,6 +1801,49 @@ def _fastpath_analysis(
             "fault timeline: outage/degradation windows gate servers and "
             "edges in time (modeled on the event engines; use "
             "engine='event' or drop fault_timeline)",
+            [],
+            no_slots,
+            0,
+            0.0,
+        )
+    # Tail-tolerance policies are likewise event-engine work: hedges race
+    # duplicate attempts through the shared queues, health ejection gates
+    # the rotation on runtime failure history, and brownout rescales
+    # service demand from the live ready-queue length — none of which the
+    # closed-form per-station recursions can replay.
+    if payload.hedge_policy is not None:
+        return (
+            False,
+            "hedge policy: speculative duplicates race through the shared "
+            "queues and dedup at the client (modeled on the event "
+            "engines; use engine='event' or drop hedge_policy)",
+            [],
+            no_slots,
+            0,
+            0.0,
+        )
+    lb_node = payload.topology_graph.nodes.load_balancer
+    if lb_node is not None and lb_node.health is not None:
+        return (
+            False,
+            "LB health gate: EWMA outlier ejection rewires the rotation "
+            "from runtime failure history (modeled on the event engines; "
+            "use engine='event' or drop load_balancer.health)",
+            [],
+            no_slots,
+            0,
+            0.0,
+        )
+    if any(
+        s.overload is not None
+        and s.overload.brownout_queue_threshold is not None
+        for s in servers
+    ):
+        return (
+            False,
+            "server brownout: degraded-profile service demand depends on "
+            "the live ready-queue length (modeled on the event engines; "
+            "use engine='event' or drop brownout_queue_threshold)",
             [],
             no_slots,
             0,

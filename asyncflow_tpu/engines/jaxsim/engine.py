@@ -74,8 +74,10 @@ from asyncflow_tpu.observability.simtrace import (
     FR_ABANDON,
     FR_ARRIVE_LB,
     FR_ARRIVE_SRV,
+    FR_CANCEL,
     FR_COMPLETE,
     FR_DROP,
+    FR_HEDGE,
     FR_REJECT,
     FR_RETRY,
     FR_RUN,
@@ -209,6 +211,22 @@ class Engine:
             or np.any(plan.fault_edge_drop != 0.0),
         )
         self._has_retry = plan.has_retry
+        # tail tolerance: hedged requests, LB health gate, server brownout
+        # — each statically pruned when the plan carries none (IN903)
+        self._has_hedge = plan.has_hedge
+        self._hedge_max = max(int(plan.hedge_max), 1)
+        self._hedge_cancel = bool(plan.hedge_cancel)
+        self._has_health = plan.has_health
+        self._health_alpha = float(plan.health_alpha)
+        self._health_readmit = float(plan.health_readmit)
+        self._has_brownout = plan.has_brownout
+        # the per-target report channel (req_cbslot bookkeeping) serves
+        # both the breaker state machine and the health EWMA
+        self._has_report = (plan.breaker_threshold > 0) or plan.has_health
+        if self._has_hedge and plan.n_generators > 1:  # pragma: no cover
+            # the payload validator forbids this combination; double-fence
+            msg = "hedge policy with multiple generators is unsupported"
+            raise ValueError(msg)
         self._att_bins = max(int(plan.retry_max_attempts), 1)
         #: retry-budget capacity; None = unlimited (no bucket compiled in)
         self._rb_cap = (
@@ -507,36 +525,59 @@ class Engine:
         Runs AFTER the failure site freed the slot, so give-up lanes stay
         freed; retry lanes are re-claimed in place (no allocation race —
         spawn and pool branches are disjoint within one iteration).
-        Orphaned attempts (client already timed out) just stay freed."""
-        if not self._has_retry:
+        Orphaned attempts (client already timed out) just stay freed.
+
+        Hedge duplicates are invisible to the retry ladder: a failed
+        duplicate dies silently (its anchor refcount drops; the primary's
+        own ladder is untouched).  A primary that gives its logical
+        request up also stops the race — late siblings dedup as losers."""
+        if not (self._has_retry or self._has_hedge):
             return st
-        tracked = pred & (st.req_orphan[i] == 0)
-        attempt = st.req_attempt[i]
-        want = tracked & (attempt < self.plan.retry_max_attempts)
-        can, st = self._consume_retry_token(st, now, want)
-        delay = self._backoff_delay(attempt, key)
-        st = st._replace(
-            req_ev=st.req_ev.at[i].set(
-                jnp.where(can, EV_RETRY, st.req_ev[i]),
-            ),
-            req_t=st.req_t.at[i].set(
-                jnp.where(can, now + delay, st.req_t[i]),
-            ),
-            req_attempt=st.req_attempt.at[i].set(
-                jnp.where(can, attempt + 1, attempt),
-            ),
-            req_deadline=st.req_deadline.at[i].set(
-                jnp.where(pred, INF, st.req_deadline[i]),
-            ),
-            req_orphan=st.req_orphan.at[i].set(
-                jnp.where(pred, 0, st.req_orphan[i]),
-            ),
-            n_retries=st.n_retries + jnp.where(can, 1, 0),
-        )
-        if self.trace is not None:
-            st = self._fr(st, i, FR_RETRY, attempt, now, can)
-            st = self._fr(st, i, FR_ABANDON, attempt, now, tracked & ~can)
-        return self._record_attempts(st, attempt, tracked & ~can)
+        can = jnp.bool_(False)
+        if self._has_retry:
+            tracked = pred & (st.req_orphan[i] == 0)
+            if self._has_hedge:
+                tracked = tracked & (st.req_is_hedge[i] == 0)
+            attempt = st.req_attempt[i]
+            want = tracked & (attempt < self.plan.retry_max_attempts)
+            can, st = self._consume_retry_token(st, now, want)
+            delay = self._backoff_delay(attempt, key)
+            st = st._replace(
+                req_ev=st.req_ev.at[i].set(
+                    jnp.where(can, EV_RETRY, st.req_ev[i]),
+                ),
+                req_t=st.req_t.at[i].set(
+                    jnp.where(can, now + delay, st.req_t[i]),
+                ),
+                req_attempt=st.req_attempt.at[i].set(
+                    jnp.where(can, attempt + 1, attempt),
+                ),
+                req_deadline=st.req_deadline.at[i].set(
+                    jnp.where(pred, INF, st.req_deadline[i]),
+                ),
+                req_orphan=st.req_orphan.at[i].set(
+                    jnp.where(pred, 0, st.req_orphan[i]),
+                ),
+                n_retries=st.n_retries + jnp.where(can, 1, 0),
+            )
+            if self.trace is not None:
+                st = self._fr(st, i, FR_RETRY, attempt, now, can)
+                st = self._fr(st, i, FR_ABANDON, attempt, now, tracked & ~can)
+            st = self._record_attempts(st, attempt, tracked & ~can)
+            if self._has_hedge:
+                gave_up = tracked & ~can
+                anchor = st.req_prime[i]
+                st = st._replace(
+                    hg_done=st.hg_done.at[anchor].set(
+                        jnp.where(gave_up, 1, st.hg_done[anchor]),
+                    ),
+                    hg_t=st.hg_t.at[anchor].set(
+                        jnp.where(gave_up, INF, st.hg_t[anchor]),
+                    ),
+                )
+        if self._has_hedge:
+            st = self._hedge_release(st, i, pred & ~can)
+        return st
 
     def _timeout_branch(self, st: EngineState, i, now, key, ov, pred) -> EngineState:
         """Slot ``i``'s client deadline fired while the attempt is still in
@@ -558,6 +599,8 @@ class Engine:
         want = pred & (attempt < self.plan.retry_max_attempts)
         can, st = self._consume_retry_token(st, now, want)
         free_mask = st.req_ev == EV_IDLE
+        if self._has_hedge:
+            free_mask = free_mask & (st.hg_live == 0)
         slot = jnp.argmax(free_mask).astype(jnp.int32)
         has_free = free_mask[slot]
         place = can & has_free
@@ -576,6 +619,32 @@ class Engine:
             n_retries=st.n_retries + jnp.where(place, 1, 0),
             n_overflow=st.n_overflow + jnp.where(overflow, 1, 0),
         )
+        if self._has_hedge:
+            # the backoff re-issue is one more live attempt of the SAME
+            # logical request: it inherits the anchor pointer (the
+            # orphaned slot keeps draining on its own); a give-up stops
+            # the race so late siblings dedup as losers
+            anchor = st.req_prime[i]
+            st = st._replace(
+                req_prime=st.req_prime.at[idx].set(anchor, mode="drop"),
+                req_is_hedge=st.req_is_hedge.at[idx].set(
+                    st.req_is_hedge[i], mode="drop",
+                ),
+                hg_live=st.hg_live.at[anchor].add(jnp.where(place, 1, 0)),
+            )
+            gave_up = pred & ~place
+            st = st._replace(
+                hg_done=st.hg_done.at[anchor].set(
+                    jnp.where(gave_up, 1, st.hg_done[anchor]),
+                ),
+                hg_t=st.hg_t.at[anchor].set(
+                    jnp.where(gave_up, INF, st.hg_t[anchor]),
+                ),
+            )
+        if self._has_brownout:
+            st = st._replace(
+                req_degraded=st.req_degraded.at[idx].set(0, mode="drop"),
+            )
         if self._has_llm:
             st = st._replace(req_llm=st.req_llm.at[idx].set(0.0, mode="drop"))
         if self.trace is not None:
@@ -665,12 +734,45 @@ class Engine:
         return self._client_fail(st, i, now, key, dead)
 
     def _client_arrive_branch(self, st, i, now, key, ov, pred) -> EngineState:
-        """Final delivery at the client (retry plans only): a non-orphan
+        """Final delivery at the client (retry/hedge plans): a non-orphan
         arrival completes the logical request; an orphaned one is the
-        server-side tail of an abandoned attempt and records nothing."""
-        if not self._has_retry:
+        server-side tail of an abandoned attempt and records nothing.
+        With a hedge policy the FIRST sibling home wins the race; later
+        arrivals dedup silently — one completion per logical request."""
+        if not (self._has_retry or self._has_hedge):
             return st
-        done = pred & (st.req_orphan[i] == 0)
+        done = pred
+        if self._has_retry:
+            done = done & (st.req_orphan[i] == 0)
+        anchor = i
+        if self._has_hedge:
+            anchor = st.req_prime[i]
+            loser = done & (st.hg_done[anchor] == 1)
+            done = done & ~loser
+            st = st._replace(
+                hg_done=st.hg_done.at[anchor].set(
+                    jnp.where(done, 1, st.hg_done[anchor]),
+                ),
+                hg_t=st.hg_t.at[anchor].set(
+                    jnp.where(done, INF, st.hg_t[anchor]),
+                ),
+                n_hedges_won=st.n_hedges_won
+                + jnp.where(done & (st.req_is_hedge[i] == 1), 1, 0),
+            )
+            if self.trace is not None:
+                st = self._fr_row(
+                    st,
+                    st.req_fr[anchor],
+                    FR_CANCEL,
+                    st.req_is_hedge[i],
+                    now,
+                    loser,
+                )
+        if self._has_brownout:
+            st = st._replace(
+                n_degraded=st.n_degraded
+                + jnp.where(done & (st.req_degraded[i] == 1), 1, 0),
+            )
         st = self._record_attempts(st, st.req_attempt[i], done)
         if self._has_llm:
             cost = st.req_llm[i]
@@ -697,18 +799,221 @@ class Engine:
                 ),
             )
         if self.trace is not None:
-            st = self._fr(st, i, FR_COMPLETE, -1, now, done)
+            # the logical request's record rides the ANCHOR's ring row (a
+            # winning duplicate completes the primary's record)
+            st = self._fr_row(st, st.req_fr[anchor], FR_COMPLETE, -1, now, done)
         st = self._complete(st, st.req_start[i], now, done)
-        return st._replace(
+        st = st._replace(
             req_ev=st.req_ev.at[i].set(jnp.where(pred, EV_IDLE, st.req_ev[i])),
             req_t=st.req_t.at[i].set(jnp.where(pred, INF, st.req_t[i])),
-            req_deadline=st.req_deadline.at[i].set(
-                jnp.where(pred, INF, st.req_deadline[i]),
+        )
+        if self._has_retry:
+            st = st._replace(
+                req_deadline=st.req_deadline.at[i].set(
+                    jnp.where(pred, INF, st.req_deadline[i]),
+                ),
+                req_orphan=st.req_orphan.at[i].set(
+                    jnp.where(pred, 0, st.req_orphan[i]),
+                ),
+            )
+        if self._has_hedge:
+            st = self._hedge_release(st, i, pred)
+        return st
+
+    # ==================================================================
+    # hedged-request machinery (statically pruned without a policy)
+    # ==================================================================
+
+    def _hedge_release(self, st: EngineState, i, pred) -> EngineState:
+        """Slot ``i``'s attempt drained: drop the anchor's live-attempt
+        refcount.  At zero the logical request is gone — reset the
+        anchor's hedge state so its slot can be reclaimed (hedging
+        duplicates OUTSTANDING work; it never resurrects a request whose
+        every attempt already failed)."""
+        if not self._has_hedge:
+            return st
+        anchor = st.req_prime[i]
+        live = jnp.maximum(st.hg_live[anchor] - 1, 0)
+        gone = pred & (live == 0)
+        return st._replace(
+            hg_live=st.hg_live.at[anchor].set(
+                jnp.where(pred, live, st.hg_live[anchor]),
             ),
-            req_orphan=st.req_orphan.at[i].set(
-                jnp.where(pred, 0, st.req_orphan[i]),
+            hg_t=st.hg_t.at[anchor].set(
+                jnp.where(gone, INF, st.hg_t[anchor]),
+            ),
+            hg_n=st.hg_n.at[anchor].set(
+                jnp.where(gone, 0, st.hg_n[anchor]),
+            ),
+            hg_done=st.hg_done.at[anchor].set(
+                jnp.where(gone, 0, st.hg_done[anchor]),
             ),
         )
+
+    def _hedge_checkpoint(self, st: EngineState, i, now, pred):
+        """Routing-boundary cancellation (``cancel_on_first`` only): when
+        the race is already won, the arriving loser — primary or duplicate
+        alike — is cancelled here instead of admitted.  Work already
+        inside a server runs to completion as an orphan; cancellation
+        never claws back admitted work.  A cancelled attempt vanishes
+        WITHOUT reporting to the breaker/health channels (its half-open
+        probe reservation is returned so the round isn't starved)."""
+        if not (self._has_hedge and self._hedge_cancel):
+            return st, pred
+        anchor = st.req_prime[i]
+        cancel = pred & (st.hg_done[anchor] == 1)
+        if self.trace is not None:
+            # node = 0 the primary lost, 1 a duplicate lost
+            st = self._fr_row(
+                st,
+                st.req_fr[anchor],
+                FR_CANCEL,
+                st.req_is_hedge[i],
+                now,
+                cancel,
+            )
+        if self._has_breaker:
+            slot = st.req_cbslot[i]
+            unprobe = cancel & (slot >= 0) & (st.req_probe[i] > 0)
+            st = st._replace(
+                cb_probes_out=st.cb_probes_out.at[jnp.clip(slot, 0, None)]
+                .add(jnp.where(unprobe, -1, 0)),
+            )
+            st = st._replace(
+                cb_probes_out=jnp.maximum(st.cb_probes_out, 0),
+            )
+        if self._has_report:
+            st = st._replace(
+                req_cbslot=st.req_cbslot.at[i].set(
+                    jnp.where(cancel, -1, st.req_cbslot[i]),
+                ),
+                req_probe=st.req_probe.at[i].set(
+                    jnp.where(cancel, 0, st.req_probe[i]),
+                ),
+            )
+        if self._has_retry:
+            st = st._replace(
+                req_deadline=st.req_deadline.at[i].set(
+                    jnp.where(cancel, INF, st.req_deadline[i]),
+                ),
+            )
+        st = st._replace(
+            req_ev=st.req_ev.at[i].set(
+                jnp.where(cancel, EV_IDLE, st.req_ev[i]),
+            ),
+            req_t=st.req_t.at[i].set(jnp.where(cancel, INF, st.req_t[i])),
+            n_hedges_cancelled=st.n_hedges_cancelled
+            + jnp.where(cancel, 1, 0),
+        )
+        st = self._hedge_release(st, i, cancel)
+        return st, pred & ~cancel
+
+    def _hedge_branch(self, st: EngineState, i, now, key, ov, pred) -> EngineState:
+        """Anchor ``i``'s hedge timer fired: issue a speculative duplicate
+        down the (single generator's) entry chain without abandoning the
+        original.  The duplicate copies the logical request's identity —
+        anchor pointer, start time, attempt number — but carries no client
+        deadline (hedges are invisible to the retry ladder) and records
+        only FR_HEDGE here: its transit noise stays out of the flight
+        record.  The timer re-arms one delay out until the per-request
+        budget is spent."""
+        if not self._has_hedge:
+            return st
+        plan = self.plan
+        fire = pred & (st.hg_done[i] == 0) & (st.hg_n[i] < self._hedge_max)
+        ordinal = st.hg_n[i] + 1
+        st = st._replace(
+            # stale timers (race won / budget spent) just disarm
+            hg_t=st.hg_t.at[i].set(
+                jnp.where(
+                    pred,
+                    jnp.where(
+                        fire & (ordinal < self._hedge_max),
+                        now + ov.hedge_delay,
+                        INF,
+                    ),
+                    st.hg_t[i],
+                ),
+            ),
+            hg_n=st.hg_n.at[i].set(jnp.where(fire, ordinal, st.hg_n[i])),
+            n_hedges=st.n_hedges + jnp.where(fire, 1, 0),
+        )
+        if self.trace is not None:
+            st = self._fr_row(st, st.req_fr[i], FR_HEDGE, ordinal, now, fire)
+        alive = fire
+        t_cur = now
+        for j, eidx in enumerate(plan.entry_edges.tolist()):
+            e = jnp.int32(eidx)
+            dropped, delay = self._sample_edge(
+                e, t_cur, jax.random.fold_in(key, 8 + j), ov,
+            )
+            survives = alive & ~dropped
+            st = self._edge_interval(st, e, t_cur, t_cur + delay, survives)
+            st = st._replace(
+                n_dropped=st.n_dropped + jnp.where(alive & dropped, 1, 0),
+            )
+            t_cur = jnp.where(survives, t_cur + delay, t_cur)
+            alive = survives
+        free_mask = (st.req_ev == EV_IDLE) & (st.hg_live == 0)
+        slot = jnp.argmax(free_mask).astype(jnp.int32)
+        has_free = free_mask[slot]
+        place = alive & has_free
+        overflow = alive & ~has_free
+        ev0 = (
+            EV_ARRIVE_LB
+            if plan.entry_target_kind == TARGET_LB
+            else EV_ARRIVE_SRV
+        )
+        idx = jnp.where(place, slot, jnp.int32(self.pool))
+        st = st._replace(
+            req_ev=st.req_ev.at[idx].set(ev0, mode="drop"),
+            req_t=st.req_t.at[idx].set(t_cur, mode="drop"),
+            req_srv=st.req_srv.at[idx].set(
+                jnp.int32(max(plan.entry_target, 0)), mode="drop",
+            ),
+            req_start=st.req_start.at[idx].set(
+                st.req_start[i], mode="drop",
+            ),
+            req_lbslot=st.req_lbslot.at[idx].set(-1, mode="drop"),
+            req_ram=st.req_ram.at[idx].set(0.0, mode="drop"),
+            req_ticket=st.req_ticket.at[idx].set(NO_TICKET, mode="drop"),
+            req_prime=st.req_prime.at[idx].set(i, mode="drop"),
+            req_is_hedge=st.req_is_hedge.at[idx].set(1, mode="drop"),
+            hg_live=st.hg_live.at[i].add(jnp.where(place, 1, 0)),
+            n_overflow=st.n_overflow + jnp.where(overflow, 1, 0),
+        )
+        if self._has_retry:
+            st = st._replace(
+                req_deadline=st.req_deadline.at[idx].set(INF, mode="drop"),
+                req_attempt=st.req_attempt.at[idx].set(
+                    st.req_attempt[i], mode="drop",
+                ),
+                req_orphan=st.req_orphan.at[idx].set(0, mode="drop"),
+            )
+        if self._has_brownout:
+            st = st._replace(
+                req_degraded=st.req_degraded.at[idx].set(0, mode="drop"),
+            )
+        if self._has_llm:
+            st = st._replace(
+                req_llm=st.req_llm.at[idx].set(0.0, mode="drop"),
+            )
+        if self._crn:
+            # the duplicate draws from the logical request's CRN family on
+            # a disjoint draw band (primaries count draws from 0)
+            st = st._replace(
+                req_seq=st.req_seq.at[idx].set(st.req_seq[i], mode="drop"),
+                req_draws=st.req_draws.at[idx].set(
+                    10000 * ordinal, mode="drop",
+                ),
+            )
+        if self.trace is not None:
+            st = st._replace(req_fr=st.req_fr.at[idx].set(-1, mode="drop"))
+        if self.collect_traces:
+            st = st._replace(
+                req_hop_n=st.req_hop_n.at[idx].set(0, mode="drop"),
+            )
+        return st
 
     # ==================================================================
     # arrival sampler (window-jump semantics cloned from the reference)
@@ -982,6 +1287,10 @@ class Engine:
             alive = jnp.where(g == gi, pred_gi, alive)
 
         free_mask = st.req_ev == EV_IDLE
+        if self._has_hedge:
+            # a freed anchor slot stays reserved while sibling attempts
+            # are still in flight (its identity fields must survive)
+            free_mask = free_mask & (st.hg_live == 0)
         slot = jnp.argmax(free_mask).astype(jnp.int32)
         has_free = free_mask[slot]
         overflow = alive & ~has_free
@@ -1068,6 +1377,25 @@ class Engine:
                 ),
                 req_orphan=st.req_orphan.at[idx].set(0, mode="drop"),
             )
+        if self._has_hedge:
+            # the primary anchors its logical request at its own slot; the
+            # hedge timer arms at the emission time (a <= 0 per-scenario
+            # delay override leaves it disarmed — the A/B off switch)
+            st = st._replace(
+                req_prime=st.req_prime.at[idx].set(slot, mode="drop"),
+                req_is_hedge=st.req_is_hedge.at[idx].set(0, mode="drop"),
+                hg_t=st.hg_t.at[idx].set(
+                    jnp.where(ov.hedge_delay > 0, now + ov.hedge_delay, INF),
+                    mode="drop",
+                ),
+                hg_n=st.hg_n.at[idx].set(0, mode="drop"),
+                hg_live=st.hg_live.at[idx].set(1, mode="drop"),
+                hg_done=st.hg_done.at[idx].set(0, mode="drop"),
+            )
+        if self._has_brownout:
+            st = st._replace(
+                req_degraded=st.req_degraded.at[idx].set(0, mode="drop"),
+            )
         if self._has_llm:
             st = st._replace(
                 req_llm=st.req_llm.at[idx].set(0.0, mode="drop"),
@@ -1107,6 +1435,13 @@ class Engine:
         p = self.params
         kind = p.seg_kind[s, ep, seg]
         dur = p.seg_dur[s, ep, seg]
+        if self._has_brownout:
+            # degraded requests run the cheaper CPU profile
+            dur = jnp.where(
+                (kind == SEG_CPU) & (st.req_degraded[i] == 1),
+                dur * p.server_brownout_cpu[s],
+                dur,
+            )
         is_cpu = pred & (kind == SEG_CPU)
         is_io = pred & (kind == SEG_IO)
         is_end = pred & (kind == SEG_END)
@@ -1235,7 +1570,7 @@ class Engine:
                 n_rejected=st.n_rejected + jnp.where(shed, 1, 0),
             )
             st = self._breaker_server_report(
-                st, i, now, jnp.bool_(True), shed,
+                st, i, now, jnp.bool_(True), ov, shed,
             )
             st = self._client_fail(st, i, now, key, shed)
         return self._exit_flow(st, i, s, now, key, ov, is_end)
@@ -1311,7 +1646,7 @@ class Engine:
                 srv_conn=st.srv_conn.at[s].add(jnp.where(pred, -1, 0)),
             )
         # departing the routed target is the breaker's success signal
-        st = self._breaker_server_report(st, i, now, jnp.bool_(False), pred)
+        st = self._breaker_server_report(st, i, now, jnp.bool_(False), ov, pred)
 
         # route the single exit edge of this server
         e = p.exit_edge[s]
@@ -1324,12 +1659,14 @@ class Engine:
         drop_here = pred & dropped
 
         st = self._edge_interval(st, e, now, arrive, pred & ~dropped)
-        if self._has_retry:
+        if self._has_retry or self._has_hedge:
             # the final leg stays EVENT-DRIVEN: the client deadline must
             # race the last transit exactly like the oracle's heap (a
             # timeout during the final edge orphans the attempt), so
             # completion is deferred to an EV_ARRIVE_CLIENT event at
             # ``arrive`` instead of being folded into this exit event
+            # (hedging also needs it: the sibling race is settled at the
+            # client, never mid-flight)
             if self.collect_traces:
                 st = self._hop(st, i, self.HOP_EDGE + e, arrive, pred & ~dropped)
             if self.trace is not None:
@@ -1375,6 +1712,11 @@ class Engine:
             )
             return self._client_fail(st, i, now, key, drop_here)
         done = to_client & (arrive < plan.horizon)
+        if self._has_brownout:
+            st = st._replace(
+                n_degraded=st.n_degraded
+                + jnp.where(done & (st.req_degraded[i] == 1), 1, 0),
+            )
         if self._has_llm:
             cost = st.req_llm[i]
             st = st._replace(
@@ -1509,16 +1851,45 @@ class Engine:
             ),
         )
 
-    def _breaker_server_report(self, st, i, now, failed, pred):
-        """Report slot ``i``'s routing outcome once (no-op after clearing)."""
-        if not self._has_breaker:
+    def _breaker_server_report(self, st, i, now, failed, ov, pred):
+        """Report slot ``i``'s routing outcome once (no-op after clearing).
+
+        One report feeds BOTH outlier channels: the circuit breaker's
+        consecutive-failure state machine and the LB health gate's EWMA
+        ``h <- (1 - alpha) * h + alpha * x`` (x = 1 failure, 0 success —
+        the formula :meth:`HealthScalars.observe` pins for the oracle).
+        Crossing the ejection threshold while in rotation ejects the slot
+        until ``now + readmit_s``; requests already in flight to an
+        ejected slot keep updating its EWMA without re-extending the
+        ejection."""
+        if not self._has_report:
             return st
         slot = st.req_cbslot[i]
         act = pred & (slot >= 0)
         slot_c = jnp.clip(slot, 0, None)
-        st = self._breaker_report(
-            st, slot_c, st.req_probe[i] > 0, failed, now, act,
-        )
+        if self._has_breaker:
+            st = self._breaker_report(
+                st, slot_c, st.req_probe[i] > 0, failed, now, act,
+            )
+        if self._has_health:
+            alpha = jnp.float32(self._health_alpha)
+            x = jnp.where(failed, jnp.float32(1.0), jnp.float32(0.0))
+            h = (1.0 - alpha) * st.hl_h[slot_c] + alpha * x
+            in_rot = st.hl_until[slot_c] <= 0
+            eject = act & in_rot & (h >= ov.health_threshold)
+            st = st._replace(
+                hl_h=st.hl_h.at[slot_c].set(
+                    jnp.where(act, h, st.hl_h[slot_c]),
+                ),
+                hl_until=st.hl_until.at[slot_c].set(
+                    jnp.where(
+                        eject,
+                        now + jnp.float32(self._health_readmit),
+                        st.hl_until[slot_c],
+                    ),
+                ),
+                n_ejections=st.n_ejections + jnp.where(eject, 1, 0),
+            )
         return st._replace(
             req_cbslot=st.req_cbslot.at[i].set(
                 jnp.where(act, -1, st.req_cbslot[i]),
@@ -1535,27 +1906,48 @@ class Engine:
         if self.plan.n_lb_edges == 0:
             return st
         p = self.params
+        st, pred = self._hedge_checkpoint(st, i, now, pred)
         empty = st.lb_len <= 0
         drop_empty = pred & empty
         route = pred & ~empty
 
-        if self._has_breaker:
-            # lazy cooldown expiry: open slots whose cooldown has elapsed
-            # become half-open with fresh probe slots
-            wake = route & (st.cb_state == 1) & (now >= st.cb_open_until)
-            st = st._replace(
-                cb_state=jnp.where(wake, 2, st.cb_state),
-                cb_probes_out=jnp.where(wake, 0, st.cb_probes_out),
-                cb_probe_ok=jnp.where(wake, 0, st.cb_probe_ok),
-            )
-            if self.trace is not None:
-                # lazy open -> half-open wakes, one ring entry per slot
-                for k in range(max(self.plan.n_lb_edges, 1)):
-                    st = self._bk(st, k, 2, now, wake[k])
-            admits = (st.cb_state == 0) | (
-                (st.cb_state == 2)
-                & (st.cb_probes_out < self.plan.breaker_probes)
-            )
+        if self._has_report:
+            el = max(self.plan.n_lb_edges, 1)
+            admits = jnp.ones(el, dtype=bool)
+            if self._has_breaker:
+                # lazy cooldown expiry: open slots whose cooldown has
+                # elapsed become half-open with fresh probe slots
+                wake = route & (st.cb_state == 1) & (now >= st.cb_open_until)
+                st = st._replace(
+                    cb_state=jnp.where(wake, 2, st.cb_state),
+                    cb_probes_out=jnp.where(wake, 0, st.cb_probes_out),
+                    cb_probe_ok=jnp.where(wake, 0, st.cb_probe_ok),
+                )
+                if self.trace is not None:
+                    # lazy open -> half-open wakes, one ring entry per slot
+                    for k in range(el):
+                        st = self._bk(st, k, 2, now, wake[k])
+                admits = (st.cb_state == 0) | (
+                    (st.cb_state == 2)
+                    & (st.cb_probes_out < self.plan.breaker_probes)
+                )
+            if self._has_health:
+                # lazy readmission: elapsed ejections rejoin with a fresh
+                # EWMA before this pick considers them
+                ready = route & (st.hl_until > 0) & (now >= st.hl_until)
+                st = st._replace(
+                    hl_h=jnp.where(ready, 0.0, st.hl_h),
+                    hl_until=jnp.where(ready, 0.0, st.hl_until),
+                )
+                healthy = st.hl_until <= 0
+                admits_h = admits & healthy
+                # panic bypass: when every breaker-admitted rotation member
+                # is health-ejected, route on breaker admits alone — an
+                # all-ejected rotation must not blackhole traffic
+                pos = jnp.arange(el, dtype=jnp.int32)
+                valid = pos < st.lb_len
+                any_h = jnp.any(valid & admits_h[st.lb_order])
+                admits = jnp.where(any_h, admits_h, admits)
             if weights is not None:
                 slot, none_open = self._lb_pick_weighted(
                     st, weights, jax.random.fold_in(key, 33), admits,
@@ -1574,11 +1966,15 @@ class Engine:
                     jnp.where(reject, INF, st.req_t[i]),
                 ),
             )
-            probe = route & (st.cb_state[slot] == 2)
+            probe = jnp.bool_(False)
+            if self._has_breaker:
+                probe = route & (st.cb_state[slot] == 2)
+                st = st._replace(
+                    cb_probes_out=st.cb_probes_out.at[slot].add(
+                        jnp.where(probe, 1, 0),
+                    ),
+                )
             st = st._replace(
-                cb_probes_out=st.cb_probes_out.at[slot].add(
-                    jnp.where(probe, 1, 0),
-                ),
                 req_cbslot=st.req_cbslot.at[i].set(
                     jnp.where(route, slot, st.req_cbslot[i]),
                 ),
@@ -1600,10 +1996,10 @@ class Engine:
         arrive = now + delay
         ok = route & ~dropped
         drop_edge = route & dropped
-        if self._has_breaker:
+        if self._has_report:
             # a dropped send on the routing edge is a connection failure
             st = self._breaker_server_report(
-                st, i, now, jnp.bool_(True), drop_edge,
+                st, i, now, jnp.bool_(True), ov, drop_edge,
             )
 
         st = self._hop(st, i, self.HOP_LB, now, pred)
@@ -1611,13 +2007,13 @@ class Engine:
         st = self._edge_interval(st, e, now, arrive, ok)
         if self.trace is not None:
             st = self._fr(st, i, FR_ARRIVE_LB, -1, now, pred)
-            if self._has_breaker:
+            if self._has_report:
                 st = self._fr(st, i, FR_REJECT, -1, now, reject)
             st = self._fr(st, i, FR_DROP, -1, now, drop_empty)
             st = self._fr(st, i, FR_DROP, e, now, drop_edge)
             st = self._fr(st, i, FR_TRANSIT, e, arrive, ok)
         free = drop_empty | drop_edge
-        client_fail = (free | reject) if self._has_breaker else free
+        client_fail = (free | reject) if self._has_report else free
         st = st._replace(
             lb_order=order,
             lb_conn=st.lb_conn.at[slot].add(jnp.where(ok, 1, 0)),
@@ -1655,6 +2051,11 @@ class Engine:
                 ),
             )
 
+        # server-side routing boundary: a loser arriving after the race
+        # was won is cancelled BEFORE admission (outage check, rate
+        # limiter, sockets) — admitted work is never clawed back
+        st, pred = self._hedge_checkpoint(st, i, now, pred)
+
         if self._has_srv_faults:
             # server-outage fault window: the server is dark and hard-
             # refuses the arrival.  Unlike the legacy SERVER_DOWN event
@@ -1674,7 +2075,7 @@ class Engine:
             if self.trace is not None:
                 st = self._fr(st, i, FR_REJECT, s, now, dark)
             st = self._breaker_server_report(
-                st, i, now, jnp.bool_(True), dark,
+                st, i, now, jnp.bool_(True), ov, dark,
             )
             st = self._client_fail(st, i, now, key, dark)
             pred = pred & ~dark
@@ -1711,7 +2112,7 @@ class Engine:
             if self.trace is not None:
                 st = self._fr(st, i, FR_REJECT, s, now, limited)
             st = self._breaker_server_report(
-                st, i, now, jnp.bool_(True), limited,
+                st, i, now, jnp.bool_(True), ov, limited,
             )
             st = self._client_fail(st, i, now, key, limited)
             pred = pred & ~limited
@@ -1731,7 +2132,7 @@ class Engine:
             if self.trace is not None:
                 st = self._fr(st, i, FR_REJECT, s, now, refuse)
             st = self._breaker_server_report(
-                st, i, now, jnp.bool_(True), refuse,
+                st, i, now, jnp.bool_(True), ov, refuse,
             )
             st = self._client_fail(st, i, now, key, refuse)
             pred = pred & ~refuse
@@ -1752,11 +2153,32 @@ class Engine:
         st = st._replace(
             req_ep=st.req_ep.at[i].set(jnp.where(pred, ep, st.req_ep[i])),
         )
+        if self._has_brownout:
+            # brownout decision, latched once per arrival at endpoint
+            # start: above the ready-queue threshold the endpoint serves
+            # the degraded (cheaper) step profile instead of shedding
+            bq = ov.brownout_q[s]
+            deg = (
+                pred
+                & (bq >= 0)
+                & (st.cpu_wait_n[s].astype(jnp.float32) >= bq)
+            )
+            st = st._replace(
+                req_degraded=st.req_degraded.at[i].set(
+                    jnp.where(pred, jnp.where(deg, 1, 0), st.req_degraded[i]),
+                ),
+            )
         if not self._has_ram:
             # no RAM steps anywhere in the plan: admission always succeeds
             return self._seg_start(st, i, s, ep, jnp.int32(0), now, key, ov, pred)
 
         need = p.endpoint_ram[s, ep]
+        if self._has_brownout:
+            need = jnp.where(
+                st.req_degraded[i] == 1,
+                need * p.server_brownout_ram[s],
+                need,
+            )
         st = st._replace(
             req_ram=st.req_ram.at[i].set(jnp.where(pred, need, st.req_ram[i])),
         )
@@ -1812,6 +2234,12 @@ class Engine:
         grant = was_cpu & (tick[j] < NO_TICKET)
         release = was_cpu & ~grant
         jdur = p.seg_dur[st.req_srv[j], st.req_ep[j], st.req_seg[j]]
+        if self._has_brownout:
+            jdur = jnp.where(
+                st.req_degraded[j] == 1,
+                jdur * p.server_brownout_cpu[s],
+                jdur,
+            )
         ev_next = jnp.int32(EV_SEG_END)
         t_next = now + jdur
         if self._has_timeout:
@@ -1855,7 +2283,7 @@ class Engine:
         )
         if self.trace is not None:
             st = self._fr(st, i, FR_REJECT, s, now, pred)
-        st = self._breaker_server_report(st, i, now, jnp.bool_(True), pred)
+        st = self._breaker_server_report(st, i, now, jnp.bool_(True), ov, pred)
         return self._client_fail(st, i, now, key, pred)
 
     def _seg_end_branch(self, st, i, now, key, ov, pred) -> EngineState:
@@ -1966,12 +2394,12 @@ class Engine:
             ),
             req_cbslot=(
                 jnp.full(pool, -1, jnp.int32)
-                if self._has_breaker
+                if self._has_report
                 else jnp.zeros(1, jnp.int32)
             ),
             req_probe=(
                 jnp.zeros(pool, jnp.int32)
-                if self._has_breaker
+                if self._has_report
                 else jnp.zeros(1, jnp.int32)
             ),
             rl_tokens=(
@@ -2099,6 +2527,28 @@ class Engine:
                 self._bk_cap if self.trace is not None else 1, jnp.int32,
             ),
             bk_n=jnp.int32(0),
+            req_prime=jnp.zeros(pool if self._has_hedge else 1, jnp.int32),
+            req_is_hedge=jnp.zeros(
+                pool if self._has_hedge else 1, jnp.int32,
+            ),
+            hg_t=jnp.full(
+                pool if self._has_hedge else 1, INF, jnp.float32,
+            ),
+            hg_n=jnp.zeros(pool if self._has_hedge else 1, jnp.int32),
+            hg_live=jnp.zeros(pool if self._has_hedge else 1, jnp.int32),
+            hg_done=jnp.zeros(pool if self._has_hedge else 1, jnp.int32),
+            n_hedges=jnp.int32(0),
+            n_hedges_won=jnp.int32(0),
+            n_hedges_cancelled=jnp.int32(0),
+            hl_h=jnp.zeros(elp if self._has_health else 1, jnp.float32),
+            hl_until=jnp.zeros(
+                elp if self._has_health else 1, jnp.float32,
+            ),
+            n_ejections=jnp.int32(0),
+            req_degraded=jnp.zeros(
+                pool if self._has_brownout else 1, jnp.int32,
+            ),
+            n_degraded=jnp.int32(0),
         )
         # first arrival (gap from t=0), per generator stream
         if self._n_gen > 1:
@@ -2137,11 +2587,14 @@ class Engine:
         """The single pool scan per iteration: cache argmin index + value so
         ``_cond`` and the next body read scalars.  With a retry policy the
         effective per-slot time is ``min(req_t, req_deadline)`` — a client
-        timeout is an event even while the attempt is parked at INF."""
+        timeout is an event even while the attempt is parked at INF.  With
+        a hedge policy the anchor slot's pending hedge timer joins the min
+        the same way."""
+        eff = st.req_t
         if self._has_retry:
-            eff = jnp.minimum(st.req_t, st.req_deadline)
-        else:
-            eff = st.req_t
+            eff = jnp.minimum(eff, st.req_deadline)
+        if self._has_hedge:
+            eff = jnp.minimum(eff, st.hg_t)
         i = jnp.argmin(eff).astype(jnp.int32)
         return st._replace(nxt_i=i, nxt_t=eff[i])
 
@@ -2195,12 +2648,24 @@ class Engine:
             # event (deadline <= req_t; on ties the timeout wins, matching
             # the oracle heap's schedule order) — orphan + maybe re-issue;
             # the slot's real event stays pending for a later iteration
-            is_to = is_pool & (st.req_deadline[i] <= st.req_t[i])
+            own = st.req_t[i]
+            if self._has_hedge:
+                own = jnp.minimum(own, st.hg_t[i])
+            is_to = is_pool & (st.req_deadline[i] <= own)
             st = self._timeout_branch(st, i, now, kit, ov, is_to)
             is_pool = is_pool & ~is_to
+        if self._has_hedge:
+            # the anchor's hedge timer fired before (or, on a tie, instead
+            # of) the slot's own event: the oracle inserts the hedge timer
+            # at spawn — the earliest heap insertion — so ties go to it
+            is_hg = is_pool & (st.hg_t[i] <= st.req_t[i])
+            st = self._hedge_branch(st, i, now, kit, ov, is_hg)
+            is_pool = is_pool & ~is_hg
+        if self._has_retry:
             st = self._retry_branch(
                 st, i, now, kit, ov, is_pool & (ev == EV_RETRY),
             )
+        if self._has_retry or self._has_hedge:
             st = self._client_arrive_branch(
                 st, i, now, kit, ov, is_pool & (ev == EV_ARRIVE_CLIENT),
             )
@@ -2587,6 +3052,11 @@ def run_single(
             if plan.has_retry and hasattr(state, "att_hist")
             else None
         ),
+        total_hedges=int(getattr(state, "n_hedges", 0)),
+        hedges_won=int(getattr(state, "n_hedges_won", 0)),
+        hedges_cancelled=int(getattr(state, "n_hedges_cancelled", 0)),
+        lb_ejections=int(getattr(state, "n_ejections", 0)),
+        degraded_completions=int(getattr(state, "n_degraded", 0)),
     )
 
 
@@ -2707,6 +3177,31 @@ def sweep_results(
         attempts_hist=(
             np.asarray(final.att_hist)
             if engine.plan.has_retry and hasattr(final, "att_hist")
+            else None
+        ),
+        total_hedges=(
+            np.asarray(final.n_hedges)
+            if engine.plan.has_hedge and hasattr(final, "n_hedges")
+            else None
+        ),
+        hedges_won=(
+            np.asarray(final.n_hedges_won)
+            if engine.plan.has_hedge and hasattr(final, "n_hedges_won")
+            else None
+        ),
+        hedges_cancelled=(
+            np.asarray(final.n_hedges_cancelled)
+            if engine.plan.has_hedge and hasattr(final, "n_hedges_cancelled")
+            else None
+        ),
+        lb_ejections=(
+            np.asarray(final.n_ejections)
+            if engine.plan.has_health and hasattr(final, "n_ejections")
+            else None
+        ),
+        degraded_completions=(
+            np.asarray(final.n_degraded)
+            if engine.plan.has_brownout and hasattr(final, "n_degraded")
             else None
         ),
         gauge_means=(

@@ -72,6 +72,10 @@ class PlanParams(NamedTuple):
     fault_srv_down: jnp.ndarray  # (K, NS) i32
     fault_edge_lat: jnp.ndarray  # (M, NE) f32 multiplicative factor
     fault_edge_drop: jnp.ndarray  # (M, NE) f32 additive dropout boost
+    # brownout degraded-profile factors (the queue THRESHOLD rides the
+    # overrides so brownout A/B sweeps can batch per scenario)
+    server_brownout_cpu: jnp.ndarray  # (NS,) f32 CPU-duration scale
+    server_brownout_ram: jnp.ndarray  # (NS,) f32 RAM-demand scale
 
 
 def params_from_plan(plan: StaticPlan) -> PlanParams:
@@ -115,6 +119,8 @@ def params_from_plan(plan: StaticPlan) -> PlanParams:
         fault_srv_down=jnp.asarray(plan.fault_srv_down),
         fault_edge_lat=jnp.asarray(plan.fault_edge_lat),
         fault_edge_drop=jnp.asarray(plan.fault_edge_drop),
+        server_brownout_cpu=jnp.asarray(plan.server_brownout_cpu),
+        server_brownout_ram=jnp.asarray(plan.server_brownout_ram),
     )
 
 
@@ -242,6 +248,34 @@ class EngineState(NamedTuple):
     bk_slot: jnp.ndarray  # (C,) i32 LB rotation slot
     bk_state: jnp.ndarray  # (C,) i32 new state (0/1/2)
     bk_n: jnp.ndarray  # scalar i32
+    # hedged-request machinery (size (1,) unless the plan has a hedge
+    # policy).  ``req_prime`` is the slot index of the logical request's
+    # ANCHOR (the primary attempt's spawn slot; the primary points at
+    # itself and hedge duplicates point at it); the ``hg_*`` arrays are
+    # per-anchor logical-request state indexed by that anchor slot:
+    # ``hg_t`` the next hedge-timer fire time (INF = none pending),
+    # ``hg_n`` duplicates issued so far, ``hg_live`` the live-attempt
+    # refcount that keeps the anchor slot reserved until every sibling
+    # drained, ``hg_done`` = 1 once some attempt won the race.
+    req_prime: jnp.ndarray  # (P,) i32
+    req_is_hedge: jnp.ndarray  # (P,) i32
+    hg_t: jnp.ndarray  # (P,) f32
+    hg_n: jnp.ndarray  # (P,) i32
+    hg_live: jnp.ndarray  # (P,) i32
+    hg_done: jnp.ndarray  # (P,) i32
+    n_hedges: jnp.ndarray  # scalar i32: duplicates issued
+    n_hedges_won: jnp.ndarray  # scalar i32: races won by a duplicate
+    n_hedges_cancelled: jnp.ndarray  # scalar i32: losers cancelled en route
+    # LB health gate (size (1,) unless the plan has a health policy):
+    # per-rotation-slot EWMA failure rate and ejection expiry (0 = in the
+    # rotation; > 0 = ejected until that time, lazily readmitted at pick)
+    hl_h: jnp.ndarray  # (EL,) f32
+    hl_until: jnp.ndarray  # (EL,) f32
+    n_ejections: jnp.ndarray  # scalar i32
+    # server brownout (size (1,) unless the plan has a brownout policy):
+    # per-slot degraded flag, latched at endpoint start
+    req_degraded: jnp.ndarray  # (P,) i32
+    n_degraded: jnp.ndarray  # scalar i32: degraded completions
 
 
 class ScenarioOverrides(NamedTuple):
@@ -263,6 +297,13 @@ class ScenarioOverrides(NamedTuple):
     fault_srv_times: jnp.ndarray | None = None  # (K,) or (S, K)
     fault_edge_times: jnp.ndarray | None = None  # (M,) or (S, M)
     retry_timeout: jnp.ndarray | None = None  # scalar or (S,)
+    # tail-tolerance sweep axes: the hedge delay (<= 0 disables hedging
+    # for that scenario), the brownout ready-queue thresholds (< 0
+    # disables), and the health-gate ejection threshold (>= 1 in
+    # practice never ejects).  ``None`` = the base plan's value.
+    hedge_delay: jnp.ndarray | None = None  # scalar or (S,)
+    brownout_q: jnp.ndarray | None = None  # (NS,) or (S, NS)
+    health_threshold: jnp.ndarray | None = None  # scalar or (S,)
 
 
 def base_overrides(plan: StaticPlan) -> ScenarioOverrides:
@@ -287,6 +328,9 @@ def base_overrides(plan: StaticPlan) -> ScenarioOverrides:
         fault_srv_times=jnp.asarray(plan.fault_srv_times),
         fault_edge_times=jnp.asarray(plan.fault_edge_times),
         retry_timeout=jnp.float32(plan.retry_timeout),
+        hedge_delay=jnp.float32(plan.hedge_delay),
+        brownout_q=jnp.asarray(plan.server_brownout_q),
+        health_threshold=jnp.float32(plan.health_threshold),
     )
 
 

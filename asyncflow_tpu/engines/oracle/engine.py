@@ -50,8 +50,10 @@ from asyncflow_tpu.observability.simtrace import (
     FR_ABANDON,
     FR_ARRIVE_LB,
     FR_ARRIVE_SRV,
+    FR_CANCEL,
     FR_COMPLETE,
     FR_DROP,
+    FR_HEDGE,
     FR_REJECT,
     FR_RETRY,
     FR_RUN,
@@ -106,9 +108,36 @@ class Request:
     #: orphaned; the record survives client retries — the re-issue carries
     #: the same object)
     fr: FlightRecord | None = None
+    #: hedged-request machinery: the shared race state of this attempt's
+    #: logical request (None without a policy), 1 on speculative
+    #: duplicates, True once this attempt returned its live refcount
+    hedge: _HedgeGroup | None = None
+    is_hedge: int = 0
+    hg_released: bool = False
+    #: True while this attempt runs a server's brownout (cheaper) profile
+    degraded: bool = False
 
     def record_hop(self, kind: str, component_id: str, now: float) -> None:
         self.history.append(Hop(kind, component_id, now))
+
+
+@dataclass
+class _HedgeGroup:
+    """One logical request's hedge race, shared by all its attempts.
+
+    ``anchor`` is the attempt currently holding the anchor identity (the
+    jax engine's anchor pool slot): duplicates copy its start time and
+    attempt number, and every hedge-lifecycle flight-recorder write routes
+    through its record.  ``live`` refcounts attempts in flight; at zero
+    the logical request is gone — hedging never resurrects it.  ``done``
+    means the race is settled: a winner completed, the retry ladder gave
+    the request up, or every attempt died.
+    """
+
+    anchor: Request
+    n: int = 0
+    live: int = 1
+    done: bool = False
 
 
 class _EdgeRuntime:
@@ -217,6 +246,23 @@ class _ServerRuntime:
         self.queue_timeout = (
             cfg.overload.queue_timeout_s if cfg.overload is not None else None
         )
+        # brownout: above this ready-queue depth arrivals are served a
+        # cheaper profile (scaled CPU/RAM) instead of shed
+        self.brownout_q = (
+            cfg.overload.brownout_queue_threshold
+            if cfg.overload is not None
+            else None
+        )
+        self.brownout_cpu = (
+            float(cfg.overload.brownout_cpu_factor)
+            if cfg.overload is not None
+            else 1.0
+        )
+        self.brownout_ram = (
+            float(cfg.overload.brownout_ram_factor)
+            if cfg.overload is not None
+            else 1.0
+        )
         self.residents = 0
         self.ready_queue_len = 0
         self.io_queue_len = 0
@@ -233,6 +279,9 @@ class _ServerRuntime:
 
     def receive(self, req: Request) -> None:
         engine = self.engine
+        if engine.hedge_checkpoint(req):
+            # the hedge race is already won: cancel instead of admitting
+            return
         if engine.server_faulted(self.cfg.id, engine.sim.now):
             # server-outage fault window: the server is dark and hard-
             # refuses the arrival (the LB only learns via the breaker;
@@ -308,7 +357,18 @@ class _ServerRuntime:
                 len(endpoints) - 1,
             )
         ]
+        if engine.has_brownout:
+            # brownout decision latched per arrival: above the ready-queue
+            # threshold this visit serves the cheaper profile (an
+            # unconfigured server resets the flag — the LAST server
+            # visited decides, same as the jax engine's per-arrival latch)
+            req.degraded = (
+                self.brownout_q is not None
+                and self.ready_queue_len >= self.brownout_q
+            )
         total_ram = sum(step.quantity for step in endpoint.steps if step.is_ram)
+        if req.degraded:
+            total_ram *= self.brownout_ram
 
         if total_ram:
             ram_waits = tracing and (
@@ -392,7 +452,11 @@ class _ServerRuntime:
                             engine.client_fail(req)
                             return
                     core_locked = True
-                yield Timeout(step.quantity)
+                yield Timeout(
+                    step.quantity * self.brownout_cpu
+                    if req.degraded
+                    else step.quantity,
+                )
             elif step.is_io:
                 if core_locked:
                     self.cpu.release()
@@ -478,7 +542,12 @@ class OracleEngine:
         self.total_rejected = 0
         # resilience: fault tables (same lowering the JAX plan consumes)
         # and the client retry machinery
-        from asyncflow_tpu.compiler.faults import lower_faults, lower_retry
+        from asyncflow_tpu.compiler.faults import (
+            lower_faults,
+            lower_health,
+            lower_hedge,
+            lower_retry,
+        )
 
         self._faults = lower_faults(payload)
         self._edge_idx = {
@@ -489,6 +558,25 @@ class OracleEngine:
             for i, s in enumerate(payload.topology_graph.nodes.servers)
         }
         self.retry = lower_retry(payload.retry_policy)
+        # tail-tolerance policies (same lowering the JAX plan consumes)
+        self.hedge = lower_hedge(payload.hedge_policy)
+        _lb_node = payload.topology_graph.nodes.load_balancer
+        self.health = lower_health(
+            _lb_node.health if _lb_node is not None else None,
+        )
+        self.has_brownout = any(
+            s.overload is not None
+            and s.overload.brownout_queue_threshold is not None
+            for s in payload.topology_graph.nodes.servers
+        )
+        self.total_hedges = 0
+        self.hedges_won = 0
+        self.hedges_cancelled = 0
+        self.lb_ejections = 0
+        self.degraded_completions = 0
+        #: per-LB-out-edge health gate: EWMA failure rate + ejection lapse
+        #: (``until`` > 0 means ejected; lazily readmitted at pick time)
+        self.health_state: dict[str, dict] = {}
         self.total_timed_out = 0
         self.total_retries = 0
         self.retry_budget_exhausted = 0
@@ -616,7 +704,7 @@ class OracleEngine:
         """One arrival process per generator; multi-generator payloads
         superpose (each with its own workload params and entry edge)."""
         out = self.generator_out_by_id[workload.id]
-        if self.retry.enabled:
+        if self.retry.enabled or self.hedge.enabled:
             self._entry_out = out
             self._entry_gen_id = workload.id
         for gap in arrival_gaps(
@@ -645,6 +733,8 @@ class OracleEngine:
                     self.retry.timeout,
                     lambda r=req: self._on_timeout(r),
                 )
+            if self.hedge.enabled:
+                self._hedge_arm(req)
             out.transport(req)
 
     def _client_receive(self, req: Request) -> None:
@@ -657,11 +747,33 @@ class OracleEngine:
                 # the client already timed out and moved on: the orphaned
                 # completion is invisible (no latency, cost, or trace)
                 req.settled = True
+                self._hedge_release(req)
                 return
+            group = req.hedge
+            if group is not None:
+                if group.done:
+                    # a sibling already won the race (or the ladder gave
+                    # up): this arrival is a loser — dedup silently
+                    self._fr_rec(
+                        group.anchor.fr, FR_CANCEL, req.is_hedge, self.sim.now,
+                    )
+                    req.settled = True
+                    self._hedge_release(req)
+                    return
+                group.done = True
+                if req.is_hedge:
+                    self.hedges_won += 1
             req.settled = True
-            self._fr(req, FR_COMPLETE, -1, self.sim.now)
+            if group is not None:
+                # the logical request's record rides the ANCHOR's ring (a
+                # winning duplicate completes the primary's record)
+                self._fr_rec(group.anchor.fr, FR_COMPLETE, -1, self.sim.now)
+            else:
+                self._fr(req, FR_COMPLETE, -1, self.sim.now)
             if self.retry.enabled:
                 self._record_attempts(req.attempt)
+            if req.degraded:
+                self.degraded_completions += 1
             self.rqs_clock.append((req.initial_time, req.finish_time))
             self.llm_costs.append(req.llm_cost)
             if self.collect_traces:
@@ -669,12 +781,16 @@ class OracleEngine:
                     (hop.component_type, hop.component_id, hop.timestamp)
                     for hop in req.history
                 ]
+            self._hedge_release(req)
         else:
             assert self.client_out is not None
             self.client_out.transport(req)
 
     def _lb_receive(self, req: Request) -> None:
         assert self.lb is not None
+        if self.hedge_checkpoint(req):
+            # the hedge race is already won: cancel instead of routing
+            return
         req.record_hop(SystemNodes.LOAD_BALANCER, self.lb.id, self.sim.now)
         self._fr(req, FR_ARRIVE_LB, -1, self.sim.now)
         if not self.lb_out_edges:
@@ -700,12 +816,15 @@ class OracleEngine:
             self._fr(req, FR_REJECT, -1, self.sim.now)
             self.client_fail(req)
             return
-        if self.breaker is not None:
-            st = self._breaker_st(out.cfg.id)
+        if self.breaker is not None or self.health.enabled:
+            # arm the report-once outcome channel (feeds the breaker AND
+            # the health gate; cleared by the first report)
             req.lb_edge_id = out.cfg.id
-            if st["state"] == 2:  # half-open: this request is a probe
-                req.probe = True
-                st["probes_out"] += 1
+            if self.breaker is not None:
+                st = self._breaker_st(out.cfg.id)
+                if st["state"] == 2:  # half-open: this request is a probe
+                    req.probe = True
+                    st["probes_out"] += 1
         out.transport(req)
 
     def _breaker_st(self, edge_id: str) -> dict:
@@ -733,11 +852,37 @@ class OracleEngine:
             return st["probes_out"] < self.breaker.half_open_probes
         return True
 
+    def _health_st(self, edge_id: str) -> dict:
+        return self.health_state.setdefault(
+            edge_id, {"h": 0.0, "until": 0.0},
+        )
+
+    def _health_admits(self, edge_id: str) -> bool:
+        """Lazy readmission + health eligibility of one rotation slot
+        (``until`` > 0 means ejected; an elapsed lapse rejoins with a
+        fresh EWMA before this pick considers it)."""
+        hs = self._health_st(edge_id)
+        if hs["until"] > 0.0 and self.sim.now >= hs["until"]:
+            hs["h"] = 0.0
+            hs["until"] = 0.0
+        return hs["until"] <= 0.0
+
+    def _health_pool(self, eligible: list[str]) -> list[str]:
+        """Health gate over breaker-admitted members, with panic bypass:
+        when EVERY admitted member is ejected, route on breaker admits
+        alone — an all-ejected rotation must not blackhole traffic."""
+        if not self.health.enabled:
+            return eligible
+        healthy = [eid for eid in eligible if self._health_admits(eid)]
+        return healthy or eligible
+
     def _pick_lb_edge(self) -> _EdgeRuntime | None:
         assert self.lb is not None
         edges = self.lb_out_edges
         if self.lb_weights is not None:
-            eligible = [eid for eid in edges if self._breaker_admits(eid)]
+            eligible = self._health_pool(
+                [eid for eid in edges if self._breaker_admits(eid)],
+            )
             if not eligible:
                 return None
             w = np.array([self.lb_weights.get(eid, 0.0) for eid in eligible])
@@ -746,7 +891,9 @@ class OracleEngine:
             pick = eligible[int(self.rng.choice(len(eligible), p=w / w.sum()))]
             return edges[pick]
         if self.lb.algorithms == LbAlgorithmsName.LEAST_CONNECTIONS:
-            eligible = [eid for eid in edges if self._breaker_admits(eid)]
+            eligible = self._health_pool(
+                [eid for eid in edges if self._breaker_admits(eid)],
+            )
             if not eligible:
                 return None
             best_id = min(eligible, key=lambda eid: edges[eid].concurrent)
@@ -754,55 +901,81 @@ class OracleEngine:
         # round robin: first ADMITTING edge in rotation order; only the
         # picked edge rotates to the tail (ineligible edges keep their
         # position — the breaker skips, it does not reorder)
+        if not self.health.enabled:
+            for eid in list(edges):
+                if self._breaker_admits(eid):
+                    edges.move_to_end(eid)
+                    return edges[eid]
+            return None
+        pool = set(
+            self._health_pool(
+                [eid for eid in list(edges) if self._breaker_admits(eid)],
+            ),
+        )
         for eid in list(edges):
-            if self._breaker_admits(eid):
+            if eid in pool:
                 edges.move_to_end(eid)
                 return edges[eid]
         return None
 
-    # breaker feedback (called by edges and servers; no-ops once the
-    # request's routing slot has reported)
+    # routing-outcome feedback (called by edges and servers; no-ops once
+    # the request's routing slot has reported) — ONE report feeds both
+    # outlier channels: the circuit breaker's consecutive-failure state
+    # machine and the LB health gate's EWMA (HealthScalars.observe)
 
     def breaker_failure(self, req: Request) -> None:
-        if self.breaker is None or req.lb_edge_id is None:
-            return
-        edge_id = req.lb_edge_id
-        st = self._breaker_st(edge_id)
-        req.lb_edge_id = None
-        now = self.sim.now
-        if req.probe:
-            req.probe = False
-            st["probes_out"] = max(0, st["probes_out"] - 1)
-            # a probe failure re-opens immediately
-            st["state"] = 1
-            st["open_until"] = now + self.breaker.cooldown_s
-            self._bk_rec(edge_id, 1, now)
-            return
-        if st["state"] == 0:
-            st["consec"] += 1
-            if st["consec"] >= self.breaker.failure_threshold:
-                st["state"] = 1
-                st["open_until"] = now + self.breaker.cooldown_s
-                st["consec"] = 0
-                self._bk_rec(edge_id, 1, now)
+        self._server_report(req, failed=True)
 
     def breaker_success(self, req: Request) -> None:
-        if self.breaker is None or req.lb_edge_id is None:
+        self._server_report(req, failed=False)
+
+    def _server_report(self, req: Request, *, failed: bool) -> None:
+        if req.lb_edge_id is None:
             return
         edge_id = req.lb_edge_id
-        st = self._breaker_st(edge_id)
         req.lb_edge_id = None
-        if req.probe:
-            req.probe = False
-            st["probes_out"] = max(0, st["probes_out"] - 1)
-            st["probe_ok"] += 1
-            if st["state"] == 2 and st["probe_ok"] >= self.breaker.half_open_probes:
-                st["state"] = 0
+        now = self.sim.now
+        if self.breaker is not None:
+            st = self._breaker_st(edge_id)
+            if failed:
+                if req.probe:
+                    req.probe = False
+                    st["probes_out"] = max(0, st["probes_out"] - 1)
+                    # a probe failure re-opens immediately
+                    st["state"] = 1
+                    st["open_until"] = now + self.breaker.cooldown_s
+                    self._bk_rec(edge_id, 1, now)
+                elif st["state"] == 0:
+                    st["consec"] += 1
+                    if st["consec"] >= self.breaker.failure_threshold:
+                        st["state"] = 1
+                        st["open_until"] = now + self.breaker.cooldown_s
+                        st["consec"] = 0
+                        self._bk_rec(edge_id, 1, now)
+            elif req.probe:
+                req.probe = False
+                st["probes_out"] = max(0, st["probes_out"] - 1)
+                st["probe_ok"] += 1
+                if (
+                    st["state"] == 2
+                    and st["probe_ok"] >= self.breaker.half_open_probes
+                ):
+                    st["state"] = 0
+                    st["consec"] = 0
+                    self._bk_rec(edge_id, 0, now)
+            elif st["state"] == 0:
                 st["consec"] = 0
-                self._bk_rec(edge_id, 0, self.sim.now)
-            return
-        if st["state"] == 0:
-            st["consec"] = 0
+        if self.health.enabled:
+            hs = self._health_st(edge_id)
+            h = self.health.observe(hs["h"], failed)
+            in_rotation = hs["until"] <= 0.0
+            hs["h"] = h
+            if in_rotation and h >= self.health.threshold:
+                # outlier ejection: out of rotation until the readmit
+                # lapse (in-flight reports to an ejected slot keep
+                # updating its EWMA without re-extending the ejection)
+                hs["until"] = now + self.health.readmit
+                self.lb_ejections += 1
 
     # ------------------------------------------------------------------
     # resilience: fault lookups + client retry/timeout/backoff
@@ -878,30 +1051,54 @@ class OracleEngine:
         fr = req.fr
         self._fr_rec(fr, FR_TIMEOUT, req.attempt, self.sim.now)
         req.fr = None
-        self._maybe_reissue(req, fr)
+        if self._maybe_reissue(req, fr) and req.hedge is not None:
+            # the backoff re-issue is one more live attempt of the SAME
+            # logical request (the orphan keeps draining on its own count)
+            req.hedge.live += 1
 
     def client_fail(self, req: Request) -> None:
         """A tracked attempt failed (drop / refusal / shed / abandon /
         outage) and the client notices at failure time: back off and
         re-issue, or give the logical request up.  Orphaned attempts are
-        already abandoned — their failures are silent."""
+        already abandoned — their failures are silent, as are hedge
+        duplicates (invisible to the retry ladder: a failed duplicate
+        just drops its anchor refcount)."""
+        group = req.hedge
+        if group is not None and (req.is_hedge or req.orphan or req.settled):
+            req.settled = True
+            self._hedge_release(req)
+            return
         if not self.retry.enabled:
+            if group is not None:
+                # no ladder: the primary's death ends ITS attempt only —
+                # outstanding duplicates may still win the race
+                req.settled = True
+                self._hedge_release(req)
             return
         if req.orphan or req.settled:
             req.settled = True
             return
         req.settled = True
-        self._maybe_reissue(req)
+        if not self._maybe_reissue(req) and group is not None:
+            self._hedge_release(req)
+        # on a re-issue the backoff attempt inherits this one's refcount
 
     def _maybe_reissue(
         self, req: Request, fr: FlightRecord | None = None,
-    ) -> None:
+    ) -> bool:
+        """Back off and re-issue ``req``'s logical request, or give it up.
+        Returns True when a re-issue was scheduled."""
         if fr is None:
             fr = req.fr
+        group = req.hedge
         if req.attempt >= self.retry.max_attempts or not self._retry_token():
             self._fr_rec(fr, FR_ABANDON, req.attempt, self.sim.now)
             self._record_attempts(req.attempt)
-            return
+            if group is not None:
+                # the client gave the logical request up: the race is over
+                # (late siblings dedup as losers; the timer disarms)
+                group.done = True
+            return False
         self.total_retries += 1
         self._fr_rec(fr, FR_RETRY, req.attempt, self.sim.now)
         delay = self._backoff(req.attempt)
@@ -913,7 +1110,13 @@ class OracleEngine:
                 initial_time=self.sim.now,
                 attempt=attempt,
                 fr=fr,
+                hedge=group,
             )
+            if group is not None and group.anchor is req:
+                # an in-place re-issue keeps the anchor identity (the jax
+                # engine re-parks the anchor slot): duplicates fired later
+                # copy the NEW attempt's start time and attempt number
+                group.anchor = new_req
             if self._entry_gen_id is not None:
                 new_req.record_hop(
                     SystemNodes.GENERATOR, self._entry_gen_id, self.sim.now,
@@ -922,6 +1125,84 @@ class OracleEngine:
             self.issue(new_req)
 
         self.sim.after(delay, reissue)
+        return True
+
+    # ------------------------------------------------------------------
+    # hedged requests (inert without a policy)
+    # ------------------------------------------------------------------
+
+    def _hedge_arm(self, req: Request) -> None:
+        """Attach the spawn's race state and start its hedge timer."""
+        group = _HedgeGroup(anchor=req)
+        req.hedge = group
+        self.sim.after(self.hedge.delay, lambda: self._hedge_fire(group))
+
+    def _hedge_fire(self, group: _HedgeGroup) -> None:
+        """The hedge timer fired: issue a speculative duplicate down the
+        entry chain without abandoning the original.  The duplicate
+        copies the anchor's identity — start time, attempt number — but
+        carries no client deadline (hedges are invisible to the retry
+        ladder) and records only FR_HEDGE: its transit noise stays out
+        of the flight record.  Re-arms one delay out until the
+        per-request budget is spent; stale timers (race won, every
+        attempt dead) just disarm."""
+        if group.done or group.live <= 0 or group.n >= self.hedge.max_hedges:
+            return
+        group.n += 1
+        ordinal = group.n
+        self.total_hedges += 1
+        anchor = group.anchor
+        self._fr_rec(anchor.fr, FR_HEDGE, ordinal, self.sim.now)
+        if ordinal < self.hedge.max_hedges:
+            self.sim.after(self.hedge.delay, lambda: self._hedge_fire(group))
+        dup = Request(
+            id=anchor.id,
+            initial_time=anchor.initial_time,
+            attempt=anchor.attempt,
+            hedge=group,
+            is_hedge=1,
+        )
+        group.live += 1
+        if self._entry_gen_id is not None:
+            dup.record_hop(
+                SystemNodes.GENERATOR, self._entry_gen_id, self.sim.now,
+            )
+        out = self._entry_out
+        assert out is not None
+        out.transport(dup)
+
+    def _hedge_release(self, req: Request) -> None:
+        """Attempt ``req`` drained: drop the race's live refcount.  At
+        zero the logical request is gone — hedging duplicates
+        OUTSTANDING work; it never resurrects a dead request."""
+        group = req.hedge
+        if group is None or req.hg_released:
+            return
+        req.hg_released = True
+        group.live -= 1
+        if group.live <= 0:
+            group.done = True
+
+    def hedge_checkpoint(self, req: Request) -> bool:
+        """Routing-boundary cancellation (``cancel_on_first`` only): True
+        when the arriving attempt lost an already-settled race and was
+        cancelled here instead of admitted.  A cancelled attempt vanishes
+        WITHOUT reporting to the breaker/health channels (its half-open
+        probe reservation is returned so the round isn't starved)."""
+        group = req.hedge
+        if group is None or not self.hedge.cancel or not group.done:
+            return False
+        self._fr_rec(group.anchor.fr, FR_CANCEL, req.is_hedge, self.sim.now)
+        if req.probe and req.lb_edge_id is not None:
+            st = self._breaker_st(req.lb_edge_id)
+            st["probes_out"] = max(0, st["probes_out"] - 1)
+        req.probe = False
+        req.lb_edge_id = None
+        req.finish_time = self.sim.now
+        req.settled = True
+        self.hedges_cancelled += 1
+        self._hedge_release(req)
+        return True
 
     # ------------------------------------------------------------------
     # event injection
@@ -1080,4 +1361,9 @@ class OracleEngine:
             attempts_hist=(
                 self.attempts_hist.copy() if self.retry.enabled else None
             ),
+            total_hedges=self.total_hedges,
+            hedges_won=self.hedges_won,
+            hedges_cancelled=self.hedges_cancelled,
+            lb_ejections=self.lb_ejections,
+            degraded_completions=self.degraded_completions,
         )

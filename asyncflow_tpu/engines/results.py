@@ -30,6 +30,15 @@ class DeviceCounters:
     Goodput is ``completed``; offered load is ``generated + retries``.
     ``quarantined`` counts scenarios masked out by host-fault recovery
     (sweeps only; docs/guides/fault-tolerance.md).
+
+    The tail-tolerance counters (0 without the matching policy): ``hedges``
+    duplicate attempts issued by the hedge timer, ``hedges_won`` logical
+    requests whose *winning* completion was a hedge duplicate,
+    ``hedges_cancelled`` attempts cancelled at a routing boundary because a
+    sibling already won, ``ejections`` LB health-gate ejection episodes, and
+    ``degraded`` completions served under a server brownout profile.
+    Hedge duplicates are NOT spawns: offered load stays
+    ``generated + retries``; ``hedges`` measures the extra work injected.
     """
 
     completed: int
@@ -42,6 +51,11 @@ class DeviceCounters:
     retries: int = 0
     budget_exhausted: int = 0
     quarantined: int = 0
+    hedges: int = 0
+    hedges_won: int = 0
+    hedges_cancelled: int = 0
+    ejections: int = 0
+    degraded: int = 0
 
     def as_dict(self) -> dict[str, int]:
         return asdict(self)
@@ -97,6 +111,15 @@ class SimulationResults:
     total_retries: int = 0
     retry_budget_exhausted: int = 0
     attempts_hist: np.ndarray | None = None
+    #: tail-tolerance counters (0 without the matching policy): hedge
+    #: duplicates issued / logical requests won by a hedge / attempts
+    #: cancelled after losing the sibling race; LB health-gate ejection
+    #: episodes; completions served under a brownout profile.
+    total_hedges: int = 0
+    hedges_won: int = 0
+    hedges_cancelled: int = 0
+    lb_ejections: int = 0
+    degraded_completions: int = 0
 
     @property
     def latencies(self) -> np.ndarray:
@@ -122,6 +145,11 @@ class SimulationResults:
             timed_out=int(self.total_timed_out),
             retries=int(self.total_retries),
             budget_exhausted=int(self.retry_budget_exhausted),
+            hedges=int(self.total_hedges),
+            hedges_won=int(self.hedges_won),
+            hedges_cancelled=int(self.hedges_cancelled),
+            ejections=int(self.lb_ejections),
+            degraded=int(self.degraded_completions),
         )
 
 
@@ -179,6 +207,15 @@ class SweepResults:
     total_retries: np.ndarray | None = None
     retry_budget_exhausted: np.ndarray | None = None
     attempts_hist: np.ndarray | None = None
+    #: (S,) tail-tolerance counters (event engine on plans with the matching
+    #: policy; None otherwise — such plans are fenced off the fast path):
+    #: hedge duplicates issued / won / cancelled, LB health-gate ejection
+    #: episodes, and completions served under a brownout profile.
+    total_hedges: np.ndarray | None = None
+    hedges_won: np.ndarray | None = None
+    hedges_cancelled: np.ndarray | None = None
+    lb_ejections: np.ndarray | None = None
+    degraded_completions: np.ndarray | None = None
     #: flight-recorder ring buffers (event-engine sweeps with a
     #: ``trace=TraceConfig``; None otherwise): ``(S, K, slots)`` lifecycle
     #: codes / node indices / sim timestamps and the ``(S, K)`` event
@@ -265,6 +302,29 @@ class SweepResults:
                 if self.attempts_hist is not None
                 else None
             ),
+            total_hedges=(
+                self.total_hedges[idx]
+                if self.total_hedges is not None
+                else None
+            ),
+            hedges_won=(
+                self.hedges_won[idx] if self.hedges_won is not None else None
+            ),
+            hedges_cancelled=(
+                self.hedges_cancelled[idx]
+                if self.hedges_cancelled is not None
+                else None
+            ),
+            lb_ejections=(
+                self.lb_ejections[idx]
+                if self.lb_ejections is not None
+                else None
+            ),
+            degraded_completions=(
+                self.degraded_completions[idx]
+                if self.degraded_completions is not None
+                else None
+            ),
             llm_cost_sum=(
                 self.llm_cost_sum[idx] if self.llm_cost_sum is not None else None
             ),
@@ -324,6 +384,31 @@ class SweepResults:
                 else 0
             ),
             quarantined=self.n_quarantined,
+            hedges=(
+                int(np.sum(self.total_hedges))
+                if self.total_hedges is not None
+                else 0
+            ),
+            hedges_won=(
+                int(np.sum(self.hedges_won))
+                if self.hedges_won is not None
+                else 0
+            ),
+            hedges_cancelled=(
+                int(np.sum(self.hedges_cancelled))
+                if self.hedges_cancelled is not None
+                else 0
+            ),
+            ejections=(
+                int(np.sum(self.lb_ejections))
+                if self.lb_ejections is not None
+                else 0
+            ),
+            degraded=(
+                int(np.sum(self.degraded_completions))
+                if self.degraded_completions is not None
+                else 0
+            ),
         )
 
 

@@ -55,6 +55,8 @@ FR_REJECT = 12  #: refused (outage, rate limit, socket cap, shed, abandon,
 #: fully-open breaker rotation, pool overflow)
 FR_COMPLETE = 13  #: delivered back to the client — the request is done
 FR_ABANDON = 14  #: client gave the logical request up (node = last attempt)
+FR_HEDGE = 15  #: hedge timer fired — a duplicate issued (node = hedge ordinal)
+FR_CANCEL = 16  #: attempt cancelled en route (its sibling won the race)
 
 FR_NAMES: dict[int, str] = {
     FR_SPAWN: "spawn",
@@ -71,6 +73,8 @@ FR_NAMES: dict[int, str] = {
     FR_REJECT: "reject",
     FR_COMPLETE: "complete",
     FR_ABANDON: "abandon",
+    FR_HEDGE: "hedge",
+    FR_CANCEL: "cancel",
 }
 
 #: codes whose ``node`` field is an edge index
@@ -136,6 +140,8 @@ class FlightRecord:
                 comp = f" {server_ids[node]}"
             elif code in (FR_RETRY, FR_TIMEOUT, FR_ABANDON):
                 comp = f" attempt={node}"
+            elif code == FR_HEDGE:
+                comp = f" hedge={node}"
             elif node >= 0:
                 comp = f" #{node}"
             out.append(f"t={t:.6f}s {name}{comp}")

@@ -76,6 +76,9 @@ def make_overrides(
     req_per_minute: np.ndarray | None = None,
     fault_shift: np.ndarray | None = None,
     retry_timeout: np.ndarray | None = None,
+    hedge_delay: np.ndarray | None = None,
+    brownout_threshold: np.ndarray | None = None,
+    ejection_threshold: np.ndarray | None = None,
 ) -> ScenarioOverrides:
     """Per-scenario parameter overrides; every scale is (S,) or (S, NE).
 
@@ -87,7 +90,13 @@ def make_overrides(
     plan's); shifted times clip at 0 and the leading identity row stays
     pinned at t = 0.  ``retry_timeout``: (S,) per-scenario client request
     timeouts.  Both require the base plan to model faults / a retry
-    policy — the lowered tables they perturb must exist."""
+    policy — the lowered tables they perturb must exist.
+
+    Tail-tolerance axes (same rule — the base plan must compile the
+    subsystem in): ``hedge_delay``: (S,) per-scenario hedge timer delays;
+    ``brownout_threshold``: (S,) or (S, NS) per-scenario brownout
+    ready-queue thresholds; ``ejection_threshold``: (S,) per-scenario LB
+    health-gate ejection thresholds."""
     base = base_overrides(plan)
     if fault_shift is not None and not plan.has_faults:
         msg = (
@@ -101,6 +110,27 @@ def make_overrides(
             "retry_timeout overrides need a retry_policy in the payload: "
             "the retry machinery is compiled in only when the base plan "
             "models it"
+        )
+        raise ValueError(msg)
+    if hedge_delay is not None and not plan.has_hedge:
+        msg = (
+            "hedge_delay overrides need a hedge_policy in the payload: "
+            "the hedge machinery is compiled in only when the base plan "
+            "models it"
+        )
+        raise ValueError(msg)
+    if brownout_threshold is not None and not plan.has_brownout:
+        msg = (
+            "brownout_threshold overrides need a brownout_queue_threshold "
+            "on at least one server's overload policy: the degraded-mode "
+            "machinery is compiled in only when the base plan models it"
+        )
+        raise ValueError(msg)
+    if ejection_threshold is not None and not plan.has_health:
+        msg = (
+            "ejection_threshold overrides need a health policy on the "
+            "load balancer: the health gate is compiled in only when the "
+            "base plan models it"
         )
         raise ValueError(msg)
     g = plan.n_generators
@@ -170,7 +200,53 @@ def make_overrides(
             if retry_timeout is None
             else jnp.asarray(retry_timeout, jnp.float32)
         ),
+        hedge_delay=(
+            base.hedge_delay
+            if hedge_delay is None
+            else _scenario_axis(hedge_delay, "hedge_delay", n_scenarios)
+        ),
+        health_threshold=(
+            base.health_threshold
+            if ejection_threshold is None
+            else _scenario_axis(
+                ejection_threshold, "ejection_threshold", n_scenarios,
+            )
+        ),
+        brownout_q=(
+            base.brownout_q
+            if brownout_threshold is None
+            else _brownout_axis(
+                brownout_threshold, n_scenarios, base.brownout_q,
+            )
+        ),
     )
+
+
+def _scenario_axis(arr, name: str, n_scenarios: int) -> jnp.ndarray:
+    arr = jnp.asarray(arr, jnp.float32)
+    if arr.shape != (n_scenarios,):
+        msg = f"{name} must have shape ({n_scenarios},), got {arr.shape}"
+        raise ValueError(msg)
+    return arr
+
+
+def _brownout_axis(arr, n_scenarios: int, base_q: jnp.ndarray) -> jnp.ndarray:
+    """(S,) broadcasts one threshold across servers; (S, NS) is per-server.
+
+    Servers the BASE plan leaves unconfigured (threshold < 0) stay
+    unconfigured: the override moves the knee, it cannot conjure the
+    degraded profile's cost factors."""
+    arr = jnp.asarray(arr, jnp.float32)
+    ns = base_q.shape[0]
+    if arr.ndim == 1:
+        arr = jnp.broadcast_to(arr[:, None], (arr.shape[0], ns))
+    if arr.shape != (n_scenarios, ns):
+        msg = (
+            f"brownout_threshold must have shape ({n_scenarios},) or "
+            f"({n_scenarios}, {ns}), got {arr.shape}"
+        )
+        raise ValueError(msg)
+    return jnp.where(base_q[None, :] < 0.0, base_q[None, :], arr)
 
 
 def _gauge_index(plan: StaticPlan, metric: str, component_id: str) -> int:
@@ -693,9 +769,14 @@ class SweepRunner:
         # them at compile time (fastpath_reason), and the native C++ core
         # and Pallas VMEM kernel do not carry the machinery yet — forcing
         # them is an explicit error, never a silent mis-model.
-        resilient = self.plan.has_faults or self.plan.has_retry
-        if resilient and engine in ("native", "pallas"):
+        tail = getattr(self.plan, "has_tail_tolerance", False)
+        if (self.plan.has_faults or self.plan.has_retry) and engine in (
+            "native", "pallas",
+        ):
             raise_fence(f"resilience.{engine}")
+        if tail and engine in ("native", "pallas"):
+            raise_fence(f"tail_tolerance.{engine}")
+        resilient = self.plan.has_faults or self.plan.has_retry or tail
         if engine == "native":
             # the single-core C++ oracle, looped over the scenario grid:
             # no batching, but the lowest per-scenario constant of any
@@ -749,8 +830,8 @@ class SweepRunner:
             # the VMEM kernel models the round-5 event-engine feature set
             # (overload policies, circuit breakers, DB pools, cache
             # mixtures, LLM dynamics, weighted endpoints, multi-generator
-            # workloads) but NOT fault windows / client retries — those
-            # route to the XLA event engine
+            # workloads) but NOT fault windows / client retries / the
+            # tail-tolerance policies — those route to the XLA event engine
         ):
             from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
 
@@ -1983,6 +2064,27 @@ def _guard_resilience_overrides(
                     "overrides only move their timings"
                 )
                 raise _FastpathOverrideError(msg)
+    for flag, name, base_val, why in (
+        (plan.has_hedge, "hedge_delay", plan.hedge_delay,
+         "a hedge_policy in the payload"),
+        (plan.has_health, "health_threshold", plan.health_threshold,
+         "a health policy on the load balancer"),
+        (plan.has_brownout, "brownout_q", plan.server_brownout_q,
+         "a brownout_queue_threshold on a server's overload policy"),
+    ):
+        if flag:
+            continue
+        ov_arr = getattr(overrides, name, None)
+        if ov_arr is None:
+            continue
+        ov_arr = np.asarray(ov_arr)
+        if not np.allclose(ov_arr, np.asarray(base_val)):
+            msg = (
+                f"{name} overrides need {why}: the tail-tolerance "
+                "machinery is compiled in only when the base plan "
+                "models it"
+            )
+            raise _FastpathOverrideError(msg)
 
 
 def _mean_ci(values: np.ndarray, level: float) -> tuple[float, float, float]:

@@ -27,6 +27,7 @@ from asyncflow_tpu.config.constants import (
     SystemNodes,
 )
 from asyncflow_tpu.schemas.endpoint import Endpoint
+from asyncflow_tpu.schemas.resilience import LbHealthPolicy
 
 
 def _fixed_type(expected: SystemNodes):
@@ -101,6 +102,15 @@ class OverloadPolicy(BaseModel):
     dequeue-time deadlines (the semantics of an executor that checks a
     task's deadline when popping it), not mid-queue reneging: expired
     waiters still occupy ready-queue slots until popped.
+
+    ``brownout_queue_threshold`` (+ ``brownout_cpu_factor`` /
+    ``brownout_ram_factor``): graceful degradation instead of loss.  An
+    arrival that finds at least that many CPU ready-queue waiters parked
+    is served a *cheaper* profile — its CPU step durations scaled by
+    ``brownout_cpu_factor`` and its RAM demand by ``brownout_ram_factor``
+    — and its completion is flagged ``degraded`` instead of being shed.
+    The decision is per-request at endpoint start; pressure dropping
+    below the threshold restores the full profile for later arrivals.
     """
 
     model_config = ConfigDict(extra="forbid")
@@ -110,11 +120,26 @@ class OverloadPolicy(BaseModel):
     rate_limit_rps: PositiveFloat | None = None
     rate_limit_burst: PositiveInt | None = None
     queue_timeout_s: PositiveFloat | None = None
+    brownout_queue_threshold: PositiveInt | None = None
+    brownout_cpu_factor: float = Field(default=1.0, gt=0.0, le=1.0)
+    brownout_ram_factor: float = Field(default=1.0, gt=0.0, le=1.0)
 
     @model_validator(mode="after")
     def _burst_needs_rate(self) -> OverloadPolicy:
         if self.rate_limit_burst is not None and self.rate_limit_rps is None:
             msg = "rate_limit_burst requires rate_limit_rps"
+            raise ValueError(msg)
+        return self
+
+    @model_validator(mode="after")
+    def _brownout_factors_need_threshold(self) -> OverloadPolicy:
+        if self.brownout_queue_threshold is None and (
+            self.brownout_cpu_factor != 1.0 or self.brownout_ram_factor != 1.0
+        ):
+            msg = (
+                "brownout_cpu_factor/brownout_ram_factor require "
+                "brownout_queue_threshold"
+            )
             raise ValueError(msg)
         return self
 
@@ -178,6 +203,9 @@ class LoadBalancer(BaseModel):
     server_covered: set[str] = Field(default_factory=set)
     #: optional per-target circuit breaker (reference roadmap milestone 5)
     circuit_breaker: CircuitBreaker | None = None
+    #: optional EWMA health signal + outlier ejection per target
+    #: (tail-tolerance family; see schemas/resilience.py)
+    health: LbHealthPolicy | None = None
 
     _check_type = field_validator("type", mode="after")(
         _fixed_type(SystemNodes.LOAD_BALANCER),

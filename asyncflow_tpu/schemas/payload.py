@@ -14,7 +14,11 @@ from pydantic import BaseModel, field_validator, model_validator
 from asyncflow_tpu.config.constants import EventDescription, FaultKind
 from asyncflow_tpu.schemas.events import EventInjection
 from asyncflow_tpu.schemas.graph import TopologyGraph
-from asyncflow_tpu.schemas.resilience import FaultTimeline, RetryPolicy
+from asyncflow_tpu.schemas.resilience import (
+    FaultTimeline,
+    HedgePolicy,
+    RetryPolicy,
+)
 from asyncflow_tpu.schemas.settings import SimulationSettings
 from asyncflow_tpu.schemas.workload import RqsGenerator
 
@@ -59,6 +63,9 @@ class SimulationPayload(BaseModel):
     retry_policy: RetryPolicy | None = None
     #: scheduled fault windows (server outages, edge degradation/partition)
     fault_timeline: FaultTimeline | None = None
+    #: client-side hedged (speculative) duplicate attempts against tail
+    #: latency (tail-tolerance family; see schemas/resilience.py)
+    hedge_policy: HedgePolicy | None = None
 
     @property
     def generators(self) -> list[RqsGenerator]:
@@ -120,6 +127,18 @@ class SimulationPayload(BaseModel):
                 "yet: re-issues would need per-request entry-chain state; "
                 "model the superposition as one generator or drop the "
                 "retry policy"
+            )
+            raise ValueError(msg)
+        return self
+
+    @model_validator(mode="after")
+    def _hedge_policy_single_generator(self) -> SimulationPayload:
+        if self.hedge_policy is not None and len(self.generators) > 1:
+            msg = (
+                "hedge_policy with multiple generators is not supported "
+                "yet: duplicates would need per-request entry-chain "
+                "state; model the superposition as one generator or drop "
+                "the hedge policy"
             )
             raise ValueError(msg)
         return self

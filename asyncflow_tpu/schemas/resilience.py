@@ -14,6 +14,14 @@ modes serving studies actually sweep over:
   (``server_outage``), an edge degrades (``edge_degrade``: latency
   multiplied, dropout boosted), or an edge partitions entirely
   (``edge_partition``: every send dropped).
+- :class:`HedgePolicy` — the client's *tail-tolerance* side: speculative
+  duplicate attempts after a hedge delay, first completion wins, losers
+  cancelled at routing boundaries (the BASE/Dynamo "hedged request"
+  discipline).  Attached via ``SimulationPayload.hedge_policy``.
+- :class:`LbHealthPolicy` — the load balancer's per-target EWMA failure
+  signal + outlier ejection, independent of the circuit breaker's state
+  machine (the Envoy outlier-detection discipline).  Attached via
+  ``LoadBalancer.health``.
 
 Unlike the legacy ``server_down`` event (a graceful drain: the LB stops
 routing to the server), a ``server_outage`` fault refuses requests that
@@ -91,6 +99,77 @@ class RetryPolicy(BaseModel):
             float(self.backoff_cap_s),
             float(self.backoff_base_s) * float(self.backoff_multiplier) ** k,
         )
+
+
+class HedgePolicy(BaseModel):
+    """Client-side hedged (speculative) requests against tail latency.
+
+    Semantics (identical across the oracle and the JAX event engine):
+
+    - every logical request arms a hedge timer at issue time; if it has
+      not completed after ``hedge_delay_s`` the client issues a duplicate
+      attempt *without abandoning the original* — both race through the
+      topology (round-robin/least-connections routing naturally lands the
+      duplicate on a different LB target);
+    - up to ``max_hedges`` duplicates per logical request, each
+      ``hedge_delay_s`` after the previous one while no attempt has won;
+    - the first attempt to complete wins: goodput and latency dedup to
+      the logical request (one completion, measured from the original
+      issue time) and ``hedges_won`` counts wins by a duplicate;
+    - with ``cancel_on_first`` the losing siblings are cancelled at the
+      next routing boundary (LB arrival or server admission) —
+      work already admitted to a server runs to completion as an orphan,
+      modeling non-cancellable backends; with ``cancel_on_first=False``
+      losers always run to completion and only the dedup applies;
+    - hedge duplicates are invisible to the retry ladder: the retry
+      timeout/backoff discipline governs the primary attempt only, and a
+      hedge that fails (edge drop, refusal) dies silently — it still
+      feeds the breaker/health failure channels, but never re-issues.
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    hedge_delay_s: PositiveFloat
+    max_hedges: int = Field(
+        default=1,
+        ge=1,
+        le=4,
+        description="Maximum speculative duplicates per logical request.",
+    )
+    cancel_on_first: bool = True
+
+
+class LbHealthPolicy(BaseModel):
+    """Per-target EWMA health signal + outlier ejection on the LB.
+
+    Each LB out-edge carries an exponentially-weighted failure rate
+    ``h <- (1 - ewma_alpha) * h + ewma_alpha * x`` updated once per routed
+    request at its first failure (edge drop, outage refusal, shed,
+    rate-limit/socket refusal, deadline abandon; ``x = 1``) or its server
+    departure (``x = 0``).  When ``h`` crosses ``ejection_threshold`` the
+    target is ejected from the rotation for ``readmit_s`` seconds, then
+    readmitted with a reset signal (``h = 0``).  Ejection is independent
+    of the circuit breaker's consecutive-failure state machine — the two
+    compose, and a *panic bypass* keeps traffic flowing: when every
+    breaker-admitted target is health-ejected, health gating is ignored
+    for that pick (the Envoy panic-threshold discipline).
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    ewma_alpha: float = Field(
+        default=0.3,
+        gt=0.0,
+        le=1.0,
+        description="EWMA smoothing weight of the newest observation.",
+    )
+    ejection_threshold: float = Field(
+        default=0.5,
+        gt=0.0,
+        lt=1.0,
+        description="EWMA failure rate at/above which the target is ejected.",
+    )
+    readmit_s: PositiveFloat = 10.0
 
 
 class FaultEvent(BaseModel):

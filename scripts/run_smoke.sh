@@ -98,6 +98,26 @@ print("sim-trace schema OK")
 PY
 python -m asyncflow_tpu.observability.diverge \
   examples/yaml_input/data/trace_parity.yml --mode flight --seed 0
+# tail-tolerance slice: hedged requests + LB health gating + brownout must
+# stay deterministic across engines, refuse the fastpath, and keep the
+# hedge lifecycle visible to the flight recorder; the checker must bless
+# the shipped example (exit 0) and reject the self-defeating hedge fixture
+# whose timer sits above the client deadline (exit 2: AF305) —
+# docs/guides/resilience.md §"Tail tolerance"
+python -m pytest \
+  tests/parity/test_tail_tolerance.py::test_seed_determinism_bit_identical \
+  tests/parity/test_tail_tolerance.py::test_fastpath_refuses_tail_tolerance_plans \
+  tests/parity/test_tail_tolerance.py::test_hedge_lifecycle_spans_match \
+  -q -p no:cacheprovider
+python -m asyncflow_tpu.checker examples/yaml_input/data/hedge_tail.yml \
+  --backend cpu
+rc=0
+python -m asyncflow_tpu.checker tests/integration/data/hedge_self_defeating.yml \
+  --backend cpu > /dev/null || rc=$?
+if [ "$rc" -ne 2 ]; then
+  echo "checker exit $rc on the self-defeating hedge fixture (expected 2: AF305)" >&2
+  exit 1
+fi
 # static-checker slice: the repo must lint clean under the invariant AST
 # rules, the preflight CLI must pass a shipped example (exit 0) and call
 # a deliberately saturated scenario (exit 2) — docs/guides/diagnostics.md

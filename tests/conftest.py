@@ -179,6 +179,12 @@ _SMOKE_TESTS = (
     "tests/parity/test_flight_recorder.py::TestDisabledBitIdentity::test_event_engine_pre_trace_golden",
     "tests/parity/test_flight_recorder.py::TestSpanEquality::test_zero_divergence_on_parity_scenario",
     "tests/parity/test_flight_recorder.py::TestRefusals::test_sweep_auto_routes_traced_sweeps_to_event",
+    # tail-tolerance tier (hedged requests / LB health gating / brownout):
+    # cross-engine determinism, the fastpath refusal contract, and the
+    # deterministic hedge-lifecycle flight-recorder span equality
+    "tests/parity/test_tail_tolerance.py::test_seed_determinism_bit_identical",
+    "tests/parity/test_tail_tolerance.py::test_fastpath_refuses_tail_tolerance_plans",
+    "tests/parity/test_tail_tolerance.py::test_hedge_lifecycle_spans_match",
 )
 
 

@@ -1,0 +1,465 @@
+"""Tail-tolerance parity: hedged requests, LB health gating, brownout.
+
+Semantics under test (``schemas/resilience.py`` / ``schemas/nodes.py``;
+lowered by ``compiler/faults.py``; modeled by the oracle and the jax
+event engine):
+
+- a ``hedge_policy`` races up to ``max_hedges`` speculative duplicates
+  against a slow primary; the first arrival wins, losers are deduped at
+  the client (or cancelled at routing boundaries with
+  ``cancel_on_first``) — hedges are invisible to the retry ladder;
+- an LB ``health`` policy tracks a per-target failure EWMA and ejects
+  outliers from the rotation for ``readmit_s``, independent of the
+  circuit breaker, with a panic bypass when every target is unhealthy;
+- a server ``brownout_queue_threshold`` latches arrivals into a degraded
+  (cheaper) profile while the ready queue is deep: CPU steps scale by
+  ``brownout_cpu_factor``, RAM needs by ``brownout_ram_factor``.
+
+The two engines draw from different RNG families, so parity is
+distributional (rates within tolerances over a seed ensemble); seed
+determinism within one engine is bit-exact, and the hedge lifecycle
+canonicalizes to identical flight-recorder spans on the deterministic
+parity scenario.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import yaml
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.engines.jaxsim.engine import (
+    Engine,
+    run_single,
+    scenario_keys,
+)
+from asyncflow_tpu.engines.oracle.engine import OracleEngine
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+pytestmark = pytest.mark.integration
+
+BASE = "tests/integration/data/single_server.yml"
+LB = "examples/yaml_input/data/two_servers_lb.yml"
+PARITY = "examples/yaml_input/data/trace_parity.yml"
+SEEDS = 6
+
+
+def _payload(mut, base: str = BASE, horizon: int = 120) -> SimulationPayload:
+    data = yaml.safe_load(open(base).read())
+    data["sim_settings"]["total_simulation_time"] = horizon
+    mut(data)
+    return SimulationPayload.model_validate(data)
+
+
+def _oracle_stats(payload, n=SEEDS):
+    agg = dict.fromkeys(
+        ("gen", "done", "hedges", "won", "cancelled", "ejections",
+         "degraded", "rejected"),
+        0,
+    )
+    lats = []
+    for s in range(n):
+        r = OracleEngine(payload, seed=s).run()
+        agg["gen"] += r.total_generated
+        agg["done"] += len(r.rqs_clock)
+        agg["hedges"] += r.total_hedges
+        agg["won"] += r.hedges_won
+        agg["cancelled"] += r.hedges_cancelled
+        agg["ejections"] += r.lb_ejections
+        agg["degraded"] += r.degraded_completions
+        agg["rejected"] += r.total_rejected
+        lats.append(r.latencies)
+    return agg, np.concatenate(lats)
+
+
+def _event_stats(payload, n=SEEDS):
+    """One compiled batched event engine for all n seeds (the per-seed
+    run_single path would recompile the kernel n times)."""
+    plan = compile_payload(payload)
+    engine = Engine(plan, collect_clocks=True)
+    fin = engine.run_batch(scenario_keys(11, n))
+    clock = np.asarray(fin.clock)
+    cnt = np.asarray(fin.clock_n)
+    lats = np.concatenate(
+        [clock[i, : cnt[i], 1] - clock[i, : cnt[i], 0] for i in range(n)],
+    )
+
+    def _sum(name: str) -> int:
+        arr = getattr(fin, name, None)
+        return int(np.sum(np.asarray(arr))) if arr is not None else 0
+
+    agg = {
+        "gen": _sum("n_generated"),
+        "done": int(np.sum(cnt)),
+        "hedges": _sum("n_hedges") if plan.has_hedge else 0,
+        "won": _sum("n_hedges_won") if plan.has_hedge else 0,
+        "cancelled": _sum("n_hedges_cancelled") if plan.has_hedge else 0,
+        "ejections": _sum("n_ejections") if plan.has_health else 0,
+        "degraded": _sum("n_degraded") if plan.has_brownout else 0,
+        "rejected": _sum("n_rejected"),
+    }
+    return agg, lats
+
+
+def _assert_rates(name, a, b, *, frac_tol=0.04, lat_tol=0.08):
+    agg_a, lat_a = a
+    agg_b, lat_b = b
+    gen_a, gen_b = max(agg_a["gen"], 1), max(agg_b["gen"], 1)
+    for label in ("done", "hedges", "won", "cancelled", "degraded",
+                  "rejected"):
+        fa, fb = agg_a[label] / gen_a, agg_b[label] / gen_b
+        assert abs(fa - fb) < frac_tol, (name, label, fa, fb)
+    if lat_a.size and lat_b.size:
+        p95_a = np.percentile(lat_a, 95)
+        p95_b = np.percentile(lat_b, 95)
+        assert abs(p95_a - p95_b) <= lat_tol * max(p95_a, p95_b, 1e-9), (
+            name, "p95", p95_a, p95_b,
+        )
+
+
+# ---------------------------------------------------------------------------
+# scenario mutators
+# ---------------------------------------------------------------------------
+
+
+def _hedged(data) -> None:
+    """Hedge against the exponential edge tail: the typical round trip is
+    ~19 ms (11 ms deterministic service + exponential edges), so a 12 ms
+    delay fires on nearly every request and the duplicate's re-rolled
+    edge draws decide the race."""
+    data["hedge_policy"] = {
+        "hedge_delay_s": 0.012,
+        "max_hedges": 2,
+        "cancel_on_first": True,
+    }
+
+
+def _hedged_composed(data) -> None:
+    """Hedges + retries + a mid-run degrade window: every resilience
+    subsystem active at once (the composition parity gate)."""
+    _hedged(data)
+    # tight enough that the degrade window's x4 latency times attempts out
+    data["retry_policy"] = {
+        "request_timeout_s": 0.06,
+        "max_attempts": 3,
+        "backoff_base_s": 0.05,
+        "backoff_multiplier": 2.0,
+        "backoff_cap_s": 0.5,
+    }
+    data["fault_timeline"] = {
+        "events": [
+            {
+                "fault_id": "slow-patch",
+                "kind": "edge_degrade",
+                "target_id": "client-srv",
+                "t_start": 30.0,
+                "t_end": 80.0,
+                "latency_factor": 4.0,
+            },
+        ],
+    }
+
+
+def _health_gated(data) -> None:
+    """Mid-run outage on one LB-covered server with ONLY the health gate
+    (no breaker): the EWMA must eject the dark target and lazily readmit
+    it after the window."""
+    data["rqs_input"]["avg_active_users"]["mean"] = 60
+    data["topology_graph"]["nodes"]["load_balancer"]["health"] = {
+        "ewma_alpha": 0.3,
+        "ejection_threshold": 0.5,
+        "readmit_s": 5.0,
+    }
+    data["fault_timeline"] = {
+        "events": [
+            {
+                "fault_id": "srv2-crash",
+                "kind": "server_outage",
+                "target_id": "srv-2",
+                "t_start": 30.0,
+                "t_end": 80.0,
+            },
+        ],
+    }
+
+
+def _brownout(data) -> None:
+    """Service slow enough that the ready queue builds; the brownout knee
+    flips deep-queue arrivals onto the cheap profile."""
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    for ep in srv["endpoints"]:
+        for step in ep["steps"]:
+            if "cpu_time" in step.get("step_operation", {}):
+                step["step_operation"]["cpu_time"] = 0.03
+    srv["overload"] = {
+        "brownout_queue_threshold": 2,
+        "brownout_cpu_factor": 0.25,
+    }
+
+
+# ---------------------------------------------------------------------------
+# oracle <-> jax event engine parity (each policy alone, then composed)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_hedge_parity() -> None:
+    payload = _payload(_hedged)
+    a = _oracle_stats(payload)
+    b = _event_stats(payload)
+    # the policy must actually bite: hedges fire and some win/cancel
+    assert a[0]["hedges"] > 0 and b[0]["hedges"] > 0
+    assert a[0]["won"] > 0 and b[0]["won"] > 0
+    assert a[0]["cancelled"] > 0 and b[0]["cancelled"] > 0
+    _assert_rates("hedge", a, b)
+
+
+@pytest.mark.slow
+def test_health_failover_parity() -> None:
+    payload = _payload(_health_gated, base=LB)
+    a = _oracle_stats(payload)
+    b = _event_stats(payload)
+    assert a[0]["ejections"] > 0 and b[0]["ejections"] > 0
+    _assert_rates("health-failover", a, b)
+    # ejection counts are small integers (readmit cycles over one outage
+    # window): compare magnitudes, not fractions of traffic
+    assert abs(a[0]["ejections"] - b[0]["ejections"]) <= max(
+        4, 0.8 * min(a[0]["ejections"], b[0]["ejections"]),
+    ), (a[0]["ejections"], b[0]["ejections"])
+
+
+@pytest.mark.slow
+def test_brownout_parity() -> None:
+    payload = _payload(_brownout)
+    a = _oracle_stats(payload)
+    b = _event_stats(payload)
+    assert a[0]["degraded"] > 0 and b[0]["degraded"] > 0
+    _assert_rates("brownout", a, b)
+
+
+@pytest.mark.slow
+def test_hedge_composed_with_retry_and_faults_parity() -> None:
+    payload = _payload(_hedged_composed)
+    a = _oracle_stats(payload)
+    b = _event_stats(payload)
+    assert a[0]["hedges"] > 0 and b[0]["hedges"] > 0
+    _assert_rates("hedge+retry+fault", a, b)
+
+
+# ---------------------------------------------------------------------------
+# determinism + routing contracts
+# ---------------------------------------------------------------------------
+
+
+def test_seed_determinism_bit_identical() -> None:
+    """Two runs with identical seeds produce bit-identical hedge/health/
+    brownout counters on BOTH engines."""
+    def mut(data):
+        _hedged(data)
+        _brownout(data)
+
+    payload = _payload(mut, horizon=60)
+    r1 = OracleEngine(payload, seed=13).run()
+    r2 = OracleEngine(payload, seed=13).run()
+    assert np.array_equal(r1.rqs_clock, r2.rqs_clock)
+    assert r1.counters().as_dict() == r2.counters().as_dict()
+    assert r1.total_hedges == r2.total_hedges
+    assert r1.degraded_completions == r2.degraded_completions
+    j1 = run_single(payload, seed=13, engine="event")
+    j2 = run_single(payload, seed=13, engine="event")
+    assert np.array_equal(j1.rqs_clock, j2.rqs_clock)
+    assert j1.counters().as_dict() == j2.counters().as_dict()
+
+
+def test_fastpath_refuses_tail_tolerance_plans() -> None:
+    plan = compile_payload(_payload(_hedged, horizon=30))
+    assert plan.has_hedge and plan.has_tail_tolerance
+    assert not plan.fastpath_ok
+
+    from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+
+    with pytest.raises(ValueError, match="not eligible"):
+        FastEngine(plan)
+
+
+def test_predict_routing_matches_dispatch() -> None:
+    """The static prediction and the runtime SweepRunner dispatch must
+    agree fence-for-fence on tail-tolerance plans (the registry contract:
+    the preflight quotes exactly what the constructor raises)."""
+    from asyncflow_tpu.checker.fences import predict_routing
+    from asyncflow_tpu.parallel import SweepRunner
+
+    def _health_only(data):
+        data["topology_graph"]["nodes"]["load_balancer"]["health"] = {
+            "ewma_alpha": 0.3,
+            "ejection_threshold": 0.5,
+            "readmit_s": 5.0,
+        }
+
+    for mut, flag in (
+        (_hedged, "has_hedge"),
+        (_health_only, "has_health"),
+        (_brownout, "has_brownout"),
+    ):
+        base = LB if flag == "has_health" else BASE
+        payload = _payload(mut, base=base, horizon=30)
+        plan = compile_payload(payload)
+        assert getattr(plan, flag)
+        assert plan.has_tail_tolerance
+
+        pred = predict_routing(plan, engine="auto", backend="cpu")
+        runner = SweepRunner(payload, use_mesh=False)
+        assert pred.engine == runner.engine_kind == "event", flag
+
+        for forced in ("pallas", "native"):
+            pred_f = predict_routing(
+                plan, engine=forced, backend="cpu", native_ok=True,
+            )
+            assert pred_f.refusal is not None
+            assert pred_f.refusal.fence_id == f"tail_tolerance.{forced}"
+            with pytest.raises(Exception, match="tail-tolerance") as exc:
+                SweepRunner(payload, use_mesh=False, engine=forced)
+            # the runtime raises the registry's exact message
+            assert str(exc.value) == pred_f.refusal.message, flag
+
+
+def test_hedge_duplicates_are_not_spawns() -> None:
+    """Offered-load accounting: generated counts logical spawns + retries
+    only; hedge duplicates ride the anchor's budget (the conservation
+    contract DeviceCounters documents)."""
+    payload = _payload(_hedged, horizon=60)
+    r = OracleEngine(payload, seed=5).run()
+    j = run_single(payload, seed=5, engine="event")
+    for res in (r, j):
+        assert res.total_hedges > 0
+        c = res.counters().as_dict()
+        assert c["hedges"] == res.total_hedges
+        # completions can never exceed spawned logical requests
+        assert len(res.rqs_clock) <= res.total_generated
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder hedge lifecycle (deterministic parity scenario)
+# ---------------------------------------------------------------------------
+
+
+def _slow_hedged_parity(data) -> None:
+    """Deterministic service slow enough (0.2 s io) that every hedge
+    timer (50 ms) fires before the primary returns: the anchor always
+    wins the race and the duplicate always arrives at the client as a
+    loser — a fully deterministic issue -> hedge -> win -> cancel span."""
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    srv["endpoints"][0]["steps"] = [
+        {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.004}},
+        {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.2}},
+    ]
+    data["hedge_policy"] = {
+        "hedge_delay_s": 0.05,
+        "max_hedges": 1,
+        "cancel_on_first": True,
+    }
+
+
+def test_hedge_lifecycle_spans_match() -> None:
+    """Issue -> hedge spawn -> winner completes -> loser cancelled,
+    deterministic end to end: the full hedge lifecycle must canonicalize
+    identically on both engines, all events on the ANCHOR's record."""
+    from asyncflow_tpu.observability.diverge import compare_flight
+    from asyncflow_tpu.observability.simtrace import (
+        FR_CANCEL,
+        FR_COMPLETE,
+        FR_HEDGE,
+        FR_SPAWN,
+        TraceConfig,
+    )
+
+    payload = _payload(_slow_hedged_parity, base=PARITY, horizon=90)
+    cfg = TraceConfig(sample_requests=6, event_slots=32)
+    res_o = OracleEngine(payload, seed=1, trace=cfg).run()
+    res_j = run_single(payload, seed=1, engine="event", trace=cfg)
+    report = compare_flight(res_o.flight, res_j.flight, horizon=90.0)
+    assert report.equal, report.summary()
+    codes = {c for rec in res_o.flight.values() for c in rec.codes()}
+    assert {FR_SPAWN, FR_HEDGE, FR_COMPLETE, FR_CANCEL} <= codes
+    # every traced request hedged exactly once and one attempt lost
+    for rec in res_o.flight.values():
+        assert rec.codes().count(FR_HEDGE) == 1
+        assert rec.codes().count(FR_COMPLETE) == 1
+        assert rec.codes().count(FR_CANCEL) == 1
+
+
+def test_tracing_is_neutral_under_hedging() -> None:
+    """Recording a hedged run changes NO non-trace output on either
+    engine (tracing consumes no draws even with the policy active)."""
+    from asyncflow_tpu.observability.simtrace import TraceConfig
+
+    payload = _payload(_hedged, horizon=60)
+    plain_o = OracleEngine(payload, seed=7).run()
+    traced_o = OracleEngine(
+        payload, seed=7, trace=TraceConfig(sample_requests=4),
+    ).run()
+    assert np.array_equal(plain_o.rqs_clock, traced_o.rqs_clock)
+    assert plain_o.counters().as_dict() == traced_o.counters().as_dict()
+
+    plain_j = run_single(payload, seed=7, engine="event")
+    traced_j = run_single(
+        payload, seed=7, engine="event",
+        trace=TraceConfig(sample_requests=4),
+    )
+    assert np.array_equal(plain_j.rqs_clock, traced_j.rqs_clock)
+    assert plain_j.counters().as_dict() == traced_j.counters().as_dict()
+
+
+# ---------------------------------------------------------------------------
+# sweep overrides: tail-tolerance axes + legacy checkpoint compatibility
+# ---------------------------------------------------------------------------
+
+
+def test_hedge_delay_override_sweeps_the_policy() -> None:
+    """A (S,) hedge_delay axis turns the policy off (-1) and on across
+    scenarios of ONE compiled engine — the A/B seam compare() uses."""
+    from asyncflow_tpu.parallel.sweep import make_overrides
+
+    payload = _payload(_hedged, horizon=60)
+    plan = compile_payload(payload)
+    engine = Engine(plan)
+    n = 4
+    ov = make_overrides(
+        plan, n, hedge_delay=np.array([-1.0, 0.008, 0.012, 0.02]),
+    )
+    fin = engine.run_batch(scenario_keys(3, n), overrides=ov)
+    hedges = np.asarray(fin.n_hedges)
+    assert hedges[0] == 0, "delay<=0 must disable hedging for that scenario"
+    assert np.all(hedges[1:] > 0)
+    # shorter delays fire more duplicates
+    assert hedges[1] >= hedges[2] >= hedges[3]
+
+
+def test_legacy_override_tuples_still_load() -> None:
+    """Pre-tail-tolerance ScenarioOverrides pickles/npz rows (5- and
+    8-field constructors) must still normalize through fill_overrides —
+    sweep checkpoints from older runs stay resumable."""
+    import pickle
+
+    from asyncflow_tpu.engines.jaxsim.params import (
+        ScenarioOverrides,
+        base_overrides,
+        fill_overrides,
+    )
+
+    plan = compile_payload(_payload(_hedged, horizon=30))
+    base = base_overrides(plan)
+    legacy5 = ScenarioOverrides(*base[:5])
+    legacy8 = ScenarioOverrides(*base[:8])
+    for legacy in (legacy5, legacy8):
+        assert legacy.hedge_delay is None
+        thawed = pickle.loads(pickle.dumps(legacy))
+        filled = fill_overrides(thawed, base)
+        assert float(np.asarray(filled.hedge_delay)) == float(
+            np.asarray(base.hedge_delay),
+        )
+        assert np.array_equal(
+            np.asarray(filled.brownout_q), np.asarray(base.brownout_q),
+        )
+        assert filled.health_threshold is not None
