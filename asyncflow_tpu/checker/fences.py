@@ -109,8 +109,9 @@ FENCES: dict[str, Fence] = {
             engine="pallas",
             message=(
                 "engine='pallas' does not model fault windows / client "
-                "retries; use engine='event' (or 'auto', which routes "
-                "resilience plans to the event engine)"
+                "retries; use engine='fast' or 'event' (or 'auto', which "
+                "routes fastpath-eligible resilience plans to the scan "
+                "fast path)"
             ),
         ),
         Fence(
@@ -119,8 +120,9 @@ FENCES: dict[str, Fence] = {
             engine="native",
             message=(
                 "engine='native' does not model fault windows / client "
-                "retries; use engine='event' (or 'auto', which routes "
-                "resilience plans to the event engine)"
+                "retries; use engine='fast' or 'event' (or 'auto', which "
+                "routes fastpath-eligible resilience plans to the scan "
+                "fast path)"
             ),
         ),
         # -- tail-tolerance plans (hedges / health gate / brownout) ---------

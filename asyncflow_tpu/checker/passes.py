@@ -552,6 +552,50 @@ def resource_pass(payload, plan, out: list[Diagnostic]) -> None:
                 ))
 
 
+def _bench_engine_rates() -> tuple[str, dict[str, float]] | None:
+    """(bench name, {engine: scenarios/sec}) from the newest BENCH_r*.json
+    at the repo root — the data source for the fence burn-down speedup
+    estimates.  The headline ``value`` is the recorded engine's rate
+    (``detail.engine``, the fast path since round 2), the oracle walls
+    invert to oracle/native rates, and the resilient arm (round 8+)
+    contributes the event engine's sweep rate.  None when no bench has
+    been recorded (fresh checkout / installed package)."""
+    import json
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parents[2]
+    for path in sorted(root.glob("BENCH_r*.json"), reverse=True):
+        try:
+            parsed = json.loads(path.read_text())["parsed"]
+        except Exception:  # noqa: BLE001 - malformed round, try the previous
+            continue
+        if not isinstance(parsed, dict):
+            continue
+        detail = parsed.get("detail") or {}
+        rates: dict[str, float] = {}
+        value = parsed.get("value")
+        if isinstance(value, (int, float)) and value > 0:
+            rates[str(detail.get("engine", "fast"))] = float(value)
+        for eng, wall_key in (
+            ("oracle", "oracle_wall_s_per_scenario"),
+            ("native", "native_oracle_wall_s_per_scenario"),
+        ):
+            wall = detail.get(wall_key)
+            if isinstance(wall, (int, float)) and wall > 0:
+                rates[eng] = 1.0 / float(wall)
+        resilient = detail.get("resilient") or {}
+        for eng, rate_key in (
+            ("fast", "fast_scen_s"),
+            ("event", "event_scen_s"),
+        ):
+            rate = resilient.get(rate_key)
+            if isinstance(rate, (int, float)) and rate > 0:
+                rates.setdefault(eng, float(rate))
+        if rates:
+            return path.stem, rates
+    return None
+
+
 def routing_pass(
     payload,
     plan,
@@ -583,12 +627,55 @@ def routing_pass(
             path="SweepRunner(engine=...)",
             remedy="use engine='auto' or an engine outside the fence",
         ))
-    else:
+    # expected speedup of burning each remaining fence, from the
+    # per-engine scenarios/sec in the newest recorded BENCH — the
+    # burn-down list is prioritized by data, not by guess
+    bench = (
+        _bench_engine_rates()
+        if pred.fences and pred.engine is not None
+        else None
+    )
+    cur_rate = bench[1].get(pred.engine) if bench else None
+
+    def speedup_note(target: str) -> str:
+        if pred.engine is None:
+            return ""  # refused construction: there is no routed baseline
+        if bench is None:
+            return " (no BENCH recorded: speedup unestimated)"
+        name, rates = bench
+        alt = rates.get(target)
+        if not cur_rate or not alt:
+            return (
+                f" (expected speedup unestimated: {name} records no "
+                f"scen/s for {target!r} vs {pred.engine!r})"
+            )
+        return (
+            f" — expected speedup if burned: ~{alt / cur_rate:.1f}x "
+            f"({target} {alt:.1f} vs {pred.engine} {cur_rate:.1f} "
+            f"scen/s, {name})"
+        )
+
+    if pred.refusal is None:
+        summary = ""
+        if pred.fences and bench is not None and cur_rate:
+            parts = []
+            for eng in sorted({f.engine for f in pred.fences}):
+                alt = bench[1].get(eng)
+                parts.append(
+                    f"{eng} ~{alt / cur_rate:.1f}x"
+                    if alt
+                    else f"{eng} unmeasured"
+                )
+            summary = (
+                f"; expected speedup from burning the remaining fences "
+                f"(vs {pred.engine} at {cur_rate:.1f} scen/s, {bench[0]}): "
+                + ", ".join(parts)
+            )
         out.append(Diagnostic(
             code="AF501", severity=Severity.INFO,
             message=f"engine={pred.requested!r} runs this plan on the "
             f"{pred.engine!r} engine (backend={pred.backend!r}): "
-            + pred.why,
+            + pred.why + summary,
             path="SweepRunner(engine=...)",
             remedy="no action needed; force engine='event' to override "
             "routing",
@@ -597,7 +684,7 @@ def routing_pass(
         out.append(Diagnostic(
             code="AF502", severity=Severity.INFO,
             message=f"fence {f.fence_id}: this config cannot use the "
-            f"{f.engine!r} engine — {f.message}",
+            f"{f.engine!r} engine — {f.message}" + speedup_note(f.engine),
             path="SweepRunner(engine=...)",
             remedy="drop the feature to regain the fenced engine, or "
             "accept the routed one",

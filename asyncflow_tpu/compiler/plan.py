@@ -1779,28 +1779,20 @@ def _fastpath_analysis(
     n_servers = len(servers)
     no_slots = np.empty(0, np.int32)
 
-    # Resilience scenarios are categorically event-engine work: client
-    # retries are feedback from completions/failures into the arrival
-    # process (the scan has no re-issue channel), and fault windows gate
-    # server availability and edge parameters in time, which the
-    # closed-form per-station recursions cannot replay.
-    if payload.retry_policy is not None:
+    # Resilience plans run on the fast path (round 8 fence burn-down):
+    # fault windows lower to piecewise per-lane latency/dropout modulation
+    # keyed by send time (dark-server windows hard-refuse at arrival), and
+    # client retries run as lane-blocked attempt re-issues relaxed to a
+    # fixed point over the analytic draws (engines/jaxsim/fastpath.py).
+    # Only retry x multi-generator stays fenced: the re-issue entry chain
+    # is single-generator by contract (the event engine refuses the
+    # combination too).
+    if payload.retry_policy is not None and len(payload.generators) > 1:
         return (
             False,
-            "client retry policy: timeout/backoff re-issues feed "
-            "completions back into the arrival stream (modeled on the "
-            "event engines; use engine='event' or drop retry_policy)",
-            [],
-            no_slots,
-            0,
-            0.0,
-        )
-    if payload.fault_timeline is not None and payload.fault_timeline.events:
-        return (
-            False,
-            "fault timeline: outage/degradation windows gate servers and "
-            "edges in time (modeled on the event engines; use "
-            "engine='event' or drop fault_timeline)",
+            "client retry policy with multiple generator streams: the "
+            "backoff re-issue walks the single generator's entry chain "
+            "(the event engine refuses this combination as well)",
             [],
             no_slots,
             0,
@@ -2282,6 +2274,14 @@ def _fastpath_analysis(
         srv_rate = _server_entry_rates(payload)
         if srv_rate is None:  # pragma: no cover - cycles rejected above
             return False, "server exit chain has a cycle", [], no_slots, 0, 0.0
+        # retries amplify offered load up to the attempt cap (orphaned
+        # attempts keep consuming cores): the envelope must hold at the
+        # amplified rate, not the logical one
+        retry_amp = (
+            float(payload.retry_policy.max_attempts)
+            if payload.retry_policy is not None
+            else 1.0
+        )
         for s in range(n_servers):
             if max_visits_per_server[s] <= 1:
                 continue
@@ -2290,7 +2290,7 @@ def _fastpath_analysis(
                 default=0.0,
             )
             cores = servers[s].server_resources.cpu_cores
-            rho = srv_rate[s] * cpu_dur / max(cores, 1)
+            rho = retry_amp * srv_rate[s] * cpu_dur / max(cores, 1)
             relax_rho = max(relax_rho, rho)
             if rho > RELAX_RHO_MAX:
                 return (

@@ -141,8 +141,19 @@ class FastState(NamedTuple):
     #: cheap per-scenario what-if statistics even in histogram-only sweeps
     gauge_means: jnp.ndarray
     #: requests refused by overload controls (rate limit / queue cap /
-    #: dequeue deadline) — the event engines' n_rejected counterpart
+    #: dequeue deadline) or dark fault windows — the event engines'
+    #: n_rejected counterpart
     n_rejected: jnp.ndarray
+    #: client deadlines that fired while the attempt was in flight (the
+    #: orphaned attempt keeps consuming resources); 0 without a retry plan
+    n_timed_out: jnp.ndarray
+    #: granted backoff re-issues (event engines' n_retries counterpart)
+    n_retries: jnp.ndarray
+    #: retry wants denied by the token-bucket budget
+    n_budget_exhausted: jnp.ndarray
+    #: (max_attempts,) attempts used per ENDED logical request (completed
+    #: or given up); shape (1,) without a retry plan
+    att_hist: jnp.ndarray
 
 
 def _kw_waits(
@@ -488,6 +499,26 @@ class FastEngine:
         else:
             self.gen_n = []
             self.n = max_requests or plan.max_requests
+        # ---- resilience lowering (round 8 fence burn-down) ----
+        # Static flags prune every fault/retry op out of unconfigured
+        # plans' programs, keeping their draw streams bit-identical.
+        self._has_srv_faults = bool(np.any(plan.fault_srv_down != 0))
+        self._has_edge_faults = bool(
+            np.any(plan.fault_edge_lat != 1.0)
+            or np.any(plan.fault_edge_drop != 0.0),
+        )
+        self._attempts = (
+            max(int(plan.retry_max_attempts), 1) if plan.has_retry else 1
+        )
+        if plan.has_retry and self._attempts > 1:
+            # lane blocks: block a holds attempt a+1 of logical request i
+            # at lane a*n1 + i.  plan.max_requests is already amplified by
+            # the attempt cap (_estimate_capacity), so n1 = n // A keeps
+            # the logical 6-sigma class bound.
+            self._n_logical = max(self.n // self._attempts, 1)
+            self.n = self._n_logical * self._attempts
+        else:
+            self._n_logical = self.n
         self.n_windows = int(np.ceil(plan.horizon / plan.user_window))
         self.n_thr = int(np.ceil(plan.horizon)) or 1
         self.hist_lo, self.hist_scale = hist_constants(n_hist_bins)
@@ -557,6 +588,24 @@ class FastEngine:
         idx = searchsorted_small(self._spike_times, t_send, "right") - 1
         return delay + self._spike_values[idx, eidx]
 
+    def _edge_fault(self, eidx, t_send, ov: ScenarioOverrides):
+        """(latency factor, dropout boost) active on an edge at send time —
+        the event engine's ``_edge_fault`` on whole lane vectors.
+        Breakpoint TIMES ride the overrides (fault-timing sweeps); the
+        factor/boost tables are plan-static.  ``eidx`` may be a static int
+        or a per-lane index vector."""
+        idx = jnp.maximum(
+            searchsorted_small(
+                jnp.asarray(ov.fault_edge_times), t_send, "right",
+            )
+            - 1,
+            0,
+        )
+        return (
+            jnp.asarray(self.plan.fault_edge_lat)[idx, eidx],
+            jnp.asarray(self.plan.fault_edge_drop)[idx, eidx],
+        )
+
     def _edge_hop(self, key, edge: int, t_send, ov: ScenarioOverrides, u=None):
         """(dropped, delay+spike) vectors for one static edge index.
 
@@ -571,7 +620,15 @@ class FastEngine:
         dist_id = int(self.plan.edge_dist[edge])
         if u is None:
             u = draw_uniform(jax.random.fold_in(key, 0), t_send.shape)
-        dropped, u_lat = self._fused_drop_rescale(u, ov.edge_dropout[edge])
+        drop_p = ov.edge_dropout[edge]
+        factor = None
+        if self._has_edge_faults:
+            # fault window at send time: multiply the latency draw, boost
+            # the dropout probability (event engine's _sample_edge order:
+            # factor before the spike superposition)
+            factor, boost = self._edge_fault(edge, t_send, ov)
+            drop_p = jnp.clip(drop_p + boost, 0.0, 1.0)
+        dropped, u_lat = self._fused_drop_rescale(u, drop_p)
         z = (
             draw_normal(jax.random.fold_in(key, 2), t_send.shape)
             if dist_id in (_D_NORMAL, _D_LOGNORMAL)
@@ -580,6 +637,8 @@ class FastEngine:
         delay = self._delay(
             dist_id, ov.edge_mean[edge], ov.edge_var[edge], u_lat, z,
         )
+        if factor is not None:
+            delay = delay * factor
         if len(self.plan.spike_times) > 1:
             delay = self._add_spike(delay, t_send, edge)
         return dropped, delay
@@ -592,7 +651,12 @@ class FastEngine:
         mean = ov.edge_mean[eidx_arr]
         var = ov.edge_var[eidx_arr]
         u = draw_uniform(jax.random.fold_in(key, 0), t_send.shape)
-        dropped, u_lat = self._fused_drop_rescale(u, ov.edge_dropout[eidx_arr])
+        drop_p = ov.edge_dropout[eidx_arr]
+        factor = None
+        if self._has_edge_faults:
+            factor, boost = self._edge_fault(eidx_arr, t_send, ov)
+            drop_p = jnp.clip(drop_p + boost, 0.0, 1.0)
+        dropped, u_lat = self._fused_drop_rescale(u, drop_p)
         lb_dists = sorted(
             {int(plan.edge_dist[e]) for e in plan.lb_edge_index.tolist()},
         )
@@ -615,6 +679,8 @@ class FastEngine:
                 delay = jnp.where(
                     dist == d, self._delay(d, mean, var, u_lat, z), delay,
                 )
+        if factor is not None:
+            delay = delay * factor
         if len(plan.spike_times) > 1:
             delay = self._add_spike(delay, t_send, eidx_arr)
         return dropped, delay
@@ -623,13 +689,15 @@ class FastEngine:
     # arrivals
     # ------------------------------------------------------------------
 
-    def _arrivals(self, key, ov: ScenarioOverrides):
+    def _arrivals(self, key, ov: ScenarioOverrides, n: int | None = None):
         """(sim_times, valid, overflow) — simulation-clock arrival times.
 
         Single-stream plans produce one sorted vector; multi-generator
         plans concatenate per-stream constructions (each sorted on its own
         static slot slice — downstream consumers rank, they never assume
-        global slot-order sortedness)."""
+        global slot-order sortedness).  ``n`` overrides the single-stream
+        slot count (retry plans spawn logical requests on the first lane
+        block only)."""
         plan = self.plan
         if plan.n_generators > 1:
             um = jnp.asarray(ov.user_mean)  # (G,)
@@ -657,7 +725,7 @@ class FastEngine:
             plan.user_var,
             plan.user_window,
             self.n_windows,
-            self.n,
+            self.n if n is None else n,
         )
 
     def _arrivals_stream(
@@ -870,24 +938,35 @@ class FastEngine:
     # main
     # ------------------------------------------------------------------
 
-    def _run_one(self, key, ov: ScenarioOverrides) -> FastState:
-        plan = self.plan
-        n = self.n
-        n_gauge_rows = (
-            self._gauge_samples + 2 if self._collect_gauge_grid else 1
-        )
-        n_gauges = plan.n_gauges if self._collect_gauge_grid else 1
-        gauge = jnp.zeros((n_gauge_rows, n_gauges), jnp.float32)
+    def _journey(
+        self,
+        key,
+        ov: ScenarioOverrides,
+        t,
+        alive,
+        gauge,
+        gauge_means,
+        *,
+        record: bool = True,
+    ):
+        """One full pass of the post-arrival pipeline: entry chain ->
+        routing -> server topo loop -> completion.
 
-        t, alive, overflow = self._arrivals(jax.random.fold_in(key, 0), ov)
-        start = t
-        n_generated = jnp.sum(alive)
+        ``t``/``alive`` are per-lane issue times and liveness (for retry
+        plans, lane blocks of re-issue attempts).  Returns ``(finish,
+        completed, fail_t, gauge, gauge_means, n_dropped, n_rejected)``
+        where ``fail_t`` is the per-lane client-visible failure time (INF
+        when the lane completed or was still in flight at the horizon) —
+        entry-chain drops fail at the attempt's ISSUE time (the event
+        engine walks the chain inside the spawn event), every other
+        fail-fast site at its own event time.  ``record=False`` skips all
+        gauge/counter accumulation: the retry driver's relaxation passes
+        only need the outcome times."""
+        plan = self.plan
+        n = t.shape[0]
         n_dropped = jnp.int32(0)
         n_rejected = jnp.int32(0)
-
-        # exact time-integrals of every gauge (divided by the horizon at the
-        # end); an interval [a, b) contributes its horizon-clipped length
-        gauge_means = jnp.zeros(plan.n_gauges, jnp.float32)
+        fail_t = jnp.full(n, INF, jnp.float32)
         horizon = jnp.float32(plan.horizon)
 
         def span(a, b, on, amount=1.0):
@@ -912,11 +991,13 @@ class FastEngine:
             sizes = [n]
             fold_site = lambda g, j: 16 + j  # noqa: E731
         off = 0
-        t_parts, alive_parts = [], []
+        t_parts, alive_parts, fail_parts = [], [], []
         for g, chain in enumerate(chains):
             n_g = sizes[g]
             t_g = t[off : off + n_g]
             alive_g = alive[off : off + n_g]
+            t0_g = t_g  # attempt issue times (entry drops fail here)
+            f_g = jnp.full(n_g, INF, jnp.float32)
             for j, eidx in enumerate(chain):
                 # a send at t >= horizon never happens in the event engines
                 # (events past the horizon don't fire): freeze silently
@@ -925,23 +1006,31 @@ class FastEngine:
                     jax.random.fold_in(key, fold_site(g, j)), eidx, t_g, ov,
                 )
                 ok = alive_g & ~dropped
-                gauge = self._gauge_intervals(
-                    gauge, eidx, t_g, t_g + delay, 1.0, ok,
-                )
-                gauge_means = gauge_means.at[eidx].add(
-                    span(t_g, t_g + delay, ok),
-                )
-                n_dropped = n_dropped + jnp.sum(alive_g & dropped)
+                if record:
+                    gauge = self._gauge_intervals(
+                        gauge, eidx, t_g, t_g + delay, 1.0, ok,
+                    )
+                    gauge_means = gauge_means.at[eidx].add(
+                        span(t_g, t_g + delay, ok),
+                    )
+                    n_dropped = n_dropped + jnp.sum(alive_g & dropped)
+                f_g = jnp.where(alive_g & dropped, t0_g, f_g)
                 t_g = jnp.where(ok, t_g + delay, t_g)
                 alive_g = ok
             t_parts.append(t_g)
             alive_parts.append(alive_g)
+            fail_parts.append(f_g)
             off += n_g
         t = t_parts[0] if len(t_parts) == 1 else jnp.concatenate(t_parts)
         alive = (
             alive_parts[0]
             if len(alive_parts) == 1
             else jnp.concatenate(alive_parts)
+        )
+        fail_t = (
+            fail_parts[0]
+            if len(fail_parts) == 1
+            else jnp.concatenate(fail_parts)
         )
 
         # ---- routing ----------------------------------------------------
@@ -964,7 +1053,9 @@ class FastEngine:
                 drop_s = jnp.stack(drops, axis=1)  # (n, EL)
                 delay_s = jnp.stack(delays, axis=1)
                 slot, routed = self._routed_slots_lc(t, alive, drop_s, delay_s)
-                n_dropped = n_dropped + jnp.sum(alive & ~routed)
+                if record:
+                    n_dropped = n_dropped + jnp.sum(alive & ~routed)
+                fail_t = jnp.where(alive & ~routed, t, fail_t)
                 alive = alive & routed
                 slot = jnp.where(alive, slot, 0)
                 lanes = jnp.arange(n)
@@ -986,7 +1077,9 @@ class FastEngine:
                     # order, interleaving the outage timeline (slot -1 = no
                     # healthy target, request dropped like the event engines)
                     slot, routed = self._routed_slots(t, alive)
-                    n_dropped = n_dropped + jnp.sum(alive & ~routed)
+                    if record:
+                        n_dropped = n_dropped + jnp.sum(alive & ~routed)
+                    fail_t = jnp.where(alive & ~routed, t, fail_t)
                     alive = alive & routed
                     slot = jnp.where(alive, slot, 0)
                 eidx_arr = jnp.asarray(plan.lb_edge_index)[slot]
@@ -995,13 +1088,17 @@ class FastEngine:
                 )
             srv = jnp.asarray(plan.lb_target)[slot]
             ok = alive & ~dropped
-            gauge = self._gauge_intervals(gauge, eidx_arr, t, t + delay, 1.0, ok)
-            lo = jnp.minimum(t, horizon)
-            hi = jnp.minimum(t + delay, horizon)
-            gauge_means = gauge_means.at[eidx_arr].add(
-                jnp.where(ok, jnp.maximum(hi - lo, 0.0), 0.0),
-            )
-            n_dropped = n_dropped + jnp.sum(alive & dropped)
+            if record:
+                gauge = self._gauge_intervals(
+                    gauge, eidx_arr, t, t + delay, 1.0, ok,
+                )
+                lo = jnp.minimum(t, horizon)
+                hi = jnp.minimum(t + delay, horizon)
+                gauge_means = gauge_means.at[eidx_arr].add(
+                    jnp.where(ok, jnp.maximum(hi - lo, 0.0), 0.0),
+                )
+                n_dropped = n_dropped + jnp.sum(alive & dropped)
+            fail_t = jnp.where(alive & dropped, t, fail_t)
             t = jnp.where(ok, t + delay, t)
             alive = ok
 
@@ -1045,6 +1142,29 @@ class FastEngine:
         for s in plan.server_topo_order:
             mine = alive & (srv == s) & (t < plan.horizon)
 
+            # dark fault windows: a server that is down at the request's
+            # arrival hard-refuses it (event engine checks this BEFORE the
+            # rate limit — `_srv_faulted` in engine.py).  Static gate per
+            # server keeps unfaulted servers' programs untouched.
+            if self._has_srv_faults and bool(
+                np.any(np.asarray(plan.fault_srv_down)[:, s] != 0),
+            ):
+                fidx = jnp.maximum(
+                    searchsorted_small(
+                        jnp.asarray(ov.fault_srv_times), t, "right",
+                    )
+                    - 1,
+                    0,
+                )
+                dark = mine & (
+                    jnp.asarray(plan.fault_srv_down)[fidx, s] == 1
+                )
+                if record:
+                    n_rejected = n_rejected + jnp.sum(dark)
+                fail_t = jnp.where(dark, t, fail_t)
+                alive = alive & ~dark
+                mine = mine & ~dark
+
             # token-bucket rate limit at arrival (reference milestone 5):
             # feed-forward, so one arrival-order scan settles it exactly
             rate_s = (
@@ -1063,7 +1183,9 @@ class FastEngine:
                 )
                 accepted = acc_sorted[rank_rl]
                 limited = mine & ~accepted
-                n_rejected = n_rejected + jnp.sum(limited)
+                if record:
+                    n_rejected = n_rejected + jnp.sum(limited)
+                fail_t = jnp.where(limited, t, fail_t)
                 alive = alive & ~limited
                 mine = mine & accepted
 
@@ -1167,7 +1289,13 @@ class FastEngine:
                     mine & is_b & ~refused & ~shed, wait_s_[rank_c], 0.0,
                 )
                 rejected = refused | shed | abandoned
-                n_rejected = n_rejected + jnp.sum(rejected)
+                if record:
+                    n_rejected = n_rejected + jnp.sum(rejected)
+                # refused fail at arrival, shed at enqueue, abandons after
+                # waiting out the dequeue deadline (event: _timeout_branch)
+                fail_t = jnp.where(refused, t, fail_t)
+                fail_t = jnp.where(shed, t + pre0, fail_t)
+                fail_t = jnp.where(abandoned, t + pre0 + W_c, fail_t)
                 alive = alive & ~rejected
                 served = mine & ~rejected
                 # gauge shapes shared with the other branches; refused
@@ -1183,12 +1311,13 @@ class FastEngine:
                 # gauge_ram block below, which only sees `mine`=served)
                 rej_end = jnp.where(shed, t + pre0, t + pre0 + W_c)
                 rej_ram = (shed | abandoned) & (ram > 0)
-                gauge = self._gauge_intervals(
-                    gauge, plan.gauge_ram(s), t, rej_end, ram, rej_ram,
-                )
-                gauge_means = gauge_means.at[plan.gauge_ram(s)].add(
-                    span(t, rej_end, rej_ram, amount=ram),
-                )
+                if record:
+                    gauge = self._gauge_intervals(
+                        gauge, plan.gauge_ram(s), t, rej_end, ram, rej_ram,
+                    )
+                    gauge_means = gauge_means.at[plan.gauge_ram(s)].add(
+                        span(t, rej_end, rej_ram, amount=ram),
+                    )
                 mine = served
             elif kb == 0 and ram_k <= 0:
                 # pure-IO server: no queues, departure is deterministic
@@ -1228,7 +1357,12 @@ class FastEngine:
                 shed = part & shed_s[rank_c]
                 abandoned = part & aband_s[rank_c]
                 rejected = shed | abandoned
-                n_rejected = n_rejected + jnp.sum(rejected)
+                if record:
+                    n_rejected = n_rejected + jnp.sum(rejected)
+                # shed never enters the ready queue (fails at enqueue, which
+                # includes pre-burst cache extras); abandons wait full W_c
+                fail_t = jnp.where(shed, t + pre0, fail_t)
+                fail_t = jnp.where(abandoned, t + pre0 + W_c, fail_t)
                 alive = alive & ~rejected
                 served = mine & ~rejected
                 # gauge shapes shared with the other branches: enqueue,
@@ -1360,7 +1494,9 @@ class FastEngine:
             # gauges: one ready-wait and one pre-IO interval per visit (the
             # ram_k > 0 branch exposes its single visit in the same shapes;
             # kb == 0 means no visits and the loop is empty)
-            for k in range(min(kb, 1) if ram_k > 0 else kb):
+            for k in range(
+                (min(kb, 1) if ram_k > 0 else kb) if record else 0
+            ):
                 vb = validb[:, k]
                 gauge = self._gauge_intervals(
                     gauge,
@@ -1416,28 +1552,29 @@ class FastEngine:
             # trailing IO sleep (including any DB pool wait: the reference
             # parks connection waiters in the event loop, counted by the
             # io-sleep gauge) and RAM residency (admission to departure)
-            gauge = self._gauge_intervals(
-                gauge,
-                plan.gauge_io(s),
-                trail_start,
-                dep,
-                1.0,
-                mine & (dep > trail_start),
-            )
-            gauge_means = gauge_means.at[plan.gauge_io(s)].add(
-                span(trail_start, dep, mine & (dep > trail_start)),
-            )
-            gauge = self._gauge_intervals(
-                gauge,
-                plan.gauge_ram(s),
-                t + W_ram,
-                dep,
-                ram,
-                mine & (ram > 0),
-            )
-            gauge_means = gauge_means.at[plan.gauge_ram(s)].add(
-                span(t + W_ram, dep, mine, amount=ram),
-            )
+            if record:
+                gauge = self._gauge_intervals(
+                    gauge,
+                    plan.gauge_io(s),
+                    trail_start,
+                    dep,
+                    1.0,
+                    mine & (dep > trail_start),
+                )
+                gauge_means = gauge_means.at[plan.gauge_io(s)].add(
+                    span(trail_start, dep, mine & (dep > trail_start)),
+                )
+                gauge = self._gauge_intervals(
+                    gauge,
+                    plan.gauge_ram(s),
+                    t + W_ram,
+                    dep,
+                    ram,
+                    mine & (ram > 0),
+                )
+                gauge_means = gauge_means.at[plan.gauge_ram(s)].add(
+                    span(t + W_ram, dep, mine, amount=ram),
+                )
 
             # exit edge: the send only happens while the clock is running
             sendable = mine & (dep < plan.horizon)
@@ -1447,9 +1584,15 @@ class FastEngine:
                 u=u_exit_shared,
             )
             ok = sendable & ~dropped
-            gauge = self._gauge_intervals(gauge, eidx, dep, dep + delay, 1.0, ok)
-            gauge_means = gauge_means.at[eidx].add(span(dep, dep + delay, ok))
-            n_dropped = n_dropped + jnp.sum(sendable & dropped)
+            if record:
+                gauge = self._gauge_intervals(
+                    gauge, eidx, dep, dep + delay, 1.0, ok,
+                )
+                gauge_means = gauge_means.at[eidx].add(
+                    span(dep, dep + delay, ok),
+                )
+                n_dropped = n_dropped + jnp.sum(sendable & dropped)
+            fail_t = jnp.where(sendable & dropped, dep, fail_t)
             if plan.exit_kind[s] == TARGET_SERVER:
                 nxt = int(plan.exit_target[s])
                 t = jnp.where(ok, dep + delay, t)
@@ -1462,23 +1605,190 @@ class FastEngine:
                 completed = completed | done
                 alive = jnp.where(mine, False, alive)
 
+        return (
+            finish,
+            completed,
+            fail_t,
+            gauge,
+            gauge_means,
+            n_dropped,
+            n_rejected,
+        )
+
+    def _run_one(self, key, ov: ScenarioOverrides) -> FastState:
+        plan = self.plan
+        n = self.n
+        A = self._attempts
+        n1 = self._n_logical
+        n_gauge_rows = (
+            self._gauge_samples + 2 if self._collect_gauge_grid else 1
+        )
+        n_gauges = plan.n_gauges if self._collect_gauge_grid else 1
+        gauge = jnp.zeros((n_gauge_rows, n_gauges), jnp.float32)
+        # exact time-integrals of every gauge (divided by the horizon at the
+        # end); an interval [a, b) contributes its horizon-clipped length
+        gauge_means = jnp.zeros(plan.n_gauges, jnp.float32)
+        horizon = jnp.float32(plan.horizon)
+
+        if not plan.has_retry:
+            # single journey — the program (and its draw stream) is
+            # bit-identical to pre-resilience builds for unfaulted plans
+            t, alive, overflow = self._arrivals(jax.random.fold_in(key, 0), ov)
+            n_generated = jnp.sum(alive)
+            (
+                finish,
+                completed,
+                _fail_t,
+                gauge,
+                gauge_means,
+                n_dropped,
+                n_rejected,
+            ) = self._journey(key, ov, t, alive, gauge, gauge_means)
+            success = completed
+            lat_start = t
+            # batched-traced zeros: every FastState leaf must carry the
+            # vmap batch axis
+            zero = jnp.int32(0) * n_generated
+            n_timed_out = zero
+            n_retries = zero
+            n_budget_exhausted = zero
+            att_hist = jnp.zeros(self._attempts, jnp.int32) + zero
+        else:
+            # ---- client deadlines + capped-backoff retries --------------
+            # Lane blocks: block a (lanes [a*n1, (a+1)*n1)) holds attempt
+            # a+1 of logical request i at lane a*n1 + i.  Logical requests
+            # spawn on block 0 only; a failed/timed-out attempt in block a
+            # re-issues into block a+1 at its failure time plus backoff.
+            # The journey is re-run A times over the full lane array so
+            # retry-storm contention feeds back into every block's queue
+            # waits (same relaxation discipline as the multi-burst core
+            # queue); draws are fixed per (lane, site), so the passes
+            # converge deterministically.  Only the last pass records.
+            t1, v1, overflow = self._arrivals(
+                jax.random.fold_in(key, 0), ov, n=n1,
+            )
+            n_generated = jnp.sum(v1)
+            T = jnp.where(v1, t1, INF)
+            if A > 1:
+                T = jnp.concatenate(
+                    [T, jnp.full(n - n1, INF, jnp.float32)],
+                )
+            # per-target-block backoff delays (event `_backoff_delay`:
+            # min(cap, base * mult**(attempt-1)) times the jitter factor);
+            # the jitter draw is per lane at a reserved fold site, clear of
+            # every journey site (2048 + block)
+            boff = []
+            for a in range(1, A):
+                d = min(
+                    float(plan.retry_backoff_cap),
+                    float(plan.retry_backoff_base)
+                    * float(plan.retry_backoff_mult) ** float(a - 1),
+                )
+                if plan.retry_jitter > 0:
+                    u = draw_uniform(
+                        jax.random.fold_in(key, 2048 + a), (n1,),
+                    )
+                    d = d * (
+                        1.0 + float(plan.retry_jitter) * (2.0 * u - 1.0)
+                    )
+                else:
+                    d = jnp.full(n1, d, jnp.float32)
+                boff.append(d)
+            boff_all = jnp.concatenate(boff) if boff else None
+            rt = jnp.asarray(ov.retry_timeout, jnp.float32)
+            blk = jnp.arange(n, dtype=jnp.int32) // n1
+            can_retry = blk < (A - 1)
+            cap_b = float(plan.retry_budget_tokens)
+            for p in range(A):
+                last = p == A - 1
+                issued = T < INF
+                (
+                    finish,
+                    completed,
+                    fail_t,
+                    gauge,
+                    gauge_means,
+                    n_dropped,
+                    n_rejected,
+                ) = self._journey(
+                    key, ov, T, issued, gauge, gauge_means, record=last,
+                )
+                # per-attempt resolution: the client notices completion at
+                # C, failure at fail_t, or its deadline at D — deadline
+                # wins ties (event engine: D <= min(C, F)), and deadlines
+                # at or past the horizon never fire
+                C = jnp.where(completed, finish, INF)
+                D = T + rt
+                timed = (
+                    issued
+                    & (D <= jnp.minimum(C, fail_t))
+                    & (D < horizon)
+                )
+                failed = issued & ~timed & (fail_t < INF)
+                R = jnp.where(timed, D, fail_t)  # retry-want time
+                want = (timed | failed) & can_retry
+                if cap_b >= 0:
+                    # one global token-bucket pass over the wants in time
+                    # order — the event engines' lazily-refilled budget
+                    # bucket advances its clock on every want, denials
+                    # included, exactly like the arrival rate limiter
+                    wt = jnp.where(want, R, INF)
+                    rank_b = time_rank(wt, want)
+                    acc = _token_bucket_scan(
+                        jnp.full(n, INF).at[rank_b].set(wt),
+                        jnp.zeros(n, bool).at[rank_b].set(want),
+                        float(plan.retry_budget_refill),
+                        cap_b,
+                    )
+                    grant = want & acc[rank_b]
+                else:
+                    grant = want
+                if not last:
+                    # re-issue: block a's granted failure parks block a+1's
+                    # lane at R + backoff; parks at or past the horizon
+                    # never fire (the token is still consumed — event
+                    # engines grant before parking)
+                    tn = R[: n - n1] + boff_all
+                    T = jnp.concatenate(
+                        [
+                            T[:n1],
+                            jnp.where(
+                                grant[: n - n1] & (tn < horizon), tn, INF,
+                            ),
+                        ],
+                    )
+            success = issued & ~timed & completed
+            lat_start = T
+            denied = want & ~grant
+            give_up = denied | ((timed | failed) & ~can_retry)
+            ended = success | give_up
+            n_timed_out = jnp.sum(timed)
+            n_retries = jnp.sum(grant)
+            n_budget_exhausted = jnp.sum(denied)
+            # attempts used per ENDED logical request: the block index IS
+            # attempt-1 (event `_record_attempts`); in-flight-at-horizon
+            # attempts and granted-but-never-fired re-issues record nothing
+            att_hist = jnp.zeros(A, jnp.int32).at[
+                jnp.where(ended, blk, A)
+            ].add(1, mode="drop")
+
         # ---- reductions --------------------------------------------------
-        latency = jnp.where(completed, finish - start, 0.0)
+        latency = jnp.where(success, finish - lat_start, 0.0)
         lbin = latency_bin(latency, self.hist_lo, self.hist_scale, self.n_hist_bins)
-        one = completed.astype(jnp.int32)
+        one = success.astype(jnp.int32)
         hist = jnp.zeros(self.n_hist_bins, jnp.int32).at[
-            jnp.where(completed, lbin, self.n_hist_bins)
+            jnp.where(success, lbin, self.n_hist_bins)
         ].add(1, mode="drop")
         tbin = jnp.clip(jnp.ceil(finish).astype(jnp.int32) - 1, 0, self.n_thr - 1)
         thr = jnp.zeros(self.n_thr, jnp.int32).at[
-            jnp.where(completed, tbin, self.n_thr)
+            jnp.where(success, tbin, self.n_thr)
         ].add(1, mode="drop")
 
         if self.collect_clocks:
             # clocks in arrival order, compacted to the front
-            idx = jnp.where(completed, jnp.cumsum(one) - 1, self.n)
+            idx = jnp.where(success, jnp.cumsum(one) - 1, self.n)
             clock = jnp.zeros((self.n, 2), jnp.float32)
-            clock = clock.at[idx, 0].set(start, mode="drop")
+            clock = clock.at[idx, 0].set(lat_start, mode="drop")
             clock = clock.at[idx, 1].set(finish, mode="drop")
             clock_n = jnp.sum(one)
         else:
@@ -1490,8 +1800,8 @@ class FastEngine:
             lat_count=jnp.sum(one),
             lat_sum=jnp.sum(latency),
             lat_sumsq=jnp.sum(latency * latency),
-            lat_min=jnp.min(jnp.where(completed, latency, INF)),
-            lat_max=jnp.max(jnp.where(completed, latency, 0.0)),
+            lat_min=jnp.min(jnp.where(success, latency, INF)),
+            lat_max=jnp.max(jnp.where(success, latency, 0.0)),
             thr=thr,
             gauge=gauge,
             clock=clock,
@@ -1501,6 +1811,10 @@ class FastEngine:
             n_overflow=overflow,
             gauge_means=gauge_means / horizon,
             n_rejected=n_rejected,
+            n_timed_out=n_timed_out,
+            n_retries=n_retries,
+            n_budget_exhausted=n_budget_exhausted,
+            att_hist=att_hist,
         )
 
     def run_batch(

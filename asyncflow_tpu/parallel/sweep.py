@@ -764,11 +764,11 @@ class SweepRunner:
             self._gauge_sel, gauge_stride, self._gauge_series_ids = (
                 _resolve_gauge_series(self.plan, gauge_series)
             )
-        # Resilience plans (fault windows / client retries) are modeled by
-        # the oracle and the XLA event engine only: the fast path refuses
-        # them at compile time (fastpath_reason), and the native C++ core
-        # and Pallas VMEM kernel do not carry the machinery yet — forcing
-        # them is an explicit error, never a silent mis-model.
+        # Resilience plans (fault windows / client retries) run on the
+        # scan fast path (round 8 fence burn-down) and the XLA event
+        # engine; the native C++ core and Pallas VMEM kernel do not carry
+        # the machinery yet — forcing them is an explicit error, never a
+        # silent mis-model.
         tail = getattr(self.plan, "has_tail_tolerance", False)
         if (self.plan.has_faults or self.plan.has_retry) and engine in (
             "native", "pallas",
@@ -790,7 +790,6 @@ class SweepRunner:
                 raise_fence("native.unavailable")
             self.engine = _NativeSweepEngine(self.plan, n_hist_bins=n_hist_bins)
             self.engine_kind = "native"
-            self._scan_inner = 0
         elif engine == "fast" or (
             engine == "auto" and self.plan.fastpath_ok and self.trace is None
         ):
@@ -802,22 +801,6 @@ class SweepRunner:
                 gauge_series_stride=gauge_stride,
             )
             self.engine_kind = "fast"
-            if scan_inner is None:
-                # default everywhere: on TPU the scanned program is the only
-                # compile-safe shape (fastpath.md §8); on CPU it measures
-                # ~40% faster than one big vmap at sweep shapes (better
-                # cache locality of per-block (16, N) working sets)
-                scan_inner = 16
-            elif scan_inner and self.mesh is not None:
-                import warnings
-
-                warnings.warn(
-                    "scan_inner is ignored with a live multi-device mesh: "
-                    "the scanned fast path cannot shard its block loop; "
-                    "keep per-device chunks at a compile-safe size instead",
-                    stacklevel=2,
-                )
-            self._scan_inner = scan_inner if self.mesh is None else 0
         elif engine == "pallas" or (
             engine == "auto"
             and jax.default_backend() == "tpu"
@@ -852,6 +835,29 @@ class SweepRunner:
                 trace=self.trace,
             )
             self.engine_kind = "event"
+        # scan_inner is a fast-path-only execution knob: decide it ONCE,
+        # here, after routing — no engine branch stores a path decision
+        # before the engine is known (native never scans; pallas and the
+        # event engine dispatch on 0)
+        if self.engine_kind == "fast":
+            if scan_inner is None:
+                # default everywhere: on TPU the scanned program is the only
+                # compile-safe shape (fastpath.md §8); on CPU it measures
+                # ~40% faster than one big vmap at sweep shapes (better
+                # cache locality of per-block (16, N) working sets)
+                scan_inner = 16
+            elif scan_inner and self.mesh is not None:
+                import warnings
+
+                warnings.warn(
+                    "scan_inner is ignored with a live multi-device mesh: "
+                    "the scanned fast path cannot shard its block loop; "
+                    "keep per-device chunks at a compile-safe size instead",
+                    stacklevel=2,
+                )
+            self._scan_inner = scan_inner if self.mesh is None else 0
+        else:
+            self._scan_inner = 0
         if self._gauge_sel is not None and self.engine_kind != "fast":
             msg = fence_message(
                 "gauge_series.requires_fast", detail=self.engine_kind,

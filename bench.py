@@ -57,6 +57,11 @@ def _parse_args(argv: list[str]) -> dict:
     report the scen/s delta with tracing ENABLED under
     ``detail.trace_guard``.
 
+    ``--resilient``: run the fence burn-down arm — a small faulted +
+    retrying + CRN sweep of the bench topology, auto-dispatched (must
+    route to the scan fast path) vs the same sweep forced onto the event
+    engine, recorded under ``detail.resilient``.
+
     ``--checkpoint-dir DIR``: checkpoint the measured sweep's chunks under
     ``DIR`` so a preempted/killed benchmark is resumable.  A SIGTERM/SIGINT
     during the measured sweep drains the in-flight chunk, writes a resume
@@ -73,6 +78,7 @@ def _parse_args(argv: list[str]) -> dict:
         "telemetry": None,
         "repeats": None,
         "trace_guard": False,
+        "resilient": False,
         "checkpoint_dir": None,
         "resume": False,
     }
@@ -80,6 +86,8 @@ def _parse_args(argv: list[str]) -> dict:
     for arg in it:
         if arg == "--trace-guard":
             opts["trace_guard"] = True
+        elif arg == "--resilient":
+            opts["resilient"] = True
         elif arg == "--resume":
             opts["resume"] = True
         elif arg == "--checkpoint-dir":
@@ -274,6 +282,100 @@ def _trace_guard() -> dict:
         "scen_per_s_trace_off": round(off_rate, 3),
         "scen_per_s_trace_on": round(on_rate, 3),
         "overhead_pct": round((off_rate / max(on_rate, 1e-9) - 1) * 100, 2),
+    }
+
+
+def _resilient_payload(horizon: int):
+    """Bench topology + a mid-run outage window + client retry policy —
+    the faulted/retrying shape whose fences round 8 burned down."""
+    import yaml
+
+    from asyncflow_tpu.schemas.payload import SimulationPayload
+
+    path = os.path.join(
+        REPO, "examples", "yaml_input", "data", "two_servers_lb.yml",
+    )
+    data = yaml.safe_load(open(path).read())
+    data["sim_settings"]["total_simulation_time"] = horizon
+    data["fault_timeline"] = {
+        "events": [
+            {
+                "fault_id": "srv2-outage",
+                "kind": "server_outage",
+                "target_id": "srv-2",
+                "t_start": 30.0,
+                "t_end": 80.0,
+            },
+        ],
+    }
+    data["retry_policy"] = {
+        "request_timeout_s": 2.0,
+        "max_attempts": 3,
+        "backoff_base_s": 0.1,
+        "backoff_multiplier": 2.0,
+        "backoff_cap_s": 1.0,
+    }
+    return SimulationPayload.model_validate(data)
+
+
+def _resilient_arm() -> dict:
+    """Fence burn-down arm (BENCH_RESILIENT=1 / --resilient).
+
+    Round 8 taught the scan fast path fault windows, client retries, and
+    CRN keying; auto-dispatch now routes this shape to ``fast`` instead of
+    falling back to the event engine.  This arm measures the win: a small
+    faulted+retry+CRN sweep under auto-dispatch (asserted to land on the
+    fast path, cross-checked against ``predict_routing``) against the SAME
+    sweep forced onto the event engine.  The ``fast_scen_s`` /
+    ``event_scen_s`` keys are load-bearing — ``checker/passes.py`` reads
+    them from the newest BENCH JSON to estimate the expected speedup of
+    any remaining tripped fence in AF501/AF502.
+    """
+    from asyncflow_tpu.checker.fences import predict_routing
+    from asyncflow_tpu.parallel.sweep import SweepRunner
+    from asyncflow_tpu.schemas.experiment import (
+        ExperimentConfig,
+        VarianceReduction,
+    )
+
+    horizon = int(os.environ.get("BENCH_RESILIENT_HORIZON", "120"))
+    n = int(os.environ.get("BENCH_RESILIENT_SCENARIOS", "64"))
+    res_payload = _resilient_payload(horizon)
+    exp = ExperimentConfig(variance_reduction=VarianceReduction(crn=True))
+    fast = SweepRunner(res_payload, engine="auto", use_mesh=False, experiment=exp)
+    pred = predict_routing(fast.plan, engine="auto", crn=True)
+    if fast.engine_kind != "fast" or pred.engine != fast.engine_kind:
+        msg = (
+            "resilient arm FAILED: the faulted+retry+CRN sweep must "
+            f"auto-route to the scan fast path (dispatched "
+            f"{fast.engine_kind!r}, predicted {pred.engine!r})"
+        )
+        raise AssertionError(msg)
+    event = SweepRunner(
+        res_payload, engine="event", use_mesh=False, experiment=exp,
+    )
+    # warm both compiled shapes, then measure on fresh seeds
+    fast.run(n, seed=SEED, chunk_size=n)
+    event.run(n, seed=SEED, chunk_size=n)
+    t0 = time.time()
+    rep_fast = fast.run(n, seed=SEED + 1, chunk_size=n)
+    wall_fast = time.time() - t0
+    t0 = time.time()
+    event.run(n, seed=SEED + 1, chunk_size=n)
+    wall_event = time.time() - t0
+    fast_rate = n / max(wall_fast, 1e-9)
+    event_rate = n / max(wall_event, 1e-9)
+    summary = rep_fast.summary()
+    return {
+        "n_scenarios": n,
+        "horizon_s": horizon,
+        "engine_kind": fast.engine_kind,
+        "predicted_engine": pred.engine,
+        "crn": True,
+        "completed_total": summary["completed_total"],
+        "fast_scen_s": round(fast_rate, 3),
+        "event_scen_s": round(event_rate, 3),
+        "speedup": round(fast_rate / max(event_rate, 1e-9), 2),
     }
 
 
@@ -555,6 +657,15 @@ def run_measurement() -> None:
             f"{detail['trace_guard']['scen_per_s_trace_off']:.1f} scen/s)",
             file=sys.stderr,
         )
+    if os.environ.get("BENCH_RESILIENT") == "1":
+        detail["resilient"] = _resilient_arm()
+        res = detail["resilient"]
+        print(
+            f"resilient+crn: fast {res['fast_scen_s']:.1f} vs event "
+            f"{res['event_scen_s']:.1f} scen/s ({res['speedup']:.1f}x), "
+            f"auto-dispatch -> {res['engine_kind']}",
+            file=sys.stderr,
+        )
     if on_accel:
         # Device-time breakdown.  One blocking dispatch costs
         # warm_chunk_wall_s = kernel time + tunnel round trip, and the RTT
@@ -739,6 +850,8 @@ def main() -> None:
         os.environ["BENCH_REPEATS"] = str(opts["repeats"])
     if opts["trace_guard"]:
         os.environ["BENCH_TRACE_GUARD"] = "1"
+    if opts["resilient"]:
+        os.environ["BENCH_RESILIENT"] = "1"
     if opts["checkpoint_dir"]:
         os.environ["BENCH_CHECKPOINT_DIR"] = opts["checkpoint_dir"]
     if opts["resume"]:

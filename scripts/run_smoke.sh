@@ -16,10 +16,47 @@ python -m pytest \
 # fastpath-only benchmarks (docs/guides/resilience.md)
 python -m pytest \
   tests/parity/test_resilience.py::test_seed_determinism_bit_identical \
-  tests/parity/test_resilience.py::test_fastpath_refuses_resilience_plans \
+  tests/parity/test_resilience.py::test_fastpath_accepts_resilience_plans \
   tests/parity/test_resilience.py::test_outage_fault_is_not_a_rotation_removal \
   tests/parity/test_resilience.py::test_retry_budget_exhaustion_parity \
   -q -p no:cacheprovider
+# fence burn-down slice: a small faulted + retrying + CRN sweep must
+# auto-route to the scan fast path, with predict_routing agreeing — a
+# silent fallback to the event engine exits non-zero here long before a
+# benchmark round would notice the order-of-magnitude regression
+python - <<'PY'
+import yaml
+from asyncflow_tpu.checker.fences import predict_routing
+from asyncflow_tpu.parallel.sweep import SweepRunner
+from asyncflow_tpu.schemas.experiment import ExperimentConfig, VarianceReduction
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+data = yaml.safe_load(open("tests/integration/data/single_server.yml").read())
+data["sim_settings"]["total_simulation_time"] = 30
+data["sim_settings"]["enabled_sample_metrics"] = []
+data["retry_policy"] = {
+    "request_timeout_s": 0.5, "max_attempts": 3,
+    "backoff_base_s": 0.05, "backoff_multiplier": 2.0, "backoff_cap_s": 0.5,
+}
+data["fault_timeline"] = {"events": [{
+    "fault_id": "crash", "kind": "server_outage", "target_id": "srv-1",
+    "t_start": 8.0, "t_end": 16.0,
+}]}
+payload = SimulationPayload.model_validate(data)
+exp = ExperimentConfig(variance_reduction=VarianceReduction(crn=True))
+runner = SweepRunner(payload, engine="auto", use_mesh=False, experiment=exp)
+pred = predict_routing(runner.plan, engine="auto", crn=True)
+if runner.engine_kind != "fast" or pred.engine != runner.engine_kind:
+    raise SystemExit(
+        "fence burn-down regressed: faulted+retry+CRN sweep dispatched "
+        f"{runner.engine_kind!r}, predicted {pred.engine!r} (expected 'fast')"
+    )
+rep = runner.run(8, seed=3, chunk_size=4)
+assert int(rep.results.total_rejected.sum()) > 0, "the outage must bite"
+assert rep.results.total_retries is not None, "retry counters must surface"
+print("faulted+CRN sweep on the scan fast path OK "
+      f"(engine={runner.engine_kind}, predicted={pred.engine})")
+PY
 # analysis slice: one tiny adaptive run + one CRN compare through the
 # event engine, plus the substream contract they depend on
 # (docs/guides/mc-inference.md)

@@ -87,6 +87,36 @@ def _event_stats(payload, n=SEEDS):
     )
 
 
+def _fastpath_stats(payload, n=SEEDS):
+    """Same counters off the scan fast path (round-8 fence burn-down):
+    one compiled batched FastEngine for all n seeds."""
+    from asyncflow_tpu.engines.jaxsim.engine import scenario_keys
+    from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+
+    plan = compile_payload(payload)
+    engine = FastEngine(plan, collect_clocks=True)
+    fin = engine.run_batch(scenario_keys(11, n))
+    clock = np.asarray(fin.clock)
+    cnt = np.asarray(fin.clock_n)
+    lats = np.concatenate(
+        [clock[i, : cnt[i], 1] - clock[i, : cnt[i], 0] for i in range(n)],
+    )
+    gen = int(np.sum(np.asarray(fin.n_generated)))
+    retries = int(np.sum(np.asarray(fin.n_retries)))
+    att = (
+        np.asarray(fin.att_hist).sum(axis=0) if plan.has_retry else None
+    )
+    return (
+        gen + retries,
+        int(np.sum(np.asarray(fin.n_rejected))),
+        int(np.sum(np.asarray(fin.n_timed_out))),
+        retries,
+        int(np.sum(np.asarray(fin.n_budget_exhausted))),
+        att,
+        lats,
+    )
+
+
 def _assert_rates(name, a, b, *, frac_tol=0.04, lat_tol=0.08):
     gen_a, rej_a, to_a, re_a, be_a, att_a, lat_a = a
     gen_b, rej_b, to_b, re_b, be_b, att_b, lat_b = b
@@ -211,6 +241,10 @@ def _tight_timeout(data) -> None:
 @pytest.mark.slow
 def test_outage_breaker_parity() -> None:
     payload = _payload(_outage_with_breaker, base=LB)
+    # the round-8 burn-down covers fault windows / retries / CRN, NOT the
+    # breaker's live failure channel: this plan must stay off the fast path
+    plan = compile_payload(payload)
+    assert not plan.fastpath_ok
     a = _oracle_stats(payload)
     b = _event_stats(payload)
     # the outage must actually bite: both engines reject a visible share
@@ -224,8 +258,10 @@ def test_retry_backoff_queue_timeout_parity() -> None:
     payload = _payload(_retry_under_queue_timeout)
     a = _oracle_stats(payload)
     b = _event_stats(payload)
-    assert a[3] > 0 and b[3] > 0, "retries must actually occur"
+    c = _fastpath_stats(payload)
+    assert a[3] > 0 and b[3] > 0 and c[3] > 0, "retries must actually occur"
     _assert_rates("retry+queue-timeout", a, b)
+    _assert_rates("retry+queue-timeout/fastpath", a, c)
 
 
 @pytest.mark.slow
@@ -233,8 +269,10 @@ def test_retry_budget_exhaustion_parity() -> None:
     payload = _payload(_budget_exhaustion)
     a = _oracle_stats(payload)
     b = _event_stats(payload)
-    assert a[4] > 0 and b[4] > 0, "the budget must actually exhaust"
+    c = _fastpath_stats(payload)
+    assert a[4] > 0 and b[4] > 0 and c[4] > 0, "the budget must actually exhaust"
     _assert_rates("budget-exhaustion", a, b)
+    _assert_rates("budget-exhaustion/fastpath", a, c)
 
 
 @pytest.mark.slow
@@ -244,8 +282,10 @@ def test_client_timeout_orphans_parity() -> None:
     payload = _payload(_tight_timeout)
     a = _oracle_stats(payload)
     b = _event_stats(payload)
-    assert a[2] > 0 and b[2] > 0, "timeouts must actually fire"
+    c = _fastpath_stats(payload)
+    assert a[2] > 0 and b[2] > 0 and c[2] > 0, "timeouts must actually fire"
     _assert_rates("client-timeout", a, b)
+    _assert_rates("client-timeout/fastpath", a, c)
 
 
 # ---------------------------------------------------------------------------
@@ -269,13 +309,15 @@ def test_seed_determinism_bit_identical() -> None:
     assert np.array_equal(j1.attempts_hist, j2.attempts_hist)
 
 
-def test_fastpath_refuses_resilience_plans() -> None:
-    """The compiler must route retry/fault scenarios OFF the scan engine
-    with an actionable diagnostic."""
+def test_fastpath_accepts_resilience_plans() -> None:
+    """Round-8 fence burn-down: retry/fault scenarios are fastpath-eligible
+    and auto-dispatch (mirrored by ``predict_routing``) lands on the scan
+    engine — including with CRN keying on."""
+    from asyncflow_tpu.checker.fences import predict_routing
+    from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+
     retry_plan = compile_payload(_payload(_tight_timeout, horizon=30))
-    assert not retry_plan.fastpath_ok
-    assert "retry policy" in retry_plan.fastpath_reason
-    assert "event" in retry_plan.fastpath_reason
+    assert retry_plan.fastpath_ok, retry_plan.fastpath_reason
 
     def only_fault(data):
         data["fault_timeline"] = {
@@ -291,13 +333,191 @@ def test_fastpath_refuses_resilience_plans() -> None:
         }
 
     fault_plan = compile_payload(_payload(only_fault, horizon=30))
-    assert not fault_plan.fastpath_ok
-    assert "fault timeline" in fault_plan.fastpath_reason
+    assert fault_plan.fastpath_ok, fault_plan.fastpath_reason
 
+    for plan in (retry_plan, fault_plan):
+        assert predict_routing(plan, engine="auto").engine == "fast"
+        assert predict_routing(plan, engine="auto", crn=True).engine == "fast"
+        FastEngine(plan)  # constructs without an eligibility refusal
+
+
+def test_retry_multi_generator_stays_fenced() -> None:
+    """The one surviving resilience restriction: the retry re-issue walks
+    a single generator's entry chain, so retry x multi-generator is still
+    refused — at schema validation, before any engine can see it."""
+    from pydantic import ValidationError
+
+    data = yaml.safe_load(open(LB).read())
+    data["sim_settings"]["total_simulation_time"] = 30
+    data["retry_policy"] = {
+        "request_timeout_s": 1.0,
+        "max_attempts": 2,
+        "backoff_base_s": 0.05,
+        "backoff_multiplier": 2.0,
+        "backoff_cap_s": 0.5,
+    }
+    data["rqs_input"] = [
+        {
+            "id": "rqs-1",
+            "avg_active_users": {"mean": 20},
+            "avg_request_per_minute_per_user": {"mean": 20},
+            "user_sampling_window": 30,
+        },
+        {
+            "id": "rqs-2",
+            "avg_active_users": {"mean": 10},
+            "avg_request_per_minute_per_user": {"mean": 40},
+            "user_sampling_window": 30,
+        },
+    ]
+    data["topology_graph"]["edges"].append(
+        {
+            "id": "gen2-client",
+            "source": "rqs-2",
+            "target": "client-1",
+            "latency": {"mean": 0.004, "distribution": "exponential"},
+        },
+    )
+    with pytest.raises(
+        ValidationError, match="retry_policy with multiple generators",
+    ):
+        SimulationPayload.model_validate(data)
+
+
+def test_crn_couples_resilient_deltas_on_fastpath() -> None:
+    """CRN keying on the burned-down fast path: a paired A/B comparison
+    (1.3x edge-latency candidate) over a RESILIENT plan — retry policy +
+    mid-run outage, the combination that routed to the event engine before
+    round 8 — couples its arms on BOTH engines and yields the same p95
+    regression at equal n.  ``engine="fast"`` here only constructs at all
+    because the resilience + CRN fences are burned; the low-utilization
+    regime keeps the engines inside ordinary parity tolerances, so the
+    paired deltas must agree, not just correlate."""
+    from asyncflow_tpu.analysis.compare import compare
+
+    def resilient(data) -> None:
+        data["retry_policy"] = {
+            "request_timeout_s": 1.0,
+            "max_attempts": 3,
+            "backoff_base_s": 0.05,
+            "backoff_multiplier": 2.0,
+            "backoff_cap_s": 0.5,
+        }
+        data["fault_timeline"] = {
+            "events": [
+                {
+                    "fault_id": "crash",
+                    "kind": "server_outage",
+                    "target_id": "srv-1",
+                    "t_start": 20.0,
+                    "t_end": 30.0,
+                },
+            ],
+        }
+
+    payload = _payload(resilient, horizon=60)
+    n = 12
+    reports = {}
+    for engine in ("fast", "event"):
+        reports[engine] = compare(
+            payload,
+            None,
+            {"edge_mean_scale": np.full(n, 1.3)},
+            n_scenarios=n,
+            seed=7,
+            engine=engine,
+            use_mesh=False,
+            metrics=("latency_p95_s", "goodput_fraction"),
+            n_boot=400,
+        )
+    for engine, rep in reports.items():
+        assert rep.coupled, engine
+        assert rep.engine == engine
+        corr = rep.coupling["latency_p95_s"]["correlation"]
+        assert corr > 0.9, (engine, corr)
+    d_fast = reports["fast"].deltas["latency_p95_s"]
+    d_event = reports["event"].deltas["latency_p95_s"]
+    # the 1.3x edge candidate must decisively slow p95 on both engines,
+    # by the same amount (the engines draw from different RNG families,
+    # so agreement is on the paired point estimate, not bit-level)
+    assert d_fast.lo > 0.0, d_fast
+    assert d_event.lo > 0.0, d_event
+    assert abs(d_fast.point - d_event.point) <= 0.2 * max(
+        d_fast.point, d_event.point,
+    ), (d_fast.point, d_event.point)
+    # and the edge scale must not cost goodput on either engine
+    for engine, rep in reports.items():
+        g = rep.deltas["goodput_fraction"]
+        assert abs(g.point) < 0.01, (engine, g)
+
+
+def test_fault_table_over_dense_bound_is_bit_identical() -> None:
+    """AF404 regression: a fault timeline with more breakpoints than
+    searchsorted_small's dense-compare bound routes every lookup through
+    the ``jnp.searchsorted`` fallback.  Splitting one degrade window into
+    hundreds of contiguous same-factor sub-windows (same piecewise
+    function, >256-entry table) must not change a single bit of the fast
+    path's results — and the static checker must warn about the cliff."""
+    import jax
+
+    from asyncflow_tpu.checker.passes import check_payload
+    from asyncflow_tpu.engines.jaxsim.engine import scenario_keys
     from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+    from asyncflow_tpu.engines.jaxsim.sortutil import DENSE_TABLE_MAX
 
-    with pytest.raises(ValueError, match="not eligible"):
-        FastEngine(retry_plan)
+    def one_window(data):
+        data["fault_timeline"] = {
+            "events": [
+                {
+                    "fault_id": "deg",
+                    "kind": "edge_degrade",
+                    "target_id": "client-srv",
+                    "t_start": 10.0,
+                    "t_end": 70.0,
+                    "latency_factor": 2.5,
+                    "dropout_boost": 0.05,
+                },
+            ],
+        }
+
+    def many_windows(data):
+        n = 300
+        w = 60.0 / n
+        data["fault_timeline"] = {
+            "events": [
+                {
+                    "fault_id": f"deg-{i}",
+                    "kind": "edge_degrade",
+                    "target_id": "client-srv",
+                    # shared boundaries: t_end of window i IS t_start of
+                    # window i+1, bit-for-bit, so the unique-time lowering
+                    # never opens an unfaulted sliver between sub-windows
+                    "t_start": 10.0 + i * w,
+                    "t_end": 10.0 + (i + 1) * w,
+                    "latency_factor": 2.5,
+                    "dropout_boost": 0.05,
+                }
+                for i in range(n)
+            ],
+        }
+
+    payload_small = _payload(one_window)
+    payload_big = _payload(many_windows)
+    plan_small = compile_payload(payload_small)
+    plan_big = compile_payload(payload_big)
+    assert len(plan_small.fault_edge_times) <= DENSE_TABLE_MAX
+    assert len(plan_big.fault_edge_times) > DENSE_TABLE_MAX
+    report = check_payload(payload_big, plan=plan_big)
+    assert "AF404" in report.codes()
+
+    keys = scenario_keys(5, 4)
+    fin_small = FastEngine(plan_small).run_batch(keys)
+    fin_big = FastEngine(plan_big).run_batch(keys)
+    for leaf_a, leaf_b in zip(
+        jax.tree_util.tree_leaves(fin_small),
+        jax.tree_util.tree_leaves(fin_big),
+    ):
+        np.testing.assert_array_equal(np.asarray(leaf_a), np.asarray(leaf_b))
 
 
 def test_outage_fault_is_not_a_rotation_removal() -> None:

@@ -45,6 +45,7 @@ def test_registry_covers_every_known_fence() -> None:
         "trace.fast", "trace.pallas", "trace.native",
         "vr.pallas", "vr.native",
         "resilience.pallas", "resilience.native",
+        "tail_tolerance.pallas", "tail_tolerance.native",
         "fastpath.ineligible", "fastpath.poisson_edge",
         "native.unavailable", "gauge_series.requires_fast",
     }
@@ -106,7 +107,8 @@ def test_sweep_resilience_refusals_match_registry() -> None:
     ("mut", "kwargs", "expected"),
     [
         (None, {}, "fast"),
-        (_resilient, {}, "event"),
+        # round-8 burn-down: faulted/retrying plans route fast on auto
+        (_resilient, {}, "fast"),
         (None, {"trace": TraceConfig(sample_requests=4)}, "event"),
         (None,
          {"experiment": ExperimentConfig(

@@ -53,11 +53,12 @@ def _resilient(data) -> None:
 
 
 def test_fault_sweep_end_to_end_and_deterministic() -> None:
-    """A fault-timing sweep batches on the event engine, produces the new
-    per-scenario counters, and is deterministic under a fixed seed."""
+    """A fault-timing sweep auto-routes to the scan fast path (round-8
+    fence burn-down), produces the per-scenario resilience counters, and
+    is deterministic under a fixed seed."""
     payload = _payload(_resilient)
     runner = SweepRunner(payload, engine="auto", use_mesh=False)
-    assert runner.engine_kind == "event"
+    assert runner.engine_kind == "fast"
     n = 8
     shifts = np.linspace(0.0, 15.0, n)
     ov = make_overrides(
@@ -95,6 +96,37 @@ def test_resilient_plans_refuse_native_and_pallas() -> None:
             SweepRunner(payload, engine=engine, use_mesh=False)
 
 
+def test_scan_inner_decided_once_after_routing() -> None:
+    """``scan_inner`` is a fast-path-only knob, decided AFTER the engine
+    is known: the native C++ core never scans (the old code path defaulted
+    ``_scan_inner`` before routing, leaving a stale value on non-fast
+    engines), and the event engine dispatches on 0 too."""
+    if _native_available():
+        native = SweepRunner(
+            _payload(), engine="native", use_mesh=False, scan_inner=8,
+        )
+        assert native.engine_kind == "native"
+        assert native._scan_inner == 0
+    event = SweepRunner(
+        _payload(), engine="event", use_mesh=False, scan_inner=8,
+    )
+    assert event.engine_kind == "event"
+    assert event._scan_inner == 0
+    fast_default = SweepRunner(_payload(), engine="fast", use_mesh=False)
+    assert fast_default.engine_kind == "fast"
+    assert fast_default._scan_inner == 16
+    fast_explicit = SweepRunner(
+        _payload(), engine="fast", use_mesh=False, scan_inner=4,
+    )
+    assert fast_explicit._scan_inner == 4
+
+
+def _native_available() -> bool:
+    from asyncflow_tpu.engines.oracle.native import native_available
+
+    return native_available()
+
+
 def test_fault_overrides_need_fault_plan() -> None:
     runner = SweepRunner(_payload(), engine="auto", use_mesh=False)
     with pytest.raises(ValueError, match="fault_timeline"):
@@ -128,7 +160,10 @@ def test_sweep_survives_injected_oom_with_downshift(monkeypatch) -> None:
     baseline = runner.run(n, seed=9, chunk_size=8)
 
     runner2 = SweepRunner(payload, engine="auto", use_mesh=False)
-    real_run_batch = runner2.engine.run_batch
+    # auto routes this resilient plan to the scan fast path (round 8),
+    # whose sweeps dispatch through run_batch_scanned when scan_inner > 0
+    target = "run_batch_scanned" if runner2._scan_inner else "run_batch"
+    real_run_batch = getattr(runner2.engine, target)
     calls = {"n": 0}
 
     def flaky_run_batch(keys, ov=None, **kw):
@@ -138,7 +173,7 @@ def test_sweep_survives_injected_oom_with_downshift(monkeypatch) -> None:
             raise _FakeOOM(msg)
         return real_run_batch(keys, ov, **kw)
 
-    monkeypatch.setattr(runner2.engine, "run_batch", flaky_run_batch)
+    monkeypatch.setattr(runner2.engine, target, flaky_run_batch)
     report = runner2.run(n, seed=9, chunk_size=8)
     assert report.downshifts == [{"scenario_start": 0, "from": 8, "to": 4}]
     assert np.array_equal(report.results.completed, baseline.results.completed)
