@@ -41,25 +41,16 @@ class Fence:
 
 
 _TRACE_REMEDY = (
-    "use the event engine (engine='event', or 'auto', which routes "
-    "traced runs there)"
+    "use engine='event' or 'fast' (or 'auto', which routes traced "
+    "fastpath-eligible plans to the scan fast path)"
 )
 
 FENCES: dict[str, Fence] = {
     f.id: f
     for f in (
         # -- flight recorder (trace=TraceConfig) ---------------------------
-        Fence(
-            id="trace.fast",
-            feature="flight recorder (trace=TraceConfig)",
-            engine="fast",
-            message=(
-                "engine='fast' cannot run the flight recorder "
-                "(trace=TraceConfig): the scan fast path computes request "
-                "trajectories in closed form and has no per-event state to "
-                "record; " + _TRACE_REMEDY
-            ),
-        ),
+        # (trace.fast was burned: the scan fast path now derives the same
+        # FlightRecord rings analytically from per-lane journey state)
         Fence(
             id="trace.pallas",
             feature="flight recorder (trace=TraceConfig)",
@@ -274,7 +265,7 @@ def tripped_fences(
     """
     out: list[TrippedFence] = []
     if trace:
-        out += [_trip("trace.fast"), _trip("trace.pallas"), _trip("trace.native")]
+        out += [_trip("trace.pallas"), _trip("trace.native")]
     if crn or antithetic:
         out += [_trip("vr.pallas"), _trip("vr.native")]
     if plan.has_faults or plan.has_retry:
@@ -305,9 +296,9 @@ def predict_routing(
     This mirrors ``SweepRunner.__init__`` exactly (the fence-prediction
     parity test locks the two together): forced engines refuse tripped
     fences with the registry message; ``engine='auto'`` routes fast if the
-    plan is fastpath-eligible and untraced, else pallas on TPU when the
-    plan is neither resilient nor VR-coupled nor traced, else the XLA
-    event engine.
+    plan is fastpath-eligible (traced or not — the flight recorder runs on
+    the fast path), else pallas on TPU when the plan is neither resilient
+    nor VR-coupled nor traced, else the XLA event engine.
 
     ``backend`` defaults to ``jax.default_backend()`` (the only jax touch,
     resolved lazily); ``native_ok`` defaults to probing the C++ core only
@@ -339,7 +330,7 @@ def predict_routing(
         )
 
     # forced engines: the constructor raises on a tripped fence
-    if trace and engine in ("fast", "pallas", "native"):
+    if trace and engine in ("pallas", "native"):
         return refused(f"trace.{engine}")
     if vr_coupled and engine in ("pallas", "native"):
         return refused(f"vr.{engine}")
@@ -358,9 +349,14 @@ def predict_routing(
             return refused("native.unavailable")
 
     if engine == "auto":
-        if plan.fastpath_ok and not trace:
+        if plan.fastpath_ok:
             kind = "fast"
-            why = "plan is fastpath-eligible and untraced"
+            why = (
+                "plan is fastpath-eligible (the flight recorder rides "
+                "the fast path)"
+                if trace
+                else "plan is fastpath-eligible"
+            )
         elif (
             backend == "tpu"
             and not resilient

@@ -2892,18 +2892,12 @@ def run_single(
     if tracing and engine == "fast":
         msg = "collect_traces needs the event engine (engine='event'/'auto')"
         raise ValueError(msg)
-    # the flight recorder records per-event lifecycle state the closed-form
-    # fast path never materializes; 'auto' routes traced runs to the event
-    # engine, forcing 'fast' is an explicit error
+    # the flight recorder runs on both the event engine and the scan fast
+    # path (the fast path derives the same spans analytically from per-lane
+    # journey state), so tracing no longer forces an engine choice
     trace = engine_kw.pop("trace", None)
     if trace is not None and not isinstance(trace, TraceConfig):
         trace = TraceConfig.model_validate(trace)
-    if trace is not None and engine == "fast":
-        # canonical refusal from the shared fence registry (the static
-        # checker predicts this exact message)
-        from asyncflow_tpu.checker.fences import raise_fence
-
-        raise_fence("trace.fast")
     # Gauge recording is gated on the settings like the oracle's collector —
     # unless the caller explicitly forced it, in which case everything
     # recorded is also returned.
@@ -2921,7 +2915,6 @@ def run_single(
         and plan.fastpath_ok
         and not pool_tuned
         and not tracing
-        and trace is None
     )
     if use_fast:
         from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
@@ -2929,7 +2922,7 @@ def run_single(
         if pool_tuned:
             msg = "pool_size applies to the event engine; use max_requests here"
             raise ValueError(msg)
-        sim_engine: Engine | FastEngine = FastEngine(plan, **engine_kw)
+        sim_engine: Engine | FastEngine = FastEngine(plan, trace=trace, **engine_kw)
     else:
         sim_engine = Engine(
             plan, collect_traces=tracing, trace=trace, **engine_kw,
@@ -3022,9 +3015,10 @@ def run_single(
         flight = decode_flight(
             state.fr_ev, state.fr_node, state.fr_t, state.fr_n,
         )
-        breaker_timeline = decode_breaker(
-            state.bk_t, state.bk_slot, state.bk_state, state.bk_n,
-        )
+        if hasattr(state, "bk_t"):  # the fast path carries no breaker ring
+            breaker_timeline = decode_breaker(
+                state.bk_t, state.bk_slot, state.bk_state, state.bk_n,
+            )
 
     llm_cost = None
     if plan.has_llm and sim_engine.collect_clocks and hasattr(state, "llm_store"):

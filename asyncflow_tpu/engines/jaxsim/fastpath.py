@@ -101,6 +101,23 @@ from asyncflow_tpu.engines.jaxsim.rotation import (
     rotation_remove,
 )
 from asyncflow_tpu.engines.jaxsim.sortutil import searchsorted_small, time_rank
+from asyncflow_tpu.observability.simtrace import (
+    FR_ARRIVE_LB,
+    FR_ARRIVE_SRV,
+    FR_ABANDON,
+    FR_COMPLETE,
+    FR_DROP,
+    FR_REJECT,
+    FR_RETRY,
+    FR_RUN,
+    FR_SPAWN,
+    FR_TIMEOUT,
+    FR_TRANSIT,
+    FR_WAIT_CPU,
+    FR_WAIT_DB,
+    FR_WAIT_RAM,
+    TraceConfig,
+)
 from asyncflow_tpu.observability.telemetry import instrument_jit
 from asyncflow_tpu.engines.jaxsim.sampling import (
     antithetic_trace,
@@ -154,6 +171,14 @@ class FastState(NamedTuple):
     #: (max_attempts,) attempts used per ENDED logical request (completed
     #: or given up); shape (1,) without a retry plan
     att_hist: jnp.ndarray
+    #: flight-recorder rings (K, slots)/(K,), identical layout to the event
+    #: engine's (observability/simtrace.py) — derived analytically from the
+    #: per-lane journey state; (1, 1)/(1,) placeholders when untraced so
+    #: untraced programs stay bit-identical to pre-trace builds
+    fr_ev: jnp.ndarray
+    fr_node: jnp.ndarray
+    fr_t: jnp.ndarray
+    fr_n: jnp.ndarray
 
 
 def _kw_waits(
@@ -398,6 +423,119 @@ def _socket_station_scan(
     return wait, refused, shed, abandoned
 
 
+class _FlightTape:
+    """Per-lane flight-record CANDIDATE stream for the analytic recorder.
+
+    The event engine appends ring entries as its heap processes events; the
+    fast path has no event loop, but along any single lane the pipeline
+    emits its lifecycle transitions in event-PROCESSING order already (each
+    stage's processing time is >= the previous stage's, and the entry chain
+    is walked inside the spawn event like the event engine does).  So the
+    recorder reduces to: collect ``(code, node, record_time, process_time,
+    predicate)`` candidates in emission order, then per traced lane keep the
+    predicate-true ones — a masked cumsum scatter, no sort.  ``process_time``
+    is kept per candidate only for the retry driver's orphan masking (events
+    processed at or after a fired client deadline are invisible, mirroring
+    the event engine's ``req_fr`` detach).  Tracing consumes ZERO draws:
+    every candidate reuses quantities the journey already computed.
+    """
+
+    __slots__ = ("n", "cands")
+
+    def __init__(self, n: int) -> None:
+        self.n = n
+        self.cands: list[tuple] = []
+
+    def emit(self, code: int, node, rec_t, proc_t, pred) -> None:
+        n = self.n
+        self.cands.append((
+            int(code),
+            jnp.broadcast_to(jnp.asarray(node, jnp.int32), (n,)),
+            jnp.broadcast_to(jnp.asarray(rec_t, jnp.float32), (n,)),
+            jnp.broadcast_to(jnp.asarray(proc_t, jnp.float32), (n,)),
+            jnp.broadcast_to(jnp.asarray(pred, bool), (n,)),
+        ))
+
+    def emit_slice(
+        self, code: int, node, rec_t, proc_t, pred, off: int, n_g: int,
+    ) -> None:
+        """Emit a candidate that lives on one generator's static slot slice
+        (entry-chain hops); lanes outside the slice get a False predicate."""
+        z = jnp.zeros(self.n, jnp.float32)
+        self.emit(
+            code,
+            node,
+            z.at[off : off + n_g].set(jnp.broadcast_to(rec_t, (n_g,))),
+            z.at[off : off + n_g].set(jnp.broadcast_to(proc_t, (n_g,))),
+            jnp.zeros(self.n, bool).at[off : off + n_g].set(pred),
+        )
+
+
+def _flight_rings(cands, K: int, slots: int, *, lanes=None, blocks=None):
+    """Candidate stream -> ``(fr_ev, fr_node, fr_t, fr_n)`` rings.
+
+    ``lanes``: (K2,) traced lane per trace row (non-retry: spawn-order =
+    arrival-time order).  ``blocks = (A, n1)``: retry lane blocks — logical
+    request r's ring is the attempt-major concat of its per-block candidate
+    columns (lane ``a*n1 + r``), reproducing "a logical request keeps its
+    record across re-issues".  Writes past the slot budget are counted in
+    ``fr_n`` but not stored — ``FlightRecord.dropped`` stays explicit.
+    """
+    ev = jnp.stack([jnp.full_like(c[1], c[0]) for c in cands])  # (C, n)
+    node = jnp.stack([c[1] for c in cands])
+    rec = jnp.stack([c[2] for c in cands])
+    pred = jnp.stack([c[4] for c in cands])
+    if blocks is not None:
+        A, n1 = blocks
+        C = ev.shape[0]
+
+        def fold(a):
+            return (
+                a.reshape(C, A, n1).transpose(1, 0, 2).reshape(A * C, n1)
+            )
+
+        k2 = min(K, n1)
+        ev, node, rec, pred = (
+            fold(ev)[:, :k2],
+            fold(node)[:, :k2],
+            fold(rec)[:, :k2],
+            fold(pred)[:, :k2],
+        )
+    else:
+        k2 = int(lanes.shape[0])
+        ev, node, rec, pred = (
+            ev[:, lanes],
+            node[:, lanes],
+            rec[:, lanes],
+            pred[:, lanes],
+        )
+    cnt = jnp.cumsum(pred.astype(jnp.int32), axis=0) - pred
+    sloti = jnp.where(pred & (cnt < slots), cnt, slots)  # slots -> dropped
+    rows = jnp.broadcast_to(
+        jnp.arange(k2, dtype=jnp.int32)[None, :], sloti.shape,
+    )
+    fr_ev = (
+        jnp.zeros((k2, slots), jnp.int32).at[rows, sloti].set(ev, mode="drop")
+    )
+    fr_node = (
+        jnp.zeros((k2, slots), jnp.int32)
+        .at[rows, sloti]
+        .set(node, mode="drop")
+    )
+    fr_t = (
+        jnp.zeros((k2, slots), jnp.float32)
+        .at[rows, sloti]
+        .set(rec, mode="drop")
+    )
+    fr_n = jnp.sum(pred, axis=0).astype(jnp.int32)
+    if k2 < K:
+        fr_ev = jnp.pad(fr_ev, ((0, K - k2), (0, 0)))
+        fr_node = jnp.pad(fr_node, ((0, K - k2), (0, 0)))
+        fr_t = jnp.pad(fr_t, ((0, K - k2), (0, 0)))
+        fr_n = jnp.pad(fr_n, (0, K - k2))
+    return fr_ev, fr_node, fr_t, fr_n
+
+
 class FastEngine:
     """Batched scan engine for one eligible :class:`StaticPlan`."""
 
@@ -414,10 +552,6 @@ class FastEngine:
         gauge_series_stride: int = 0,
         trace=None,
     ) -> None:
-        if trace is not None:
-            # canonical refusal from the shared fence registry (the static
-            # checker predicts this exact message)
-            raise_fence("trace.fast")
         """``gauge_series_stride``: with ``collect_gauges=False``, a stride
         k > 0 collects every gauge on a grid coarsened k-fold
         (period ``sample_period * k``) — the sweep-scale streaming series:
@@ -435,6 +569,12 @@ class FastEngine:
             msg = f"gauge_series_stride must be >= 0, got {gauge_series_stride}"
             raise ValueError(msg)
         self.plan = plan
+        if trace is not None and not isinstance(trace, TraceConfig):
+            trace = TraceConfig.model_validate(trace)
+        #: flight recorder config — the rings are assembled analytically
+        #: from per-lane journey state (no draws, no event loop); sweep
+        #: plumbing reads this attribute to persist flight_* arrays
+        self.trace = trace
         self.collect_gauges = collect_gauges
         self.collect_clocks = collect_clocks
         if collect_gauges:
@@ -948,6 +1088,7 @@ class FastEngine:
         gauge_means,
         *,
         record: bool = True,
+        tape: _FlightTape | None = None,
     ):
         """One full pass of the post-arrival pipeline: entry chain ->
         routing -> server topo loop -> completion.
@@ -961,7 +1102,9 @@ class FastEngine:
         engine walks the chain inside the spawn event), every other
         fail-fast site at its own event time.  ``record=False`` skips all
         gauge/counter accumulation: the retry driver's relaxation passes
-        only need the outcome times."""
+        only need the outcome times.  ``tape`` collects flight-record
+        candidates (code, node, record time, processing time, predicate) in
+        per-lane event-processing order — the caller assembles the rings."""
         plan = self.plan
         n = t.shape[0]
         n_dropped = jnp.int32(0)
@@ -1014,6 +1157,16 @@ class FastEngine:
                         span(t_g, t_g + delay, ok),
                     )
                     n_dropped = n_dropped + jnp.sum(alive_g & dropped)
+                if tape is not None:
+                    # chain hops are walked inside the spawn event: record
+                    # times advance hop by hop, processing time stays at the
+                    # attempt's issue instant (event engine spawn branch)
+                    tape.emit_slice(
+                        FR_DROP, eidx, t_g, t0_g, alive_g & dropped, off, n_g,
+                    )
+                    tape.emit_slice(
+                        FR_TRANSIT, eidx, t_g + delay, t0_g, ok, off, n_g,
+                    )
                 f_g = jnp.where(alive_g & dropped, t0_g, f_g)
                 t_g = jnp.where(ok, t_g + delay, t_g)
                 alive_g = ok
@@ -1037,6 +1190,8 @@ class FastEngine:
         alive = alive & (t < plan.horizon)
         srv = jnp.full(n, jnp.int32(max(plan.entry_target, 0)))
         if plan.n_lb_edges > 0:
+            if tape is not None:
+                tape.emit(FR_ARRIVE_LB, -1, t, t, alive)
             if plan.lb_algo == 1:
                 # least connections needs every slot's CANDIDATE delivery
                 # time for the in-flight rings, so outcomes are pre-drawn
@@ -1053,6 +1208,9 @@ class FastEngine:
                 drop_s = jnp.stack(drops, axis=1)  # (n, EL)
                 delay_s = jnp.stack(delays, axis=1)
                 slot, routed = self._routed_slots_lc(t, alive, drop_s, delay_s)
+                if tape is not None:
+                    # no healthy target: dropped at the LB (node -1)
+                    tape.emit(FR_DROP, -1, t, t, alive & ~routed)
                 if record:
                     n_dropped = n_dropped + jnp.sum(alive & ~routed)
                 fail_t = jnp.where(alive & ~routed, t, fail_t)
@@ -1077,6 +1235,8 @@ class FastEngine:
                     # order, interleaving the outage timeline (slot -1 = no
                     # healthy target, request dropped like the event engines)
                     slot, routed = self._routed_slots(t, alive)
+                    if tape is not None:
+                        tape.emit(FR_DROP, -1, t, t, alive & ~routed)
                     if record:
                         n_dropped = n_dropped + jnp.sum(alive & ~routed)
                     fail_t = jnp.where(alive & ~routed, t, fail_t)
@@ -1088,6 +1248,9 @@ class FastEngine:
                 )
             srv = jnp.asarray(plan.lb_target)[slot]
             ok = alive & ~dropped
+            if tape is not None:
+                tape.emit(FR_DROP, eidx_arr, t, t, alive & dropped)
+                tape.emit(FR_TRANSIT, eidx_arr, t + delay, t, ok)
             if record:
                 gauge = self._gauge_intervals(
                     gauge, eidx_arr, t, t + delay, 1.0, ok,
@@ -1159,6 +1322,8 @@ class FastEngine:
                 dark = mine & (
                     jnp.asarray(plan.fault_srv_down)[fidx, s] == 1
                 )
+                if tape is not None:
+                    tape.emit(FR_REJECT, s, t, t, dark)
                 if record:
                     n_rejected = n_rejected + jnp.sum(dark)
                 fail_t = jnp.where(dark, t, fail_t)
@@ -1183,6 +1348,8 @@ class FastEngine:
                 )
                 accepted = acc_sorted[rank_rl]
                 limited = mine & ~accepted
+                if tape is not None:
+                    tape.emit(FR_REJECT, s, t, t, limited)
                 if record:
                     n_rejected = n_rejected + jnp.sum(limited)
                 fail_t = jnp.where(limited, t, fail_t)
@@ -1256,6 +1423,10 @@ class FastEngine:
             )
             controlled = cap_s >= 0 or qto_s >= 0
 
+            if tape is not None and conn_s < 0:
+                # socket-capacity servers defer this: their pre-admission
+                # refusals must precede FR_ARRIVE_SRV (event arrival order)
+                tape.emit(FR_ARRIVE_SRV, s, t, t, mine)
             if conn_s >= 0:
                 # socket capacity (+ any cap/deadline): joint arrival-order
                 # pass — compiler guarantees kb <= 1, no RAM tier, no
@@ -1289,6 +1460,19 @@ class FastEngine:
                     mine & is_b & ~refused & ~shed, wait_s_[rank_c], 0.0,
                 )
                 rejected = refused | shed | abandoned
+                if tape is not None:
+                    enq0 = t + pre0
+                    qwait = (
+                        mine & is_b & ~refused & ~shed & (W_c > 0)
+                    )
+                    tape.emit(FR_REJECT, s, t, t, refused)
+                    tape.emit(FR_ARRIVE_SRV, s, t, t, mine & ~refused)
+                    tape.emit(FR_REJECT, s, enq0, enq0, shed)
+                    tape.emit(FR_WAIT_CPU, s, enq0, enq0, qwait)
+                    tape.emit(FR_RUN, s, enq0 + W_c, enq0 + W_c, qwait)
+                    tape.emit(
+                        FR_REJECT, s, enq0 + W_c, enq0 + W_c, abandoned,
+                    )
                 if record:
                     n_rejected = n_rejected + jnp.sum(rejected)
                 # refused fail at arrival, shed at enqueue, abandons after
@@ -1357,6 +1541,15 @@ class FastEngine:
                 shed = part & shed_s[rank_c]
                 abandoned = part & aband_s[rank_c]
                 rejected = shed | abandoned
+                if tape is not None:
+                    enq0 = t + pre0
+                    qwait = part & ~shed & (W_c > 0)
+                    tape.emit(FR_REJECT, s, enq0, enq0, shed)
+                    tape.emit(FR_WAIT_CPU, s, enq0, enq0, qwait)
+                    tape.emit(FR_RUN, s, enq0 + W_c, enq0 + W_c, qwait)
+                    tape.emit(
+                        FR_REJECT, s, enq0 + W_c, enq0 + W_c, abandoned,
+                    )
                 if record:
                     n_rejected = n_rejected + jnp.sum(rejected)
                 # shed never enters the ready queue (fails at enqueue, which
@@ -1396,6 +1589,17 @@ class FastEngine:
                 w_cpu = w_cpu_s[rank_r]
                 W_ram = jnp.where(mine, W_ram, 0.0)
                 w_cpu = jnp.where(mine & (dur0 > 0), w_cpu, 0.0)
+                if tape is not None:
+                    # blocked-acquire pattern: WAIT at enqueue + RUN at the
+                    # grant, nothing when the resource was free (the event
+                    # engine's _resume_branch / _cpu_handoff discipline)
+                    rwait = mine & (W_ram > 0)
+                    tape.emit(FR_WAIT_RAM, s, t, t, rwait)
+                    tape.emit(FR_RUN, s, t + W_ram, t + W_ram, rwait)
+                    enq0 = t + W_ram + pre0
+                    qwait = mine & (w_cpu > 0)
+                    tape.emit(FR_WAIT_CPU, s, enq0, enq0, qwait)
+                    tape.emit(FR_RUN, s, enq0 + w_cpu, enq0 + w_cpu, qwait)
                 E = (t + W_ram + pre0)[:, None]
                 W = w_cpu[:, None]
                 pre = pre0[:, None]
@@ -1490,6 +1694,19 @@ class FastEngine:
                 E = t[:, None] + pre_cum + busy_prev
                 busy = jnp.sum(jnp.where(validb, pre + W + dur, 0.0), axis=1)
                 dep = t + busy + post
+                if tape is not None:
+                    for k in range(kb):
+                        qwait = validb[:, k] & (W[:, k] > 0)
+                        tape.emit(
+                            FR_WAIT_CPU, s, E[:, k], E[:, k], qwait,
+                        )
+                        tape.emit(
+                            FR_RUN,
+                            s,
+                            E[:, k] + W[:, k],
+                            E[:, k] + W[:, k],
+                            qwait,
+                        )
 
             # gauges: one ready-wait and one pre-IO interval per visit (the
             # ram_k > 0 branch exposes its single visit in the same shapes;
@@ -1547,6 +1764,12 @@ class FastEngine:
                 else:
                     w_s = _kw_waits(e_db, d_db, v_db, pool_k)
                 w_db = w_s[rank_db]
+                if tape is not None:
+                    dwait = use_db & (w_db > 0)
+                    tape.emit(FR_WAIT_DB, s, enq_db, enq_db, dwait)
+                    tape.emit(
+                        FR_RUN, s, enq_db + w_db, enq_db + w_db, dwait,
+                    )
                 dep = dep + jnp.where(use_db, w_db, 0.0)
 
             # trailing IO sleep (including any DB pool wait: the reference
@@ -1584,6 +1807,9 @@ class FastEngine:
                 u=u_exit_shared,
             )
             ok = sendable & ~dropped
+            if tape is not None:
+                tape.emit(FR_DROP, eidx, dep, dep, sendable & dropped)
+                tape.emit(FR_TRANSIT, eidx, dep + delay, dep, ok)
             if record:
                 gauge = self._gauge_intervals(
                     gauge, eidx, dep, dep + delay, 1.0, ok,
@@ -1601,6 +1827,17 @@ class FastEngine:
             else:  # client: completion
                 fin = dep + delay
                 done = ok & (fin < plan.horizon)
+                if tape is not None:
+                    # retry plans defer completion to the client-arrival
+                    # event (proc = delivery); non-retry exits record it
+                    # with the departure (event engine exit flow)
+                    tape.emit(
+                        FR_COMPLETE,
+                        -1,
+                        fin,
+                        fin if plan.has_retry else dep,
+                        done,
+                    )
                 finish = jnp.where(done, fin, finish)
                 completed = completed | done
                 alive = jnp.where(mine, False, alive)
@@ -1629,12 +1866,31 @@ class FastEngine:
         # end); an interval [a, b) contributes its horizon-clipped length
         gauge_means = jnp.zeros(plan.n_gauges, jnp.float32)
         horizon = jnp.float32(plan.horizon)
+        # flight-recorder placeholders: statically pruned to (1, 1)/(1,)
+        # when untraced (same discipline as the clock placeholder below) so
+        # untraced programs stay bit-identical to pre-trace builds
+        fr_ev = jnp.zeros((1, 1), jnp.int32)
+        fr_node = jnp.zeros((1, 1), jnp.int32)
+        fr_t = jnp.zeros((1, 1), jnp.float32)
+        fr_n = jnp.zeros(1, jnp.int32)
+        trace_on = self.trace is not None
 
         if not plan.has_retry:
             # single journey — the program (and its draw stream) is
             # bit-identical to pre-resilience builds for unfaulted plans
             t, alive, overflow = self._arrivals(jax.random.fold_in(key, 0), ov)
             n_generated = jnp.sum(alive)
+            tape = None
+            if trace_on:
+                tape = _FlightTape(n)
+                if plan.n_generators > 1:
+                    gen_node = jnp.concatenate([
+                        jnp.full(ng, g, jnp.int32)
+                        for g, ng in enumerate(self.gen_n)
+                    ])
+                else:
+                    gen_node = 0
+                tape.emit(FR_SPAWN, gen_node, t, t, alive)
             (
                 finish,
                 completed,
@@ -1643,7 +1899,26 @@ class FastEngine:
                 gauge_means,
                 n_dropped,
                 n_rejected,
-            ) = self._journey(key, ov, t, alive, gauge, gauge_means)
+            ) = self._journey(key, ov, t, alive, gauge, gauge_means, tape=tape)
+            if trace_on:
+                K = int(self.trace.sample_requests)
+                slots = int(self.trace.event_slots)
+                if plan.n_generators > 1:
+                    # traced rows are the first K spawned = arrival-time
+                    # order; superposed streams need the explicit rank
+                    # (single streams are already time-sorted)
+                    rank = time_rank(t, alive)
+                    lane_of_rank = (
+                        jnp.zeros(n, jnp.int32)
+                        .at[rank]
+                        .set(jnp.arange(n, dtype=jnp.int32))
+                    )
+                    lanes = lane_of_rank[: min(K, n)]
+                else:
+                    lanes = jnp.arange(min(K, n), dtype=jnp.int32)
+                fr_ev, fr_node, fr_t, fr_n = _flight_rings(
+                    tape.cands, K, slots, lanes=lanes,
+                )
             success = completed
             lat_start = t
             # batched-traced zeros: every FastState leaf must carry the
@@ -1699,8 +1974,11 @@ class FastEngine:
             blk = jnp.arange(n, dtype=jnp.int32) // n1
             can_retry = blk < (A - 1)
             cap_b = float(plan.retry_budget_tokens)
+            tape = None
             for p in range(A):
                 last = p == A - 1
+                if trace_on and last:
+                    tape = _FlightTape(n)
                 issued = T < INF
                 (
                     finish,
@@ -1712,6 +1990,7 @@ class FastEngine:
                     n_rejected,
                 ) = self._journey(
                     key, ov, T, issued, gauge, gauge_means, record=last,
+                    tape=tape,
                 )
                 # per-attempt resolution: the client notices completion at
                 # C, failure at fail_t, or its deadline at D — deadline
@@ -1771,6 +2050,39 @@ class FastEngine:
             att_hist = jnp.zeros(A, jnp.int32).at[
                 jnp.where(ended, blk, A)
             ].add(1, mode="drop")
+            if trace_on:
+                # ring assembly: a logical request's record is the attempt-
+                # major concat of its lane blocks' candidates; each block
+                # contributes [SPAWN, journey..., TIMEOUT, RETRY/ABANDON].
+                # Orphan masking mirrors the event engine's req_fr detach:
+                # a timed-out attempt's events processed at or after its
+                # deadline are invisible (the deadline event, pushed at
+                # spawn, wins same-instant ties by heap sequence).
+                K = int(self.trace.sample_requests)
+                slots = int(self.trace.event_slots)
+                D = T + rt
+                attempt = blk + 1  # node = failed attempt number (1-based)
+                cands = [
+                    (
+                        FR_SPAWN,
+                        jnp.zeros(n, jnp.int32),
+                        T,
+                        T,
+                        issued,
+                    ),
+                ]
+                cands += [
+                    (code, node, rec, proc, pred & ~(timed & (proc >= D)))
+                    for code, node, rec, proc, pred in tape.cands
+                ]
+                cands += [
+                    (FR_TIMEOUT, attempt, D, D, timed),
+                    (FR_RETRY, attempt, R, R, grant),
+                    (FR_ABANDON, attempt, R, R, (timed | failed) & ~grant),
+                ]
+                fr_ev, fr_node, fr_t, fr_n = _flight_rings(
+                    cands, K, slots, blocks=(A, n1),
+                )
 
         # ---- reductions --------------------------------------------------
         latency = jnp.where(success, finish - lat_start, 0.0)
@@ -1815,6 +2127,10 @@ class FastEngine:
             n_retries=n_retries,
             n_budget_exhausted=n_budget_exhausted,
             att_hist=att_hist,
+            fr_ev=fr_ev,
+            fr_node=fr_node,
+            fr_t=fr_t,
+            fr_n=fr_n,
         )
 
     def run_batch(

@@ -5,28 +5,30 @@ the offending mechanism had to be guessed from the topology.  This module
 turns the flight recorder (:mod:`~asyncflow_tpu.observability.simtrace`)
 into a diff tool:
 
-- **flight mode** (:func:`find_first_divergence`): run the Python oracle
-  and the JAX *event* engine on the same payload/seed with tracing on,
+- **flight mode** (:func:`find_first_divergence`): run two engines (any
+  pair of ``oracle`` / ``event`` / ``fast`` — the scan fast path now
+  carries the recorder) on the same payload/seed with tracing on,
   canonicalize both event streams (per-request RELATIVE timelines — the
-  engines' RNG families differ, so absolute times are incomparable; on
-  deterministic-latency scenarios like
+  engines' RNG/sampling families differ, so absolute times are
+  incomparable; on deterministic-latency scenarios like
   ``examples/yaml_input/data/trace_parity.yml`` the relative timelines
   must agree exactly), and report the first differing event with an
   aligned context window.  Zero divergence on the parity scenario is a
-  smoke-tier gate.
-- **stats mode** (:func:`stat_divergence`): for engines with no event
-  stream (the scan fast path) or stochastic scenarios, compare seed
-  ensembles statistic-by-statistic in lifecycle order (count, mean, then
-  quantiles) against an oracle-vs-oracle split-half noise floor — the
-  first statistic whose deviation exceeds both the tolerance AND the
-  noise floor is the localized divergence; deviations inside the noise
-  floor are the seed lottery, not an engine bug.
+  smoke-tier gate, and ``--engines fast,event`` is the event-level gate
+  on the fast path's resilient journey rewrite.
+- **stats mode** (:func:`stat_divergence`): for stochastic scenarios,
+  compare seed ensembles statistic-by-statistic in lifecycle order
+  (count, mean, then quantiles) against an oracle-vs-oracle split-half
+  noise floor — the first statistic whose deviation exceeds both the
+  tolerance AND the noise floor is the localized divergence; deviations
+  inside the noise floor are the seed lottery, not an engine bug.
 
 CLI::
 
     python -m asyncflow_tpu.observability.diverge scenario.yml \
         [--mode flight|stats] [--seed N] [--seeds N] [--engine event|fast]
-        [--requests K] [--slots N] [--tol-us 50] [--tol 0.05] [--json]
+        [--engines oracle,event|fast,event|...] [--requests K] [--slots N]
+        [--tol-us 50] [--tol 0.05] [--json]
 
 Exit status: 0 = no divergence, 2 = divergence found (1 = usage error).
 """
@@ -71,20 +73,24 @@ class DivergenceReport:
     #: mismatch near the horizon — reported, but not a divergence)
     only_oracle: list[int] = field(default_factory=list)
     only_jax: list[int] = field(default_factory=list)
+    #: the engine pair the records came from (labels the summary; the
+    #: ``*_oracle``/``*_jax`` field names stay stable for JSON consumers)
+    engines: tuple[str, str] = ("oracle", "jax")
 
     def summary(self) -> str:
+        ea, eb = self.engines
         if self.equal:
             return (
-                f"no divergence: {self.requests_compared} request span "
-                "record(s) identical after canonicalization"
+                f"no divergence ({ea} vs {eb}): {self.requests_compared} "
+                "request span record(s) identical after canonicalization"
             )
         d = self.divergence
         lines = [
-            f"first divergence at request {d.request}, event {d.index} "
-            f"({d.kind}):",
-            f"  oracle: {d.oracle_event}",
-            f"  jax:    {d.jax_event}",
-            "  context (oracle | jax), '>' marks the divergence:",
+            f"first divergence ({ea} vs {eb}) at request {d.request}, "
+            f"event {d.index} ({d.kind}):",
+            f"  {ea}: {d.oracle_event}",
+            f"  {eb}: {d.jax_event}",
+            f"  context ({ea} | {eb}), '>' marks the divergence:",
         ]
         width = max((len(s) for s in d.context_oracle), default=0)
         for left, right in zip(d.context_oracle, d.context_jax):
@@ -98,7 +104,7 @@ class DivergenceReport:
                 if len(d.context_oracle) > len(d.context_jax)
                 else d.context_jax
             )
-            side = "oracle" if longer is d.context_oracle else "jax"
+            side = ea if longer is d.context_oracle else eb
             for line in longer[-extra:]:
                 lines.append(f"    ({side} only) {line}")
         return "\n".join(lines)
@@ -119,13 +125,15 @@ def compare_flight(
     horizon: float | None = None,
     tol_us: float = 50.0,
     context: int = 4,
+    engines: tuple[str, str] = ("oracle", "jax"),
 ) -> DivergenceReport:
     """Diff two flight-record sets after canonicalization.
 
     Codes and node ids must match exactly; relative timestamps within
     ``tol_us`` microseconds (the jax engine's float32 sim clock carries
     ~8 us of rounding at a 120 s horizon — exact-quantization comparison
-    would flag pure precision noise).
+    would flag pure precision noise).  ``engines`` labels the two sides
+    in the summary (the record dicts themselves are engine-agnostic).
     """
     spans_o = canonical_spans(flight_oracle, horizon=horizon)
     spans_j = canonical_spans(flight_jax, horizon=horizon)
@@ -135,6 +143,7 @@ def compare_flight(
         requests_compared=len(common),
         only_oracle=sorted(set(spans_o) - set(spans_j)),
         only_jax=sorted(set(spans_j) - set(spans_o)),
+        engines=engines,
     )
     for req in common:
         a, b = spans_o[req], spans_j[req]
@@ -177,6 +186,27 @@ def compare_flight(
     return report
 
 
+#: engines the flight recorder runs on (pallas/native stay fenced)
+FLIGHT_ENGINES = ("oracle", "event", "fast")
+
+
+def _flight_records(payload, engine: str, seed: int, trace: TraceConfig):
+    """One engine's flight-record dict for ``payload``/``seed``."""
+    if engine == "oracle":
+        from asyncflow_tpu.engines.oracle.engine import OracleEngine
+
+        return OracleEngine(payload, seed=seed, trace=trace).run().flight
+    if engine in ("event", "fast"):
+        from asyncflow_tpu.engines.jaxsim.engine import run_single
+
+        return run_single(payload, seed=seed, engine=engine, trace=trace).flight
+    msg = (
+        f"flight mode compares {'/'.join(FLIGHT_ENGINES)} engines, "
+        f"got {engine!r}"
+    )
+    raise ValueError(msg)
+
+
 def find_first_divergence(
     payload,
     *,
@@ -184,22 +214,27 @@ def find_first_divergence(
     trace: TraceConfig | None = None,
     tol_us: float = 50.0,
     context: int = 4,
+    engines: tuple[str, str] = ("oracle", "event"),
 ) -> DivergenceReport:
-    """Run the oracle and the JAX event engine on ``payload``/``seed`` with
-    the flight recorder on and diff the canonicalized streams."""
-    from asyncflow_tpu.engines.jaxsim.engine import run_single
-    from asyncflow_tpu.engines.oracle.engine import OracleEngine
+    """Run two traced engines on ``payload``/``seed`` with the flight
+    recorder on and diff the canonicalized streams.
 
+    ``engines`` picks the pair (default the historical oracle↔event
+    diff); ``("fast", "event")`` is the event-level gate on the scan
+    fast path's analytically derived records.
+    """
+    ea, eb = engines
     trace = trace or TraceConfig()
     horizon = float(payload.sim_settings.total_simulation_time)
-    res_o = OracleEngine(payload, seed=seed, trace=trace).run()
-    res_j = run_single(payload, seed=seed, engine="event", trace=trace)
+    flight_a = _flight_records(payload, ea, seed, trace)
+    flight_b = _flight_records(payload, eb, seed, trace)
     return compare_flight(
-        res_o.flight,
-        res_j.flight,
+        flight_a,
+        flight_b,
         horizon=horizon,
         tol_us=tol_us,
         context=context,
+        engines=(ea, eb),
     )
 
 
@@ -230,10 +265,15 @@ class StatReport:
     def equal(self) -> bool:
         return self.first_exceeding is None
 
+    @property
+    def engine_pair(self) -> tuple[str, str]:
+        """The two engines this report compared (self-describing CI logs)."""
+        return ("oracle", self.engine)
+
     def summary(self) -> str:
         lines = [
-            f"ensemble comparison: oracle vs {self.engine} engine "
-            f"({self.seeds} seeds, tol {self.tol:.1%}):",
+            f"ensemble comparison (engine pair: oracle vs {self.engine}): "
+            f"{self.seeds} seeds, tol {self.tol:.1%}:",
         ]
         for r in self.rows:
             mark = ">" if r.exceeds else " "
@@ -250,7 +290,8 @@ class StatReport:
             )
         else:
             lines.append(
-                f"first diverging statistic: {self.first_exceeding}",
+                f"first diverging statistic: {self.first_exceeding} "
+                f"(oracle vs {self.engine})",
             )
         return "\n".join(lines)
 
@@ -378,7 +419,15 @@ def main(argv: list[str] | None = None) -> int:
         "--engine",
         choices=("event", "fast"),
         default="fast",
-        help="stats mode JAX engine (flight mode always diffs the event engine)",
+        help="stats mode JAX engine (compared against the oracle ensemble)",
+    )
+    parser.add_argument(
+        "--engines",
+        default="oracle,event",
+        help=(
+            "flight mode engine pair as 'A,B' (each of oracle/event/fast); "
+            "'fast,event' is the fast-path event-level gate"
+        ),
     )
     parser.add_argument(
         "--requests", type=int, default=8, help="traced requests per scenario",
@@ -413,6 +462,12 @@ def main(argv: list[str] | None = None) -> int:
     )
 
     if args.mode == "flight":
+        pair = tuple(p.strip() for p in args.engines.split(","))
+        if len(pair) != 2 or any(p not in FLIGHT_ENGINES for p in pair):
+            parser.error(
+                f"--engines must be 'A,B' with each of "
+                f"{'/'.join(FLIGHT_ENGINES)}, got {args.engines!r}"
+            )
         report = find_first_divergence(
             payload,
             seed=args.seed,
@@ -421,6 +476,7 @@ def main(argv: list[str] | None = None) -> int:
             ),
             tol_us=args.tol_us,
             context=args.context,
+            engines=pair,
         )
         if args.json:
             from dataclasses import asdict

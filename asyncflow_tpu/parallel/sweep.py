@@ -366,7 +366,7 @@ class SweepReport:
             msg = (
                 "no flight records were collected: construct "
                 "SweepRunner(..., trace=TraceConfig(...)) — the recorder "
-                "runs on the event engine"
+                "runs on the fast and event engines"
             )
             raise ValueError(msg)
         return decode_flight(
@@ -688,9 +688,11 @@ class SweepRunner:
         (:class:`asyncflow_tpu.observability.simtrace.TraceConfig`): each
         scenario records its first K spawned requests' lifecycle
         transitions into fixed-size on-device rings, surfaced per scenario
-        via :meth:`SweepReport.flight_records`.  Only the event engine
-        carries the rings — ``engine='auto'`` routes traced sweeps there;
-        forcing ``fast``/``pallas``/``native`` is an explicit error.
+        via :meth:`SweepReport.flight_records`.  The scan fast path and
+        the event engine both carry the rings (the fast path derives the
+        same spans analytically from per-lane journey state) —
+        ``engine='auto'`` keeps traced fastpath-eligible sweeps on the
+        fast path; forcing ``pallas``/``native`` is an explicit error.
         Tracing consumes no draws: every non-trace output is bit-identical
         with it on or off.
 
@@ -726,11 +728,11 @@ class SweepRunner:
         self.experiment = experiment
         #: host-fault recovery policy (None = strict fail-fast)
         self.recovery = recovery
-        #: simulation-domain flight recorder (event engine only)
+        #: simulation-domain flight recorder (fast + event engines)
         if trace is not None and not isinstance(trace, TraceConfig):
             trace = TraceConfig.model_validate(trace)
         self.trace = trace
-        if trace is not None and engine in ("fast", "pallas", "native"):
+        if trace is not None and engine in ("pallas", "native"):
             # canonical refusal from the shared fence registry: the static
             # checker predicts this exact message (docs/guides/diagnostics.md)
             raise_fence(f"trace.{engine}")
@@ -791,7 +793,7 @@ class SweepRunner:
             self.engine = _NativeSweepEngine(self.plan, n_hist_bins=n_hist_bins)
             self.engine_kind = "native"
         elif engine == "fast" or (
-            engine == "auto" and self.plan.fastpath_ok and self.trace is None
+            engine == "auto" and self.plan.fastpath_ok
         ):
             from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
 
@@ -799,6 +801,7 @@ class SweepRunner:
                 self.plan,
                 n_hist_bins=n_hist_bins,
                 gauge_series_stride=gauge_stride,
+                trace=self.trace,
             )
             self.engine_kind = "fast"
         elif engine == "pallas" or (

@@ -51,11 +51,12 @@ def _parse_args(argv: list[str]) -> dict:
     confidence interval (asyncflow_tpu.analysis) instead of a single-shot
     number; the interval lands in the BENCH JSON under ``detail.repeats``.
 
-    ``--trace-guard``: run the flight-recorder overhead guard — assert the
-    event engine's outputs with tracing DISABLED are bit-identical to the
+    ``--trace-guard``: run the flight-recorder overhead guard on both
+    traced engines (the XLA event engine and the scan fast path) — assert
+    each engine's outputs with tracing DISABLED are bit-identical to the
     pre-trace program (same seeds, byte-compared histograms/counters) and
-    report the scen/s delta with tracing ENABLED under
-    ``detail.trace_guard``.
+    report the per-engine scen/s delta with tracing ENABLED under
+    ``detail.trace_guard.event`` / ``detail.trace_guard.fast``.
 
     ``--resilient``: run the fence burn-down arm — a small faulted +
     retrying + CRN sweep of the bench topology, auto-dispatched (must
@@ -199,18 +200,29 @@ def _emit(payload: dict) -> None:
 def _trace_guard() -> dict:
     """Flight-recorder overhead guard (BENCH_TRACE_GUARD=1 / --trace-guard).
 
-    Two contracts, on a small event-engine sweep of the bench topology:
+    Two contracts, on small sweeps of the bench topology — once per traced
+    engine (the XLA event engine AND the scan fast path, whose recorder is
+    derived analytically from per-lane journey state):
 
     1. **bit-identity**: every non-trace result array of the TRACED engine
        byte-compares equal to the plain engine's across the same seeds —
        recording consumes no draws and mutates no simulation state.  (The
-       plain engine being bit-identical to pre-trace builds is pinned
+       plain engines being bit-identical to pre-trace builds is pinned
        separately by tests/parity/test_flight_recorder.py's golden
        digests.)
     2. **measured overhead**: scen/s with the recorder enabled vs
-       disabled, reported (not gated — ring writes are masked scatters and
-       their cost is the number this detail exists to track).
+       disabled, reported per engine (not gated — ring writes are masked
+       scatters and their cost is the number this detail exists to track).
     """
+    from asyncflow_tpu.compiler import compile_payload  # numpy-only
+
+    out = {"event": _trace_guard_for("event")}
+    if compile_payload(_payload()).fastpath_ok:
+        out["fast"] = _trace_guard_for("fast")
+    return out
+
+
+def _trace_guard_for(engine: str) -> dict:
     import numpy as np
 
     from asyncflow_tpu.observability.simtrace import TraceConfig
@@ -222,10 +234,10 @@ def _trace_guard() -> dict:
         os.environ.get("BENCH_TRACE_GUARD_HORIZON", "60"),
     )
     n = int(os.environ.get("BENCH_TRACE_GUARD_SCENARIOS", "32"))
-    base = SweepRunner(guard_payload, engine="event", use_mesh=False)
+    base = SweepRunner(guard_payload, engine=engine, use_mesh=False)
     traced = SweepRunner(
         guard_payload,
-        engine="event",
+        engine=engine,
         use_mesh=False,
         trace=TraceConfig(sample_requests=8, event_slots=48),
     )
@@ -268,14 +280,15 @@ def _trace_guard() -> dict:
             mismatched.append(name)
     if mismatched:
         msg = (
-            "trace guard FAILED: enabling the flight recorder changed "
-            f"non-trace outputs {mismatched} — recording must never "
-            "consume a draw or mutate simulation state"
+            f"trace guard FAILED on the {engine} engine: enabling the "
+            f"flight recorder changed non-trace outputs {mismatched} — "
+            "recording must never consume a draw or mutate simulation state"
         )
         raise AssertionError(msg)
     off_rate = n / max(wall_off, 1e-9)
     on_rate = n / max(wall_on, 1e-9)
     return {
+        "engine": engine,
         "n_scenarios": n,
         "horizon_s": int(guard_payload.sim_settings.total_simulation_time),
         "bit_identical_outputs": True,
@@ -650,13 +663,14 @@ def run_measurement() -> None:
         detail["telemetry"] = telemetry_out
     if os.environ.get("BENCH_TRACE_GUARD") == "1":
         detail["trace_guard"] = _trace_guard()
-        print(
-            "trace guard: outputs bit-identical; overhead "
-            f"{detail['trace_guard']['overhead_pct']:+.1f}% "
-            f"({detail['trace_guard']['scen_per_s_trace_on']:.1f} vs "
-            f"{detail['trace_guard']['scen_per_s_trace_off']:.1f} scen/s)",
-            file=sys.stderr,
-        )
+        for eng, tg in detail["trace_guard"].items():
+            print(
+                f"trace guard [{eng}]: outputs bit-identical; overhead "
+                f"{tg['overhead_pct']:+.1f}% "
+                f"({tg['scen_per_s_trace_on']:.1f} vs "
+                f"{tg['scen_per_s_trace_off']:.1f} scen/s)",
+                file=sys.stderr,
+            )
     if os.environ.get("BENCH_RESILIENT") == "1":
         detail["resilient"] = _resilient_arm()
         res = detail["resilient"]

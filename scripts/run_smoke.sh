@@ -20,13 +20,15 @@ python -m pytest \
   tests/parity/test_resilience.py::test_outage_fault_is_not_a_rotation_removal \
   tests/parity/test_resilience.py::test_retry_budget_exhaustion_parity \
   -q -p no:cacheprovider
-# fence burn-down slice: a small faulted + retrying + CRN sweep must
-# auto-route to the scan fast path, with predict_routing agreeing — a
-# silent fallback to the event engine exits non-zero here long before a
-# benchmark round would notice the order-of-magnitude regression
+# fence burn-down slice: a small faulted + retrying + CRN sweep — now
+# TRACED (round 12 burned trace.fast) — must auto-route to the scan fast
+# path, with predict_routing agreeing — a silent fallback to the event
+# engine exits non-zero here long before a benchmark round would notice
+# the order-of-magnitude regression
 python - <<'PY'
 import yaml
 from asyncflow_tpu.checker.fences import predict_routing
+from asyncflow_tpu.observability import TraceConfig
 from asyncflow_tpu.parallel.sweep import SweepRunner
 from asyncflow_tpu.schemas.experiment import ExperimentConfig, VarianceReduction
 from asyncflow_tpu.schemas.payload import SimulationPayload
@@ -44,17 +46,23 @@ data["fault_timeline"] = {"events": [{
 }]}
 payload = SimulationPayload.model_validate(data)
 exp = ExperimentConfig(variance_reduction=VarianceReduction(crn=True))
-runner = SweepRunner(payload, engine="auto", use_mesh=False, experiment=exp)
-pred = predict_routing(runner.plan, engine="auto", crn=True)
+trace = TraceConfig(sample_requests=4, event_slots=24)
+runner = SweepRunner(payload, engine="auto", use_mesh=False, experiment=exp,
+                     trace=trace)
+pred = predict_routing(runner.plan, engine="auto", crn=True, trace=True)
 if runner.engine_kind != "fast" or pred.engine != runner.engine_kind:
     raise SystemExit(
-        "fence burn-down regressed: faulted+retry+CRN sweep dispatched "
-        f"{runner.engine_kind!r}, predicted {pred.engine!r} (expected 'fast')"
+        "fence burn-down regressed: traced faulted+retry+CRN sweep "
+        f"dispatched {runner.engine_kind!r}, predicted {pred.engine!r} "
+        "(expected 'fast')"
     )
 rep = runner.run(8, seed=3, chunk_size=4)
 assert int(rep.results.total_rejected.sum()) > 0, "the outage must bite"
 assert rep.results.total_retries is not None, "retry counters must surface"
-print("faulted+CRN sweep on the scan fast path OK "
+assert any(
+    rep.flight_records(scenario=s) for s in range(8)
+), "the traced fast-path sweep must surface flight records"
+print("traced faulted+CRN sweep on the scan fast path OK "
       f"(engine={runner.engine_kind}, predicted={pred.engine})")
 PY
 # analysis slice: one tiny adaptive run + one CRN compare through the
@@ -135,6 +143,16 @@ print("sim-trace schema OK")
 PY
 python -m asyncflow_tpu.observability.diverge \
   examples/yaml_input/data/trace_parity.yml --mode flight --seed 0
+# the fast path's analytically derived records must match the event
+# engine event-by-event — on the deterministic parity scenario AND on a
+# resilient fixture whose full-horizon outage exercises the reject ->
+# retry -> abandon lifecycle (round 12 burned trace.fast)
+python -m asyncflow_tpu.observability.diverge \
+  examples/yaml_input/data/trace_parity.yml --mode flight --seed 0 \
+  --engines fast,event
+python -m asyncflow_tpu.observability.diverge \
+  examples/yaml_input/data/trace_parity_resilient.yml --mode flight --seed 0 \
+  --engines fast,event
 # tail-tolerance slice: hedged requests + LB health gating + brownout must
 # stay deterministic across engines, refuse the fastpath, and keep the
 # hedge lifecycle visible to the flight recorder; the checker must bless

@@ -124,6 +124,29 @@ class TestEnabledNeutrality:
                 np.asarray(getattr(traced, name)),
             ), name
 
+    def test_fast_path_outputs_identical_with_tracing(self) -> None:
+        """Fast-path tracing neutrality: the recorder consumes no draws,
+        so every non-trace stream is bit-identical with it on or off (the
+        trace=None side is itself pinned to the pre-trace golden above)."""
+        from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+
+        plan = compile_payload(_payload())
+        keys = scenario_keys(7, 4)
+        plain = FastEngine(plan, collect_clocks=True).run_batch(keys)
+        traced = FastEngine(
+            plan,
+            collect_clocks=True,
+            trace=TraceConfig(sample_requests=4, event_slots=16),
+        ).run_batch(keys)
+        for name in ("hist", "clock", "clock_n", "n_generated", "n_dropped"):
+            assert np.array_equal(
+                np.asarray(getattr(plain, name)),
+                np.asarray(getattr(traced, name)),
+            ), name
+        # untraced state carries only the static (1, 1)/(1,) placeholders
+        assert plain.fr_ev.shape[-2:] == (1, 1)
+        assert traced.fr_ev.shape[-2:] == (4, 16)
+
     def test_oracle_outputs_identical_with_tracing(self) -> None:
         payload = _payload()
         plain = OracleEngine(payload, seed=7).run()
@@ -187,6 +210,141 @@ class TestSpanEquality:
 
 
 # ---------------------------------------------------------------------------
+# 3b. the scan fast path's analytically derived records (round 12)
+# ---------------------------------------------------------------------------
+
+
+RESILIENT = "examples/yaml_input/data/trace_parity_resilient.yml"
+
+
+def _retry_lifecycle_payload():
+    """trace_parity mutated so every request times out, backs off once,
+    re-issues, and abandons — deterministic end to end (variance-0 edges,
+    jitter-free backoff, service >> timeout)."""
+
+    def mut(data):
+        srv = data["topology_graph"]["nodes"]["servers"][0]
+        srv["endpoints"][0]["steps"] = [
+            {"kind": "initial_parsing", "step_operation": {"cpu_time": 0.004}},
+            {"kind": "io_wait", "step_operation": {"io_waiting_time": 0.8}},
+        ]
+        data["retry_policy"] = {
+            "request_timeout_s": 0.05,
+            "max_attempts": 2,
+            "backoff_base_s": 0.1,
+            "jitter": 0.0,
+        }
+
+    return _payload(PARITY, horizon=90, mut=mut)
+
+
+class TestFastPathSpanEquality:
+    """The event-level gate on PR 8's resilient journey rewrite: the fast
+    path's analytic records must canonicalize identically to the event
+    engine's (and the oracle's) — absolute times are incomparable across
+    engines (different sampling families), relative spans are exact."""
+
+    def test_fast_event_zero_divergence_on_parity_scenario(self) -> None:
+        payload = _payload(PARITY, horizon=120)
+        report = find_first_divergence(
+            payload,
+            seed=0,
+            trace=TraceConfig(sample_requests=8),
+            engines=("fast", "event"),
+        )
+        assert report.equal, report.summary()
+        assert report.requests_compared >= 6
+        assert report.engines == ("fast", "event")
+
+    def test_oracle_fast_retry_lifecycle_spans_match(self) -> None:
+        """Timeout -> backoff -> re-issue -> abandon through the bounded
+        attempt loop: the fast path derives the same spans the oracle's
+        heap loop records."""
+        payload = _retry_lifecycle_payload()
+        cfg = TraceConfig(sample_requests=6, event_slots=32)
+        res_o = OracleEngine(payload, seed=1, trace=cfg).run()
+        res_f = run_single(payload, seed=1, engine="fast", trace=cfg)
+        report = compare_flight(
+            res_o.flight, res_f.flight, horizon=90.0,
+            engines=("oracle", "fast"),
+        )
+        assert report.equal, report.summary()
+        codes = {c for rec in res_f.flight.values() for c in rec.codes()}
+        assert {FR_TIMEOUT, FR_RETRY, FR_SPAWN, FR_ABANDON} <= codes
+
+    def test_fast_event_retry_lifecycle_spans_match(self) -> None:
+        payload = _retry_lifecycle_payload()
+        cfg = TraceConfig(sample_requests=6, event_slots=32)
+        res_f = run_single(payload, seed=1, engine="fast", trace=cfg)
+        res_j = run_single(payload, seed=1, engine="event", trace=cfg)
+        report = compare_flight(
+            res_f.flight, res_j.flight, horizon=90.0,
+            engines=("fast", "event"),
+        )
+        assert report.equal, report.summary()
+
+    def test_fault_window_gating_spans_match(self) -> None:
+        """The resilient fixture's full-horizon outage window gates every
+        arrival through the dark-server REJECT -> RETRY -> ABANDON path on
+        all three engines identically (the window predicate is evaluated
+        per arrival on the fast path's analytic journey)."""
+        from asyncflow_tpu.observability.simtrace import FR_REJECT
+
+        payload = _payload(RESILIENT, horizon=90)
+        cfg = TraceConfig(sample_requests=8, event_slots=32)
+        res_o = OracleEngine(payload, seed=1, trace=cfg).run()
+        res_f = run_single(payload, seed=1, engine="fast", trace=cfg)
+        res_j = run_single(payload, seed=1, engine="event", trace=cfg)
+        for flight_a, flight_b, pair in (
+            (res_o.flight, res_f.flight, ("oracle", "fast")),
+            (res_f.flight, res_j.flight, ("fast", "event")),
+        ):
+            report = compare_flight(
+                flight_a, flight_b, horizon=90.0, engines=pair,
+            )
+            assert report.equal, report.summary()
+        codes = {c for rec in res_f.flight.values() for c in rec.codes()}
+        assert {FR_SPAWN, FR_REJECT, FR_RETRY, FR_ABANDON} <= codes
+
+    def test_fast_routed_sweep_flight_records_npz_round_trip(self) -> None:
+        """A traced fast-routed sweep's rings survive chunk npz
+        persistence: a second runner over the same checkpoint dir loads
+        every chunk from disk and decodes identical FlightRecords."""
+        import shutil
+        import tempfile
+
+        from asyncflow_tpu.parallel import SweepRunner
+
+        def clip_window(data):
+            data["fault_timeline"]["events"][0]["t_end"] = 60.0
+
+        payload = _payload(RESILIENT, horizon=60, mut=clip_window)
+        cfg = TraceConfig(sample_requests=4, event_slots=24)
+        ck = tempfile.mkdtemp(prefix="asyncflow_flight_ck_")
+        try:
+            runner = SweepRunner(payload, use_mesh=False, trace=cfg)
+            assert runner.engine_kind == "fast"
+            fresh = runner.run(4, seed=5, chunk_size=2, checkpoint_dir=ck)
+            reloaded = SweepRunner(payload, use_mesh=False, trace=cfg).run(
+                4, seed=5, chunk_size=2, checkpoint_dir=ck,
+            )
+            assert np.array_equal(
+                fresh.results.flight_ev, reloaded.results.flight_ev,
+            )
+            assert np.array_equal(
+                fresh.results.flight_t, reloaded.results.flight_t,
+            )
+            for s in range(4):
+                a, b = fresh.flight_records(s), reloaded.flight_records(s)
+                assert a.keys() == b.keys()
+                for req in a:
+                    assert a[req].events == b[req].events
+                    assert a[req].dropped == b[req].dropped
+        finally:
+            shutil.rmtree(ck, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
 # 4. explicit ring truncation
 # ---------------------------------------------------------------------------
 
@@ -216,20 +374,38 @@ class TestTruncation:
         from asyncflow_tpu.parallel import SweepRunner
 
         payload = _payload(PARITY, horizon=60)
-        runner = SweepRunner(
-            payload,
-            use_mesh=False,
-            trace=TraceConfig(sample_requests=3, event_slots=4),
-        )
-        assert runner.engine_kind == "event"
-        report = runner.run(3, seed=0, chunk_size=3)
-        dropped = report.flight_dropped_events()
-        assert dropped.shape == (3,)
-        assert np.all(dropped > 0)
-        records = report.flight_records(0)
-        assert records and all(
-            len(r.events) <= 4 and r.dropped >= 1 for r in records.values()
-        )
+        for engine, expected_kind in (("event", "event"), ("auto", "fast")):
+            runner = SweepRunner(
+                payload,
+                engine=engine,
+                use_mesh=False,
+                trace=TraceConfig(sample_requests=3, event_slots=4),
+            )
+            assert runner.engine_kind == expected_kind
+            report = runner.run(3, seed=0, chunk_size=3)
+            dropped = report.flight_dropped_events()
+            assert dropped.shape == (3,)
+            assert np.all(dropped > 0)
+            records = report.flight_records(0)
+            assert records and all(
+                len(r.events) <= 4 and r.dropped >= 1
+                for r in records.values()
+            )
+
+    def test_fast_path_truncation_keeps_first_events(self) -> None:
+        """The fast path's analytic rings truncate exactly like the event
+        engine's: the FIRST ``event_slots`` transitions survive verbatim
+        and ``fr_n`` keeps counting past the budget (dropped > 0)."""
+        payload = _payload(PARITY, horizon=120)
+        tiny = TraceConfig(sample_requests=4, event_slots=4)
+        full = TraceConfig(sample_requests=4, event_slots=32)
+        res_tiny = run_single(payload, seed=0, engine="fast", trace=tiny)
+        res_full = run_single(payload, seed=0, engine="fast", trace=full)
+        assert flight_dropped_events(res_tiny.flight) > 0
+        for req, rec in res_tiny.flight.items():
+            assert len(rec.events) <= 4
+            assert rec.dropped >= 1
+            assert rec.events == res_full.flight[req].events[:4]
 
 
 # ---------------------------------------------------------------------------
@@ -238,11 +414,18 @@ class TestTruncation:
 
 
 class TestRefusals:
-    def test_fast_engine_refuses(self) -> None:
+    def test_fast_engine_accepts_trace(self) -> None:
+        """The trace.fast fence is burned: the fast path constructs with a
+        TraceConfig and returns real rings (not the untraced placeholder
+        shapes)."""
         from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
 
-        with pytest.raises(ValueError, match="closed form"):
-            FastEngine(compile_payload(_payload()), trace=TraceConfig())
+        cfg = TraceConfig(sample_requests=4, event_slots=16)
+        engine = FastEngine(compile_payload(_payload()), trace=cfg)
+        final = engine.run_batch(scenario_keys(7, 2))
+        assert final.fr_ev.shape == (2, 4, 16)
+        assert final.fr_n.shape == (2, 4)
+        assert np.asarray(final.fr_n).max() > 0
 
     def test_pallas_engine_refuses(self) -> None:
         from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
@@ -256,21 +439,30 @@ class TestRefusals:
         with pytest.raises(ValueError, match="ABI"):
             run_native(compile_payload(_payload()), trace=TraceConfig())
 
-    def test_run_single_forced_fast_refuses(self) -> None:
+    def test_run_single_forced_fast_runs_traced(self) -> None:
+        res = run_single(
+            _payload(), engine="fast", trace=TraceConfig(sample_requests=4),
+        )
+        assert res.flight and all(
+            rec.events for rec in res.flight.values()
+        )
+        # collect_traces (per-hop rings) stays an event-engine feature
         with pytest.raises(ValueError, match="event engine"):
-            run_single(_payload(), engine="fast", trace=TraceConfig())
+            run_single(_payload(), engine="fast", collect_traces=True)
 
     def test_sweep_runner_forced_engines_refuse(self) -> None:
         from asyncflow_tpu.parallel import SweepRunner
 
-        for engine in ("fast", "pallas", "native"):
+        for engine in ("pallas", "native"):
             with pytest.raises(ValueError, match="flight recorder"):
                 SweepRunner(
                     _payload(), use_mesh=False, engine=engine,
                     trace=TraceConfig(),
                 )
 
-    def test_sweep_auto_routes_traced_sweeps_to_event(self) -> None:
+    def test_sweep_auto_keeps_traced_sweeps_on_fast(self) -> None:
+        """Round-12 burn-down: tracing no longer demotes a fastpath-
+        eligible sweep to the event engine."""
         from asyncflow_tpu.parallel import SweepRunner
 
         payload = _payload()
@@ -279,7 +471,7 @@ class TestRefusals:
             SweepRunner(
                 payload, use_mesh=False, trace=TraceConfig(),
             ).engine_kind
-            == "event"
+            == "fast"
         )
 
 

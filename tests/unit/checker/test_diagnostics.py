@@ -342,18 +342,44 @@ def test_af501_routing_prediction_always_present(payload) -> None:
 def test_af502_tripped_fences_listed(payload) -> None:
     report = check_payload(payload, backend="cpu", trace=True)
     fences = find(report, "AF502")
-    assert {"trace.fast", "trace.pallas", "trace.native"} <= {
-        d.message.split()[1].rstrip(":") for d in fences
-    }
+    listed = {d.message.split()[1].rstrip(":") for d in fences}
+    assert {"trace.pallas", "trace.native"} <= listed
+    # round-12 burn-down: tracing neither fences the fast path nor quotes
+    # an event-engine fallback — traced eligible plans ROUTE fast
+    assert "trace.fast" not in listed
     (route,) = find(report, "AF501")
-    assert "'event'" in route.message
+    assert "'fast'" in route.message
+
+
+def test_af502_burned_trace_fence_quotes_no_fast_fallback(payload) -> None:
+    """AF501/AF502 pricing after the round-12 burn: a traced config must
+    not price an event-engine fallback for tracing (there is none — the
+    fast path runs traced), while the surviving trace.pallas/trace.native
+    rows keep their BENCH-derived speedup estimates (or the explicit
+    'unestimated' note when no BENCH records the engine)."""
+    report = check_payload(payload, backend="cpu", trace=True)
+    for diag in find(report, "AF502"):
+        fence_id = diag.message.split()[1].rstrip(":")
+        if fence_id.startswith("trace."):
+            assert fence_id in ("trace.pallas", "trace.native")
+            # the pricing clause survives for the still-fenced engines
+            assert ("expected speedup" in diag.message
+                    or "unestimated" in diag.message)
+    (route,) = find(report, "AF501")
+    assert "flight recorder rides the fast path" in route.message
 
 
 def test_af503_forced_engine_refusal_is_error(payload) -> None:
-    report = check_payload(payload, backend="cpu", engine="fast", trace=True)
+    # engine='fast' with tracing is legal now; pallas keeps the refusal
+    report = check_payload(payload, backend="tpu", engine="pallas",
+                           trace=True)
     (diag,) = find(report, "AF503")
     assert diag.severity is Severity.ERROR
+    assert "trace.pallas" in diag.message or "pallas" in diag.message
     assert report.exit_code == 2
+    # and the burned fence no longer errors a forced-fast traced config
+    ok = check_payload(payload, backend="cpu", engine="fast", trace=True)
+    assert not find(ok, "AF503")
 
 
 # ---------------------------------------------------------------------------

@@ -41,8 +41,10 @@ def _resilient(data) -> None:
 
 
 def test_registry_covers_every_known_fence() -> None:
+    # trace.fast is BURNED (round 12): the scan fast path carries the
+    # flight recorder, so the registry must not resurrect its fence
     assert set(FENCES) == {
-        "trace.fast", "trace.pallas", "trace.native",
+        "trace.pallas", "trace.native",
         "vr.pallas", "vr.native",
         "resilience.pallas", "resilience.native",
         "tail_tolerance.pallas", "tail_tolerance.native",
@@ -59,9 +61,11 @@ def test_raise_fence_uses_registered_exception_type() -> None:
     with pytest.raises(RuntimeError):
         raise_fence("native.unavailable")
     with pytest.raises(ValueError):
-        raise_fence("trace.fast")
+        raise_fence("trace.pallas")
     with pytest.raises(KeyError):
         fence_message("no.such.fence")
+    with pytest.raises(KeyError):  # burned, not just unregistered
+        raise_fence("trace.fast")
 
 
 # ---------------------------------------------------------------------------
@@ -72,11 +76,14 @@ def test_raise_fence_uses_registered_exception_type() -> None:
 def test_sweep_trace_refusals_match_registry() -> None:
     payload = build_payload()
     cfg = TraceConfig(sample_requests=4)
-    for engine in ("fast", "pallas", "native"):
+    for engine in ("pallas", "native"):
         with pytest.raises(ValueError) as err:
             SweepRunner(payload, engine=engine, use_mesh=False, trace=cfg,
                         preflight="off")
         assert str(err.value) == fence_message(f"trace.{engine}")
+    # the fast fence is burned: forcing engine='fast' with tracing builds
+    SweepRunner(payload, engine="fast", use_mesh=False, trace=cfg,
+                preflight="off")
 
 
 def test_sweep_vr_refusals_match_registry() -> None:
@@ -109,7 +116,8 @@ def test_sweep_resilience_refusals_match_registry() -> None:
         (None, {}, "fast"),
         # round-8 burn-down: faulted/retrying plans route fast on auto
         (_resilient, {}, "fast"),
-        (None, {"trace": TraceConfig(sample_requests=4)}, "event"),
+        # round-12 burn-down: traced fastpath-eligible plans stay fast
+        (None, {"trace": TraceConfig(sample_requests=4)}, "fast"),
         (None,
          {"experiment": ExperimentConfig(
              variance_reduction=VarianceReduction(crn=True))},
@@ -135,15 +143,18 @@ def test_prediction_matches_actual_routing(mut, kwargs, expected) -> None:
     assert pred.ok and pred.engine == expected
 
 
-def test_prediction_forced_fast_with_trace_is_refused() -> None:
+def test_prediction_forced_fast_with_trace_is_allowed() -> None:
     payload = build_payload()
     runner = SweepRunner(payload, engine="auto", use_mesh=False,
                          preflight="off")
     pred = predict_routing(runner.plan, engine="fast", backend="cpu",
                            trace=True)
-    assert not pred.ok
-    assert pred.refusal.fence_id == "trace.fast"
-    assert pred.refusal.message == fence_message("trace.fast")
+    assert pred.ok and pred.engine == "fast"
+    pred_pallas = predict_routing(runner.plan, engine="pallas",
+                                  backend="tpu", trace=True)
+    assert not pred_pallas.ok
+    assert pred_pallas.refusal.fence_id == "trace.pallas"
+    assert pred_pallas.refusal.message == fence_message("trace.pallas")
 
 
 def test_tripped_fences_for_traced_resilient_plan() -> None:
@@ -156,9 +167,11 @@ def test_tripped_fences_for_traced_resilient_plan() -> None:
         f.fence_id
         for f in tripped_fences(runner.plan, trace=True, crn=True)
     }
-    assert {"trace.fast", "trace.pallas", "trace.native",
+    assert {"trace.pallas", "trace.native",
             "vr.pallas", "vr.native",
             "resilience.pallas", "resilience.native"} <= ids
+    # burned: tracing no longer fences the fast path
+    assert "trace.fast" not in ids
 
 
 def test_prediction_rejects_unknown_engine() -> None:

@@ -61,7 +61,28 @@ def test_code_divergence_localized_with_context() -> None:
     # aligned windows with the divergence marked
     assert any(line.startswith(">") for line in d.context_oracle)
     assert any("drop" in line for line in d.context_jax)
-    assert "first divergence at request 0, event 3" in report.summary()
+    assert (
+        "first divergence (oracle vs jax) at request 0, event 3"
+        in report.summary()
+    )
+
+
+def test_summary_names_the_engine_pair() -> None:
+    """CI logs from the fast,event gate must be self-describing: both the
+    equal and the diverged summaries carry the compared pair."""
+    eq = compare_flight(
+        _flight(_BASE), _flight(_BASE), engines=("fast", "event"),
+    )
+    assert "fast vs event" in eq.summary()
+    diverged = list(_BASE)
+    diverged[3] = (FR_DROP, 1, 0.015)
+    bad = compare_flight(
+        _flight(_BASE), _flight(diverged), engines=("fast", "event"),
+    )
+    assert not bad.equal
+    s = bad.summary()
+    assert "first divergence (fast vs event)" in s
+    assert "  fast: " in s and "  event: " in s
 
 
 def test_node_divergence() -> None:
