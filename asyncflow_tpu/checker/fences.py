@@ -164,14 +164,29 @@ FENCES: dict[str, Fence] = {
             ),
             exc=RuntimeError,
         ),
+        # -- streaming gauge series (gauge_series=...) ----------------------
+        # (gauge_series.requires_fast was burned: the XLA event engine now
+        # records the same interval-endpoint coarse grid inside its scan
+        # body, so only the pallas/native engines still refuse)
         Fence(
-            id="gauge_series.requires_fast",
+            id="gauge_series.pallas",
             feature="streaming gauge series",
-            engine="event",
+            engine="pallas",
             message=(
-                "gauge_series needs the fast-path engine (streaming series "
-                "ride its interval-endpoint grid); this plan runs on "
-                "'{detail}'"
+                "engine='pallas' does not record streaming gauge series "
+                "(the kernel keeps no per-tick gauge grid in VMEM); use "
+                "engine='fast' or 'event' (or 'auto', which routes "
+                "gauge-series sweeps off the pallas kernel)"
+            ),
+        ),
+        Fence(
+            id="gauge_series.native",
+            feature="streaming gauge series",
+            engine="native",
+            message=(
+                "engine='native' does not record streaming gauge series "
+                "(the coarse gauge grid is not wired through the native "
+                "core's C ABI); use engine='fast' or 'event'"
             ),
         ),
     )
@@ -255,6 +270,7 @@ def tripped_fences(
     trace: bool = False,
     crn: bool = False,
     antithetic: bool = False,
+    gauge_series: bool = False,
 ) -> tuple[TrippedFence, ...]:
     """Every fence this (plan, features) combination trips.
 
@@ -268,6 +284,8 @@ def tripped_fences(
         out += [_trip("trace.pallas"), _trip("trace.native")]
     if crn or antithetic:
         out += [_trip("vr.pallas"), _trip("vr.native")]
+    if gauge_series:
+        out += [_trip("gauge_series.pallas"), _trip("gauge_series.native")]
     if plan.has_faults or plan.has_retry:
         out += [_trip("resilience.pallas"), _trip("resilience.native")]
     if getattr(plan, "has_tail_tolerance", False):
@@ -298,7 +316,8 @@ def predict_routing(
     fences with the registry message; ``engine='auto'`` routes fast if the
     plan is fastpath-eligible (traced or not — the flight recorder runs on
     the fast path), else pallas on TPU when the plan is neither resilient
-    nor VR-coupled nor traced, else the XLA event engine.
+    nor VR-coupled nor traced nor collecting gauge series, else the XLA
+    event engine (which records gauge series in its scan body).
 
     ``backend`` defaults to ``jax.default_backend()`` (the only jax touch,
     resolved lazily); ``native_ok`` defaults to probing the C++ core only
@@ -317,7 +336,13 @@ def predict_routing(
     vr_coupled = crn or antithetic
     tail = getattr(plan, "has_tail_tolerance", False)
     resilient = plan.has_faults or plan.has_retry or tail
-    fences = tripped_fences(plan, trace=trace, crn=crn, antithetic=antithetic)
+    fences = tripped_fences(
+        plan,
+        trace=trace,
+        crn=crn,
+        antithetic=antithetic,
+        gauge_series=gauge_series,
+    )
 
     def refused(fence_id: str, **fmt: object) -> RoutingPrediction:
         return RoutingPrediction(
@@ -334,6 +359,8 @@ def predict_routing(
         return refused(f"trace.{engine}")
     if vr_coupled and engine in ("pallas", "native"):
         return refused(f"vr.{engine}")
+    if gauge_series and engine in ("pallas", "native"):
+        return refused(f"gauge_series.{engine}")
     if (plan.has_faults or plan.has_retry) and engine in ("pallas", "native"):
         return refused(f"resilience.{engine}")
     if tail and engine in ("pallas", "native"):
@@ -362,9 +389,10 @@ def predict_routing(
             and not resilient
             and not vr_coupled
             and not trace
+            and not gauge_series
         ):
             kind = "pallas"
-            why = "TPU backend, no resilience/VR/trace fences tripped"
+            why = "TPU backend, no resilience/VR/trace/gauge-series fences tripped"
         else:
             kind = "event"
             blockers = [f.feature for f in fences if f.engine == "fast"]
@@ -376,9 +404,6 @@ def predict_routing(
     else:
         kind = engine
         why = f"engine={engine!r} was forced and trips no fence"
-
-    if gauge_series and kind != "fast":
-        return refused("gauge_series.requires_fast", detail=kind)
 
     return RoutingPrediction(
         requested=engine,

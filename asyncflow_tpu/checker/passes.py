@@ -606,6 +606,7 @@ def routing_pass(
     trace: bool = False,
     crn: bool = False,
     antithetic: bool = False,
+    gauge_series: bool = False,
 ) -> None:
     """AF501-AF503: which engine runs this, and every fence on the way."""
     pred = predict_routing(
@@ -615,6 +616,7 @@ def routing_pass(
         trace=trace,
         crn=crn,
         antithetic=antithetic,
+        gauge_series=gauge_series,
         # availability probe only matters for a forced native engine; the
         # static answer ("the constructor would raise") stays deterministic
         native_ok=True if engine == "native" else None,
@@ -705,6 +707,7 @@ def check_payload(
     trace: bool = False,
     crn: bool = False,
     antithetic: bool = False,
+    gauge_series: bool = False,
 ) -> CheckReport:
     """Run every static pass over a validated payload -> :class:`CheckReport`.
 
@@ -738,5 +741,6 @@ def check_payload(
             payload, plan, out,
             engine=engine, backend=backend,
             trace=trace, crn=crn, antithetic=antithetic,
+            gauge_series=gauge_series,
         )
     return CheckReport(diagnostics=out)

@@ -46,6 +46,7 @@ def run_preflight(
     trace: bool = False,
     crn: bool = False,
     antithetic: bool = False,
+    gauge_series: bool = False,
 ) -> CheckReport | None:
     """Analyze ``payload`` and report per ``mode`` (None when ``"off"``).
 
@@ -64,6 +65,7 @@ def run_preflight(
         report = check_payload(
             payload, plan=plan, engine=engine, backend=backend,
             trace=trace, crn=crn, antithetic=antithetic,
+            gauge_series=gauge_series,
         )
     except Exception as err:  # noqa: BLE001 - see docstring
         if mode == "strict":

@@ -138,6 +138,7 @@ class Engine:
         collect_gauges: bool = False,
         collect_clocks: bool = False,
         collect_traces: bool = False,
+        gauge_series_stride: int = 0,
         n_hist_bins: int = 1024,
         pool_size: int | None = None,
         max_requests: int | None = None,
@@ -167,10 +168,29 @@ class Engine:
         if collect_traces and not collect_clocks:
             msg = "collect_traces requires collect_clocks (traces index rows)"
             raise ValueError(msg)
+        if gauge_series_stride < 0:
+            msg = f"gauge_series_stride must be >= 0, got {gauge_series_stride}"
+            raise ValueError(msg)
         self.plan = plan
         self.collect_gauges = collect_gauges
         self.collect_clocks = collect_clocks
         self.collect_traces = collect_traces
+        # Streaming gauge series (sweep-scale): same interval-endpoint grid
+        # as the full gauge collection, resampled onto a coarse grid of
+        # n_samples // stride rows.  collect_gauges keeps the fine grid and
+        # wins when both are requested (FastEngine contract, fastpath.py).
+        if collect_gauges:
+            self._gauge_period = plan.sample_period
+            self._gauge_samples = plan.n_samples
+        elif gauge_series_stride:
+            self._gauge_period = plan.sample_period * gauge_series_stride
+            self._gauge_samples = plan.n_samples // gauge_series_stride
+        else:
+            self._gauge_period = plan.sample_period
+            self._gauge_samples = 0
+        self._collect_gauge_grid = collect_gauges or gauge_series_stride > 0
+        #: coarse-grid stride consumed by ``sweep_results`` (0 = fine grid)
+        self.gauge_series_stride = 0 if collect_gauges else gauge_series_stride
         # Hop ring capacity: gen + (edge + client) per entry hop + per
         # server visit (LB + edge + server + exit edge) + final client.
         # Acyclic exit DAGs visit each server once; exit-to-LB topologies
@@ -336,8 +356,13 @@ class Engine:
     # ==================================================================
 
     def _bucket(self, t):
-        """Sample-tick bucket: a delta at ``t`` affects samples at ticks >= t."""
-        return sample_bucket(t, self.plan.sample_period, self.plan.n_samples)
+        """Sample-tick bucket: a delta at ``t`` affects samples at ticks >= t.
+
+        Rides the engine's gauge grid: the fine plan grid under
+        ``collect_gauges``, the coarse ``n_samples // stride`` grid under
+        ``gauge_series_stride`` (same interval-endpoint resample contract
+        as the scan fast path)."""
+        return sample_bucket(t, self._gauge_period, self._gauge_samples)
 
     def _g_edge(self, e):
         return self.plan.gauge_edge(e)
@@ -426,7 +451,7 @@ class Engine:
     # ==================================================================
 
     def _gauge_add(self, st: EngineState, t, gidx, val, pred) -> EngineState:
-        if not self.collect_gauges:
+        if not self._collect_gauge_grid:
             return st
         v = jnp.where(pred, val, 0.0)
         return st._replace(gauge=st.gauge.at[self._bucket(t), gidx].add(v))
@@ -2335,8 +2360,10 @@ class Engine:
         plan = self.plan
         pool = self.pool
         elp = max(plan.n_lb_edges, 1)
-        n_gauge_rows = plan.n_samples + 2 if self.collect_gauges else 1
-        n_gauges = plan.n_gauges if self.collect_gauges else 1
+        n_gauge_rows = (
+            self._gauge_samples + 2 if self._collect_gauge_grid else 1
+        )
+        n_gauges = plan.n_gauges if self._collect_gauge_grid else 1
         maxn = self.max_requests if self.collect_clocks else 1
         st = EngineState(
             req_t=jnp.full(pool, INF, jnp.float32),
@@ -3113,19 +3140,35 @@ def sweep_results(
 
     gauge_series = None
     series_period = None
+    gauge_hist = None
+    gauge_hist_cap = None
     stride = getattr(engine, "gauge_series_stride", 0)
     if gauge_sel is not None and stride:
         import jax.numpy as jnp
+
+        from asyncflow_tpu.engines.results import (
+            build_gauge_hist,
+            gauge_hist_caps,
+        )
 
         # slice the selected columns BEFORE the cumsum: only k columns are
         # materialized, not a second full (S, T+2, n_gauges) grid
         selected = final.gauge[:, :, np.asarray(gauge_sel)]
         gauge_series = np.asarray(jnp.cumsum(selected, axis=1)[:, 1:-1])
         series_period = engine.plan.sample_period * stride
+        # fixed-bin value histograms across this chunk's scenario rows
+        # (summed across chunks by _concat_sweeps -> SweepResults.gauge_bands).
+        # Binning runs on the host over the device-reduced coarse series: one
+        # float64 rule shared with every rebuild site (quarantine edits,
+        # scenario-axis slicing), so sums and rebuilds are bit-consistent.
+        gauge_hist_cap = gauge_hist_caps(engine.plan, gauge_sel)
+        gauge_hist = build_gauge_hist(gauge_series, gauge_hist_cap)
 
     return SweepResults(
         gauge_series=gauge_series,
         gauge_series_period=series_period,
+        gauge_hist=gauge_hist,
+        gauge_hist_cap=gauge_hist_cap,
         settings=settings,
         completed=np.asarray(final.lat_count),
         latency_hist=np.asarray(final.hist),

@@ -64,11 +64,22 @@ _FFI_TARGET = "af_stable_argsort_rank"
 _ffi_ready: bool | None = None
 
 
+def _ffi_api():
+    """The jax FFI namespace: top-level ``jax.ffi`` (jax >= 0.5) or its
+    ``jax.extend.ffi`` predecessor — same four functions either way."""
+    try:
+        from jax import ffi
+    except ImportError:
+        from jax.extend import ffi
+    return ffi
+
+
 def _ensure_ffi() -> bool:
     global _ffi_ready
     if _ffi_ready is not None:
         return _ffi_ready
     try:
+        ffi = _ffi_api()
         src = Path(__file__).parent / "ffisort.cpp"
         out_dir = Path(tempfile.gettempdir()) / f"asyncflow_tpu_ffi_{os.getuid()}"
         out_dir.mkdir(exist_ok=True, mode=0o700)
@@ -83,7 +94,7 @@ def _ensure_ffi() -> bool:
             subprocess.run(
                 [
                     "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                    f"-I{jax.ffi.include_dir()}",
+                    f"-I{ffi.include_dir()}",
                     str(src), "-o", str(tmp),
                 ],
                 check=True,
@@ -91,9 +102,9 @@ def _ensure_ffi() -> bool:
             )
             os.replace(tmp, out)
         lib = ctypes.CDLL(str(out))
-        jax.ffi.register_ffi_target(
+        ffi.register_ffi_target(
             _FFI_TARGET,
-            jax.ffi.pycapsule(lib.AfStableArgsortRank),
+            ffi.pycapsule(lib.AfStableArgsortRank),
             platform="cpu",
         )
         _ffi_ready = True
@@ -105,7 +116,7 @@ def _ensure_ffi() -> bool:
 def _ffi_rank(keys: jnp.ndarray) -> jnp.ndarray:
     """Stable-sort rank of f32 keys via the native kernel (CPU only)."""
     shape = jax.ShapeDtypeStruct(keys.shape, jnp.int32)
-    _, rank = jax.ffi.ffi_call(
+    _, rank = _ffi_api().ffi_call(
         _FFI_TARGET, (shape, shape), vmap_method="expand_dims",
     )(keys)
     return rank

@@ -12,6 +12,66 @@ import numpy as np
 
 from asyncflow_tpu.schemas.settings import SimulationSettings
 
+#: fixed-bin resolution of the streaming gauge histograms behind
+#: :attr:`SweepResults.gauge_bands` — linear bins over [0, cap) per gauge
+#: column, so a band value is exact to cap / GAUGE_HIST_BINS.
+GAUGE_HIST_BINS = 128
+
+#: the quantiles :attr:`SweepResults.gauge_bands` reports, in row order.
+GAUGE_BAND_QS = (50.0, 90.0, 99.0)
+
+
+def gauge_hist_caps(plan, sel) -> np.ndarray:
+    """Per-column value caps for the gauge histograms.
+
+    ``sel`` holds gauge-layout column indices (``[edges | ready | io |
+    ram]``, :attr:`StaticPlan.n_gauges`).  Connection/queue gauges are
+    bounded by the request pool; RAM by the server's capacity.  Duck-typed
+    on ``plan`` (``n_edges`` / ``n_servers`` / ``pool_size`` /
+    ``server_ram``) so tests can pass a stand-in.
+    """
+    sel = np.asarray(sel, np.int64)
+    caps = np.full(sel.shape, float(plan.pool_size), np.float64)
+    ram0 = plan.n_edges + 2 * plan.n_servers
+    is_ram = sel >= ram0
+    if np.any(is_ram):
+        caps[is_ram] = np.asarray(plan.server_ram, np.float64)[
+            sel[is_ram] - ram0
+        ]
+    return np.maximum(caps, 1e-9)
+
+
+def build_gauge_hist(
+    series: np.ndarray,
+    caps: np.ndarray,
+    *,
+    quarantined: np.ndarray | None = None,
+    n_bins: int = GAUGE_HIST_BINS,
+) -> np.ndarray:
+    """Reduce an ``(S, T_g, k)`` gauge series to ``(T_g, k, B)`` int64
+    fixed-bin counts across the scenario axis.
+
+    The single binning rule every build/rebuild site shares (initial chunk
+    reduction, quarantine edits, scenario-axis slicing): float64
+    ``floor(v / cap * B)`` clipped to ``[0, B-1]``, quarantined rows
+    excluded so the bands reflect ``effective_n``.
+    """
+    series = np.asarray(series)
+    if quarantined is not None and np.any(quarantined):
+        series = series[~np.asarray(quarantined, bool)]
+    _, T, k = series.shape
+    caps = np.asarray(caps, np.float64).reshape(1, 1, k)
+    idx = np.clip(
+        np.floor(series.astype(np.float64) / caps * n_bins).astype(np.int64),
+        0,
+        n_bins - 1,
+    )
+    hist = np.zeros((T, k, n_bins), np.int64)
+    t_idx = np.broadcast_to(np.arange(T)[None, :, None], idx.shape)
+    k_idx = np.broadcast_to(np.arange(k)[None, None, :], idx.shape)
+    np.add.at(hist, (t_idx, k_idx, idx), 1)
+    return hist
+
 
 @dataclass(frozen=True)
 class DeviceCounters:
@@ -195,6 +255,15 @@ class SweepResults:
     gauge_series: np.ndarray | None = None
     #: seconds between gauge_series rows (sample_period * stride).
     gauge_series_period: float | None = None
+    #: (T_g, k, B) int64 cross-scenario gauge histograms — per coarse time
+    #: bin and selected gauge column, ``B = GAUGE_HIST_BINS`` linear value
+    #: bins over [0, cap).  Built per chunk from ``gauge_series``, summed
+    #: across chunks, quarantine-aware (masked rows hold no counts); feeds
+    #: :attr:`gauge_bands`.  None without a gauge_series spec.
+    gauge_hist: np.ndarray | None = None
+    #: (k,) per-column value caps of the gauge histograms (pool size for
+    #: connection/queue gauges, server RAM for ram_in_use).
+    gauge_hist_cap: np.ndarray | None = None
     #: (S,) requests shed by overload policies per scenario.  The event and
     #: native engines always populate it (zeros when no cap binds); None
     #: only for engines with no shed channel at all (fast path / Pallas,
@@ -254,6 +323,30 @@ class SweepResults:
             return self
         return self[~np.asarray(self.quarantined, bool)]
 
+    @property
+    def gauge_bands(self) -> np.ndarray | None:
+        """(3, T_g, k) cross-scenario quantile bands of the gauge series.
+
+        Row order is :data:`GAUGE_BAND_QS` (p50/p90/p99); column j is the
+        j-th selected gauge, time axis the coarse resample grid.  Computed
+        from the fixed-bin histograms with the same interpolation rule as
+        :func:`hist_percentile`, so a band value is exact to
+        ``cap / GAUGE_HIST_BINS``.  Quarantined scenarios hold no counts —
+        the bands reflect the effective sweep.  None without a
+        gauge_series spec.
+        """
+        if self.gauge_hist is None or self.gauge_hist_cap is None:
+            return None
+        T, k, B = self.gauge_hist.shape
+        out = np.zeros((len(GAUGE_BAND_QS), T, k))
+        for j in range(k):
+            edges = np.linspace(0.0, float(self.gauge_hist_cap[j]), B + 1)
+            for qi, q in enumerate(GAUGE_BAND_QS):
+                out[qi, :, j] = hist_percentile(
+                    self.gauge_hist[:, j, :], edges, q,
+                )
+        return out
+
     def __getitem__(self, idx) -> SweepResults:
         """Slice along the scenario axis."""
         return SweepResults(
@@ -277,6 +370,22 @@ class SweepResults:
                 self.gauge_series[idx] if self.gauge_series is not None else None
             ),
             gauge_series_period=self.gauge_series_period,
+            # the histograms span the scenario axis: rebuild from the kept
+            # rows (minus any still-quarantined ones) instead of slicing
+            gauge_hist=(
+                build_gauge_hist(
+                    self.gauge_series[idx],
+                    self.gauge_hist_cap,
+                    quarantined=(
+                        self.quarantined[idx]
+                        if self.quarantined is not None
+                        else None
+                    ),
+                )
+                if self.gauge_hist is not None and self.gauge_series is not None
+                else None
+            ),
+            gauge_hist_cap=self.gauge_hist_cap,
             total_rejected=(
                 self.total_rejected[idx]
                 if self.total_rejected is not None

@@ -1,0 +1,327 @@
+"""Static HTML sweep dashboard: one self-contained file, no external assets.
+
+Renders a telemetry JSONL (and optionally the finished
+:class:`~asyncflow_tpu.parallel.SweepReport`) into inline-SVG charts:
+
+- run summary with confidence intervals (when a report is given),
+- live progress (scenarios done / EWMA throughput over elapsed time),
+- cross-scenario gauge quantile bands over simulated time,
+- recovery / quarantine timeline,
+- phase timers and the compile ledger's warm/cold verdicts.
+
+The output embeds everything (styles, SVG, data) so it can be attached to
+a CI artifact or mailed around::
+
+    python -m asyncflow_tpu.observability.dashboard run.jsonl -o sweep.html
+
+Chart rendering is host-side Python producing plain SVG — no JS
+dependencies, nothing fetched at view time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import json
+import sys
+from pathlib import Path
+
+from asyncflow_tpu.observability.export import read_run_records
+
+_CSS = """
+body { font-family: system-ui, sans-serif; margin: 2rem auto; max-width: 70rem;
+       color: #1a1a2e; background: #fafafa; }
+h1 { font-size: 1.4rem; } h2 { font-size: 1.1rem; margin-top: 2rem;
+     border-bottom: 1px solid #ddd; padding-bottom: .3rem; }
+table { border-collapse: collapse; font-size: .85rem; }
+td, th { padding: .25rem .7rem; border: 1px solid #e0e0e0; text-align: left; }
+th { background: #f0f0f4; }
+.warm { color: #1b7837; } .cold { color: #b2182b; }
+svg { background: #fff; border: 1px solid #e0e0e0; }
+.note { color: #666; font-size: .8rem; }
+"""
+
+_W, _H, _PAD = 640, 220, 40
+
+
+def _esc(x) -> str:
+    return html.escape(str(x))
+
+
+def _scale(vals, lo, hi, out_lo, out_hi):
+    span = (hi - lo) or 1.0
+    return [out_lo + (v - lo) / span * (out_hi - out_lo) for v in vals]
+
+
+def _axes(x_label: str, y_label: str, x_max, y_max) -> str:
+    return (
+        f'<line x1="{_PAD}" y1="{_H - _PAD}" x2="{_W - 10}" y2="{_H - _PAD}" '
+        'stroke="#999"/>'
+        f'<line x1="{_PAD}" y1="10" x2="{_PAD}" y2="{_H - _PAD}" stroke="#999"/>'
+        f'<text x="{_W // 2}" y="{_H - 6}" font-size="11" text-anchor="middle">'
+        f"{_esc(x_label)} (max {x_max:g})</text>"
+        f'<text x="12" y="{_H // 2}" font-size="11" text-anchor="middle" '
+        f'transform="rotate(-90 12 {_H // 2})">{_esc(y_label)} '
+        f"(max {y_max:g})</text>"
+    )
+
+
+def _polyline(xs, ys, x_max, y_max, color: str) -> str:
+    px = _scale(xs, 0.0, x_max, _PAD, _W - 10)
+    py = _scale(ys, 0.0, y_max, _H - _PAD, 10)
+    pts = " ".join(f"{x:.1f},{y:.1f}" for x, y in zip(px, py))
+    return f'<polyline points="{pts}" fill="none" stroke="{color}" stroke-width="1.5"/>'
+
+
+def _band(xs, lo, hi, x_max, y_max, color: str) -> str:
+    px = _scale(xs, 0.0, x_max, _PAD, _W - 10)
+    plo = _scale(lo, 0.0, y_max, _H - _PAD, 10)
+    phi = _scale(hi, 0.0, y_max, _H - _PAD, 10)
+    ring = list(zip(px, phi)) + list(zip(px[::-1], plo[::-1]))
+    pts = " ".join(f"{x:.1f},{y:.1f}" for x, y in ring)
+    return f'<polygon points="{pts}" fill="{color}" fill-opacity="0.25" stroke="none"/>'
+
+
+def _svg(body: str) -> str:
+    return f'<svg viewBox="0 0 {_W} {_H}" width="{_W}" height="{_H}">{body}</svg>'
+
+
+def _kv_table(pairs) -> str:
+    rows = "".join(
+        f"<tr><th>{_esc(k)}</th><td>{_esc(v)}</td></tr>" for k, v in pairs
+    )
+    return f"<table>{rows}</table>"
+
+
+# ---------------------------------------------------------------------------
+# sections
+# ---------------------------------------------------------------------------
+
+
+def _summary_section(sweep: dict | None, report) -> str:
+    out = ["<h2>Summary</h2>"]
+    if sweep is not None:
+        meta = sweep.get("meta", {})
+        keys = (
+            "engine", "backend", "n_scenarios", "seed", "wall_seconds",
+            "scenarios_per_second", "n_quarantined", "recovery_actions",
+            "horizon_s", "n_devices",
+        )
+        out.append(_kv_table([(k, meta[k]) for k in keys if k in meta]))
+    else:
+        out.append('<p class="note">no terminal kind="sweep" record yet — '
+                   "the sweep is still running or was preempted.</p>")
+    if report is not None:
+        point, lo, hi = report.per_scenario_percentile_mean_ci(95)
+        est = report.pooled_percentile_ci(99)
+        out.append("<h3>Confidence intervals</h3>")
+        out.append(_kv_table([
+            ("mean per-scenario p95 (95% CI)",
+             f"{point:.4f}s  [{lo:.4f}, {hi:.4f}]"),
+            ("pooled p99 (95% CI)",
+             f"{est.point:.4f}s  [{est.lo:.4f}, {est.hi:.4f}]"),
+            ("effective scenarios",
+             report.n_scenarios - report.n_quarantined),
+        ]))
+    return "\n".join(out)
+
+
+def _progress_section(progress: list[dict]) -> str:
+    if not progress:
+        return ""
+    metas = [p.get("meta", {}) for p in progress]
+    xs = [m.get("elapsed_s", 0.0) for m in metas]
+    done = [m.get("scenarios_done", 0) for m in metas]
+    rate = [m.get("ewma_scenarios_per_second", 0.0) for m in metas]
+    x_max = max(xs) or 1.0
+    body = _axes("elapsed s", "scenarios done", x_max, max(done) or 1)
+    body += _polyline(xs, done, x_max, max(done) or 1, "#2166ac")
+    chart1 = _svg(body)
+    body = _axes("elapsed s", "EWMA scen/s", x_max, max(rate) or 1.0)
+    body += _polyline(xs, rate, x_max, max(rate) or 1.0, "#542788")
+    chart2 = _svg(body)
+    return f"<h2>Progress</h2>{chart1}\n{chart2}"
+
+
+def _bands_section(report) -> str:
+    if report is None or report.results.gauge_bands is None:
+        return ""
+    from asyncflow_tpu.engines.results import GAUGE_BAND_QS
+
+    out = ["<h2>Gauge quantile bands</h2>",
+           '<p class="note">across-scenario p50/p90/p99 of the streamed '
+           "gauge at each coarse tick (histogram-backed, quarantine-"
+           "excluded).</p>"]
+    for cid in report.gauge_series_ids:
+        times, bands = report.gauge_bands(cid)
+        xs = list(map(float, times))
+        x_max = max(xs) or 1.0
+        y_max = float(bands.max()) or 1.0
+        body = _axes("sim time s", _esc(cid), x_max, y_max)
+        body += _band(xs, bands[0].tolist(), bands[2].tolist(), x_max, y_max,
+                      "#2166ac")
+        for qi, color in enumerate(("#2166ac", "#542788", "#b2182b")):
+            body += _polyline(xs, bands[qi].tolist(), x_max, y_max, color)
+        legend = " / ".join(
+            f"p{q:g}" for q in GAUGE_BAND_QS
+        )
+        out.append(f"<h3>{_esc(cid)} <span class='note'>({legend})</span></h3>")
+        out.append(_svg(body))
+    return "\n".join(out)
+
+
+def _recovery_section(progress: list[dict], recovery: list[dict]) -> str:
+    actions = [a for r in recovery for a in r.get("meta", {}).get("actions", [])]
+    if not actions and not any(
+        p.get("meta", {}).get("n_quarantined") for p in progress
+    ):
+        return ('<h2>Recovery / quarantine</h2>'
+                '<p class="note">no recovery actions recorded.</p>')
+    rows = "".join(
+        "<tr>"
+        f"<td>{_esc(a.get('kind', '?'))}</td>"
+        f"<td>{_esc(a.get('scenario', a.get('scenario_start', '')))}</td>"
+        f"<td>{_esc(a.get('reason', a.get('error', '')))[:200]}</td>"
+        "</tr>"
+        for a in actions
+    )
+    table = (
+        "<table><tr><th>action</th><th>scenario</th><th>detail</th></tr>"
+        f"{rows}</table>"
+    )
+    # quarantine tally over elapsed time, from the heartbeats
+    metas = [p.get("meta", {}) for p in progress]
+    xs = [m.get("elapsed_s", 0.0) for m in metas]
+    qs = [m.get("n_quarantined", 0) for m in metas]
+    chart = ""
+    if xs and max(qs):
+        body = _axes("elapsed s", "quarantined", max(xs) or 1.0, max(qs))
+        body += _polyline(xs, qs, max(xs) or 1.0, max(qs), "#b2182b")
+        chart = _svg(body)
+    return f"<h2>Recovery / quarantine</h2>{table}\n{chart}"
+
+
+def _phases_section(sweep: dict | None) -> str:
+    if sweep is None or not sweep.get("phase_totals_s"):
+        return ""
+    totals = sweep["phase_totals_s"]
+    t_max = max(totals.values()) or 1.0
+    bar_w = _W - 180
+    rows = []
+    for i, (name, secs) in enumerate(
+        sorted(totals.items(), key=lambda kv: -kv[1]),
+    ):
+        y = 14 + i * 22
+        w = max(secs / t_max * bar_w, 1.0)
+        rows.append(
+            f'<text x="4" y="{y + 12}" font-size="11">{_esc(name)}</text>'
+            f'<rect x="120" y="{y}" width="{w:.1f}" height="16" '
+            'fill="#2166ac"/>'
+            f'<text x="{124 + w:.1f}" y="{y + 12}" font-size="11">'
+            f"{secs:.3f}s</text>",
+        )
+    height = 22 * len(totals) + 20
+    svg = (f'<svg viewBox="0 0 {_W} {height}" width="{_W}" '
+           f'height="{height}">{"".join(rows)}</svg>')
+    return f"<h2>Phase timers</h2>{svg}"
+
+
+def _compiles_section(sweep: dict | None) -> str:
+    if sweep is None or not sweep.get("compiles"):
+        return ""
+    rows = []
+    for c in sweep["compiles"]:
+        warm = bool(c.get("cache_hit"))
+        verdict = ('<span class="warm">warm</span>' if warm
+                   else '<span class="cold">cold</span>')
+        secs = c.get("compile_s")
+        rows.append(
+            "<tr>"
+            f"<td>{_esc(c.get('key', '?'))[:60]}</td>"
+            f"<td>{_esc(c.get('engine', ''))}</td>"
+            f"<td>{verdict}</td>"
+            f"<td>{'' if secs is None else f'{secs:.3f}s'}</td>"
+            "</tr>",
+        )
+    return (
+        "<h2>Compile ledger</h2>"
+        "<table><tr><th>program</th><th>engine</th><th>verdict</th>"
+        f"<th>compile</th></tr>{''.join(rows)}</table>"
+    )
+
+
+# ---------------------------------------------------------------------------
+# assembly
+# ---------------------------------------------------------------------------
+
+
+def build_dashboard(
+    records: list[dict],
+    *,
+    report=None,
+    title: str = "asyncflow sweep",
+) -> str:
+    """Render the run records (+ optional finished report) to an HTML page."""
+    progress = [r for r in records if r.get("kind") == "progress"]
+    recovery = [r for r in records if r.get("kind") == "recovery"]
+    sweeps = [r for r in records if r.get("kind") == "sweep"]
+    sweep = sweeps[-1] if sweeps else None
+    sections = [
+        _summary_section(sweep, report),
+        _progress_section(progress),
+        _bands_section(report),
+        _recovery_section(progress, recovery),
+        _phases_section(sweep),
+        _compiles_section(sweep),
+    ]
+    body = "\n".join(s for s in sections if s)
+    return (
+        "<!DOCTYPE html>\n<html><head><meta charset='utf-8'>"
+        f"<title>{_esc(title)}</title><style>{_CSS}</style></head>"
+        f"<body><h1>{_esc(title)}</h1>\n{body}\n"
+        f"<p class='note'>records: {len(records)} "
+        f"(progress {len(progress)}, recovery {len(recovery)}, "
+        f"sweep {len(sweeps)})</p></body></html>"
+    )
+
+
+def write_dashboard(
+    jsonl_path: str | Path,
+    out_path: str | Path,
+    *,
+    report=None,
+    title: str | None = None,
+) -> Path:
+    """Read a telemetry JSONL and write the dashboard HTML beside it."""
+    records = read_run_records(jsonl_path)
+    page = build_dashboard(
+        records,
+        report=report,
+        title=title or f"asyncflow sweep — {Path(jsonl_path).name}",
+    )
+    out = Path(out_path)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(page)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m asyncflow_tpu.observability.dashboard",
+        description="Render a sweep telemetry JSONL to a static HTML page.",
+    )
+    parser.add_argument("jsonl", help="telemetry JSONL path")
+    parser.add_argument(
+        "-o", "--out", default=None,
+        help="output HTML path (default: <jsonl stem>.html beside the input)",
+    )
+    args = parser.parse_args(argv)
+    out = args.out or str(Path(args.jsonl).with_suffix(".html"))
+    path = write_dashboard(args.jsonl, out)
+    n = len(read_run_records(args.jsonl))
+    print(f"wrote {path} ({n} records)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,168 @@
+"""Live sweep follower: tail a telemetry JSONL and render fleet progress.
+
+``SweepRunner.run(..., telemetry=TelemetryConfig(jsonl_path=...))`` appends
+one ``kind="progress"`` record per finished chunk (scenarios done, EWMA
+throughput, ETA, quarantine/recovery tallies) and a final ``kind="sweep"``
+record.  This module follows that file from another terminal::
+
+    python -m asyncflow_tpu.observability.live run.jsonl
+
+``--once`` renders the current state and exits (the smoke/CI mode);
+without it the follower polls until the terminal ``kind="sweep"`` record
+lands.  Pure stdlib — safe to run on hosts without jax installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+from typing import Iterator
+
+#: meta keys every progress record carries (validated by the smoke tier)
+PROGRESS_META_KEYS = (
+    "phase",
+    "engine",
+    "seed",
+    "n_scenarios",
+    "scenarios_done",
+    "chunk_rows",
+    "elapsed_s",
+    "scenarios_per_second",
+    "ewma_scenarios_per_second",
+    "eta_s",
+    "n_quarantined",
+    "recovery_actions",
+)
+
+
+def validate_progress_record(record: dict) -> list[str]:
+    """Schema check for one ``kind="progress"`` record (empty = valid)."""
+    problems: list[str] = []
+    if record.get("kind") != "progress":
+        problems.append(f"kind is {record.get('kind')!r}, expected 'progress'")
+    meta = record.get("meta")
+    if not isinstance(meta, dict):
+        return [*problems, "missing meta dict"]
+    for key in PROGRESS_META_KEYS:
+        if key not in meta:
+            problems.append(f"missing meta key {key!r}")
+    for key in ("scenarios_done", "n_scenarios", "chunk_rows"):
+        if key in meta and not isinstance(meta[key], int):
+            problems.append(f"meta[{key!r}] is not an int")
+    return problems
+
+
+def iter_records(path: str | Path, *, poll_s: float = 0.5, follow: bool = True) -> Iterator[dict]:
+    """Yield records from ``path`` oldest-first, then (with ``follow``)
+    poll for appended lines until a terminal ``kind="sweep"`` record.
+
+    Torn tail lines (a chunk heartbeat from a killed process) are held
+    until their newline arrives, never dropped or mis-parsed.
+    """
+    path = Path(path)
+    offset = 0
+    buf = ""
+    while True:
+        if path.exists():
+            with path.open() as fh:
+                fh.seek(offset)
+                buf += fh.read()
+                offset = fh.tell()
+            done = False
+            while "\n" in buf:
+                line, buf = buf.split("\n", 1)
+                if not line.strip():
+                    continue
+                try:
+                    record = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                yield record
+                if record.get("kind") == "sweep":
+                    done = True
+            if done or not follow:
+                return
+        elif not follow:
+            return
+        time.sleep(poll_s)
+
+
+def _bar(done: int, total: int, width: int = 30) -> str:
+    filled = int(width * done / max(total, 1))
+    return "#" * filled + "-" * (width - filled)
+
+
+def format_progress(record: dict) -> str:
+    """One follower line for a ``kind="progress"`` record."""
+    m = record.get("meta", {})
+    done, total = m.get("scenarios_done", 0), m.get("n_scenarios", 0)
+    line = (
+        f"[{_bar(done, total)}] {done}/{total} "
+        f"{m.get('ewma_scenarios_per_second', 0.0):8.1f} scen/s "
+        f"eta {m.get('eta_s', 0.0):7.1f}s "
+        f"({m.get('engine', '?')}/{m.get('phase', '?')})"
+    )
+    if m.get("n_quarantined"):
+        line += f"  quarantined={m['n_quarantined']}"
+    if m.get("recovery_actions"):
+        line += f"  recovery={m['recovery_actions']}"
+    return line
+
+
+def format_final(record: dict) -> str:
+    """The terminal line once the ``kind="sweep"`` record lands."""
+    m = record.get("meta", {})
+    return (
+        f"done: {m.get('n_scenarios', '?')} scenarios on "
+        f"'{m.get('engine', '?')}' in {m.get('wall_seconds', 0.0)}s "
+        f"({m.get('scenarios_per_second', 0.0)} scen/s), "
+        f"{m.get('n_quarantined', 0)} quarantined, "
+        f"{m.get('recovery_actions', 0)} recovery action(s)"
+    )
+
+
+def format_recovery(record: dict) -> str:
+    m = record.get("meta", {})
+    kinds: dict[str, int] = {}
+    for action in m.get("actions", []):
+        kinds[action.get("kind", "?")] = kinds.get(action.get("kind", "?"), 0) + 1
+    summary = ", ".join(f"{k}x{n}" for k, n in sorted(kinds.items()))
+    return f"recovery: {summary or 'no actions'}"
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m asyncflow_tpu.observability.live",
+        description="Follow a sweep's telemetry JSONL and render progress.",
+    )
+    parser.add_argument("jsonl", help="telemetry JSONL path (may not exist yet)")
+    parser.add_argument(
+        "--poll", type=float, default=0.5, help="poll interval seconds",
+    )
+    parser.add_argument(
+        "--once",
+        action="store_true",
+        help="render the records present now and exit (no follow)",
+    )
+    args = parser.parse_args(argv)
+
+    saw_final = False
+    for record in iter_records(args.jsonl, poll_s=args.poll, follow=not args.once):
+        kind = record.get("kind")
+        if kind == "progress":
+            print(format_progress(record), flush=True)
+        elif kind == "recovery":
+            print(format_recovery(record), flush=True)
+        elif kind == "sweep":
+            print(format_final(record), flush=True)
+            saw_final = True
+    if not saw_final and not args.once:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

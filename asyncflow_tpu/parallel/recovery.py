@@ -358,6 +358,32 @@ def nonfinite_rows(part) -> list[tuple[int, str]]:
     ]
 
 
+#: SweepResults fields that do NOT carry a leading scenario axis and must
+#: never be row-masked/spliced — gauge_hist is (T_g, k, B) and could alias
+#: a chunk's row count by coincidence, so it is rebuilt, not mutated.
+_NON_ROW_FIELDS = (
+    "settings",
+    "hist_edges",
+    "gauge_series_period",
+    "gauge_hist",
+    "gauge_hist_cap",
+)
+
+
+def _rebuild_gauge_hist(part) -> None:
+    """Re-derive the cross-scenario gauge histograms after a row edit so
+    :attr:`SweepResults.gauge_bands` keeps excluding quarantined rows."""
+    if part.gauge_hist is None or part.gauge_series is None:
+        return
+    from asyncflow_tpu.engines.results import build_gauge_hist
+
+    part.gauge_hist = build_gauge_hist(
+        part.gauge_series,
+        part.gauge_hist_cap,
+        quarantined=part.quarantined,
+    )
+
+
 def _zero_rows(part, rows: list[int], reasons: list[str]):
     """Mask the given rows out of every per-scenario array (copying — the
     arrays may be read-only views of device buffers) and set the
@@ -365,7 +391,7 @@ def _zero_rows(part, rows: list[int], reasons: list[str]):
     n = int(np.asarray(part.completed).shape[0])
     idx = np.asarray(rows, np.int64)
     for f in fields(part):
-        if f.name in ("settings", "hist_edges", "gauge_series_period"):
+        if f.name in _NON_ROW_FIELDS:
             continue
         arr = getattr(part, f.name)
         if arr is None:
@@ -395,6 +421,7 @@ def _zero_rows(part, rows: list[int], reasons: list[str]):
         reason[row] = why
     part.quarantined = mask
     part.quarantine_reason = np.asarray(reason, dtype=np.str_)
+    _rebuild_gauge_hist(part)
     return part
 
 
@@ -421,7 +448,7 @@ def masked_like(template, n: int, reason: str):
     n_t = int(np.asarray(template.completed).shape[0])
     for f in fields(template):
         arr = getattr(template, f.name)
-        if f.name in ("settings", "hist_edges", "gauge_series_period"):
+        if f.name in _NON_ROW_FIELDS:
             zero[f.name] = copy.copy(arr) if f.name != "settings" else arr
             continue
         if arr is None:
@@ -441,7 +468,7 @@ def splice_row(part, row: int, single) -> None:
     isolated bit-identical re-run that came back clean)."""
     n = int(np.asarray(part.completed).shape[0])
     for f in fields(part):
-        if f.name in ("settings", "hist_edges", "gauge_series_period"):
+        if f.name in _NON_ROW_FIELDS:
             continue
         dst = getattr(part, f.name)
         src = getattr(single, f.name, None)
@@ -453,6 +480,7 @@ def splice_row(part, row: int, single) -> None:
             continue
         dst_arr[row] = src_arr[0]
         setattr(part, f.name, dst_arr)
+    _rebuild_gauge_hist(part)
 
 
 # ---------------------------------------------------------------------------

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from asyncflow_tpu.checker.fences import fence_message, raise_fence
+from asyncflow_tpu.checker.fences import raise_fence
 from asyncflow_tpu.checker.preflight import run_preflight
 from asyncflow_tpu.compiler.plan import StaticPlan, compile_payload
 from asyncflow_tpu.engines.jaxsim.engine import Engine, scenario_keys, sweep_results
@@ -520,6 +520,28 @@ class SweepReport:
         lo, med, hi = np.percentile(series, [lo_q, 50.0, hi_q], axis=0)
         return times, lo, med, hi
 
+    def gauge_bands(self, component_id: str) -> tuple[np.ndarray, np.ndarray]:
+        """(times, (3, T) bands): histogram-backed p50/p90/p99 over time.
+
+        Unlike :meth:`gauge_series_band` (exact percentiles over the
+        in-memory series), these come from the fixed-bin value histograms
+        that chunks reduce into (:attr:`SweepResults.gauge_bands`), so they
+        stay cheap at fleet scale, survive checkpoint resume, and exclude
+        quarantined scenario rows.  Row order follows
+        ``asyncflow_tpu.engines.results.GAUGE_BAND_QS``.
+        """
+        times, _ = self.gauge_series(component_id)
+        bands = self.results.gauge_bands
+        if bands is None:
+            msg = (
+                "this sweep carries no gauge-band histograms (chunks "
+                "predating the band schema); re-run the sweep to get "
+                "histogram-backed bands"
+            )
+            raise ValueError(msg)
+        col = self.gauge_series_ids.index(component_id)
+        return times, bands[:, :, col]
+
     def summary(self) -> dict:
         res = self.results
         completed = res.completed.sum()
@@ -644,14 +666,17 @@ class SweepRunner:
 
         ``gauge_series``: ``(metric, component_ids, resample_s)`` — collect
         per-scenario streaming time series of the named gauge for the named
-        components, resampled to ``resample_s`` seconds (fast path only).
-        ``metric`` is a :class:`SampledMetricName` (or its string value);
+        components, resampled to ``resample_s`` seconds (scan fast path and
+        XLA event engine; the pallas/native engines refuse).  ``metric`` is
+        a :class:`SampledMetricName` (or its string value);
         ``component_ids`` a list of edge ids (edge concurrency) or server
         ids (ready/io/ram).  The coarse grid is computed on device, so a
         100k-scenario sweep streams a few hundred floats per scenario to
         the host instead of the full fine-grained grid; the value at each
         coarse tick is exactly the fine-grid value at that time.  Access
-        via :meth:`SweepReport.gauge_series`.
+        via :meth:`SweepReport.gauge_series`; cross-scenario quantile
+        bands via :attr:`SweepResults.gauge_bands` /
+        :meth:`SweepReport.gauge_bands`.
 
         ``scan_inner``: fast-path block size for the in-program chunk loop
         (``FastEngine.run_batch_scanned``).  ``None`` auto-enables blocks of
@@ -766,6 +791,10 @@ class SweepRunner:
             self._gauge_sel, gauge_stride, self._gauge_series_ids = (
                 _resolve_gauge_series(self.plan, gauge_series)
             )
+        if self._gauge_sel is not None and engine in ("pallas", "native"):
+            # streaming series ride the jaxsim interval-endpoint gauge grid
+            # (fast + event engines); pallas/native carry no such grid
+            raise_fence(f"gauge_series.{engine}")
         # Resilience plans (fault windows / client retries) run on the
         # scan fast path (round 8 fence burn-down) and the XLA event
         # engine; the native C++ core and Pallas VMEM kernel do not carry
@@ -813,6 +842,9 @@ class SweepRunner:
             and not vr_coupled
             # the flight recorder's rings live in the XLA event engine
             and self.trace is None
+            # streaming gauge series ride the jaxsim gauge grid: auto
+            # routes gauge-series sweeps off the pallas kernel
+            and self._gauge_sel is None
             # the VMEM kernel models the round-5 event-engine feature set
             # (overload policies, circuit breakers, DB pools, cache
             # mixtures, LLM dynamics, weighted endpoints, multi-generator
@@ -833,6 +865,7 @@ class SweepRunner:
                 self.plan,
                 collect_gauges=False,
                 collect_clocks=False,
+                gauge_series_stride=gauge_stride,
                 n_hist_bins=n_hist_bins,
                 crn=self._crn,
                 trace=self.trace,
@@ -861,15 +894,6 @@ class SweepRunner:
             self._scan_inner = scan_inner if self.mesh is None else 0
         else:
             self._scan_inner = 0
-        if self._gauge_sel is not None and self.engine_kind != "fast":
-            msg = fence_message(
-                "gauge_series.requires_fast", detail=self.engine_kind,
-            ) + (
-                f" because: {self.plan.fastpath_reason}"
-                if self.plan.fastpath_reason
-                else ""
-            )
-            raise ValueError(msg)
         # default-on static preflight: findings surface as one
         # PreflightWarning (+ a kind="preflight" run record when telemetry
         # is configured); "strict" raises PreflightError, "off" skips.
@@ -884,6 +908,7 @@ class SweepRunner:
             trace=self.trace is not None,
             crn=self._crn,
             antithetic=self._antithetic,
+            gauge_series=self._gauge_sel is not None,
         )
 
     def _guard_fastpath_overrides(self, overrides: ScenarioOverrides | None) -> None:
@@ -902,8 +927,9 @@ class SweepRunner:
         digest = hashlib.sha256()
         # bump when the per-chunk npz schema changes so stale chunks are
         # never silently merged (e.g. pre-gauge_means chunks); v6 added
-        # the quarantine mask/reason arrays and the digest sidecars
-        digest.update(b"chunk-schema-v6")
+        # the quarantine mask/reason arrays and the digest sidecars; v7 the
+        # gauge_hist/gauge_hist_cap band histograms
+        digest.update(b"chunk-schema-v7")
         digest.update(self.payload.model_dump_json().encode())
         # the LOWERED plan arrays, not just the payload: any plan-level
         # field (fault tables, retry scalars, capacity estimates — and
@@ -1000,6 +1026,7 @@ class SweepRunner:
                 "checkpoint_dir": checkpoint_dir,
                 "first_scenario": first_scenario,
                 "tel": tel,
+                "cfg": cfg,
             }
             if not self._antithetic:
                 return self._run_impl(n_scenarios, **kw)
@@ -1114,6 +1141,7 @@ class SweepRunner:
         checkpoint_dir: str | None,
         first_scenario: int,
         tel,
+        cfg: TelemetryConfig | None = None,
         antithetic: bool = False,
     ) -> SweepReport:
         import time
@@ -1551,6 +1579,43 @@ class SweepRunner:
                 signal_name=name,
             )
 
+        # live progress heartbeats (docs/guides/observability.md, "Fleet
+        # view"): one kind="progress" record per finished chunk, tailed by
+        # `python -m asyncflow_tpu.observability.live` and the dashboard
+        ewma_rate = [0.0]
+        beat = [t0, 0]  # [last heartbeat time, scenario rows completed]
+
+        def _progress(n_rows: int, phase: str) -> None:
+            beat[1] += n_rows
+            if cfg is None or not cfg.enabled:
+                return
+            now = time.time()
+            inst = n_rows / max(now - beat[0], 1e-9)
+            beat[0] = now
+            # EWMA over per-chunk throughput: stable ETA under downshifts
+            # and retries without forgetting the long-run rate
+            ewma_rate[0] = (
+                inst if not ewma_rate[0] else 0.3 * inst + 0.7 * ewma_rate[0]
+            )
+            remaining = max(n_scenarios - beat[1], 0)
+            emit_event_record(
+                cfg,
+                kind="progress",
+                phase=phase,
+                engine=self.engine_kind,
+                seed=seed,
+                first_scenario=first_scenario,
+                n_scenarios=n_scenarios,
+                scenarios_done=beat[1],
+                chunk_rows=n_rows,
+                elapsed_s=round(now - t0, 3),
+                scenarios_per_second=round(inst, 3),
+                ewma_scenarios_per_second=round(ewma_rate[0], 3),
+                eta_s=round(remaining / max(ewma_rate[0], 1e-9), 3),
+                n_quarantined=quarantined_total,
+                recovery_actions=len(rlog.actions),
+            )
+
         partials: list[SweepResults] = []
         #: (slot, scenario start, take, device state) pipelining window
         inflight: list[tuple[int, int, int, object]] = []
@@ -1571,6 +1636,7 @@ class SweepRunner:
                     # prior run may have saved downshifted (smaller) chunks
                     done += int(cached.completed.shape[0])
                     chunk_idx += 1
+                    _progress(int(cached.completed.shape[0]), "cached")
                     continue
                 if ckpt or self.engine_kind == "native":
                     # checkpointing persists chunks as numpy -> sync run
@@ -1581,6 +1647,7 @@ class SweepRunner:
                     partials.append(part)
                     done += take
                     chunk_idx += 1
+                    _progress(take, "execute")
                     continue
                 try:
                     final = _dispatch(done, take, chunk_idx)
@@ -1588,6 +1655,7 @@ class SweepRunner:
                     partials.append(_recover_range(done, take, chunk_idx, err))
                     done += take
                     chunk_idx += 1
+                    _progress(take, "execute")
                     continue
                 # pipeline: jax dispatch is async, so keep a small window
                 # of chunks in flight and convert the oldest to host
@@ -1602,6 +1670,7 @@ class SweepRunner:
                         partials[slot] = _fetch(oldest, slot, start)
                     except Exception as err:  # noqa: BLE001
                         partials[slot] = _recover_range(start, itake, slot, err)
+                    _progress(itake, "pipeline")
                 done += take
                 chunk_idx += 1
             for slot, start, itake, final in inflight:
@@ -1609,6 +1678,7 @@ class SweepRunner:
                     partials[slot] = _fetch(final, slot, start)
                 except Exception as err:  # noqa: BLE001 - filtered below
                     partials[slot] = _recover_range(start, itake, slot, err)
+                _progress(itake, "drain")
         wall = time.time() - t0
         self._last_downshifts = downshifts
 
@@ -1861,6 +1931,9 @@ class _SweepCheckpoint:
         if part.gauge_series is not None:
             payload["gauge_series"] = part.gauge_series
             payload["gauge_series_period"] = np.float64(part.gauge_series_period)
+        if part.gauge_hist is not None:
+            payload["gauge_hist"] = part.gauge_hist
+            payload["gauge_hist_cap"] = part.gauge_hist_cap
         if part.total_rejected is not None:
             payload["total_rejected"] = part.total_rejected
         if part.llm_cost_sum is not None:
@@ -1931,6 +2004,10 @@ class _SweepCheckpoint:
                     float(data["gauge_series_period"])
                     if "gauge_series_period" in data
                     else None
+                ),
+                gauge_hist=data["gauge_hist"] if "gauge_hist" in data else None,
+                gauge_hist_cap=(
+                    data["gauge_hist_cap"] if "gauge_hist_cap" in data else None
                 ),
                 total_rejected=(
                     data["total_rejected"] if "total_rejected" in data else None
@@ -2319,6 +2396,13 @@ def _concat_sweeps(parts: list[SweepResults]) -> SweepResults:
                 else None
             ),
             gauge_series_period=first.gauge_series_period,
+            # histograms span the scenario axis: chunks SUM, not concatenate
+            gauge_hist=(
+                np.sum([p.gauge_hist for p in parts], axis=0)
+                if all(p.gauge_hist is not None for p in parts)
+                else None
+            ),
+            gauge_hist_cap=first.gauge_hist_cap,
             total_rejected=(
                 np.concatenate([p.total_rejected for p in parts])
                 if all(p.total_rejected is not None for p in parts)

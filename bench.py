@@ -58,6 +58,13 @@ def _parse_args(argv: list[str]) -> dict:
     report the per-engine scen/s delta with tracing ENABLED under
     ``detail.trace_guard.event`` / ``detail.trace_guard.fast``.
 
+    ``--gauge-guard``: run the streaming gauge-series overhead guard on
+    both recording engines (the scan fast path and the XLA event engine)
+    — assert each engine's non-gauge outputs with the coarse gauge grid
+    ENABLED are bit-identical / 1-ulp-equal to the plain program (same
+    seeds) and report the per-engine scen/s delta under
+    ``detail.gauge_guard.fast`` / ``detail.gauge_guard.event``.
+
     ``--resilient``: run the fence burn-down arm — a small faulted +
     retrying + CRN sweep of the bench topology, auto-dispatched (must
     route to the scan fast path) vs the same sweep forced onto the event
@@ -79,6 +86,7 @@ def _parse_args(argv: list[str]) -> dict:
         "telemetry": None,
         "repeats": None,
         "trace_guard": False,
+        "gauge_guard": False,
         "resilient": False,
         "checkpoint_dir": None,
         "resume": False,
@@ -87,6 +95,8 @@ def _parse_args(argv: list[str]) -> dict:
     for arg in it:
         if arg == "--trace-guard":
             opts["trace_guard"] = True
+        elif arg == "--gauge-guard":
+            opts["gauge_guard"] = True
         elif arg == "--resilient":
             opts["resilient"] = True
         elif arg == "--resume":
@@ -294,6 +304,106 @@ def _trace_guard_for(engine: str) -> dict:
         "bit_identical_outputs": True,
         "scen_per_s_trace_off": round(off_rate, 3),
         "scen_per_s_trace_on": round(on_rate, 3),
+        "overhead_pct": round((off_rate / max(on_rate, 1e-9) - 1) * 100, 2),
+    }
+
+
+def _gauge_guard() -> dict:
+    """Streaming gauge-series overhead guard (BENCH_GAUGE_GUARD=1 /
+    --gauge-guard).
+
+    Same two contracts as the trace guard, for the coarse gauge grid both
+    recording engines now carry (the gauge_series.requires_fast fence is
+    burned):
+
+    1. **bit-identity**: every non-gauge result array with the grid
+       enabled byte-compares equal to the plain engine's across the same
+       seeds — the interval-endpoint scatters consume no draws and mutate
+       no simulation state.  The float32 running SUMS get the same 1-ulp
+       allowance as the trace guard (a different XLA compilation may move
+       fusion boundaries).
+    2. **measured overhead**: scen/s with the grid enabled vs disabled,
+       reported per engine (not gated — the number this detail tracks).
+    """
+    from asyncflow_tpu.compiler import compile_payload  # numpy-only
+
+    out = {"event": _gauge_guard_for("event")}
+    if compile_payload(_payload()).fastpath_ok:
+        out["fast"] = _gauge_guard_for("fast")
+    return out
+
+
+def _gauge_guard_for(engine: str) -> dict:
+    import numpy as np
+
+    from asyncflow_tpu.parallel.sweep import SweepRunner
+
+    guard_payload = _payload()
+    guard_payload.sim_settings.total_simulation_time = int(
+        os.environ.get("BENCH_GAUGE_GUARD_HORIZON", "60"),
+    )
+    n = int(os.environ.get("BENCH_GAUGE_GUARD_SCENARIOS", "32"))
+    base = SweepRunner(guard_payload, engine=engine, use_mesh=False)
+    gauged = SweepRunner(
+        guard_payload,
+        engine=engine,
+        use_mesh=False,
+        gauge_series=("ram_in_use", ["srv-1"], 1.0),
+    )
+    base.run(n, seed=SEED, chunk_size=n)
+    gauged.run(n, seed=SEED, chunk_size=n)
+    t0 = time.time()
+    rep_off = base.run(n, seed=SEED + 1, chunk_size=n)
+    wall_off = time.time() - t0
+    t0 = time.time()
+    rep_on = gauged.run(n, seed=SEED + 1, chunk_size=n)
+    wall_on = time.time() - t0
+
+    series = rep_on.results.gauge_series
+    if series is None or not np.asarray(series).any():
+        msg = (
+            f"gauge guard FAILED on the {engine} engine: no streaming "
+            "series was recorded (the grid never scattered)"
+        )
+        raise AssertionError(msg)
+    mismatched = [
+        name
+        for name in (
+            "completed",
+            "latency_hist",
+            "latency_min",
+            "latency_max",
+            "throughput",
+            "total_generated",
+            "total_dropped",
+            "overflow_dropped",
+        )
+        if not np.array_equal(
+            np.asarray(getattr(rep_off.results, name)),
+            np.asarray(getattr(rep_on.results, name)),
+        )
+    ]
+    for name in ("latency_sum", "latency_sumsq"):
+        a = np.asarray(getattr(rep_off.results, name))
+        b = np.asarray(getattr(rep_on.results, name))
+        if not np.allclose(a, b, rtol=1e-6, atol=0.0):
+            mismatched.append(name)
+    if mismatched:
+        msg = (
+            f"gauge guard FAILED on the {engine} engine: enabling the "
+            f"gauge grid changed non-gauge outputs {mismatched} — "
+            "recording must never consume a draw or mutate simulation state"
+        )
+        raise AssertionError(msg)
+    off_rate = n / max(wall_off, 1e-9)
+    on_rate = n / max(wall_on, 1e-9)
+    return {
+        "engine": engine,
+        "n_scenarios": n,
+        "horizon_s": int(guard_payload.sim_settings.total_simulation_time),
+        "bit_identical_outputs": True,
+        "scen_per_s_gauges_off": round(off_rate, 3),
+        "scen_per_s_gauges_on": round(on_rate, 3),
         "overhead_pct": round((off_rate / max(on_rate, 1e-9) - 1) * 100, 2),
     }
 
@@ -671,6 +781,16 @@ def run_measurement() -> None:
                 f"{tg['scen_per_s_trace_off']:.1f} scen/s)",
                 file=sys.stderr,
             )
+    if os.environ.get("BENCH_GAUGE_GUARD") == "1":
+        detail["gauge_guard"] = _gauge_guard()
+        for eng, gg in detail["gauge_guard"].items():
+            print(
+                f"gauge guard [{eng}]: outputs bit-identical; overhead "
+                f"{gg['overhead_pct']:+.1f}% "
+                f"({gg['scen_per_s_gauges_on']:.1f} vs "
+                f"{gg['scen_per_s_gauges_off']:.1f} scen/s)",
+                file=sys.stderr,
+            )
     if os.environ.get("BENCH_RESILIENT") == "1":
         detail["resilient"] = _resilient_arm()
         res = detail["resilient"]
@@ -864,6 +984,8 @@ def main() -> None:
         os.environ["BENCH_REPEATS"] = str(opts["repeats"])
     if opts["trace_guard"]:
         os.environ["BENCH_TRACE_GUARD"] = "1"
+    if opts["gauge_guard"]:
+        os.environ["BENCH_GAUGE_GUARD"] = "1"
     if opts["resilient"]:
         os.environ["BENCH_RESILIENT"] = "1"
     if opts["checkpoint_dir"]:

@@ -65,6 +65,56 @@ assert any(
 print("traced faulted+CRN sweep on the scan fast path OK "
       f"(engine={runner.engine_kind}, predicted={pred.engine})")
 PY
+# fleet-view slice: a tiny gauge-series sweep FORCED onto the XLA event
+# engine (round 14 burned gauge_series.requires_fast) with predict_routing
+# agreeing, every kind="progress" heartbeat schema-valid, and the
+# self-contained HTML dashboard rendering the gauge quantile bands
+# (docs/guides/observability.md §"Fleet view")
+python - <<'PY'
+import yaml
+from asyncflow_tpu.checker.fences import predict_routing
+from asyncflow_tpu.observability import TelemetryConfig
+from asyncflow_tpu.observability.dashboard import write_dashboard
+from asyncflow_tpu.observability.export import read_run_records
+from asyncflow_tpu.observability.live import validate_progress_record
+from asyncflow_tpu.parallel.sweep import SweepRunner
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+data = yaml.safe_load(open("tests/integration/data/single_server.yml").read())
+data["sim_settings"]["total_simulation_time"] = 20
+data["sim_settings"]["enabled_sample_metrics"] = []
+payload = SimulationPayload.model_validate(data)
+runner = SweepRunner(payload, engine="event", use_mesh=False,
+                     gauge_series=("ram_in_use", ["srv-1"], 1.0))
+pred = predict_routing(runner.plan, engine="event", gauge_series=True)
+if runner.engine_kind != "event" or pred.engine != runner.engine_kind:
+    raise SystemExit(
+        "fence burn-down regressed: gauge-series sweep forced onto the "
+        f"event engine dispatched {runner.engine_kind!r}, predicted "
+        f"{pred.engine!r} (expected 'event')"
+    )
+tel = "/tmp/asyncflow_smoke_fleet.jsonl"
+open(tel, "w").close()
+rep = runner.run(6, seed=2, chunk_size=2,
+                 telemetry=TelemetryConfig(jsonl_path=tel))
+records = read_run_records(tel)
+beats = [r for r in records if r["kind"] == "progress"]
+assert beats, "no kind='progress' heartbeats were emitted"
+for rec in beats:
+    problems = validate_progress_record(rec)
+    assert not problems, problems
+assert beats[-1]["meta"]["scenarios_done"] == 6, beats[-1]["meta"]
+times, bands = rep.gauge_bands("srv-1")
+assert bands.shape == (3, times.shape[0]), bands.shape
+page = write_dashboard(tel, "/tmp/asyncflow_smoke_fleet.html",
+                       report=rep).read_text()
+for token in ("Gauge quantile bands", "srv-1", "Progress", "<svg"):
+    assert token in page, f"dashboard is missing {token!r}"
+assert "<script" not in page and "http://" not in page and "https://" not in page
+print("event-engine gauge sweep + heartbeats + dashboard OK "
+      f"(engine={runner.engine_kind}, predicted={pred.engine}, "
+      f"{len(beats)} heartbeats)")
+PY
 # analysis slice: one tiny adaptive run + one CRN compare through the
 # event engine, plus the substream contract they depend on
 # (docs/guides/mc-inference.md)

@@ -31,6 +31,15 @@ EXPECTED_KEYS = {
     "timed_out",
     "retries",
     "budget_exhausted",
+    # host-fault quarantine (docs/guides/fault-tolerance.md)
+    "quarantined",
+    # tail-tolerance counters (0 without hedge/LB-health/brownout policies;
+    # docs/guides/tail-tolerance.md)
+    "hedges",
+    "hedges_won",
+    "hedges_cancelled",
+    "ejections",
+    "degraded",
 }
 
 

@@ -42,14 +42,18 @@ def _resilient(data) -> None:
 
 def test_registry_covers_every_known_fence() -> None:
     # trace.fast is BURNED (round 12): the scan fast path carries the
-    # flight recorder, so the registry must not resurrect its fence
+    # flight recorder, so the registry must not resurrect its fence.
+    # gauge_series.requires_fast is BURNED (round 14): the event engine
+    # records the coarse gauge grid in its scan body; only pallas/native
+    # still refuse streaming series.
     assert set(FENCES) == {
         "trace.pallas", "trace.native",
         "vr.pallas", "vr.native",
         "resilience.pallas", "resilience.native",
         "tail_tolerance.pallas", "tail_tolerance.native",
         "fastpath.ineligible", "fastpath.poisson_edge",
-        "native.unavailable", "gauge_series.requires_fast",
+        "native.unavailable",
+        "gauge_series.pallas", "gauge_series.native",
     }
     for fence in FENCES.values():
         assert fence.message and fence.feature and fence.engine
@@ -66,6 +70,8 @@ def test_raise_fence_uses_registered_exception_type() -> None:
         fence_message("no.such.fence")
     with pytest.raises(KeyError):  # burned, not just unregistered
         raise_fence("trace.fast")
+    with pytest.raises(KeyError):  # burned round 14
+        raise_fence("gauge_series.requires_fast")
 
 
 # ---------------------------------------------------------------------------
@@ -94,6 +100,23 @@ def test_sweep_vr_refusals_match_registry() -> None:
             SweepRunner(payload, engine=engine, use_mesh=False,
                         experiment=exp, preflight="off")
         assert str(err.value) == fence_message(f"vr.{engine}")
+
+
+def test_sweep_gauge_series_refusals_match_registry() -> None:
+    payload = build_payload()
+    spec = ("ready_queue_len", ["srv-1"], 1.0)
+    for engine in ("pallas", "native"):
+        with pytest.raises(ValueError) as err:
+            SweepRunner(payload, engine=engine, use_mesh=False,
+                        gauge_series=spec, preflight="off")
+        assert str(err.value) == fence_message(f"gauge_series.{engine}")
+    # the requires_fast fence is burned: the event engine accepts
+    runner = SweepRunner(payload, engine="event", use_mesh=False,
+                         gauge_series=spec, preflight="off")
+    assert runner.engine_kind == "event"
+    pred = predict_routing(runner.plan, engine="event", backend="cpu",
+                           gauge_series=True)
+    assert pred.ok and pred.engine == "event"
 
 
 def test_sweep_resilience_refusals_match_registry() -> None:
@@ -143,6 +166,32 @@ def test_prediction_matches_actual_routing(mut, kwargs, expected) -> None:
     assert pred.ok and pred.engine == expected
 
 
+def test_prediction_gauge_series_routing_matches_actual() -> None:
+    # round-14 burn-down: a gauge-series sweep of a plan OFF the fast path
+    # (tail tolerance) must auto-dispatch the event engine, and the static
+    # prediction must agree — the old requires_fast refusal is gone.
+    def mut(data):
+        data["hedge_policy"] = {"hedge_delay_s": 0.4, "max_hedges": 1}
+
+    payload = build_payload(mut)
+    spec = ("ready_queue_len", ["srv-1"], 1.0)
+    runner = SweepRunner(payload, engine="auto", use_mesh=False,
+                         gauge_series=spec, preflight="off")
+    assert runner.engine_kind == "event"
+    assert not runner.plan.fastpath_ok
+    pred = predict_routing(runner.plan, engine="auto", backend="cpu",
+                           gauge_series=True)
+    assert pred.ok and pred.engine == "event"
+    # on TPU the pallas kernel would otherwise take tail-free plans: the
+    # gauge-series condition must route it off the kernel there too
+    plain = SweepRunner(build_payload(), engine="auto", use_mesh=False,
+                        preflight="off")
+    pred_tpu = predict_routing(
+        plain.plan, engine="auto", backend="tpu", gauge_series=True,
+    )
+    assert pred_tpu.ok and pred_tpu.engine == "fast"
+
+
 def test_prediction_forced_fast_with_trace_is_allowed() -> None:
     payload = build_payload()
     runner = SweepRunner(payload, engine="auto", use_mesh=False,
@@ -165,13 +214,18 @@ def test_tripped_fences_for_traced_resilient_plan() -> None:
                          preflight="off")
     ids = {
         f.fence_id
-        for f in tripped_fences(runner.plan, trace=True, crn=True)
+        for f in tripped_fences(
+            runner.plan, trace=True, crn=True, gauge_series=True,
+        )
     }
     assert {"trace.pallas", "trace.native",
             "vr.pallas", "vr.native",
+            "gauge_series.pallas", "gauge_series.native",
             "resilience.pallas", "resilience.native"} <= ids
-    # burned: tracing no longer fences the fast path
+    # burned: tracing no longer fences the fast path, and streaming gauge
+    # series no longer fence the event engine
     assert "trace.fast" not in ids
+    assert "gauge_series.requires_fast" not in ids
 
 
 def test_prediction_rejects_unknown_engine() -> None:

@@ -152,3 +152,47 @@ def test_searchsorted_large_table_falls_back_exactly(side, rng):
         )
         assert (got == want).all(), nt
         assert got.dtype == np.int32
+
+
+def test_fastpath_windows_past_table_max_bit_identical(monkeypatch):
+    """The arrival constructor's window lookup (fastpath ``_arrivals_stream``)
+    must survive plans with more windows than DENSE_TABLE_MAX: a 1 s
+    sampling window over a 300 s horizon puts a 300-entry int32 offsets
+    table through ``searchsorted_small``, and the log-n fallback arm has to
+    produce bit-identical engine results to the dense compare arm."""
+    import yaml
+
+    from asyncflow_tpu.compiler import compile_payload
+    from asyncflow_tpu.engines.jaxsim import sortutil
+    from asyncflow_tpu.engines.jaxsim.engine import scenario_keys
+    from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+    from asyncflow_tpu.schemas.payload import SimulationPayload
+
+    data = yaml.safe_load(
+        open("tests/integration/data/single_server.yml").read(),
+    )
+    data["sim_settings"]["total_simulation_time"] = 300
+    data["rqs_input"]["user_sampling_window"] = 1
+    data["rqs_input"]["avg_active_users"]["mean"] = 5
+    plan = compile_payload(SimulationPayload.model_validate(data))
+    assert plan.fastpath_ok
+
+    eng = FastEngine(plan)
+    assert eng.n_windows > sortutil.DENSE_TABLE_MAX  # the fallback arm runs
+    fallback = eng.run_batch(scenario_keys(3, 2))
+
+    # force the dense compare arm on the same 300-entry table (fresh trace:
+    # the threshold is read at trace time)
+    monkeypatch.setattr(sortutil, "DENSE_TABLE_MAX", 10_000)
+    jax.clear_caches()
+    dense = FastEngine(plan).run_batch(scenario_keys(3, 2))
+    for name in (
+        "lat_count", "hist", "lat_sum", "lat_max",
+        "n_generated", "n_dropped",
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fallback, name)),
+            np.asarray(getattr(dense, name)),
+            err_msg=name,
+        )
+    assert int(np.asarray(fallback.lat_count).sum()) > 0

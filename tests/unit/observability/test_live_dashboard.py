@@ -1,0 +1,189 @@
+"""The fleet-view surfaces: kind="progress" heartbeats, the live follower,
+and the static HTML dashboard."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+import yaml
+
+from asyncflow_tpu.observability.dashboard import build_dashboard, write_dashboard
+from asyncflow_tpu.observability.export import read_run_records
+from asyncflow_tpu.observability.live import (
+    format_final,
+    format_progress,
+    iter_records,
+    validate_progress_record,
+)
+from asyncflow_tpu.observability.telemetry import TelemetryConfig
+from asyncflow_tpu.parallel import SweepRunner
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+BASE = "tests/integration/data/single_server.yml"
+
+
+def _progress_record(done: int, total: int, **over) -> dict:
+    meta = {
+        "phase": "pipeline",
+        "engine": "fast",
+        "seed": 0,
+        "first_scenario": 0,
+        "n_scenarios": total,
+        "scenarios_done": done,
+        "chunk_rows": 2,
+        "elapsed_s": float(done),
+        "scenarios_per_second": 2.0,
+        "ewma_scenarios_per_second": 2.0,
+        "eta_s": float(total - done) / 2.0,
+        "n_quarantined": 0,
+        "recovery_actions": 0,
+    }
+    meta.update(over)
+    return {
+        "schema": "asyncflow-telemetry/1",
+        "ts": 0.0,
+        "kind": "progress",
+        "label": "",
+        "pid": 1,
+        "meta": meta,
+        "phase_totals_s": {},
+        "phases": [],
+        "compiles": [],
+        "counters": {},
+    }
+
+
+def _sweep_record(**meta_over) -> dict:
+    rec = _progress_record(8, 8)
+    rec["kind"] = "sweep"
+    rec["meta"] = {
+        "engine": "fast",
+        "backend": "cpu",
+        "n_scenarios": 8,
+        "seed": 0,
+        "wall_seconds": 4.0,
+        "scenarios_per_second": 2.0,
+        "n_quarantined": 0,
+        "recovery_actions": 0,
+        **meta_over,
+    }
+    rec["phase_totals_s"] = {"execute": 3.0, "fetch": 0.5}
+    rec["compiles"] = [
+        {"key": "fast/run_batch", "engine": "fast", "cache_hit": False,
+         "compile_s": 1.2},
+        {"key": "fast/run_batch", "engine": "fast", "cache_hit": True,
+         "compile_s": None},
+    ]
+    return rec
+
+
+def test_progress_schema_validator() -> None:
+    assert validate_progress_record(_progress_record(2, 8)) == []
+    bad = _progress_record(2, 8)
+    del bad["meta"]["eta_s"]
+    assert any("eta_s" in p for p in validate_progress_record(bad))
+    assert validate_progress_record({"kind": "sweep"})
+
+
+def test_follower_formatting() -> None:
+    line = format_progress(_progress_record(2, 8, n_quarantined=1))
+    assert "2/8" in line
+    assert "quarantined=1" in line
+    final = format_final(_sweep_record())
+    assert "8 scenarios" in final
+    assert "'fast'" in final
+
+
+def test_iter_records_stops_at_sweep_and_holds_torn_tail(tmp_path) -> None:
+    path = tmp_path / "run.jsonl"
+    full = json.dumps(_progress_record(2, 8))
+    torn = json.dumps(_progress_record(4, 8))
+    path.write_text(full + "\n" + torn[: len(torn) // 2])
+    got = list(iter_records(path, follow=False))
+    assert len(got) == 1  # the torn line is held, not mis-parsed
+    path.write_text(
+        full + "\n" + torn + "\n" + json.dumps(_sweep_record()) + "\n",
+    )
+    got = list(iter_records(path, follow=False))
+    assert [r["kind"] for r in got] == ["progress", "progress", "sweep"]
+
+
+def test_dashboard_from_records_only() -> None:
+    records = [
+        _progress_record(2, 8),
+        _progress_record(4, 8),
+        _sweep_record(),
+    ]
+    page = build_dashboard(records)
+    for token in ("Summary", "Progress", "Phase timers", "Compile ledger",
+                  "<svg", "warm", "cold"):
+        assert token in page
+    # self-contained: nothing fetched at view time
+    assert "http://" not in page
+    assert "https://" not in page
+    assert "<script" not in page
+
+
+def test_dashboard_handles_unfinished_run() -> None:
+    page = build_dashboard([_progress_record(2, 8)])
+    assert "still running" in page
+
+
+@pytest.mark.slow
+def test_sweep_emits_valid_heartbeats_and_dashboard(tmp_path) -> None:
+    """End to end: a real sweep's JSONL validates, follows, and renders."""
+    data = yaml.safe_load(open(BASE).read())
+    data["sim_settings"]["total_simulation_time"] = 30
+    payload = SimulationPayload.model_validate(data)
+    jsonl = tmp_path / "run.jsonl"
+    rep = SweepRunner(
+        payload,
+        use_mesh=False,
+        gauge_series=("ram_in_use", ["srv-1"], 1.0),
+    ).run(8, seed=3, chunk_size=2, telemetry=TelemetryConfig(jsonl_path=str(jsonl)))
+
+    records = read_run_records(jsonl)
+    progress = [r for r in records if r["kind"] == "progress"]
+    assert progress, "no heartbeats were emitted"
+    for rec in progress:
+        assert validate_progress_record(rec) == []
+    assert progress[-1]["meta"]["scenarios_done"] == 8
+    assert records[-1]["kind"] == "sweep"
+
+    out = subprocess.run(
+        [sys.executable, "-m", "asyncflow_tpu.observability.live",
+         str(jsonl), "--once"],
+        capture_output=True,
+        text=True,
+        check=True,
+    )
+    assert "8/8" in out.stdout
+    assert "done: 8 scenarios" in out.stdout
+
+    html = write_dashboard(jsonl, tmp_path / "dash.html", report=rep)
+    page = html.read_text()
+    for token in ("Gauge quantile bands", "srv-1", "Confidence intervals",
+                  "Progress", "<svg"):
+        assert token in page
+
+
+def test_dashboard_cli(tmp_path) -> None:
+    jsonl = tmp_path / "run.jsonl"
+    with jsonl.open("w") as fh:
+        for rec in (_progress_record(4, 8), _sweep_record()):
+            fh.write(json.dumps(rec) + "\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "asyncflow_tpu.observability.dashboard",
+         str(jsonl)],
+        capture_output=True,
+        text=True,
+        check=True,
+    )
+    dest = Path(str(jsonl.with_suffix(".html")))
+    assert dest.exists()
+    assert "wrote" in out.stdout
+    assert "<svg" in dest.read_text()

@@ -1,0 +1,153 @@
+"""Cross-scenario gauge quantile bands (``SweepResults.gauge_bands``).
+
+Chunks reduce their coarse gauge series into fixed-bin value histograms
+(``gauge_hist``) that sum across chunk rows; bands are read back through the
+repo's one percentile definition (``hist_percentile``).  The histograms must
+be rebuilt — never row-sliced — on every scenario-axis edit, persist through
+checkpoint resume, and exclude quarantined rows.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import yaml
+
+from asyncflow_tpu.engines.results import (
+    GAUGE_BAND_QS,
+    GAUGE_HIST_BINS,
+    build_gauge_hist,
+    gauge_hist_caps,
+)
+from asyncflow_tpu.parallel import SweepRunner
+from asyncflow_tpu.parallel.recovery import _zero_rows
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+BASE = "tests/integration/data/single_server.yml"
+SPEC = ("ram_in_use", ["srv-1"], 1.0)
+
+
+def _payload(horizon: int = 60) -> SimulationPayload:
+    data = yaml.safe_load(open(BASE).read())
+    data["sim_settings"]["total_simulation_time"] = horizon
+    return SimulationPayload.model_validate(data)
+
+
+def test_bands_match_inverted_cdf_within_one_bin() -> None:
+    rep = SweepRunner(_payload(), use_mesh=False, gauge_series=SPEC).run(
+        16, seed=7, chunk_size=4,
+    )
+    res = rep.results
+    assert res.gauge_hist.shape == (
+        res.gauge_series.shape[1],
+        1,
+        GAUGE_HIST_BINS,
+    )
+    # every (tick, column) cell pools exactly the effective scenario count
+    assert np.all(res.gauge_hist.sum(axis=-1) == 16)
+    # ram columns are capped by the server's ram_mb
+    assert res.gauge_hist_cap[0] == pytest.approx(1024.0)
+
+    bands = res.gauge_bands
+    assert bands.shape == (len(GAUGE_BAND_QS), res.gauge_series.shape[1], 1)
+    assert np.all(bands[0] <= bands[1] + 1e-9)
+    assert np.all(bands[1] <= bands[2] + 1e-9)
+    # hist_percentile interpolates inside the crossing bin, so it sits
+    # within one bin width of the inverted-CDF sample percentile
+    binw = res.gauge_hist_cap[0] / GAUGE_HIST_BINS
+    exact = np.percentile(
+        res.gauge_series[:, :, 0],
+        list(GAUGE_BAND_QS),
+        axis=0,
+        method="inverted_cdf",
+    )
+    assert np.abs(bands[:, :, 0] - exact).max() <= binw + 1e-9
+
+    # the report accessor selects the component column
+    times, b = rep.gauge_bands("srv-1")
+    assert b.shape == (len(GAUGE_BAND_QS), res.gauge_series.shape[1])
+    np.testing.assert_array_equal(b, bands[:, :, 0])
+    assert times[0] == pytest.approx(SPEC[2])
+
+
+def test_chunks_sum_to_single_chunk_hist() -> None:
+    # the chunked run's summed histograms must equal one big chunk's
+    payload = _payload()
+    chunked = SweepRunner(payload, use_mesh=False, gauge_series=SPEC).run(
+        8, seed=3, chunk_size=2,
+    )
+    whole = SweepRunner(payload, use_mesh=False, gauge_series=SPEC).run(
+        8, seed=3, chunk_size=8,
+    )
+    np.testing.assert_array_equal(
+        chunked.results.gauge_hist, whole.results.gauge_hist,
+    )
+
+
+def test_event_engine_records_band_histograms() -> None:
+    rep = SweepRunner(
+        _payload(), engine="event", use_mesh=False, gauge_series=SPEC,
+    ).run(4, seed=5, chunk_size=4)
+    assert rep.results.gauge_hist is not None
+    assert np.all(rep.results.gauge_hist.sum(axis=-1) == 4)
+    assert rep.results.gauge_bands is not None
+
+
+def test_hist_survives_checkpoint_resume(tmp_path) -> None:
+    payload = _payload()
+    runner = SweepRunner(payload, use_mesh=False, gauge_series=SPEC)
+    first = runner.run(8, seed=9, chunk_size=4, checkpoint_dir=str(tmp_path))
+    resumed = runner.run(8, seed=9, chunk_size=4, checkpoint_dir=str(tmp_path))
+    np.testing.assert_array_equal(
+        first.results.gauge_hist, resumed.results.gauge_hist,
+    )
+    np.testing.assert_array_equal(
+        first.results.gauge_hist_cap, resumed.results.gauge_hist_cap,
+    )
+
+
+def test_scenario_slicing_rebuilds_hist() -> None:
+    rep = SweepRunner(_payload(), use_mesh=False, gauge_series=SPEC).run(
+        8, seed=9, chunk_size=8,
+    )
+    sliced = rep.results[:4]
+    assert np.all(sliced.gauge_hist.sum(axis=-1) == 4)
+    np.testing.assert_array_equal(
+        sliced.gauge_hist,
+        build_gauge_hist(rep.results.gauge_series[:4], sliced.gauge_hist_cap),
+    )
+
+
+def test_quarantined_rows_leave_the_bands() -> None:
+    rep = SweepRunner(_payload(), use_mesh=False, gauge_series=SPEC).run(
+        8, seed=9, chunk_size=8,
+    )
+    part = rep.results[:8]  # detached copy
+    part = _zero_rows(part, [1, 5], ["host fault", "host fault"])
+    # the masked rows are gone from the pooled counts...
+    assert np.all(part.gauge_hist.sum(axis=-1) == 6)
+    # ...and the remaining histogram is exactly the survivors'
+    survivors = np.delete(rep.results.gauge_series, [1, 5], axis=0)
+    np.testing.assert_array_equal(
+        part.gauge_hist,
+        build_gauge_hist(survivors, part.gauge_hist_cap),
+    )
+
+
+def test_caps_follow_gauge_layout() -> None:
+    from asyncflow_tpu.compiler import compile_payload
+
+    plan = compile_payload(_payload())
+    sel = [plan.gauge_edge(0), plan.gauge_ready(0), plan.gauge_ram(0)]
+    caps = gauge_hist_caps(plan, sel)
+    assert caps[0] == pytest.approx(plan.pool_size)
+    assert caps[1] == pytest.approx(plan.pool_size)
+    assert caps[2] == pytest.approx(float(np.asarray(plan.server_ram)[0]))
+
+
+def test_bands_absent_without_spec() -> None:
+    rep = SweepRunner(_payload(), use_mesh=False).run(4, seed=1, chunk_size=4)
+    assert rep.results.gauge_hist is None
+    assert rep.results.gauge_bands is None
+    with pytest.raises(ValueError, match="no streaming gauge series"):
+        rep.gauge_bands("srv-1")
