@@ -394,6 +394,19 @@ def _ratio_components(results, metric: str) -> tuple[np.ndarray, np.ndarray]:
         if results.total_retries is not None:
             offered = offered + np.asarray(results.total_retries, np.float64)
         return completed, np.maximum(offered, 1e-300)
+    if metric == "availability_fraction":
+        # completions over (completions + arrivals lost to dark fault
+        # windows): the chaos-campaign headline "does hedging buy
+        # availability" answers as a CRN-paired interval on this ratio
+        if getattr(results, "dark_lost", None) is None:
+            msg = (
+                "availability_fraction needs a sweep that carried the "
+                "fault/hazard machinery (results.dark_lost is None): add a "
+                "hazard_model or fault_timeline to the payload"
+            )
+            raise ValueError(msg)
+        dark = np.asarray(results.dark_lost, np.float64)
+        return completed, np.maximum(completed + dark, 1e-300)
     msg = f"unknown ratio metric {metric!r}"
     raise ValueError(msg)
 

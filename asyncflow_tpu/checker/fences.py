@@ -116,6 +116,33 @@ FENCES: dict[str, Fence] = {
                 "fast path)"
             ),
         ),
+        # -- chaos campaigns (hazard_model sampled fault tables) ------------
+        Fence(
+            id="hazard.pallas",
+            feature="chaos campaign (hazard_model)",
+            engine="pallas",
+            message=(
+                "engine='pallas' does not model chaos campaigns "
+                "(hazard_model): the sampled per-scenario fault tables "
+                "ride the scenario-override seam the VMEM kernel does not "
+                "carry; use engine='fast' or 'event' (or 'auto', which "
+                "routes fastpath-eligible hazard plans to the scan fast "
+                "path)"
+            ),
+        ),
+        Fence(
+            id="hazard.native",
+            feature="chaos campaign (hazard_model)",
+            engine="native",
+            message=(
+                "engine='native' does not model chaos campaigns "
+                "(hazard_model): the sampled per-scenario fault tables "
+                "ride the scenario-override seam the C++ core does not "
+                "carry; use engine='fast' or 'event' (or 'auto', which "
+                "routes fastpath-eligible hazard plans to the scan fast "
+                "path)"
+            ),
+        ),
         # -- tail-tolerance plans (hedges / health gate / brownout) ---------
         Fence(
             id="tail_tolerance.pallas",
@@ -288,6 +315,8 @@ def tripped_fences(
         out += [_trip("gauge_series.pallas"), _trip("gauge_series.native")]
     if plan.has_faults or plan.has_retry:
         out += [_trip("resilience.pallas"), _trip("resilience.native")]
+    if getattr(plan, "has_hazards", False):
+        out += [_trip("hazard.pallas"), _trip("hazard.native")]
     if getattr(plan, "has_tail_tolerance", False):
         out += [
             _trip("tail_tolerance.pallas"),
@@ -335,7 +364,8 @@ def predict_routing(
         backend = jax.default_backend()
     vr_coupled = crn or antithetic
     tail = getattr(plan, "has_tail_tolerance", False)
-    resilient = plan.has_faults or plan.has_retry or tail
+    hazards = getattr(plan, "has_hazards", False)
+    resilient = plan.has_faults or plan.has_retry or tail or hazards
     fences = tripped_fences(
         plan,
         trace=trace,
@@ -363,6 +393,8 @@ def predict_routing(
         return refused(f"gauge_series.{engine}")
     if (plan.has_faults or plan.has_retry) and engine in ("pallas", "native"):
         return refused(f"resilience.{engine}")
+    if hazards and engine in ("pallas", "native"):
+        return refused(f"hazard.{engine}")
     if tail and engine in ("pallas", "native"):
         return refused(f"tail_tolerance.{engine}")
     if engine == "fast" and not plan.fastpath_ok:

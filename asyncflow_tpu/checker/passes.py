@@ -552,6 +552,92 @@ def resource_pass(payload, plan, out: list[Diagnostic]) -> None:
                 ))
 
 
+def hazard_pass(payload, out: list[Diagnostic]) -> None:
+    """AF601-AF604: chaos-campaign sanity (docs/guides/resilience.md).
+
+    The payload validator only checks that hazard targets EXIST; the
+    semantic traps — a blast group that darkens a whole tier, repairs
+    longer than the horizon, campaigns dense enough to blow the per-domain
+    slot budget — validate fine and are refused here by name, so the
+    checker CLI exits 2 before a sweep burns compute on a meaningless
+    campaign.
+    """
+    hm = getattr(payload, "hazard_model", None)
+    if hm is None:
+        return
+    horizon = float(payload.sim_settings.total_simulation_time)
+    g = payload.topology_graph
+    server_ids = {s.id for s in g.nodes.servers}
+    edge_ids = {e.id for e in g.edges}
+    lb = g.nodes.load_balancer
+    #: the serving tier a blast group must not fully cover: the LB's
+    #: replica cover when an LB exists, else every server
+    tier = set(lb.server_covered) if lb is not None else set(server_ids)
+    max_faults = int(hm.max_faults_per_component)
+    for d, domain in enumerate(hm.domains):
+        path = f"hazard_model.domains[{d}]"
+        unknown = [
+            t for t in domain.targets
+            if t not in server_ids and t not in edge_ids
+        ]
+        if unknown:
+            # unreachable through pydantic validation, but check_payload
+            # also takes hand-constructed payloads; a hazard aimed at
+            # nothing must never silently sample an empty campaign
+            out.append(Diagnostic(
+                code="AF601", severity=Severity.ERROR,
+                message=f"failure domain {domain.domain_id!r} targets "
+                f"unknown component(s) {unknown}: the campaign would "
+                "sample windows no engine applies to anything",
+                path=path,
+                remedy="target declared server/edge ids (or delete the "
+                "domain)",
+            ))
+            continue
+        covered = {t for t in domain.targets if t in server_ids}
+        if tier and tier <= covered:
+            out.append(Diagnostic(
+                code="AF602", severity=Severity.ERROR,
+                message=f"failure domain {domain.domain_id!r} is a blast "
+                f"group covering every server of the serving tier "
+                f"({sorted(tier)}): each sampled window is a full outage "
+                "— zero availability by construction, not a resilience "
+                "measurement",
+                path=path,
+                remedy="split the blast group so at least one replica "
+                "stays outside the correlated domain",
+            ))
+        mttr_mean = float(domain.mttr.mean)
+        if mttr_mean >= horizon:
+            out.append(Diagnostic(
+                code="AF603", severity=Severity.ERROR,
+                message=f"failure domain {domain.domain_id!r} repairs "
+                f"slower than the simulation: MTTR mean {mttr_mean:g}s >= "
+                f"horizon {horizon:g}s, so the first sampled fault "
+                "typically never heals in-sim and availability measures "
+                "the fault start time, not the recovery model",
+                path=f"{path}.mttr",
+                remedy="shorten the MTTR (or lengthen "
+                "sim_settings.total_simulation_time past several "
+                "MTBF+MTTR cycles)",
+            ))
+        cycle = float(domain.mtbf.mean) + mttr_mean
+        if cycle > 0 and horizon / cycle > max_faults:
+            out.append(Diagnostic(
+                code="AF604", severity=Severity.WARNING,
+                message=f"failure domain {domain.domain_id!r} expects "
+                f"~{horizon / cycle:.1f} fault cycles over the {horizon:g}s "
+                f"horizon but max_faults_per_component={max_faults}: "
+                "late-horizon windows will be truncated (counted in the "
+                "hazard_truncated scorecard counter, like flight-recorder "
+                "ring overflow)",
+                path=f"{path}.mtbf",
+                remedy="raise hazard_model.max_faults_per_component or "
+                "lengthen the MTBF so the expected cycle count fits the "
+                "slot budget",
+            ))
+
+
 def _bench_engine_rates() -> tuple[str, dict[str, float]] | None:
     """(bench name, {engine: scenarios/sec}) from the newest BENCH_r*.json
     at the repo root — the data source for the fence burn-down speedup
@@ -736,6 +822,7 @@ def check_payload(
     graph_pass(payload, out)
     time_pass(payload, out)
     resource_pass(payload, plan, out)
+    hazard_pass(payload, out)
     if plan is not None:
         routing_pass(
             payload, plan, out,

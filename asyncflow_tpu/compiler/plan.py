@@ -571,6 +571,45 @@ class StaticPlan:
     server_brownout_ram: np.ndarray = field(
         default_factory=lambda: np.empty(0, np.float32),
     )
+    #: chaos-campaign hazard model (compiler/hazards.py HazardSpec): (D,)
+    #: per-domain MTBF/MTTR duration laws (_DIST_IDS codes + mean/scale),
+    #: edge degrade magnitudes, and (D, NS)/(D, NE) blast-group target
+    #: masks.  Size 0 = no hazard model.  The per-scenario window tables
+    #: are NOT plan state — they are sampled at sweep time from
+    #: (seed, scenario index) so the plan digest stays seed-independent.
+    hz_mtbf_dist: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.int32),
+    )
+    hz_mtbf_mean: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.float64),
+    )
+    hz_mtbf_var: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.float64),
+    )
+    hz_mttr_dist: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.int32),
+    )
+    hz_mttr_mean: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.float64),
+    )
+    hz_mttr_var: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.float64),
+    )
+    hz_lat_factor: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.float64),
+    )
+    hz_drop_boost: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.float64),
+    )
+    hz_srv_targets: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0), np.int8),
+    )
+    hz_edge_targets: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0), np.int8),
+    )
+    #: window-slot budget F per (scenario, domain); truncation past it is
+    #: counted, never silent (hazard_truncated scorecard counter).
+    hz_max_faults: int = 0
 
     @property
     def has_weighted_endpoints(self) -> bool:
@@ -618,6 +657,32 @@ class StaticPlan:
             or np.any(self.fault_edge_lat != 1.0)
             or np.any(self.fault_edge_drop != 0.0),
         )
+
+    @property
+    def has_hazards(self) -> bool:
+        """True when a chaos-campaign hazard model is lowered — i.e.
+        fault windows are SAMPLED per scenario rather than (only)
+        hand-authored.  The routing predicate behind the ``hazard.*``
+        fences."""
+        return bool(self.hz_mtbf_mean.size) and self.hz_max_faults > 0
+
+    #: per-domain server/edge blast-group membership collapsed over
+    #: domains — the static gates engines use to decide which per-server
+    #: branches must carry the fault check at trace time.
+
+    @property
+    def hz_srv_mask(self) -> np.ndarray:
+        """(NS,) bool: server is targeted by some failure domain."""
+        if not self.hz_srv_targets.size:
+            return np.zeros(self.n_servers, bool)
+        return np.asarray(self.hz_srv_targets).any(axis=0)
+
+    @property
+    def hz_edge_mask(self) -> np.ndarray:
+        """(NE,) bool: edge is targeted by some failure domain."""
+        if not self.hz_edge_targets.size:
+            return np.zeros(self.n_edges, bool)
+        return np.asarray(self.hz_edge_targets).any(axis=0)
 
     @property
     def has_retry(self) -> bool:
@@ -1431,6 +1496,13 @@ def _compile_payload(
     fault_arrays = lower_faults(payload)
     retry = lower_retry(payload.retry_policy)
 
+    # ---- chaos campaign: stochastic hazard model (compiler/hazards.py);
+    # only the per-domain laws live on the plan — the per-scenario window
+    # tables are sampled at sweep time from (seed, scenario index)
+    from asyncflow_tpu.compiler.hazards import lower_hazards
+
+    hazards = lower_hazards(payload)
+
     # ---- tail tolerance: hedging, LB health gate, server brownout ----
     # (hedging over a single target still helps when the primary is parked
     # in retry backoff, so no LB requirement; the health gate is LB-only
@@ -1679,6 +1751,23 @@ def _compile_payload(
         fault_edge_times=fault_arrays.edge_times,
         fault_edge_lat=fault_arrays.edge_lat,
         fault_edge_drop=fault_arrays.edge_drop,
+        **(
+            {
+                "hz_mtbf_dist": hazards.mtbf_dist,
+                "hz_mtbf_mean": hazards.mtbf_mean,
+                "hz_mtbf_var": hazards.mtbf_var,
+                "hz_mttr_dist": hazards.mttr_dist,
+                "hz_mttr_mean": hazards.mttr_mean,
+                "hz_mttr_var": hazards.mttr_var,
+                "hz_lat_factor": hazards.lat_factor,
+                "hz_drop_boost": hazards.drop_boost,
+                "hz_srv_targets": hazards.srv_targets,
+                "hz_edge_targets": hazards.edge_targets,
+                "hz_max_faults": hazards.max_faults,
+            }
+            if hazards is not None
+            else {}
+        ),
         retry_timeout=retry.timeout,
         retry_max_attempts=retry.max_attempts,
         retry_backoff_base=retry.backoff_base,

@@ -225,10 +225,13 @@ class Engine:
         self._has_breaker = plan.breaker_threshold > 0
         # resilience: fault-window gating + client retry machinery, each
         # statically pruned when the plan carries none
-        self._has_srv_faults = bool(np.any(plan.fault_srv_down != 0))
+        self._has_srv_faults = bool(
+            np.any(plan.fault_srv_down != 0) or np.any(plan.hz_srv_mask),
+        )
         self._has_edge_faults = bool(
             np.any(plan.fault_edge_lat != 1.0)
-            or np.any(plan.fault_edge_drop != 0.0),
+            or np.any(plan.fault_edge_drop != 0.0)
+            or np.any(plan.hz_edge_mask),
         )
         self._has_retry = plan.has_retry
         # tail tolerance: hedged requests, LB health gate, server brownout
@@ -384,14 +387,15 @@ class Engine:
 
     def _srv_faulted(self, s, t, ov):
         """1 while server ``s`` sits inside a server_outage fault window.
-        Breakpoint TIMES come from the overrides (fault-timing sweeps);
-        the down-flag table is plan-static."""
+        Times AND value rows both ride the overrides: hand-authored
+        timelines broadcast the plan table, chaos campaigns batch a
+        sampled (S, K, NS) table per scenario."""
         if not self._has_srv_faults:
             return jnp.bool_(False)
         idx = jnp.maximum(
             searchsorted_small(ov.fault_srv_times, t, "right") - 1, 0,
         )
-        return self.params.fault_srv_down[idx, s] == 1
+        return ov.fault_srv_down[idx, s] == 1
 
     def _edge_fault(self, e, t, ov):
         """(latency factor, dropout boost) active on edge ``e`` at ``t``."""
@@ -399,8 +403,8 @@ class Engine:
             searchsorted_small(ov.fault_edge_times, t, "right") - 1, 0,
         )
         return (
-            self.params.fault_edge_lat[idx, e],
-            self.params.fault_edge_drop[idx, e],
+            ov.fault_edge_lat[idx, e],
+            ov.fault_edge_drop[idx, e],
         )
 
     def _sample_delay(self, edge, key, ov):
@@ -2096,6 +2100,7 @@ class Engine:
                     jnp.where(dark, INF, st.req_t[i]),
                 ),
                 n_rejected=st.n_rejected + jnp.where(dark, 1, 0),
+                n_dark_lost=st.n_dark_lost + jnp.where(dark, 1, 0),
             )
             if self.trace is not None:
                 st = self._fr(st, i, FR_REJECT, s, now, dark)
@@ -2513,6 +2518,7 @@ class Engine:
             clock_n=jnp.int32(0),
             n_generated=jnp.int32(0),
             n_rejected=jnp.int32(0),
+            n_dark_lost=jnp.int32(0),
             n_dropped=jnp.int32(0),
             n_overflow=jnp.int32(0),
             req_seq=jnp.zeros(pool if self._crn else 1, jnp.int32),
@@ -2954,7 +2960,28 @@ def run_single(
         sim_engine = Engine(
             plan, collect_traces=tracing, trace=trace, **engine_kw,
         )
-    final = sim_engine.run_batch(scenario_keys(seed, 1))
+    # chaos campaign: sample scenario 0's fault tables from (seed, index 0)
+    # — the SAME draw the sweep path makes for its first scenario, so a
+    # single run is bit-identical to sweep scenario 0
+    hz_tables = None
+    hazard_ov = None
+    if plan.has_hazards:
+        from asyncflow_tpu.compiler.hazards import hazard_fault_tables
+
+        hz_tables = hazard_fault_tables(plan, seed, 0, 1)
+        hazard_ov = ScenarioOverrides(
+            None,
+            None,
+            None,
+            None,
+            None,
+            fault_srv_times=jnp.asarray(hz_tables.srv_times[0]),
+            fault_edge_times=jnp.asarray(hz_tables.edge_times[0]),
+            fault_srv_down=jnp.asarray(hz_tables.srv_down[0]),
+            fault_edge_lat=jnp.asarray(hz_tables.edge_lat[0]),
+            fault_edge_drop=jnp.asarray(hz_tables.edge_drop[0]),
+        )
+    final = sim_engine.run_batch(scenario_keys(seed, 1), hazard_ov)
     state = jax.tree.map(lambda x: np.asarray(x[0]), final)
 
     if int(state.n_overflow) > 0:
@@ -3051,6 +3078,35 @@ def run_single(
     if plan.has_llm and sim_engine.collect_clocks and hasattr(state, "llm_store"):
         llm_cost = state.llm_store[: int(state.clock_n)].astype(np.float64)
 
+    # resilience scorecard: pure functions of the sampled tables + the
+    # per-second throughput row — identical math to the sweep path
+    unavailable_s = None
+    degraded_goodput = None
+    hazard_truncated = 0
+    time_to_drain = None
+    if hz_tables is not None:
+        from asyncflow_tpu.compiler import hazards as _hz
+
+        hazard_truncated = int(hz_tables.truncated[0])
+        unavailable_s = _hz.unavailable_seconds(
+            hz_tables.srv_times, hz_tables.srv_down, plan.horizon,
+        )[0]
+        thr_row = np.asarray(state.thr, np.float64)
+        mask = _hz.degraded_seconds_mask(
+            hz_tables, plan.horizon, thr_row.shape[0],
+        )
+        degraded_goodput = float(thr_row[mask[0]].sum())
+        ready_key = SampledMetricName.READY_QUEUE_LEN.value
+        if sampled.get(ready_key):
+            series = np.stack(
+                [sampled[ready_key][sid] for sid in plan.server_ids], axis=-1,
+            )[None]
+            first, last = _hz.window_span(hz_tables, plan.horizon)
+            drain = _hz.time_to_drain(
+                series, plan.sample_period, first, last,
+            )[0]
+            time_to_drain = None if np.isnan(drain) else float(drain)
+
     return SimulationResults(
         settings=payload.sim_settings,
         rqs_clock=clock,
@@ -3078,6 +3134,11 @@ def run_single(
         hedges_cancelled=int(getattr(state, "n_hedges_cancelled", 0)),
         lb_ejections=int(getattr(state, "n_ejections", 0)),
         degraded_completions=int(getattr(state, "n_degraded", 0)),
+        dark_lost=int(getattr(state, "n_dark_lost", 0)),
+        unavailable_s=unavailable_s,
+        degraded_goodput=degraded_goodput,
+        hazard_truncated=hazard_truncated,
+        time_to_drain=time_to_drain,
     )
 
 
@@ -3244,6 +3305,12 @@ def sweep_results(
         gauge_means=(
             np.asarray(final.gauge_means)
             if hasattr(final, "gauge_means")
+            else None
+        ),
+        dark_lost=(
+            np.asarray(final.n_dark_lost)
+            if (engine.plan.has_hazards or engine.plan.has_faults)
+            and hasattr(final, "n_dark_lost")
             else None
         ),
         truncated=engine_truncated(engine, final),

@@ -161,6 +161,9 @@ class FastState(NamedTuple):
     #: dequeue deadline) or dark fault windows — the event engines'
     #: n_rejected counterpart
     n_rejected: jnp.ndarray
+    #: the dark-window subset of n_rejected (arrivals refused because the
+    #: server sat inside a fault window) — the availability numerator
+    n_dark_lost: jnp.ndarray
     #: client deadlines that fired while the attempt was in flight (the
     #: orphaned attempt keeps consuming resources); 0 without a retry plan
     n_timed_out: jnp.ndarray
@@ -642,10 +645,13 @@ class FastEngine:
         # ---- resilience lowering (round 8 fence burn-down) ----
         # Static flags prune every fault/retry op out of unconfigured
         # plans' programs, keeping their draw streams bit-identical.
-        self._has_srv_faults = bool(np.any(plan.fault_srv_down != 0))
+        self._has_srv_faults = bool(
+            np.any(plan.fault_srv_down != 0) or np.any(plan.hz_srv_mask),
+        )
         self._has_edge_faults = bool(
             np.any(plan.fault_edge_lat != 1.0)
-            or np.any(plan.fault_edge_drop != 0.0),
+            or np.any(plan.fault_edge_drop != 0.0)
+            or np.any(plan.hz_edge_mask),
         )
         self._attempts = (
             max(int(plan.retry_max_attempts), 1) if plan.has_retry else 1
@@ -730,10 +736,11 @@ class FastEngine:
 
     def _edge_fault(self, eidx, t_send, ov: ScenarioOverrides):
         """(latency factor, dropout boost) active on an edge at send time —
-        the event engine's ``_edge_fault`` on whole lane vectors.
-        Breakpoint TIMES ride the overrides (fault-timing sweeps); the
-        factor/boost tables are plan-static.  ``eidx`` may be a static int
-        or a per-lane index vector."""
+        the event engine's ``_edge_fault`` on whole lane vectors.  Times
+        AND value rows both ride the overrides: hand-authored timelines
+        broadcast the plan table, chaos campaigns batch a sampled
+        (S, M, NE) table per scenario.  ``eidx`` may be a static int or a
+        per-lane index vector."""
         idx = jnp.maximum(
             searchsorted_small(
                 jnp.asarray(ov.fault_edge_times), t_send, "right",
@@ -742,8 +749,8 @@ class FastEngine:
             0,
         )
         return (
-            jnp.asarray(self.plan.fault_edge_lat)[idx, eidx],
-            jnp.asarray(self.plan.fault_edge_drop)[idx, eidx],
+            jnp.asarray(ov.fault_edge_lat)[idx, eidx],
+            jnp.asarray(ov.fault_edge_drop)[idx, eidx],
         )
 
     def _edge_hop(self, key, edge: int, t_send, ov: ScenarioOverrides, u=None):
@@ -1095,7 +1102,8 @@ class FastEngine:
 
         ``t``/``alive`` are per-lane issue times and liveness (for retry
         plans, lane blocks of re-issue attempts).  Returns ``(finish,
-        completed, fail_t, gauge, gauge_means, n_dropped, n_rejected)``
+        completed, fail_t, gauge, gauge_means, n_dropped, n_rejected,
+        n_dark_lost)``
         where ``fail_t`` is the per-lane client-visible failure time (INF
         when the lane completed or was still in flight at the horizon) —
         entry-chain drops fail at the attempt's ISSUE time (the event
@@ -1109,6 +1117,7 @@ class FastEngine:
         n = t.shape[0]
         n_dropped = jnp.int32(0)
         n_rejected = jnp.int32(0)
+        n_dark_lost = jnp.int32(0)
         fail_t = jnp.full(n, INF, jnp.float32)
         horizon = jnp.float32(plan.horizon)
 
@@ -1310,7 +1319,8 @@ class FastEngine:
             # rate limit — `_srv_faulted` in engine.py).  Static gate per
             # server keeps unfaulted servers' programs untouched.
             if self._has_srv_faults and bool(
-                np.any(np.asarray(plan.fault_srv_down)[:, s] != 0),
+                np.any(np.asarray(plan.fault_srv_down)[:, s] != 0)
+                or plan.hz_srv_mask[s],
             ):
                 fidx = jnp.maximum(
                     searchsorted_small(
@@ -1320,12 +1330,13 @@ class FastEngine:
                     0,
                 )
                 dark = mine & (
-                    jnp.asarray(plan.fault_srv_down)[fidx, s] == 1
+                    jnp.asarray(ov.fault_srv_down)[fidx, s] == 1
                 )
                 if tape is not None:
                     tape.emit(FR_REJECT, s, t, t, dark)
                 if record:
                     n_rejected = n_rejected + jnp.sum(dark)
+                    n_dark_lost = n_dark_lost + jnp.sum(dark)
                 fail_t = jnp.where(dark, t, fail_t)
                 alive = alive & ~dark
                 mine = mine & ~dark
@@ -1850,6 +1861,7 @@ class FastEngine:
             gauge_means,
             n_dropped,
             n_rejected,
+            n_dark_lost,
         )
 
     def _run_one(self, key, ov: ScenarioOverrides) -> FastState:
@@ -1899,6 +1911,7 @@ class FastEngine:
                 gauge_means,
                 n_dropped,
                 n_rejected,
+                n_dark_lost,
             ) = self._journey(key, ov, t, alive, gauge, gauge_means, tape=tape)
             if trace_on:
                 K = int(self.trace.sample_requests)
@@ -1988,6 +2001,7 @@ class FastEngine:
                     gauge_means,
                     n_dropped,
                     n_rejected,
+                    n_dark_lost,
                 ) = self._journey(
                     key, ov, T, issued, gauge, gauge_means, record=last,
                     tape=tape,
@@ -2123,6 +2137,7 @@ class FastEngine:
             n_overflow=overflow,
             gauge_means=gauge_means / horizon,
             n_rejected=n_rejected,
+            n_dark_lost=n_dark_lost,
             n_timed_out=n_timed_out,
             n_retries=n_retries,
             n_budget_exhausted=n_budget_exhausted,

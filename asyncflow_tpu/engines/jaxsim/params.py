@@ -67,11 +67,9 @@ class PlanParams(NamedTuple):
     user_mean: jnp.ndarray  # scalar, overridable per scenario
     user_var: jnp.ndarray
     req_rate: jnp.ndarray  # requests / user / second
-    # resilience fault tables (values; the breakpoint TIMES ride the
-    # overrides so fault-timing Monte-Carlo sweeps can batch per scenario)
-    fault_srv_down: jnp.ndarray  # (K, NS) i32
-    fault_edge_lat: jnp.ndarray  # (M, NE) f32 multiplicative factor
-    fault_edge_drop: jnp.ndarray  # (M, NE) f32 additive dropout boost
+    # NOTE: the fault tables (times AND value rows) all ride the
+    # overrides now — chaos campaigns batch a sampled (S, K, ...) table
+    # per scenario, so no fault state is plan-static here.
     # brownout degraded-profile factors (the queue THRESHOLD rides the
     # overrides so brownout A/B sweeps can batch per scenario)
     server_brownout_cpu: jnp.ndarray  # (NS,) f32 CPU-duration scale
@@ -116,9 +114,6 @@ def params_from_plan(plan: StaticPlan) -> PlanParams:
         user_mean=jnp.float32(plan.user_mean),
         user_var=jnp.float32(plan.user_var),
         req_rate=jnp.float32(plan.req_per_user_per_sec),
-        fault_srv_down=jnp.asarray(plan.fault_srv_down),
-        fault_edge_lat=jnp.asarray(plan.fault_edge_lat),
-        fault_edge_drop=jnp.asarray(plan.fault_edge_drop),
         server_brownout_cpu=jnp.asarray(plan.server_brownout_cpu),
         server_brownout_ram=jnp.asarray(plan.server_brownout_ram),
     )
@@ -222,6 +217,8 @@ class EngineState(NamedTuple):
     n_dropped: jnp.ndarray
     n_overflow: jnp.ndarray
     n_rejected: jnp.ndarray  # requests shed by overload policies
+    n_dark_lost: jnp.ndarray  # scalar i32: arrivals refused by a dark
+    # (fault-window) server — the availability scorecard numerator
     # CRN (common-random-numbers) keying state — size (1,) placeholders
     # unless the engine was built with ``crn=True``.  ``req_seq`` is the
     # slot's spawn sequence number (the arrival counter at spawn),
@@ -304,6 +301,16 @@ class ScenarioOverrides(NamedTuple):
     hedge_delay: jnp.ndarray | None = None  # scalar or (S,)
     brownout_q: jnp.ndarray | None = None  # (NS,) or (S, NS)
     health_threshold: jnp.ndarray | None = None  # scalar or (S,)
+    # chaos-campaign axes: the fault-table VALUE rows join the overrides
+    # (they were PlanParams state before hazards) so sampled campaigns
+    # can batch a whole (S, K, ...) window table per scenario, and the
+    # hazard intensity knobs become CRN-paired sweep axes.  ``None`` =
+    # the base plan's (static) tables / 1.0 scales.
+    fault_srv_down: jnp.ndarray | None = None  # (K, NS) or (S, K, NS) i32
+    fault_edge_lat: jnp.ndarray | None = None  # (M, NE) or (S, M, NE) f32
+    fault_edge_drop: jnp.ndarray | None = None  # (M, NE) or (S, M, NE) f32
+    hazard_scale: jnp.ndarray | None = None  # scalar or (S,): divides MTBF
+    mttr_scale: jnp.ndarray | None = None  # scalar or (S,): multiplies MTTR
 
 
 def base_overrides(plan: StaticPlan) -> ScenarioOverrides:
@@ -331,6 +338,11 @@ def base_overrides(plan: StaticPlan) -> ScenarioOverrides:
         hedge_delay=jnp.float32(plan.hedge_delay),
         brownout_q=jnp.asarray(plan.server_brownout_q),
         health_threshold=jnp.float32(plan.health_threshold),
+        fault_srv_down=jnp.asarray(plan.fault_srv_down),
+        fault_edge_lat=jnp.asarray(plan.fault_edge_lat),
+        fault_edge_drop=jnp.asarray(plan.fault_edge_drop),
+        hazard_scale=jnp.float32(1.0),
+        mttr_scale=jnp.float32(1.0),
     )
 
 

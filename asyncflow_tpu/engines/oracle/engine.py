@@ -291,6 +291,7 @@ class _ServerRuntime:
                 SystemNodes.SERVER, f"{self.cfg.id}-outage", engine.sim.now,
             )
             engine.total_rejected += 1
+            engine.dark_lost += 1
             engine._fr(
                 req, FR_REJECT, engine._server_idx[self.cfg.id], engine.sim.now,
             )
@@ -557,6 +558,50 @@ class OracleEngine:
             s.id: i
             for i, s in enumerate(payload.topology_graph.nodes.servers)
         }
+        # chaos campaign: sample scenario 0's merged fault tables from
+        # (seed, index 0) — identical draws AND identical merged tables to
+        # what the JAX engines consume, so oracle parity holds bit-for-bit
+        self.dark_lost = 0
+        self._hz_tables = None
+        if payload.hazard_model is not None:
+            from types import SimpleNamespace
+
+            from asyncflow_tpu.compiler.faults import FaultArrays
+            from asyncflow_tpu.compiler.hazards import (
+                hazard_fault_tables,
+                lower_hazards,
+            )
+
+            spec = lower_hazards(payload)
+            shim = SimpleNamespace(
+                hz_mtbf_dist=spec.mtbf_dist,
+                hz_mtbf_mean=spec.mtbf_mean,
+                hz_mtbf_var=spec.mtbf_var,
+                hz_mttr_dist=spec.mttr_dist,
+                hz_mttr_mean=spec.mttr_mean,
+                hz_mttr_var=spec.mttr_var,
+                hz_lat_factor=spec.lat_factor,
+                hz_drop_boost=spec.drop_boost,
+                hz_srv_targets=spec.srv_targets,
+                hz_edge_targets=spec.edge_targets,
+                hz_max_faults=spec.max_faults,
+                horizon=float(payload.sim_settings.total_simulation_time),
+                fault_srv_times=self._faults.srv_times,
+                fault_srv_down=self._faults.srv_down,
+                fault_edge_times=self._faults.edge_times,
+                fault_edge_lat=self._faults.edge_lat,
+                fault_edge_drop=self._faults.edge_drop,
+            )
+            self._hz_tables = hazard_fault_tables(
+                shim, int(seed) if seed is not None else 0, 0, 1,
+            )
+            self._faults = FaultArrays(
+                srv_times=self._hz_tables.srv_times[0],
+                srv_down=self._hz_tables.srv_down[0],
+                edge_times=self._hz_tables.edge_times[0],
+                edge_lat=self._hz_tables.edge_lat[0],
+                edge_drop=self._hz_tables.edge_drop[0],
+            )
         self.retry = lower_retry(payload.retry_policy)
         # tail-tolerance policies (same lowering the JAX plan consumes)
         self.hedge = lower_hedge(payload.hedge_policy)
@@ -1336,6 +1381,45 @@ class OracleEngine:
             if self.rqs_clock
             else np.empty((0, 2), dtype=np.float64)
         )
+
+        # resilience scorecard: same pure-table math as the JAX paths
+        unavailable_s = None
+        degraded_goodput = None
+        hazard_truncated = 0
+        time_to_drain = None
+        if self._hz_tables is not None:
+            from asyncflow_tpu.compiler import hazards as _hz
+
+            horizon = float(self.settings.total_simulation_time)
+            hazard_truncated = int(self._hz_tables.truncated[0])
+            unavailable_s = _hz.unavailable_seconds(
+                self._hz_tables.srv_times, self._hz_tables.srv_down, horizon,
+            )[0]
+            n_thr = int(np.ceil(horizon)) or 1
+            thr_row = np.zeros(n_thr)
+            if clock.shape[0]:
+                # same bucket rule as the device engines: bucket b counts
+                # completions with ceil(finish) - 1 == b, clipped in range
+                tbin = np.clip(
+                    np.ceil(clock[:, 1]).astype(np.int64) - 1, 0, n_thr - 1,
+                )
+                np.add.at(thr_row, tbin, 1.0)
+            mask = _hz.degraded_seconds_mask(self._hz_tables, horizon, n_thr)
+            degraded_goodput = float(thr_row[mask[0]].sum())
+            ready = sampled.get(SampledMetricName.READY_QUEUE_LEN.value)
+            if ready:
+                series = np.stack(
+                    [ready[sid] for sid in self.servers], axis=-1,
+                )[None]
+                first, last = _hz.window_span(self._hz_tables, horizon)
+                drain = _hz.time_to_drain(
+                    series,
+                    float(self.settings.sample_period_s),
+                    first,
+                    last,
+                )[0]
+                time_to_drain = None if np.isnan(drain) else float(drain)
+
         return SimulationResults(
             settings=self.settings,
             rqs_clock=clock,
@@ -1366,4 +1450,9 @@ class OracleEngine:
             hedges_cancelled=self.hedges_cancelled,
             lb_ejections=self.lb_ejections,
             degraded_completions=self.degraded_completions,
+            dark_lost=self.dark_lost,
+            unavailable_s=unavailable_s,
+            degraded_goodput=degraded_goodput,
+            hazard_truncated=hazard_truncated,
+            time_to_drain=time_to_drain,
         )

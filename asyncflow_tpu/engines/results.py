@@ -116,6 +116,13 @@ class DeviceCounters:
     hedges_cancelled: int = 0
     ejections: int = 0
     degraded: int = 0
+    #: resilience scorecard (chaos campaigns; 0 without a hazard model):
+    #: arrivals refused by dark fault windows, completions landing inside
+    #: degraded (fault-active) seconds, and sampled in-horizon windows
+    #: dropped by the max_faults_per_component slot budget.
+    dark_lost: int = 0
+    degraded_goodput: int = 0
+    hazard_truncated: int = 0
 
     def as_dict(self) -> dict[str, int]:
         return asdict(self)
@@ -180,6 +187,19 @@ class SimulationResults:
     hedges_cancelled: int = 0
     lb_ejections: int = 0
     degraded_completions: int = 0
+    #: resilience scorecard (chaos campaigns; zeros/None without a hazard
+    #: model): arrivals hard-refused by dark fault windows, (NS,) exact
+    #: per-server dark seconds integrated from the sampled tables,
+    #: completions landing inside degraded (fault-active) seconds,
+    #: in-horizon sampled windows dropped by the slot budget, and the
+    #: sim-time from the last window closing until the ready-queue series
+    #: re-enter their pre-fault band (None when gauges are off or the
+    #: queue never settles).
+    dark_lost: int = 0
+    unavailable_s: np.ndarray | None = None
+    degraded_goodput: float | None = None
+    hazard_truncated: int = 0
+    time_to_drain: float | None = None
 
     @property
     def latencies(self) -> np.ndarray:
@@ -210,6 +230,9 @@ class SimulationResults:
             hedges_cancelled=int(self.hedges_cancelled),
             ejections=int(self.lb_ejections),
             degraded=int(self.degraded_completions),
+            dark_lost=int(self.dark_lost),
+            degraded_goodput=int(self.degraded_goodput or 0),
+            hazard_truncated=int(self.hazard_truncated),
         )
 
 
@@ -294,6 +317,18 @@ class SweepResults:
     flight_node: np.ndarray | None = None
     flight_t: np.ndarray | None = None
     flight_n: np.ndarray | None = None
+    #: resilience scorecard (chaos campaigns; None without a hazard
+    #: model): (S,) arrivals lost to dark windows, (S, NS) exact
+    #: per-server dark seconds, (S,) completions landing inside degraded
+    #: seconds, (S,) sim-time from the last window closing until the
+    #: ready-queue series re-enter their pre-fault band (NaN = undefined:
+    #: no window, no pre-fault samples, or never drained), and (S,)
+    #: in-horizon sampled windows dropped by the slot budget.
+    dark_lost: np.ndarray | None = None
+    unavailable_s: np.ndarray | None = None
+    degraded_goodput: np.ndarray | None = None
+    time_to_drain: np.ndarray | None = None
+    hazard_truncated: np.ndarray | None = None
     #: (S,) bool host-fault quarantine mask: True rows produced non-finite
     #: metrics (or deterministically crashed the engine) and were masked
     #: out — their metric rows are zeroed, ``quarantine_reason`` names why.
@@ -442,6 +477,29 @@ class SweepResults:
                 if self.llm_cost_sumsq is not None
                 else None
             ),
+            dark_lost=(
+                self.dark_lost[idx] if self.dark_lost is not None else None
+            ),
+            unavailable_s=(
+                self.unavailable_s[idx]
+                if self.unavailable_s is not None
+                else None
+            ),
+            degraded_goodput=(
+                self.degraded_goodput[idx]
+                if self.degraded_goodput is not None
+                else None
+            ),
+            time_to_drain=(
+                self.time_to_drain[idx]
+                if self.time_to_drain is not None
+                else None
+            ),
+            hazard_truncated=(
+                self.hazard_truncated[idx]
+                if self.hazard_truncated is not None
+                else None
+            ),
             flight_ev=self.flight_ev[idx] if self.flight_ev is not None else None,
             flight_node=(
                 self.flight_node[idx] if self.flight_node is not None else None
@@ -516,6 +574,21 @@ class SweepResults:
             degraded=(
                 int(np.sum(self.degraded_completions))
                 if self.degraded_completions is not None
+                else 0
+            ),
+            dark_lost=(
+                int(np.sum(self.dark_lost))
+                if self.dark_lost is not None
+                else 0
+            ),
+            degraded_goodput=(
+                int(np.sum(self.degraded_goodput))
+                if self.degraded_goodput is not None
+                else 0
+            ),
+            hazard_truncated=(
+                int(np.sum(self.hazard_truncated))
+                if self.hazard_truncated is not None
                 else 0
             ),
         )

@@ -170,6 +170,63 @@ def _bands_section(report) -> str:
     return "\n".join(out)
 
 
+def _scorecard_section(sweep: dict | None, report) -> str:
+    """Resilience scorecard (docs/guides/resilience.md, "Chaos campaigns"):
+    availability, dark-window losses, degraded-window goodput, drain times
+    — rendered only when the run carried the fault/hazard machinery."""
+    rows: list[tuple[str, object]] = []
+    res = getattr(report, "results", None)
+    if res is not None and getattr(res, "dark_lost", None) is not None:
+        import numpy as np
+
+        completed = int(np.asarray(res.completed).sum())
+        dark = int(np.asarray(res.dark_lost).sum())
+        rows.append(("requests lost to dark windows", dark))
+        rows.append((
+            "availability fraction",
+            f"{completed / max(completed + dark, 1):.4f}",
+        ))
+        if res.unavailable_s is not None:
+            per_server = np.asarray(res.unavailable_s).sum(axis=0)
+            rows.append((
+                "unavailable seconds (per server, summed over scenarios)",
+                ", ".join(f"{v:.1f}" for v in per_server),
+            ))
+        if res.degraded_goodput is not None:
+            rows.append((
+                "goodput inside degraded windows",
+                int(np.asarray(res.degraded_goodput).sum()),
+            ))
+        if res.time_to_drain is not None:
+            ttd = np.asarray(res.time_to_drain, np.float64)
+            finite = ttd[np.isfinite(ttd)]
+            rows.append((
+                "time to drain (mean over measured scenarios)",
+                f"{finite.mean():.2f}s ({finite.size} measured)"
+                if finite.size
+                else "unmeasured (stream a ready_queue_len gauge series)",
+            ))
+        if res.hazard_truncated is not None:
+            rows.append((
+                "hazard windows truncated (slot budget)",
+                int(np.asarray(res.hazard_truncated).sum()),
+            ))
+    elif sweep is not None:
+        counters = sweep.get("counters") or {}
+        if not counters.get("dark_lost"):
+            return ""
+        for key in ("dark_lost", "degraded_goodput", "hazard_truncated"):
+            if key in counters:
+                rows.append((key, counters[key]))
+    if not rows:
+        return ""
+    return (
+        "<h2>Resilience scorecard</h2>"
+        '<p class="note">chaos-campaign availability metrics '
+        "(docs/guides/resilience.md).</p>" + _kv_table(rows)
+    )
+
+
 def _recovery_section(progress: list[dict], recovery: list[dict]) -> str:
     actions = [a for r in recovery for a in r.get("meta", {}).get("actions", [])]
     if not actions and not any(
@@ -270,6 +327,7 @@ def build_dashboard(
         _summary_section(sweep, report),
         _progress_section(progress),
         _bands_section(report),
+        _scorecard_section(sweep, report),
         _recovery_section(progress, recovery),
         _phases_section(sweep),
         _compiles_section(sweep),

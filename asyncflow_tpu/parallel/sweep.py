@@ -79,6 +79,8 @@ def make_overrides(
     hedge_delay: np.ndarray | None = None,
     brownout_threshold: np.ndarray | None = None,
     ejection_threshold: np.ndarray | None = None,
+    hazard_scale: np.ndarray | None = None,
+    mttr_scale: np.ndarray | None = None,
 ) -> ScenarioOverrides:
     """Per-scenario parameter overrides; every scale is (S,) or (S, NE).
 
@@ -96,8 +98,22 @@ def make_overrides(
     subsystem in): ``hedge_delay``: (S,) per-scenario hedge timer delays;
     ``brownout_threshold``: (S,) or (S, NS) per-scenario brownout
     ready-queue thresholds; ``ejection_threshold``: (S,) per-scenario LB
-    health-gate ejection thresholds."""
+    health-gate ejection thresholds.
+
+    Chaos-campaign axes (base plan must carry a ``hazard_model``):
+    ``hazard_scale``: (S,) divides every domain's MTBF mean (higher =
+    more chaos); ``mttr_scale``: (S,) multiplies every domain's MTTR
+    mean (higher = slower repair).  Both reuse the same lockstep
+    uniforms, so scale sweeps are CRN-paired by construction."""
     base = base_overrides(plan)
+    for name, arr in (("hazard_scale", hazard_scale),
+                      ("mttr_scale", mttr_scale)):
+        if arr is not None and not plan.has_hazards:
+            msg = (
+                f"{name} overrides need a hazard_model in the payload: "
+                "the sampled fault campaign they rescale must exist"
+            )
+            raise ValueError(msg)
     if fault_shift is not None and not plan.has_faults:
         msg = (
             "fault_shift overrides need a fault_timeline in the payload: "
@@ -218,6 +234,16 @@ def make_overrides(
             else _brownout_axis(
                 brownout_threshold, n_scenarios, base.brownout_q,
             )
+        ),
+        hazard_scale=(
+            base.hazard_scale
+            if hazard_scale is None
+            else _scenario_axis(hazard_scale, "hazard_scale", n_scenarios)
+        ),
+        mttr_scale=(
+            base.mttr_scale
+            if mttr_scale is None
+            else _scenario_axis(mttr_scale, "mttr_scale", n_scenarios)
         ),
     )
 
@@ -609,11 +635,43 @@ class SweepReport:
             "latency_p50_s": self.aggregate_percentile(50),
             "latency_p95_s": self.aggregate_percentile(95),
             "latency_p99_s": self.aggregate_percentile(99),
+            # resilience scorecard (docs/guides/resilience.md, "Chaos
+            # campaigns"): present only on sweeps that carried the fault /
+            # hazard machinery, so unconfigured summaries stay unchanged
+            **self._scorecard_fields(res),
             # pooled order-statistic CIs (asyncflow_tpu.analysis): intervals
             # on the POOLED tail quantiles the point fields above report —
             # [lo, hi] at ci_level, NaN-pairs on empty sweeps
             **self._percentile_ci_fields(),
         }
+
+    def _scorecard_fields(self, res: SweepResults) -> dict:
+        """Resilience scorecard summary keys; empty on plain sweeps."""
+        if res.dark_lost is None:
+            return {}
+        completed = int(res.completed.sum())
+        dark = int(res.dark_lost.sum())
+        out: dict = {
+            "dark_lost_total": dark,
+            # completions over (completions + requests lost to dark
+            # windows): the CRN-pairable availability headline
+            "availability_fraction": float(
+                completed / max(completed + dark, 1),
+            ),
+        }
+        if res.unavailable_s is not None:
+            out["unavailable_s_total"] = float(res.unavailable_s.sum())
+        if res.degraded_goodput is not None:
+            out["degraded_goodput_total"] = float(res.degraded_goodput.sum())
+        if res.hazard_truncated is not None:
+            out["hazard_truncated_total"] = int(res.hazard_truncated.sum())
+        if res.time_to_drain is not None:
+            ttd = np.asarray(res.time_to_drain, np.float64)
+            finite = ttd[np.isfinite(ttd)]
+            out["time_to_drain_mean_s"] = (
+                float(finite.mean()) if finite.size else None
+            )
+        return out
 
     #: confidence level of the summary()'s interval fields
     CI_LEVEL = 0.95
@@ -786,11 +844,16 @@ class SweepRunner:
         )
         self._gauge_sel: np.ndarray | None = None
         self._gauge_series_ids: list[str] | None = None
+        self._gauge_series_metric: str | None = None
         gauge_stride = 0
         if gauge_series is not None:
             self._gauge_sel, gauge_stride, self._gauge_series_ids = (
                 _resolve_gauge_series(self.plan, gauge_series)
             )
+            # the scorecard's time-to-drain needs to know WHICH gauge the
+            # streamed series carries (only ready-queue depth defines the
+            # pre-fault band the drain is measured against)
+            self._gauge_series_metric = str(gauge_series[0])
         if self._gauge_sel is not None and engine in ("pallas", "native"):
             # streaming series ride the jaxsim interval-endpoint gauge grid
             # (fast + event engines); pallas/native carry no such grid
@@ -805,9 +868,16 @@ class SweepRunner:
             "native", "pallas",
         ):
             raise_fence(f"resilience.{engine}")
+        # Chaos campaigns sample per-scenario fault tables that ride the
+        # scenario-override seam — a seam the native C++ loop and the
+        # Pallas VMEM kernel do not carry; forcing them is an explicit
+        # refusal, never a hazard-free mis-model.
+        hazards = getattr(self.plan, "has_hazards", False)
+        if hazards and engine in ("native", "pallas"):
+            raise_fence(f"hazard.{engine}")
         if tail and engine in ("native", "pallas"):
             raise_fence(f"tail_tolerance.{engine}")
-        resilient = self.plan.has_faults or self.plan.has_retry or tail
+        resilient = self.plan.has_faults or self.plan.has_retry or tail or hazards
         if engine == "native":
             # the single-core C++ oracle, looped over the scenario grid:
             # no batching, but the lowest per-scenario constant of any
@@ -928,8 +998,9 @@ class SweepRunner:
         # bump when the per-chunk npz schema changes so stale chunks are
         # never silently merged (e.g. pre-gauge_means chunks); v6 added
         # the quarantine mask/reason arrays and the digest sidecars; v7 the
-        # gauge_hist/gauge_hist_cap band histograms
-        digest.update(b"chunk-schema-v7")
+        # gauge_hist/gauge_hist_cap band histograms; v8 the dark_lost
+        # availability counter (chaos campaigns)
+        digest.update(b"chunk-schema-v8")
         digest.update(self.payload.model_dump_json().encode())
         # the LOWERED plan arrays, not just the payload: any plan-level
         # field (fault tables, retry scalars, capacity estimates — and
@@ -1131,6 +1202,45 @@ class SweepRunner:
         tel.finalize(counters=report.results.counters())
         return report
 
+    def _attach_scorecard(self, merged: SweepResults, hz_tables) -> None:
+        """Thread the resilience scorecard through the merged results.
+
+        Everything here is computed on the HOST from the sampled window
+        tables (the only engine-carried scorecard signal is the dark-lost
+        counter, which chunks/checkpoints already merged), so the numbers
+        are bit-identical across engines, chunk sizes, and resume —
+        exactly like the tables themselves.
+        """
+        from asyncflow_tpu.compiler import hazards as _hz
+
+        horizon = float(self.plan.horizon)
+        merged.hazard_truncated = np.asarray(hz_tables.truncated, np.int64)
+        merged.unavailable_s = _hz.unavailable_seconds(
+            hz_tables.srv_times, hz_tables.srv_down, horizon,
+        )
+        thr = np.asarray(merged.throughput, np.float64)
+        mask = _hz.degraded_seconds_mask(hz_tables, horizon, thr.shape[1])
+        merged.degraded_goodput = (thr * mask).sum(axis=1)
+        # time-to-drain needs a streamed ready-queue series; without one
+        # (or with a different gauge streamed) it is NaN = "not measured",
+        # never silently zero
+        drain = np.full(thr.shape[0], np.nan)
+        from asyncflow_tpu.config.constants import SampledMetricName
+
+        if (
+            merged.gauge_series is not None
+            and self._gauge_series_metric
+            == SampledMetricName.READY_QUEUE_LEN.value
+        ):
+            first_start, last_end = _hz.window_span(hz_tables, horizon)
+            drain = _hz.time_to_drain(
+                np.asarray(merged.gauge_series, np.float64),
+                float(merged.gauge_series_period),
+                first_start,
+                last_end,
+            )
+        merged.time_to_drain = drain
+
     def _run_impl(
         self,
         n_scenarios: int,
@@ -1152,6 +1262,39 @@ class SweepRunner:
             overrides = fill_overrides(overrides, base_overrides(self.plan))
         self._guard_fastpath_overrides(overrides)
         _guard_resilience_overrides(self.plan, overrides)
+        # Chaos campaigns: sample the hazard model into per-scenario fault
+        # tables ONCE, for the whole global block [first_scenario,
+        # first_scenario + n), BEFORE chunking/checkpoint identity — every
+        # chunk, isolated quarantine re-run, resumed run, and antithetic
+        # half then slices the SAME (S, ...) tables (prefix-stable draws
+        # keyed by fold_in(scenario_key, (domain, ordinal))), so recovery
+        # never resamples and chunk size cannot change a window.
+        hz_tables = None
+        if self.plan.has_hazards:
+            from asyncflow_tpu.compiler.hazards import hazard_fault_tables
+
+            if overrides is None:
+                overrides = base_overrides(self.plan)
+
+            def _hz_scale(x):
+                arr = np.asarray(x, np.float64)
+                return arr if arr.ndim else float(arr)
+
+            hz_tables = hazard_fault_tables(
+                self.plan,
+                seed,
+                first_scenario,
+                n_scenarios,
+                hazard_scale=_hz_scale(overrides.hazard_scale),
+                mttr_scale=_hz_scale(overrides.mttr_scale),
+            )
+            overrides = overrides._replace(
+                fault_srv_times=jnp.asarray(hz_tables.srv_times),
+                fault_srv_down=jnp.asarray(hz_tables.srv_down),
+                fault_edge_times=jnp.asarray(hz_tables.edge_times),
+                fault_edge_lat=jnp.asarray(hz_tables.edge_lat),
+                fault_edge_drop=jnp.asarray(hz_tables.edge_drop),
+            )
         n_dev = len(self.mesh.devices.flat) if self.mesh is not None else 1
         default = self.default_chunk(self.engine_kind)
         chunk = chunk_size or min(default * n_dev, n_scenarios)
@@ -1686,6 +1829,8 @@ class SweepRunner:
             ckpt.write_manifest(status="complete", scenarios_done=n_scenarios)
         with _ph(tel, "postprocess"):
             merged = _concat_sweeps(partials)[:n_scenarios]
+            if hz_tables is not None:
+                self._attach_scorecard(merged, hz_tables)
         return SweepReport(
             results=merged,
             n_scenarios=n_scenarios,
@@ -1941,6 +2086,8 @@ class _SweepCheckpoint:
             payload["llm_cost_sumsq"] = part.llm_cost_sumsq
         if part.truncated is not None:
             payload["truncated"] = part.truncated
+        if part.dark_lost is not None:
+            payload["dark_lost"] = part.dark_lost
         if part.total_timed_out is not None:
             payload["total_timed_out"] = part.total_timed_out
             payload["total_retries"] = part.total_retries
@@ -2019,6 +2166,7 @@ class _SweepCheckpoint:
                     data["llm_cost_sumsq"] if "llm_cost_sumsq" in data else None
                 ),
                 truncated=data["truncated"] if "truncated" in data else None,
+                dark_lost=data["dark_lost"] if "dark_lost" in data else None,
                 total_timed_out=(
                     data["total_timed_out"]
                     if "total_timed_out" in data
@@ -2132,10 +2280,13 @@ def _guard_resilience_overrides(
                 "the base plan models it"
             )
             raise _FastpathOverrideError(msg)
-    if not plan.has_faults:
+    if not (plan.has_faults or plan.has_hazards):
         for name, base_arr in (
             ("fault_srv_times", plan.fault_srv_times),
             ("fault_edge_times", plan.fault_edge_times),
+            ("fault_srv_down", plan.fault_srv_down),
+            ("fault_edge_lat", plan.fault_edge_lat),
+            ("fault_edge_drop", plan.fault_edge_drop),
         ):
             ov_arr = getattr(overrides, name)
             if ov_arr is None:
@@ -2145,9 +2296,47 @@ def _guard_resilience_overrides(
                 ov_arr, base_arr,
             ):
                 msg = (
-                    f"{name} overrides need a fault_timeline in the "
-                    "payload: the compiler lowers the window shapes; "
-                    "overrides only move their timings"
+                    f"{name} overrides need a fault_timeline or a "
+                    "hazard_model in the payload: the compiler lowers the "
+                    "window machinery only when the base plan models it"
+                )
+                raise _FastpathOverrideError(msg)
+    if plan.has_hazards:
+        # a hazard plan's fault tables are SAMPLED per scenario from the
+        # hazard model; hand-built table overrides would be silently
+        # replaced by the campaign, so refuse them loudly (rescale the
+        # campaign via hazard_scale / mttr_scale instead)
+        for name, base_arr in (
+            ("fault_srv_times", plan.fault_srv_times),
+            ("fault_edge_times", plan.fault_edge_times),
+            ("fault_srv_down", plan.fault_srv_down),
+            ("fault_edge_lat", plan.fault_edge_lat),
+            ("fault_edge_drop", plan.fault_edge_drop),
+        ):
+            ov_arr = getattr(overrides, name)
+            if ov_arr is None:
+                continue
+            ov_arr = np.asarray(ov_arr)
+            if ov_arr.shape != np.asarray(base_arr).shape or not np.allclose(
+                ov_arr, base_arr,
+            ):
+                msg = (
+                    f"{name} overrides conflict with the payload's "
+                    "hazard_model: the chaos campaign samples these tables "
+                    "per scenario and would overwrite the override; use "
+                    "hazard_scale / mttr_scale axes to reshape the campaign"
+                )
+                raise _FastpathOverrideError(msg)
+    if not plan.has_hazards:
+        for name in ("hazard_scale", "mttr_scale"):
+            ov_arr = getattr(overrides, name, None)
+            if ov_arr is None:
+                continue
+            if not np.allclose(np.asarray(ov_arr), 1.0):
+                msg = (
+                    f"{name} overrides need a hazard_model in the "
+                    "payload: the sampled fault campaign they rescale "
+                    "must exist"
                 )
                 raise _FastpathOverrideError(msg)
     for flag, name, base_val, why in (
@@ -2388,6 +2577,34 @@ def _concat_sweeps(parts: list[SweepResults]) -> SweepResults:
             truncated=(
                 np.concatenate([p.truncated for p in parts])
                 if all(p.truncated is not None for p in parts)
+                else None
+            ),
+            dark_lost=(
+                np.concatenate([p.dark_lost for p in parts])
+                if all(p.dark_lost is not None for p in parts)
+                else None
+            ),
+            # scorecard fields are attached post-merge by _run_impl (they
+            # derive from the sampled tables, not chunk outputs); concat
+            # support exists for the antithetic half-report merge
+            unavailable_s=(
+                np.concatenate([p.unavailable_s for p in parts])
+                if all(p.unavailable_s is not None for p in parts)
+                else None
+            ),
+            degraded_goodput=(
+                np.concatenate([p.degraded_goodput for p in parts])
+                if all(p.degraded_goodput is not None for p in parts)
+                else None
+            ),
+            time_to_drain=(
+                np.concatenate([p.time_to_drain for p in parts])
+                if all(p.time_to_drain is not None for p in parts)
+                else None
+            ),
+            hazard_truncated=(
+                np.concatenate([p.hazard_truncated for p in parts])
+                if all(p.hazard_truncated is not None for p in parts)
                 else None
             ),
             gauge_series=(

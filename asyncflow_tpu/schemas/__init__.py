@@ -23,7 +23,13 @@ from asyncflow_tpu.schemas.nodes import (
 )
 from asyncflow_tpu.schemas.payload import SimulationPayload
 from asyncflow_tpu.schemas.random_variables import RVConfig
-from asyncflow_tpu.schemas.resilience import FaultEvent, FaultTimeline, RetryPolicy
+from asyncflow_tpu.schemas.resilience import (
+    FailureDomain,
+    FaultEvent,
+    FaultTimeline,
+    HazardModel,
+    RetryPolicy,
+)
 from asyncflow_tpu.schemas.settings import SimulationSettings
 from asyncflow_tpu.schemas.workload import RqsGenerator
 
@@ -34,8 +40,10 @@ __all__ = [
     "Endpoint",
     "EventInjection",
     "ExperimentConfig",
+    "FailureDomain",
     "FaultEvent",
     "FaultTimeline",
+    "HazardModel",
     "LoadBalancer",
     "PrecisionTarget",
     "RVConfig",

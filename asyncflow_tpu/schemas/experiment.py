@@ -35,6 +35,10 @@ SUPPORTED_METRICS = (
     "latency_p95_s",
     "latency_p99_s",
     "goodput_fraction",
+    # chaos-campaign availability: completed / (completed + dark_lost);
+    # needs a sweep that carried the fault/hazard machinery (the
+    # estimator raises a named error otherwise)
+    "availability_fraction",
 )
 
 

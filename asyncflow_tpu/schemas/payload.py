@@ -16,6 +16,7 @@ from asyncflow_tpu.schemas.events import EventInjection
 from asyncflow_tpu.schemas.graph import TopologyGraph
 from asyncflow_tpu.schemas.resilience import (
     FaultTimeline,
+    HazardModel,
     HedgePolicy,
     RetryPolicy,
 )
@@ -66,6 +67,10 @@ class SimulationPayload(BaseModel):
     #: client-side hedged (speculative) duplicate attempts against tail
     #: latency (tail-tolerance family; see schemas/resilience.py)
     hedge_policy: HedgePolicy | None = None
+    #: randomized chaos campaign: stochastic MTBF/MTTR failure domains the
+    #: compiler samples into per-scenario fault tables (chaos-campaign
+    #: family; see schemas/resilience.py and compiler/hazards.py)
+    hazard_model: HazardModel | None = None
 
     @property
     def generators(self) -> list[RqsGenerator]:
@@ -178,6 +183,38 @@ class SimulationPayload(BaseModel):
     # FAULT windows may cover every server simultaneously — arrivals are
     # hard-refused, which is exactly the "total outage + retry storm"
     # scenario the resilience subsystem exists to model.
+
+    @model_validator(mode="after")
+    def _hazard_targets_exist(self) -> SimulationPayload:
+        """Every failure-domain target must be a declared server or edge,
+        and edge targets need explicit degrade semantics.  Semantic sanity
+        beyond existence (MTTR vs horizon, zero-availability blast groups)
+        is the checker's AF6xx hazard pass — those payloads VALIDATE, so
+        the checker can refuse them by name."""
+        if self.hazard_model is None:
+            return self
+        server_ids = {s.id for s in self.topology_graph.nodes.servers}
+        edge_ids = {e.id for e in self.topology_graph.edges}
+        for domain in self.hazard_model.domains:
+            for target in domain.targets:
+                if target not in server_ids and target not in edge_ids:
+                    msg = (
+                        f"failure domain {domain.domain_id!r}: target "
+                        f"{target!r} is not a declared server or edge"
+                    )
+                    raise ValueError(msg)
+            edge_targets = [t for t in domain.targets if t in edge_ids]
+            degrade_fields = (
+                domain.latency_factor != 1.0 or domain.dropout_boost != 0.0
+            )
+            if edge_targets and not degrade_fields:
+                msg = (
+                    f"failure domain {domain.domain_id!r}: edge targets "
+                    f"{edge_targets} need latency_factor > 1 and/or "
+                    "dropout_boost > 0"
+                )
+                raise ValueError(msg)
+        return self
 
     # ------------------------------------------------------------------
     # Event validators

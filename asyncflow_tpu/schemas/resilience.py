@@ -42,7 +42,17 @@ from pydantic import (
     model_validator,
 )
 
-from asyncflow_tpu.config.constants import FaultKind, RetryDefaults
+from asyncflow_tpu.config.constants import Distribution, FaultKind, RetryDefaults
+from asyncflow_tpu.schemas.random_variables import RVConfig
+
+#: duration laws a hazard process may draw MTBF/MTTR from — the subset of
+#: the random_variables vocabulary with a continuous inverse CDF (poisson
+#: counts and the mean-ignoring U(0,1) make no sense as repair times).
+HAZARD_DISTRIBUTIONS = frozenset({
+    Distribution.EXPONENTIAL,
+    Distribution.NORMAL,
+    Distribution.LOG_NORMAL,
+})
 
 
 class RetryPolicy(BaseModel):
@@ -228,5 +238,99 @@ class FaultTimeline(BaseModel):
         if len(ids) != len(set(ids)):
             dup = sorted({i for i in ids if ids.count(i) > 1})
             msg = f"duplicate fault ids: {dup}"
+            raise ValueError(msg)
+        return self
+
+
+class FailureDomain(BaseModel):
+    """One correlated stochastic failure process (a *blast group*).
+
+    Every target in the domain fails together: the compiler draws ONE
+    alternating up/down recurrence per (scenario, domain) —
+    ``t_start_j = t_end_{j-1} + MTBF_draw``, ``t_end_j = t_start_j +
+    MTTR_draw`` — and applies each sampled window to all targets at once
+    (rack/zone/dependency-shaped correlated failures).  Server targets go
+    dark (hard-refuse arrivals, exactly like a scheduled ``server_outage``
+    window); edge targets degrade by ``latency_factor``/``dropout_boost``
+    (exactly like ``edge_degrade``).
+
+    MTBF/MTTR draw from the :class:`RVConfig` vocabulary restricted to
+    the continuous duration laws (:data:`HAZARD_DISTRIBUTIONS`); draws are
+    lockstep inverse-CDF transforms of per-``(scenario, domain, ordinal)``
+    ``fold_in`` uniforms, so every engine materializes bit-identical
+    window tables (see ``compiler/hazards.py``).
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    domain_id: str
+    #: server and/or edge ids that fail together (the blast radius).
+    targets: list[str]
+    #: up-time law: the gap from one repair completing to the next failure.
+    mtbf: RVConfig
+    #: repair-time law: how long each sampled fault window lasts.
+    mttr: RVConfig
+    #: edge targets only: latency multiplier while a window is active
+    #: (superposes multiplicatively with other windows, like edge_degrade).
+    latency_factor: float = Field(default=1.0, ge=1.0)
+    #: edge targets only: additive dropout boost while a window is active
+    #: (engines clip base + boost to 1).
+    dropout_boost: float = Field(default=0.0, ge=0.0, le=1.0)
+
+    @model_validator(mode="after")
+    def _targets_and_laws_consistent(self) -> FailureDomain:
+        if not self.targets:
+            msg = f"failure domain {self.domain_id!r}: targets must be non-empty"
+            raise ValueError(msg)
+        if len(self.targets) != len(set(self.targets)):
+            dup = sorted({t for t in self.targets if self.targets.count(t) > 1})
+            msg = f"failure domain {self.domain_id!r}: duplicate targets {dup}"
+            raise ValueError(msg)
+        for name, rv in (("mtbf", self.mtbf), ("mttr", self.mttr)):
+            if rv.distribution not in HAZARD_DISTRIBUTIONS:
+                allowed = sorted(d.value for d in HAZARD_DISTRIBUTIONS)
+                msg = (
+                    f"failure domain {self.domain_id!r}: {name} distribution "
+                    f"{rv.distribution.value!r} is not a duration law; pick "
+                    f"one of {allowed}"
+                )
+                raise ValueError(msg)
+            if rv.mean <= 0:
+                msg = (
+                    f"failure domain {self.domain_id!r}: {name} mean must be "
+                    f"> 0, got {rv.mean}"
+                )
+                raise ValueError(msg)
+        return self
+
+
+class HazardModel(BaseModel):
+    """Randomized chaos-campaign description: a set of failure domains plus
+    a bounded per-component fault-slot budget.
+
+    ``max_faults_per_component`` caps how many sampled windows per
+    (scenario, domain) enter the lowered fault tables — the table shapes
+    must be static for the vmapped engines.  Sampling keeps drawing past
+    the budget (up to ``2x``) so truncation is *counted*, never silent:
+    ``hazard_truncated`` in the resilience scorecard reports how many
+    in-horizon windows were dropped, exactly the flight recorder's
+    explicit-truncation discipline.
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    domains: list[FailureDomain]
+    #: fault-window slots per (scenario, domain) in the lowered tables.
+    max_faults_per_component: PositiveInt = Field(default=4, le=64)
+
+    @model_validator(mode="after")
+    def _unique_domains(self) -> HazardModel:
+        if not self.domains:
+            msg = "hazard model: domains must be non-empty"
+            raise ValueError(msg)
+        ids = [d.domain_id for d in self.domains]
+        if len(ids) != len(set(ids)):
+            dup = sorted({i for i in ids if ids.count(i) > 1})
+            msg = f"duplicate failure-domain ids: {dup}"
             raise ValueError(msg)
         return self

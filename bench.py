@@ -70,6 +70,11 @@ def _parse_args(argv: list[str]) -> dict:
     route to the scan fast path) vs the same sweep forced onto the event
     engine, recorded under ``detail.resilient``.
 
+    ``--chaos``: run the chaos-campaign arm — the bench topology plus a
+    sampled hazard model (per-scenario fault tables), auto-dispatched
+    (must route to the scan fast path) vs the same sweep forced onto the
+    event engine, recorded under ``detail.chaos``.
+
     ``--checkpoint-dir DIR``: checkpoint the measured sweep's chunks under
     ``DIR`` so a preempted/killed benchmark is resumable.  A SIGTERM/SIGINT
     during the measured sweep drains the in-flight chunk, writes a resume
@@ -88,6 +93,7 @@ def _parse_args(argv: list[str]) -> dict:
         "trace_guard": False,
         "gauge_guard": False,
         "resilient": False,
+        "chaos": False,
         "checkpoint_dir": None,
         "resume": False,
     }
@@ -99,6 +105,8 @@ def _parse_args(argv: list[str]) -> dict:
             opts["gauge_guard"] = True
         elif arg == "--resilient":
             opts["resilient"] = True
+        elif arg == "--chaos":
+            opts["chaos"] = True
         elif arg == "--resume":
             opts["resume"] = True
         elif arg == "--checkpoint-dir":
@@ -502,6 +510,97 @@ def _resilient_arm() -> dict:
     }
 
 
+def _chaos_payload(horizon: int):
+    """Bench topology + a sampled hazard campaign: one rack domain on
+    srv-1 (exponential MTBF, lognormal MTTR) and one WAN domain degrading
+    the lb->srv-2 edge — the chaos-campaign shape PR 17 wired through the
+    per-scenario fault tables."""
+    import yaml
+
+    from asyncflow_tpu.schemas.payload import SimulationPayload
+
+    path = os.path.join(
+        REPO, "examples", "yaml_input", "data", "two_servers_lb.yml",
+    )
+    data = yaml.safe_load(open(path).read())
+    data["sim_settings"]["total_simulation_time"] = horizon
+    data["hazard_model"] = {
+        "max_faults_per_component": 4,
+        "domains": [
+            {
+                "domain_id": "rack-a",
+                "targets": ["srv-1"],
+                "mtbf": {"mean": 40.0, "distribution": "exponential"},
+                "mttr": {
+                    "mean": 5.0, "variance": 0.3,
+                    "distribution": "log_normal",
+                },
+            },
+            {
+                "domain_id": "wan",
+                "targets": ["lb-srv2"],
+                "mtbf": {"mean": 60.0, "distribution": "exponential"},
+                "mttr": {"mean": 4.0, "distribution": "exponential"},
+                "latency_factor": 4.0,
+                "dropout_boost": 0.05,
+            },
+        ],
+    }
+    return SimulationPayload.model_validate(data)
+
+
+def _chaos_arm() -> dict:
+    """Chaos-campaign arm (BENCH_CHAOS=1 / --chaos).
+
+    PR 17 taught the sweep to sample a hazard model into per-scenario
+    fault tables that ride the scenario-override seam — a shape the scan
+    fast path already carries, so auto-dispatch must keep routing it fast
+    (asserted, cross-checked against ``predict_routing``).  Measures the
+    hazard sweep under auto-dispatch against the SAME sweep forced onto
+    the event engine, under ``detail.chaos``.
+    """
+    from asyncflow_tpu.checker.fences import predict_routing
+    from asyncflow_tpu.parallel.sweep import SweepRunner
+
+    horizon = int(os.environ.get("BENCH_CHAOS_HORIZON", "120"))
+    n = int(os.environ.get("BENCH_CHAOS_SCENARIOS", "64"))
+    hz_payload = _chaos_payload(horizon)
+    fast = SweepRunner(hz_payload, engine="auto", use_mesh=False)
+    pred = predict_routing(fast.plan, engine="auto")
+    if fast.engine_kind != "fast" or pred.engine != fast.engine_kind:
+        msg = (
+            "chaos arm FAILED: the hazard-campaign sweep must auto-route "
+            f"to the scan fast path (dispatched {fast.engine_kind!r}, "
+            f"predicted {pred.engine!r})"
+        )
+        raise AssertionError(msg)
+    event = SweepRunner(hz_payload, engine="event", use_mesh=False)
+    # warm both compiled shapes, then measure on fresh seeds
+    fast.run(n, seed=SEED, chunk_size=n)
+    event.run(n, seed=SEED, chunk_size=n)
+    t0 = time.time()
+    rep_fast = fast.run(n, seed=SEED + 1, chunk_size=n)
+    wall_fast = time.time() - t0
+    t0 = time.time()
+    event.run(n, seed=SEED + 1, chunk_size=n)
+    wall_event = time.time() - t0
+    fast_rate = n / max(wall_fast, 1e-9)
+    event_rate = n / max(wall_event, 1e-9)
+    summary = rep_fast.summary()
+    return {
+        "n_scenarios": n,
+        "horizon_s": horizon,
+        "engine_kind": fast.engine_kind,
+        "predicted_engine": pred.engine,
+        "completed_total": summary["completed_total"],
+        "dark_lost_total": summary["dark_lost_total"],
+        "availability_fraction": round(summary["availability_fraction"], 4),
+        "fast_scen_s": round(fast_rate, 3),
+        "event_scen_s": round(event_rate, 3),
+        "speedup": round(fast_rate / max(event_rate, 1e-9), 2),
+    }
+
+
 def _result_json(
     *,
     value: float,
@@ -800,6 +899,16 @@ def run_measurement() -> None:
             f"auto-dispatch -> {res['engine_kind']}",
             file=sys.stderr,
         )
+    if os.environ.get("BENCH_CHAOS") == "1":
+        detail["chaos"] = _chaos_arm()
+        hz = detail["chaos"]
+        print(
+            f"chaos: fast {hz['fast_scen_s']:.1f} vs event "
+            f"{hz['event_scen_s']:.1f} scen/s ({hz['speedup']:.1f}x), "
+            f"auto-dispatch -> {hz['engine_kind']}, availability "
+            f"{hz['availability_fraction']:.4f}",
+            file=sys.stderr,
+        )
     if on_accel:
         # Device-time breakdown.  One blocking dispatch costs
         # warm_chunk_wall_s = kernel time + tunnel round trip, and the RTT
@@ -988,6 +1097,8 @@ def main() -> None:
         os.environ["BENCH_GAUGE_GUARD"] = "1"
     if opts["resilient"]:
         os.environ["BENCH_RESILIENT"] = "1"
+    if opts["chaos"]:
+        os.environ["BENCH_CHAOS"] = "1"
     if opts["checkpoint_dir"]:
         os.environ["BENCH_CHECKPOINT_DIR"] = opts["checkpoint_dir"]
     if opts["resume"]:

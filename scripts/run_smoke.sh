@@ -223,6 +223,53 @@ if [ "$rc" -ne 2 ]; then
   echo "checker exit $rc on the self-defeating hedge fixture (expected 2: AF305)" >&2
   exit 1
 fi
+# chaos-campaign slice: a tiny hazard_model sweep must auto-route to the
+# scan fast path (predict_routing agreeing), surface a non-empty resilience
+# scorecard, and the checker must bless the shipped campaign (exit 0) while
+# rejecting the zero-availability blast group (exit 2: AF602) —
+# docs/guides/resilience.md §"Chaos campaigns"
+python - <<'PY'
+import yaml
+from asyncflow_tpu.checker.fences import predict_routing
+from asyncflow_tpu.parallel.sweep import SweepRunner
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+data = yaml.safe_load(
+    open("examples/yaml_input/data/chaos_campaign.yml").read())
+data["sim_settings"]["total_simulation_time"] = 60
+data["sim_settings"]["enabled_sample_metrics"] = []
+payload = SimulationPayload.model_validate(data)
+runner = SweepRunner(payload, engine="auto", use_mesh=False)
+pred = predict_routing(runner.plan, engine="auto")
+if runner.engine_kind != "fast" or pred.engine != runner.engine_kind:
+    raise SystemExit(
+        "hazard routing regressed: chaos-campaign sweep dispatched "
+        f"{runner.engine_kind!r}, predicted {pred.engine!r} (expected 'fast')"
+    )
+rep = runner.run(8, seed=3, chunk_size=4)
+res = rep.results
+assert res.dark_lost is not None, "scorecard counters must surface"
+assert res.unavailable_s is not None and res.hazard_truncated is not None
+assert float(res.unavailable_s.sum()) > 0.0, \
+    "the sampled campaign must take something dark"
+summ = rep.summary()
+for key in ("dark_lost_total", "availability_fraction",
+            "unavailable_s_total", "hazard_truncated_total"):
+    assert key in summ, f"summary is missing {key!r}"
+assert 0.0 < summ["availability_fraction"] <= 1.0, summ
+print("chaos-campaign sweep on the scan fast path OK "
+      f"(engine={runner.engine_kind}, predicted={pred.engine}, "
+      f"availability={summ['availability_fraction']:.4f})")
+PY
+python -m asyncflow_tpu.checker examples/yaml_input/data/chaos_campaign.yml \
+  --backend cpu
+rc=0
+python -m asyncflow_tpu.checker tests/integration/data/zero_availability.yml \
+  --backend cpu > /dev/null || rc=$?
+if [ "$rc" -ne 2 ]; then
+  echo "checker exit $rc on the zero-availability fixture (expected 2: AF602)" >&2
+  exit 1
+fi
 # static-checker slice: the repo must lint clean under the invariant AST
 # rules, the preflight CLI must pass a shipped example (exit 0) and call
 # a deliberately saturated scenario (exit 2) — docs/guides/diagnostics.md

@@ -1,0 +1,251 @@
+"""Sampled-timeline determinism: a chaos campaign's fault tables are a
+pure function of ``(plan, seed, global_scenario_index)``.
+
+This is the contract that makes chaos campaigns analyzable at all
+(docs/guides/resilience.md, "Chaos campaigns"): the lockstep inverse-CDF
+draws are keyed by ``fold_in(scenario_key, (domain, fault_ordinal))``, so
+the same scenario row sees the same sampled windows no matter how the
+sweep is chunked, split across ``run()`` calls, killed and resumed, or
+quarantine-spliced — and the oracle heap loop consumes the SAME host
+tables the vmapped engines do, so the environment is bit-identical across
+engine families even though their traffic RNGs differ.
+"""
+
+from __future__ import annotations
+
+import signal
+
+import numpy as np
+import pytest
+import yaml
+
+from asyncflow_tpu.compiler.hazards import hazard_fault_tables
+from asyncflow_tpu.parallel.sweep import (
+    SweepRunner,
+    _concat_sweeps,
+    _SweepCheckpoint,
+    make_overrides,
+)
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+CAMPAIGN = "examples/yaml_input/data/chaos_campaign.yml"
+HORIZON = 40
+SEED = 11
+
+#: per-scenario metric rows (engine-dependent values, still deterministic)
+METRIC_FIELDS = ("latency_hist", "completed", "latency_sum",
+                 "total_generated", "dark_lost", "degraded_goodput")
+#: scorecard rows derived purely from the sampled environment (identical
+#: across engine families; degraded_goodput is traffic-weighted and is NOT)
+ENVIRONMENT_FIELDS = ("unavailable_s", "hazard_truncated", "time_to_drain")
+TABLE_FIELDS = ("srv_times", "srv_down", "edge_times", "edge_lat",
+                "edge_drop", "starts", "ends", "truncated")
+
+
+def _payload() -> SimulationPayload:
+    data = yaml.safe_load(open(CAMPAIGN).read())
+    data["sim_settings"]["total_simulation_time"] = HORIZON
+    data["sim_settings"]["enabled_sample_metrics"] = []
+    # lighter traffic + denser campaign than the shipped example, so every
+    # scenario sees windows (and dark loss) inside the short horizon
+    data["rqs_input"]["avg_active_users"]["mean"] = 80
+    domains = data["hazard_model"]["domains"]
+    domains[0]["mtbf"]["mean"] = 12.0
+    domains[0]["mttr"]["mean"] = 4.0
+    domains[1]["mtbf"]["mean"] = 15.0
+    domains[1]["mttr"]["mean"] = 3.0
+    return SimulationPayload.model_validate(data)
+
+
+@pytest.fixture(scope="module")
+def payload() -> SimulationPayload:
+    return _payload()
+
+
+@pytest.fixture(scope="module")
+def fast_runner(payload) -> SweepRunner:
+    return SweepRunner(payload, engine="fast", use_mesh=False)
+
+
+def _assert_fields_equal(res_a, res_b, fields, keep=None) -> None:
+    for name in fields:
+        a, b = getattr(res_a, name), getattr(res_b, name)
+        assert (a is None) == (b is None), name
+        if a is None:
+            continue
+        a, b = np.asarray(a), np.asarray(b)
+        if keep is not None:
+            a, b = a[keep], b[keep]
+        np.testing.assert_array_equal(a, b, err_msg=name)
+
+
+# ---------------------------------------------------------------------------
+# table-level determinism
+# ---------------------------------------------------------------------------
+
+
+def test_sampled_tables_are_prefix_stable(fast_runner) -> None:
+    """fold_in keying makes the table grid prefix-stable in both the
+    scenario count and the first_scenario offset — the property resume,
+    adaptive continuation, and CRN pairing all lean on."""
+    plan = fast_runner.plan
+    whole = hazard_fault_tables(plan, SEED, 0, 6)
+    tail = hazard_fault_tables(plan, SEED, 2, 4)
+    for name in TABLE_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(whole, name))[2:],
+            np.asarray(getattr(tail, name)),
+            err_msg=name,
+        )
+    # and resampling the same range is bit-identical (pure function)
+    again = hazard_fault_tables(plan, SEED, 0, 6)
+    for name in TABLE_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(whole, name)),
+            np.asarray(getattr(again, name)),
+            err_msg=name,
+        )
+
+
+def test_scorecard_environment_identical_fast_vs_event(payload) -> None:
+    """The event engine and the scan fast path materialize the same sampled
+    environment: unavailable seconds, truncation counts, and degraded
+    windows are bit-identical (traffic counters differ by RNG family)."""
+    reports = {
+        eng: SweepRunner(payload, engine=eng, use_mesh=False).run(
+            6, seed=SEED, chunk_size=6,
+        )
+        for eng in ("fast", "event")
+    }
+    fast, event = reports["fast"].results, reports["event"].results
+    _assert_fields_equal(fast, event, ENVIRONMENT_FIELDS)
+    assert int(fast.dark_lost.sum()) > 0
+    assert int(event.dark_lost.sum()) > 0
+    assert float(fast.unavailable_s.sum()) > 0.0
+
+
+def test_oracle_consumes_the_same_sampled_tables(payload, fast_runner) -> None:
+    """The oracle heap loop's scorecard rows equal scenario row 0 of the
+    sweep grid: same tables, same einsum, bitwise."""
+    from asyncflow_tpu.engines.oracle.engine import OracleEngine
+
+    res = OracleEngine(payload, seed=SEED).run()
+    sweep = fast_runner.run(1, seed=SEED).results
+    np.testing.assert_array_equal(
+        np.asarray(res.unavailable_s),
+        np.asarray(sweep.unavailable_s)[0],
+    )
+    assert int(res.hazard_truncated) == int(sweep.hazard_truncated[0])
+    assert res.dark_lost >= 0
+
+
+# ---------------------------------------------------------------------------
+# sweep-level invariances (chunking / range splits / resume / quarantine)
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_size_invariance_includes_scorecard(fast_runner) -> None:
+    whole = fast_runner.run(8, seed=SEED, chunk_size=8)
+    chunked = fast_runner.run(8, seed=SEED, chunk_size=3)
+    _assert_fields_equal(whole.results, chunked.results,
+                         METRIC_FIELDS + ENVIRONMENT_FIELDS)
+
+
+def test_scenario_range_split_invariance(fast_runner) -> None:
+    whole = fast_runner.run(8, seed=SEED)
+    first = fast_runner.run(5, seed=SEED, first_scenario=0)
+    rest = fast_runner.run(3, seed=SEED, first_scenario=5)
+    merged = _concat_sweeps([first.results, rest.results])
+    _assert_fields_equal(whole.results, merged,
+                         METRIC_FIELDS + ENVIRONMENT_FIELDS)
+
+
+def test_kill_resume_bit_identical(fast_runner, tmp_path) -> None:
+    """A checkpointed hazard sweep SIGTERM-killed mid-run resumes to a
+    result bit-identical to an uninterrupted run — resumed chunks re-sample
+    the same windows, and the dark_lost counter survives the npz round
+    trip (chunk-schema-v8)."""
+    from asyncflow_tpu.parallel.recovery import SweepPreempted
+
+    clean = fast_runner.run(8, seed=SEED, chunk_size=2)
+    ck = tmp_path / "ck"
+    orig, calls = _SweepCheckpoint.save, {"n": 0}
+
+    def killing_save(self, start, part):
+        orig(self, start, part)
+        calls["n"] += 1
+        if calls["n"] == 2:
+            signal.raise_signal(signal.SIGTERM)
+
+    _SweepCheckpoint.save = killing_save
+    try:
+        with pytest.raises(SweepPreempted):
+            fast_runner.run(8, seed=SEED, chunk_size=2,
+                            checkpoint_dir=str(ck))
+    finally:
+        _SweepCheckpoint.save = orig
+    resumed = fast_runner.run(8, seed=SEED, chunk_size=2,
+                              checkpoint_dir=str(ck))
+    _assert_fields_equal(clean.results, resumed.results,
+                         METRIC_FIELDS + ENVIRONMENT_FIELDS)
+
+
+def test_quarantine_splice_does_not_resample(fast_runner) -> None:
+    """One NaN-producing scenario is quarantined; the surviving rows (and
+    the whole sampled environment) are bit-identical to a clean run — the
+    isolated re-run and splice slice the already-sampled tables instead of
+    drawing fresh windows."""
+    n, bad = 8, 3
+    nan_scale = np.ones(n)
+    nan_scale[bad] = np.nan
+    report = fast_runner.run(
+        n, seed=SEED, chunk_size=4,
+        overrides=make_overrides(fast_runner.plan, n,
+                                 edge_mean_scale=nan_scale),
+    )
+    assert report.quarantined_scenarios() == [bad]
+    clean = fast_runner.run(
+        n, seed=SEED, chunk_size=4,
+        overrides=make_overrides(fast_runner.plan, n,
+                                 edge_mean_scale=np.ones(n)),
+    )
+    keep = np.ones(n, bool)
+    keep[bad] = False
+    _assert_fields_equal(report.results, clean.results, METRIC_FIELDS,
+                         keep=keep)
+    # the sampled environment is independent of the traffic override and
+    # of the quarantine machinery: identical on EVERY row, masked or not
+    _assert_fields_equal(report.results, clean.results,
+                         ("unavailable_s", "hazard_truncated"))
+    # the masked row holds no traffic counters
+    assert int(report.results.dark_lost[bad]) == 0
+    assert int(report.results.completed[bad]) == 0
+
+
+# ---------------------------------------------------------------------------
+# scorecard summary gates
+# ---------------------------------------------------------------------------
+
+
+def test_summary_carries_the_scorecard(fast_runner) -> None:
+    summ = fast_runner.run(6, seed=SEED).summary()
+    assert summ["dark_lost_total"] > 0
+    assert 0.0 < summ["availability_fraction"] < 1.0
+    assert summ["unavailable_s_total"] > 0.0
+    assert summ["degraded_goodput_total"] >= 0.0
+    assert summ["hazard_truncated_total"] >= 0
+    # no gauge series streamed -> drain time is unmeasured, not fabricated
+    assert summ["time_to_drain_mean_s"] is None
+
+
+def test_plain_sweeps_report_no_scorecard(payload) -> None:
+    data = yaml.safe_load(open(CAMPAIGN).read())
+    del data["hazard_model"]
+    data["sim_settings"]["total_simulation_time"] = 10
+    data["sim_settings"]["enabled_sample_metrics"] = []
+    plain = SimulationPayload.model_validate(data)
+    report = SweepRunner(plain, engine="fast", use_mesh=False).run(
+        2, seed=SEED,
+    )
+    assert report.results.dark_lost is None
+    assert "availability_fraction" not in report.summary()

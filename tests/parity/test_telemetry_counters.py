@@ -40,6 +40,11 @@ EXPECTED_KEYS = {
     "hedges_cancelled",
     "ejections",
     "degraded",
+    # chaos-campaign scorecard counters (0 without a hazard_model or fault
+    # timeline; docs/guides/resilience.md §"Chaos campaigns")
+    "dark_lost",
+    "degraded_goodput",
+    "hazard_truncated",
 }
 
 

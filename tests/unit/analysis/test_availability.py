@@ -1,0 +1,67 @@
+"""``availability_fraction``: the chaos-campaign headline metric through
+the estimator stack — a CRN-paired compare() answers "does this buy
+availability" with an interval, and sweeps that never carried the fault
+machinery are refused by name (docs/guides/resilience.md)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import yaml
+
+from asyncflow_tpu.analysis import compare
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+CAMPAIGN = "examples/yaml_input/data/chaos_campaign.yml"
+N = 16
+
+
+@pytest.fixture(scope="module")
+def payload():
+    data = yaml.safe_load(open(CAMPAIGN).read())
+    data["sim_settings"]["total_simulation_time"] = 40
+    data["sim_settings"]["enabled_sample_metrics"] = []
+    data["rqs_input"]["avg_active_users"]["mean"] = 80
+    for dom, mtbf, mttr in zip(
+        data["hazard_model"]["domains"], (12.0, 15.0), (4.0, 3.0),
+    ):
+        dom["mtbf"]["mean"] = mtbf
+        dom["mttr"]["mean"] = mttr
+    return SimulationPayload.model_validate(data)
+
+
+def test_crn_paired_availability_compare(payload) -> None:
+    """Tripling the hazard rate (hazard_scale divides every MTBF mean)
+    must cost availability, decisively, on shared draws."""
+    rep = compare(
+        payload, None, {"hazard_scale": np.full(N, 3.0)},
+        n_scenarios=N, seed=7, use_mesh=False, n_boot=300,
+        metrics=("availability_fraction",),
+    )
+    assert rep.coupled
+    est = rep.deltas["availability_fraction"]
+    assert est.point < 0  # candidate loses availability
+    assert est.lo <= est.point <= est.hi
+    # same uniforms on both arms: per-scenario fractions strongly coupled
+    assert rep.coupling["availability_fraction"]["correlation"] > 0.5
+
+
+def test_availability_needs_the_hazard_machinery() -> None:
+    from asyncflow_tpu.runtime.runner import SimulationRunner
+
+    plain = SimulationRunner.from_yaml(
+        "tests/integration/data/single_server.yml",
+    ).simulation_input
+    with pytest.raises(ValueError, match="availability_fraction needs"):
+        compare(
+            plain, None, {"edge_mean_scale": np.full(8, 1.3)},
+            n_scenarios=8, seed=7, use_mesh=False, n_boot=100,
+            metrics=("availability_fraction",),
+        )
+
+
+def test_precision_target_accepts_the_metric() -> None:
+    from asyncflow_tpu.schemas.experiment import PrecisionTarget
+
+    t = PrecisionTarget(metric="availability_fraction", half_width=0.01)
+    assert t.metric == "availability_fraction"

@@ -31,8 +31,13 @@ def test_json_output_parses(capsys) -> None:
     assert doc["exit_code"] == 2
 
 
-def test_forced_fast_with_trace_exits_two(capsys) -> None:
+def test_forced_pallas_with_trace_exits_two(capsys) -> None:
+    # trace.fast is burned (round 12): forcing the fast path with tracing
+    # builds and exits clean; the pallas kernel still refuses (AF503)
     assert main([CLEAN, "--backend", "cpu", "--engine", "fast",
+                 "--trace"]) == 0
+    capsys.readouterr()
+    assert main([CLEAN, "--backend", "cpu", "--engine", "pallas",
                  "--trace"]) == 2
     assert "AF503" in capsys.readouterr().out
 

@@ -20,7 +20,16 @@ REPO = Path(__file__).resolve().parents[3]
 YAML_DIR = REPO / "examples" / "yaml_input" / "data"
 SWEEPS_DIR = REPO / "examples" / "sweeps"
 
-YAML_EXAMPLES = sorted(YAML_DIR.glob("*.yml"))
+#: deliberately pathological examples, asserted FLAGGED below instead of
+#: clean: the resilient trace-parity fixture keeps its only server dark
+#: for the whole horizon so the divergence CLI can exercise the full
+#: reject -> retry -> abandon lifecycle (round 12) — exactly the AF303
+#: zero-goodput regime the checker must call
+DELIBERATE = {"trace_parity_resilient"}
+
+YAML_EXAMPLES = sorted(
+    p for p in YAML_DIR.glob("*.yml") if p.stem not in DELIBERATE
+)
 
 
 def _sweep_module(name: str):
@@ -67,6 +76,17 @@ BASELINE_BUILDERS = [
 def test_sweep_example_baselines_are_clean(module, build) -> None:
     mod = _sweep_module(module)
     _assert_clean(build(mod), module)
+
+
+def test_resilient_trace_fixture_is_flagged() -> None:
+    """The full-horizon outage in the resilient trace-parity example is
+    intentional (see DELIBERATE) — the checker must refuse it by name."""
+    payload = SimulationPayload.model_validate(yaml.safe_load(
+        (YAML_DIR / "trace_parity_resilient.yml").read_text(),
+    ))
+    report = check_payload(payload, backend="cpu")
+    assert "AF303" in report.codes()
+    assert report.exit_code == 2
 
 
 def test_db_pool_collapse_arm_is_flagged() -> None:

@@ -51,6 +51,7 @@ def test_registry_covers_every_known_fence() -> None:
         "vr.pallas", "vr.native",
         "resilience.pallas", "resilience.native",
         "tail_tolerance.pallas", "tail_tolerance.native",
+        "hazard.pallas", "hazard.native",
         "fastpath.ineligible", "fastpath.poisson_edge",
         "native.unavailable",
         "gauge_series.pallas", "gauge_series.native",

@@ -1,0 +1,120 @@
+"""AF601-AF604 chaos-campaign sanity: the semantic traps that validate
+fine (targets exist) but make a campaign meaningless must be refused by
+name, and the CLI exit codes on the shipped fixtures are the contract the
+CI hazard slice pins (docs/guides/resilience.md, "Chaos campaigns")."""
+
+from __future__ import annotations
+
+import yaml
+
+from asyncflow_tpu.checker.__main__ import main
+from asyncflow_tpu.checker.passes import check_payload, hazard_pass
+from asyncflow_tpu.schemas.payload import SimulationPayload
+from asyncflow_tpu.schemas.resilience import FailureDomain, HazardModel
+
+CAMPAIGN = "examples/yaml_input/data/chaos_campaign.yml"
+ZERO_AVAILABILITY = "tests/integration/data/zero_availability.yml"
+
+
+def _load(path: str, mut=None) -> SimulationPayload:
+    data = yaml.safe_load(open(path).read())
+    if mut:
+        mut(data)
+    return SimulationPayload.model_validate(data)
+
+
+def _hazard_codes(payload) -> dict[str, str]:
+    out: list = []
+    hazard_pass(payload, out)
+    return {d.code: d.severity.value for d in out}
+
+
+# ---------------------------------------------------------------------------
+# pass-level findings
+# ---------------------------------------------------------------------------
+
+
+def test_shipped_campaign_raises_no_hazard_findings() -> None:
+    assert _hazard_codes(_load(CAMPAIGN)) == {}
+
+
+def test_payloads_without_hazard_model_are_ignored() -> None:
+    def drop(data):
+        del data["hazard_model"]
+
+    assert _hazard_codes(_load(CAMPAIGN, drop)) == {}
+
+
+def test_af601_unknown_target_is_an_error() -> None:
+    # pydantic refuses unknown targets at validation, so reach the pass the
+    # way a hand-constructed payload would: splice an unvalidated domain in
+    payload = _load(CAMPAIGN)
+    ghost = FailureDomain.model_construct(
+        domain_id="ghost", targets=["srv-9"],
+        mtbf=payload.hazard_model.domains[0].mtbf,
+        mttr=payload.hazard_model.domains[0].mttr,
+        latency_factor=1.0, dropout_boost=0.0,
+    )
+    hacked = payload.model_copy(update={
+        "hazard_model": HazardModel.model_construct(
+            domains=[ghost], max_faults_per_component=4,
+        ),
+    })
+    assert _hazard_codes(hacked) == {"AF601": "error"}
+
+
+def test_af602_blast_group_covering_the_tier_is_an_error() -> None:
+    codes = _hazard_codes(_load(ZERO_AVAILABILITY))
+    assert codes["AF602"] == "error"
+
+
+def test_af602_spares_domains_leaving_a_replica_outside() -> None:
+    # the shipped campaign's rack-a domain darkens srv-1 only: srv-2 stays
+    # outside the correlated domain, so the same pass stays silent
+    def widen(data):
+        data["hazard_model"]["domains"][0]["targets"] = ["srv-1"]
+
+    assert "AF602" not in _hazard_codes(_load(ZERO_AVAILABILITY, widen))
+
+
+def test_af603_mttr_spanning_the_horizon_is_an_error() -> None:
+    def slow_repair(data):
+        data["hazard_model"]["domains"][0]["mttr"]["mean"] = 900.0
+
+    codes = _hazard_codes(_load(CAMPAIGN, slow_repair))
+    assert codes.get("AF603") == "error"
+
+
+def test_af604_truncation_likely_is_a_warning() -> None:
+    # horizon 600 / (mtbf 30 + mttr 10) = 15 expected cycles >> 4 slots
+    def dense(data):
+        dom = data["hazard_model"]["domains"][0]
+        dom["mtbf"]["mean"] = 30.0
+        dom["mttr"] = {"mean": 10.0, "distribution": "exponential"}
+
+    codes = _hazard_codes(_load(CAMPAIGN, dense))
+    assert codes.get("AF604") == "warning"
+
+
+def test_check_payload_runs_the_hazard_pass() -> None:
+    report = check_payload(_load(ZERO_AVAILABILITY), backend="cpu")
+    found = {d.code for d in report.diagnostics}
+    assert "AF602" in found
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes on the shipped fixtures (mirrors the CI hazard slice)
+# ---------------------------------------------------------------------------
+
+
+def test_cli_blesses_the_shipped_campaign(capsys) -> None:
+    assert main([CAMPAIGN, "--backend", "cpu"]) == 0
+    out = capsys.readouterr().out
+    # the hazard fences must be on record as INFO, not refusals
+    assert "hazard.pallas" in out
+    assert "hazard.native" in out
+
+
+def test_cli_rejects_the_zero_availability_fixture(capsys) -> None:
+    assert main([ZERO_AVAILABILITY, "--backend", "cpu"]) == 2
+    assert "AF602" in capsys.readouterr().out
