@@ -407,6 +407,20 @@ def _ratio_components(results, metric: str) -> tuple[np.ndarray, np.ndarray]:
             raise ValueError(msg)
         dark = np.asarray(results.dark_lost, np.float64)
         return completed, np.maximum(completed + dark, 1e-300)
+    if metric == "tokens_per_s":
+        # generated tokens over simulated seconds: the serving throughput
+        # headline (docs/guides/serving.md); the denominator is the fixed
+        # horizon per scenario so the ratio-of-sums pools correctly
+        if getattr(results, "decode_tokens", None) is None:
+            msg = (
+                "tokens_per_s needs a sweep whose plan carries llm_serve "
+                "steps (results.decode_tokens is None): add an llm_serve "
+                "step and a serving policy to the payload"
+            )
+            raise ValueError(msg)
+        decode = np.asarray(results.decode_tokens, np.float64)
+        horizon = max(float(results.settings.total_simulation_time), 1e-300)
+        return decode, np.full_like(decode, horizon)
     msg = f"unknown ratio metric {metric!r}"
     raise ValueError(msg)
 

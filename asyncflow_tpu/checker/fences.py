@@ -166,6 +166,45 @@ FENCES: dict[str, Fence] = {
                 "plans to the event engine)"
             ),
         ),
+        # -- LLM serving plans (llm_serve batch/KV dynamics) ----------------
+        # event-only initially: the continuous-batching admission gate and
+        # KV eviction lifecycle run on the oracle and the XLA event engine;
+        # AF501 prices the routing gap for the other engines.
+        Fence(
+            id="llm.fastpath",
+            feature="LLM serving (llm_serve batch/KV dynamics)",
+            engine="fast",
+            message=(
+                "the closed-form fast path cannot model LLM serving "
+                "(continuous-batching admission and KV eviction are "
+                "event-driven); use engine='event' (or 'auto', which "
+                "routes serving plans to the event engine)"
+            ),
+        ),
+        Fence(
+            id="llm.pallas",
+            feature="LLM serving (llm_serve batch/KV dynamics)",
+            engine="pallas",
+            message=(
+                "engine='pallas' does not model LLM serving (the "
+                "continuous-batching gate and KV eviction lifecycle ride "
+                "per-server FIFO state the VMEM kernel does not carry); "
+                "use engine='event' (or 'auto', which routes serving "
+                "plans to the event engine)"
+            ),
+        ),
+        Fence(
+            id="llm.native",
+            feature="LLM serving (llm_serve batch/KV dynamics)",
+            engine="native",
+            message=(
+                "engine='native' does not model LLM serving (the "
+                "continuous-batching gate and KV eviction lifecycle are "
+                "not wired through the native core's C ABI); use "
+                "engine='event' (or 'auto', which routes serving plans "
+                "to the event engine)"
+            ),
+        ),
         # -- fast-path eligibility -----------------------------------------
         Fence(
             id="fastpath.ineligible",
@@ -322,7 +361,15 @@ def tripped_fences(
             _trip("tail_tolerance.pallas"),
             _trip("tail_tolerance.native"),
         ]
-    if not plan.fastpath_ok:
+    if getattr(plan, "has_serving", False):
+        # the llm.fastpath trip subsumes the generic ineligibility reason
+        # (fastpath_reason cites the serving dynamics for these plans)
+        out += [
+            _trip("llm.fastpath"),
+            _trip("llm.pallas"),
+            _trip("llm.native"),
+        ]
+    elif not plan.fastpath_ok:
         out.append(_trip("fastpath.ineligible", detail=plan.fastpath_reason))
     return tuple(out)
 
@@ -365,6 +412,7 @@ def predict_routing(
     vr_coupled = crn or antithetic
     tail = getattr(plan, "has_tail_tolerance", False)
     hazards = getattr(plan, "has_hazards", False)
+    serving = getattr(plan, "has_serving", False)
     resilient = plan.has_faults or plan.has_retry or tail or hazards
     fences = tripped_fences(
         plan,
@@ -397,6 +445,10 @@ def predict_routing(
         return refused(f"hazard.{engine}")
     if tail and engine in ("pallas", "native"):
         return refused(f"tail_tolerance.{engine}")
+    if serving and engine in ("pallas", "native"):
+        return refused(f"llm.{engine}")
+    if engine == "fast" and serving:
+        return refused("llm.fastpath")
     if engine == "fast" and not plan.fastpath_ok:
         return refused("fastpath.ineligible", detail=plan.fastpath_reason)
     if engine == "native":
@@ -422,6 +474,7 @@ def predict_routing(
             and not vr_coupled
             and not trace
             and not gauge_series
+            and not serving
         ):
             kind = "pallas"
             why = "TPU backend, no resilience/VR/trace/gauge-series fences tripped"

@@ -638,6 +638,96 @@ def hazard_pass(payload, out: list[Diagnostic]) -> None:
             ))
 
 
+def serving_pass(payload, out: list[Diagnostic]) -> None:
+    """AF701-AF703: LLM serving sanity (docs/guides/serving.md).
+
+    The schema validator only checks that a serving policy EXISTS next to
+    ``llm_serve`` steps; the semantic traps — a token budget too small for
+    even one typical request (deterministic eviction livelock), a budget
+    the p99 prompt can never be admitted under (head-of-line starvation),
+    a replay trace extending past the horizon — validate fine and are
+    refused here by name, before a sweep burns compute thrashing the KV
+    gate.  The budget collapse mirrors the compiler's
+    (``min(max_batch_tokens, kv_cache_mb / kv_mb_per_token)``).
+    """
+    for srv in payload.topology_graph.nodes.servers:
+        pol = getattr(srv, "serving", None)
+        if pol is None:
+            continue
+        steps = [
+            (ei, st)
+            for ei, ep in enumerate(srv.endpoints)
+            for st in ep.steps
+            if getattr(st, "is_serving", False)
+        ]
+        budget = float("inf")
+        if pol.max_batch_tokens is not None:
+            budget = float(pol.max_batch_tokens)
+        if pol.kv_cache_mb is not None:
+            kv_max = max(
+                (float(st.kv_mb_per_token) for _, st in steps), default=0.0,
+            )
+            if kv_max > 0:
+                budget = min(budget, float(pol.kv_cache_mb) / kv_max)
+        for ei, st in steps:
+            path = (
+                f"servers[{srv.id}].endpoints[{ei}] (llm_serve) "
+                f"vs servers[{srv.id}].serving"
+            )
+            footprint = float(st.input_tokens.mean) + float(
+                st.output_tokens.mean,
+            )
+            if budget < footprint:
+                out.append(Diagnostic(
+                    code="AF701", severity=Severity.ERROR,
+                    message=f"server {srv.id!r}: serving token budget "
+                    f"{budget:g} cannot hold even one typical request "
+                    f"(mean prompt {st.input_tokens.mean:g} + mean "
+                    f"generation {st.output_tokens.mean:g} = {footprint:g} "
+                    "resident tokens) — every decode extension evicts, so "
+                    "requests thrash prefill->evict until max_evictions "
+                    "rejects them: a deterministic livelock, not a "
+                    "capacity measurement",
+                    path=path,
+                    remedy="raise max_batch_tokens / kv_cache_mb past the "
+                    "mean request footprint (or shorten the workload's "
+                    "token distributions)",
+                ))
+                continue  # AF702 is strictly weaker; don't double-report
+            p99_in = float(st.input_tokens.p99)
+            if budget < p99_in:
+                out.append(Diagnostic(
+                    code="AF702", severity=Severity.WARNING,
+                    message=f"server {srv.id!r}: serving token budget "
+                    f"{budget:g} < the ~p99 prompt length {p99_in:g}: "
+                    "long requests can never be admitted and park at the "
+                    "head of the FIFO, starving everything queued behind "
+                    "them",
+                    path=path,
+                    remedy="raise the token budget past "
+                    "input_tokens.mean + 2.326*sigma, or cap prompt "
+                    "lengths upstream",
+                ))
+    gens = payload.generators
+    replay = getattr(gens[0], "replay", None) if len(gens) == 1 else None
+    if replay is not None:
+        horizon = float(payload.sim_settings.total_simulation_time)
+        t_max = float(replay.times[-1])
+        if t_max >= horizon:
+            n_lost = sum(1 for t in replay.times if t >= horizon)
+            out.append(Diagnostic(
+                code="AF703", severity=Severity.WARNING,
+                message=f"replay trace extends past the horizon: last "
+                f"arrival at {t_max:g}s >= "
+                f"total_simulation_time {horizon:g}s, so the final "
+                f"{n_lost} of {len(replay.times)} logged requests never "
+                "replay and the run underestimates the trace's load",
+                path="rqs_input.replay.times",
+                remedy="lengthen sim_settings.total_simulation_time past "
+                "the last arrival (plus drain time), or trim the trace",
+            ))
+
+
 def _bench_engine_rates() -> tuple[str, dict[str, float]] | None:
     """(bench name, {engine: scenarios/sec}) from the newest BENCH_r*.json
     at the repo root — the data source for the fence burn-down speedup
@@ -823,6 +913,7 @@ def check_payload(
     time_pass(payload, out)
     resource_pass(payload, plan, out)
     hazard_pass(payload, out)
+    serving_pass(payload, out)
     if plan is not None:
         routing_pass(
             payload, plan, out,

@@ -64,6 +64,17 @@ SEG_CACHE = 4
 # the request accrues tokens * cost_per_token.  Modeled by the oracle,
 # native, and event engines; the fast path declines with a named reason.
 SEG_LLM = 5
+# an llm_serve step (serving subsystem, asyncflow_tpu/serving): lowered to
+# a PREFILL/DECODE segment PAIR.  Prefill runs after continuous-batching
+# admission (single FIFO gated on the server's batch slot + resident-token
+# budgets) and sleeps base + input_tokens * time_per_token, holding
+# input_tokens KV tokens; decode extends the KV hold by output_tokens and
+# sleeps output_tokens / rate, or EVICTS when the extension does not fit
+# (KV freed, prefill redone from the FIFO tail, counted in kv_evictions).
+# Modeled by the oracle and event engines; fast path/pallas/native decline
+# behind the llm.* fences.
+SEG_PREFILL = 6
+SEG_DECODE = 7
 
 # Multi-burst relaxation envelope: nominal per-server core utilization above
 # which the fast path's fixed-point relaxation is measurably biased vs the
@@ -89,9 +100,15 @@ def _compile_endpoint(
     endpoint: Endpoint,
     *,
     db_pooled: bool = False,
-) -> tuple[list[tuple[int, float]], float, list[tuple[float, float] | None]]:
+) -> tuple[
+    list[tuple[int, float]],
+    float,
+    list[tuple[float, float] | None],
+    list[tuple[float, float, float] | None],
+    list[tuple[float, ...] | None],
+]:
     """Merge step runs into alternating (kind, duration) segments + RAM total
-    + per-segment cache mixture params.
+    + per-segment cache mixture / llm / serving params.
 
     With ``db_pooled``, each ``io_db`` step lowers to its own
     :data:`SEG_DB` segment — adjacent io_db steps must NOT merge, because
@@ -104,12 +121,42 @@ def _compile_endpoint(
     :data:`SEG_CACHE` segments carrying ``(hit_probability, miss_time)``
     in the returned ``cache`` list (aligned with the segments; None for
     deterministic segments); the segment duration is the HIT latency.
+
+    ``llm_serve`` steps lower to a :data:`SEG_PREFILL` + :data:`SEG_DECODE`
+    segment PAIR whose durations are the expected phase times; BOTH rows
+    carry the same 10-tuple of serving params in the returned ``sv`` list
+    (tin mean/var, tout mean/var, prefill s/token, prefill base, decode
+    rate mean/var, kv MB/token, cost/token) so either segment row resolves
+    the step's full dynamics.
     """
     segments: list[tuple[int, float]] = []
     cache: list[tuple[float, float] | None] = []
     llm: list[tuple[float, float, float] | None] = []
+    sv: list[tuple[float, ...] | None] = []
     total_ram = 0.0
     for step in endpoint.steps:
+        if getattr(step, "is_serving", False):
+            params = (
+                float(step.input_tokens.mean),
+                float(step.input_tokens.variance),
+                float(step.output_tokens.mean),
+                float(step.output_tokens.variance),
+                float(step.prefill_time_per_token_s),
+                float(step.prefill_base_s),
+                float(step.decode_tokens_per_s.mean),
+                float(step.decode_tokens_per_s.variance),
+                float(step.kv_mb_per_token),
+                float(step.cost_per_token),
+            )
+            segments.append((SEG_PREFILL, step.expected_prefill_s))
+            cache.append(None)
+            llm.append(None)
+            sv.append(params)
+            segments.append((SEG_DECODE, step.expected_decode_s))
+            cache.append(None)
+            llm.append(None)
+            sv.append(params)
+            continue
         if step.is_ram:
             total_ram += step.quantity
             continue
@@ -126,7 +173,7 @@ def _compile_endpoint(
         if (
             segments
             and segments[-1][0] == kind
-            and kind not in (SEG_DB, SEG_CACHE, SEG_LLM)
+            and kind not in (SEG_DB, SEG_CACHE, SEG_LLM, SEG_PREFILL, SEG_DECODE)
         ):
             segments[-1] = (kind, segments[-1][1] + step.quantity)
         else:
@@ -145,7 +192,8 @@ def _compile_endpoint(
                 if kind == SEG_LLM
                 else None,
             )
-    return segments, total_ram, cache, llm
+            sv.append(None)
+    return segments, total_ram, cache, llm, sv
 
 
 # fastpath cache-placement sentinels (fp_cache_slot values < 0):
@@ -378,6 +426,14 @@ class StaticPlan:
         for name in ("server_brownout_cpu", "server_brownout_ram"):
             if not getattr(self, name).size:
                 setattr(self, name, np.ones(self.n_servers, np.float32))
+        # serving budgets: hand-built / legacy plans get explicit
+        # "-1 = unlimited" vectors like every other per-server control
+        if not self.serve_tokens.size:
+            self.serve_tokens = np.full(self.n_servers, -1.0, np.float32)
+        if not self.serve_slots.size:
+            self.serve_slots = np.full(self.n_servers, -1, np.int32)
+        if not self.serve_evict_max.size:
+            self.serve_evict_max = np.full(self.n_servers, 3, np.int32)
         if not self.server_rate_burst.size:
             self.server_rate_burst = np.zeros(self.n_servers, np.int32)
         # hand-built plans: identity fault tables at the plan's own widths
@@ -522,6 +578,65 @@ class StaticPlan:
         default_factory=lambda: np.empty((0, 0, 0), np.float32),
     )
 
+    #: serving subsystem (asyncflow_tpu/serving): SEG_PREFILL/SEG_DECODE
+    #: per-segment dynamics, duplicated on both rows of each pair.
+    #: (NS, NEP, NSEG+1) f32 each; empty (0,0,0) when no llm_serve step.
+    sv_tin_mean: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0, 0), np.float32),
+    )
+    sv_tin_var: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0, 0), np.float32),
+    )
+    sv_tout_mean: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0, 0), np.float32),
+    )
+    sv_tout_var: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0, 0), np.float32),
+    )
+    sv_prefill_tpt: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0, 0), np.float32),
+    )
+    sv_prefill_base: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0, 0), np.float32),
+    )
+    sv_rate_mean: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0, 0), np.float32),
+    )
+    sv_rate_var: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0, 0), np.float32),
+    )
+    sv_kv_mb: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0, 0), np.float32),
+    )
+    sv_cost: np.ndarray = field(
+        default_factory=lambda: np.empty((0, 0, 0), np.float32),
+    )
+    #: per-server continuous-batching budgets (ServingPolicy collapsed):
+    #: resident-token budget = min(max_batch_tokens, kv_cache_mb / max
+    #: kv_mb_per_token over serving steps); -1 = unlimited.
+    serve_tokens: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.float32),
+    )
+    #: (NS,) i32 concurrent-request batch slots; -1 = unlimited.
+    serve_slots: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.int32),
+    )
+    #: (NS,) i32 evictions tolerated per request before terminal reject.
+    serve_evict_max: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.int32),
+    )
+    #: trace-replay arrival table (serving/trace_replay): (R,) f64 sorted
+    #: spawn times; (R,) f32 per-request token presets (-1 = draw).
+    replay_times: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.float64),
+    )
+    replay_tok_in: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.float32),
+    )
+    replay_tok_out: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.float32),
+    )
+
     #: resilience fault tables (compiler/faults.py): piecewise-constant
     #: breakpoints with a leading identity row at t = 0.  (K,) change
     #: times + (K, NS) outage flags; (M,) change times + (M, NE)
@@ -633,6 +748,16 @@ class StaticPlan:
     def has_stochastic_cache(self) -> bool:
         """True when any segment is a cache hit/miss mixture."""
         return bool(self.seg_hit_prob.size and np.any(self.seg_hit_prob > 0))
+
+    @property
+    def has_serving(self) -> bool:
+        """True when any segment is an LLM serving prefill/decode pair."""
+        return bool(np.any(self.seg_kind == SEG_PREFILL))
+
+    @property
+    def has_replay(self) -> bool:
+        """True when a trace-replay arrival table replaces the generator."""
+        return bool(self.replay_times.size)
 
     @property
     def has_rate_limit(self) -> bool:
@@ -900,17 +1025,26 @@ def _estimate_capacity(payload: SimulationPayload) -> tuple[int, int]:
         io_req = 0.0
         ram_req = 0.0
         for endpoint in server.endpoints:
-            segs, ram, cache, llm = _compile_endpoint(endpoint)
+            segs, ram, cache, llm, sv = _compile_endpoint(endpoint)
             # capacity bounds use the worst-case duration of stochastic
             # segments — cache: the miss latency; llm: a 6-sigma token
-            # draw — relabeled SEG_IO so they enter the io/residence sums
-            # below (both are IO sleeps)
+            # draw; serving: a 6-sigma prompt/sequence draw — relabeled
+            # SEG_IO so they enter the io/residence sums below (all are
+            # IO sleeps)
             def _worst_seg(i: int, k: int, d: float) -> tuple[int, float]:
                 if cache[i] is not None:
                     return (SEG_IO, max(d, cache[i][1]))
                 if llm[i] is not None:
                     m, tpt, _ = llm[i]
                     return (SEG_IO, d + (m + 6.0 * math.sqrt(max(m, 1.0))) * tpt)
+                if sv[i] is not None:
+                    tin_m, tin_v, tout_m, tout_v, tpt, base, rate_m, rate_v, _, _ = sv[i]
+                    if k == SEG_PREFILL:
+                        tin = tin_m + 6.0 * math.sqrt(tin_v)
+                        return (SEG_IO, base + tin * tpt)
+                    tout = tout_m + 6.0 * math.sqrt(tout_v)
+                    rate = max(rate_m - 6.0 * math.sqrt(rate_v), 0.1 * rate_m)
+                    return (SEG_IO, tout / rate)
                 return (k, d)
 
             segs = [_worst_seg(i, k, d) for i, (k, d) in enumerate(segs)]
@@ -1186,7 +1320,9 @@ def _compile_payload(
 
         def _worst(step) -> float:
             # worst-case duration: stochastic cache steps may sleep the
-            # miss latency; llm steps a 6-sigma token draw
+            # miss latency; llm/serving steps a 6-sigma token draw
+            if getattr(step, "is_serving", False):
+                return step.worst_duration
             if step.is_stochastic_cache:
                 return max(float(step.quantity), float(step.cache_miss_time))
             if step.is_llm:
@@ -1330,7 +1466,7 @@ def _compile_payload(
             queue_timeout_model[s_i] = deadline
 
     compiled: list[
-        list[tuple[list[tuple[int, float]], float, list]]
+        list[tuple[list[tuple[int, float]], float, list, list, list]]
     ] = [
         [
             _compile_endpoint(ep, db_pooled=db_model[s])
@@ -1370,6 +1506,42 @@ def _compile_payload(
     seg_llm_cost = np.zeros(
         (n_servers, max_endpoints, max_segments + 1), dtype=np.float32,
     )
+    # SEG_PREFILL/SEG_DECODE serving dynamics (empty unless some endpoint
+    # carries an llm_serve step — the engines statically prune on that)
+    any_serving = any(
+        sv_p is not None
+        for per_server in compiled
+        for *_, sv_list in per_server
+        for sv_p in sv_list
+    )
+    sv_shape = (n_servers, max_endpoints, max_segments + 1) if any_serving else (0, 0, 0)
+    sv_tables = {
+        name: np.zeros(sv_shape, dtype=np.float32)
+        for name in (
+            "sv_tin_mean",
+            "sv_tin_var",
+            "sv_tout_mean",
+            "sv_tout_var",
+            "sv_prefill_tpt",
+            "sv_prefill_base",
+            "sv_rate_mean",
+            "sv_rate_var",
+            "sv_kv_mb",
+            "sv_cost",
+        )
+    }
+    _SV_ORDER = (
+        "sv_tin_mean",
+        "sv_tin_var",
+        "sv_tout_mean",
+        "sv_tout_var",
+        "sv_prefill_tpt",
+        "sv_prefill_base",
+        "sv_rate_mean",
+        "sv_rate_var",
+        "sv_kv_mb",
+        "sv_cost",
+    )
     endpoint_ram = np.zeros((n_servers, max_endpoints), dtype=np.float32)
     # cumulative endpoint-selection probabilities (selection_weight; the
     # uniform default lowers to the same evenly-spaced table the
@@ -1398,7 +1570,7 @@ def _compile_payload(
     endpoint_post_io = np.zeros((n_servers, max_endpoints), dtype=np.float32)
     for s, per_server in enumerate(compiled):
         n_endpoints[s] = len(per_server)
-        for e, (segs, ram, cache, llm) in enumerate(per_server):
+        for e, (segs, ram, cache, llm, sv) in enumerate(per_server):
             endpoint_ram[s, e] = ram
             for k, (seg_k, dur) in enumerate(segs):
                 seg_kind[s, e, k] = seg_k
@@ -1410,6 +1582,9 @@ def _compile_payload(
                     seg_llm_tokens[s, e, k] = llm[k][0]
                     seg_llm_tpt[s, e, k] = llm[k][1]
                     seg_llm_cost[s, e, k] = llm[k][2]
+                if sv[k] is not None:
+                    for name, value in zip(_SV_ORDER, sv[k]):
+                        sv_tables[name][s, e, k] = value
             dur_list, pre_list, post = bursts[s][e]
             n_bursts[s, e] = len(dur_list)
             burst_dur[s, e, : len(dur_list)] = dur_list
@@ -1420,7 +1595,7 @@ def _compile_payload(
     # + cache-mixture placements (zero-filled where the endpoint has none;
     # _fastpath_analysis declines the shapes _fastpath_lowering rejects)
     fp_lowered = [
-        [_fastpath_lowering(segs, cache) for segs, _, cache, _ in per_server]
+        [_fastpath_lowering(segs, cache) for segs, _, cache, *_ in per_server]
         for per_server in compiled
     ]
     cmax = max(
@@ -1448,6 +1623,54 @@ def _compile_payload(
                 fp_cache_slot[s, e, j] = slot
                 fp_cache_miss_prob[s, e, j] = miss_p
                 fp_cache_extra[s, e, j] = extra
+
+    # ---- serving budgets: ServingPolicy collapsed to per-server scalars.
+    # The resident-token budget IS the KV-cache container: min of the
+    # explicit batch-token cap and kv_cache_mb / (max kv_mb_per_token over
+    # the server's serving steps); -1 = unlimited.
+    serve_tokens = np.full(n_servers, -1.0, dtype=np.float32)
+    serve_slots = np.full(n_servers, -1, dtype=np.int32)
+    serve_evict_max = np.full(n_servers, 3, dtype=np.int32)
+    for s_i, server in enumerate(servers):
+        pol = getattr(server, "serving", None)
+        if pol is None:
+            continue
+        budget = math.inf
+        if pol.max_batch_tokens is not None:
+            budget = float(pol.max_batch_tokens)
+        if pol.kv_cache_mb is not None:
+            kv_max = max(
+                (
+                    float(st.kv_mb_per_token)
+                    for ep in server.endpoints
+                    for st in ep.steps
+                    if getattr(st, "is_serving", False)
+                ),
+                default=0.0,
+            )
+            if kv_max > 0:
+                budget = min(budget, float(pol.kv_cache_mb) / kv_max)
+        if budget < math.inf:
+            serve_tokens[s_i] = budget
+        if pol.max_batch_requests is not None:
+            serve_slots[s_i] = int(pol.max_batch_requests)
+        serve_evict_max[s_i] = int(pol.max_evictions)
+
+    # ---- trace-replay arrival table (single generator by schema contract)
+    replay = generators[0].replay if len(generators) == 1 else None
+    if replay is not None:
+        replay_times = np.asarray(replay.times, dtype=np.float64)
+        n_replay = len(replay.times)
+        replay_tok_in = (
+            np.asarray(replay.input_tokens, dtype=np.float32)
+            if replay.input_tokens is not None
+            else np.full(n_replay, -1.0, dtype=np.float32)
+        )
+        replay_tok_out = (
+            np.asarray(replay.output_tokens, dtype=np.float32)
+            if replay.output_tokens is not None
+            else np.full(n_replay, -1.0, dtype=np.float32)
+        )
 
     server_cores = np.array(
         [server.server_resources.cpu_cores for server in servers],
@@ -1596,12 +1819,22 @@ def _compile_payload(
 
     # ---- capacities ----
     max_requests, pool_estimate = _estimate_capacity(payload)
+    if replay is not None:
+        # a replayed scenario must reproduce the log's arrival count
+        # exactly — never let the stochastic capacity model under-bound it
+        max_requests = max(max_requests, len(replay.times) + 64)
     pool = pool_size or pool_estimate
     events_per_request = (
         2 * (len(entry_edges) + 2)  # spawn + entry hops + lb + exits
         + 3 * (max_segments + 1)  # segment starts/ends + grants
         + 4
     )
+    if any_serving:
+        # eviction headroom: each tolerated eviction replays the pair's
+        # segments plus park/grant/release bookkeeping (formula unchanged
+        # for non-serving plans)
+        evict_amp = int(serve_evict_max.max()) + 1
+        events_per_request += evict_amp * (3 * (max_segments + 1) + 4)
     max_iterations = max_requests * events_per_request + len(outages) + 1024
 
     horizon = float(settings.total_simulation_time)
@@ -1740,6 +1973,25 @@ def _compile_payload(
         seg_llm_tokens=seg_llm_tokens,
         seg_llm_tpt=seg_llm_tpt,
         seg_llm_cost=seg_llm_cost,
+        **(
+            {
+                **sv_tables,
+                "serve_tokens": serve_tokens,
+                "serve_slots": serve_slots,
+                "serve_evict_max": serve_evict_max,
+            }
+            if any_serving
+            else {}
+        ),
+        **(
+            {
+                "replay_times": replay_times,
+                "replay_tok_in": replay_tok_in,
+                "replay_tok_out": replay_tok_out,
+            }
+            if replay is not None
+            else {}
+        ),
         fp_db_pre=fp_db_pre,
         fp_db_dur=fp_db_dur,
         fp_db_post=fp_db_post,
@@ -1867,6 +2119,32 @@ def _fastpath_analysis(
     servers = payload.topology_graph.nodes.servers
     n_servers = len(servers)
     no_slots = np.empty(0, np.int32)
+
+    # LLM serving is event-engine work: continuous-batching admission is
+    # a stateful two-resource FIFO and KV eviction re-queues requests mid
+    # endpoint — neither fits the closed-form per-station recursions
+    # (the llm.fastpath fence names this gap; AF501 prices it).
+    if any(getattr(s, "serving", None) is not None for s in servers):
+        return (
+            False,
+            "llm serving endpoints: continuous-batching admission and KV "
+            "eviction are stateful event dynamics (modeled on the event "
+            "engines; see the llm.fastpath fence)",
+            [],
+            no_slots,
+            0,
+            0.0,
+        )
+    if any(g.replay is not None for g in payload.generators):
+        return (
+            False,
+            "trace-replay arrival table: the fast path synthesizes its "
+            "own window-Poisson arrivals (modeled on the event engines)",
+            [],
+            no_slots,
+            0,
+            0.0,
+        )
 
     # Resilience plans run on the fast path (round 8 fence burn-down):
     # fault windows lower to piecewise per-lane latency/dropout modulation
@@ -2135,6 +2413,21 @@ def _fastpath_analysis(
                 0,
                 0.0,
             )
+        if any(
+            k in (SEG_PREFILL, SEG_DECODE)
+            for segs, *_ in compiled[s]
+            for k, _ in segs
+        ):
+            return (
+                False,
+                f"server {server.id}: LLM serving batch dynamics "
+                "(continuous batching and KV eviction modeled on the "
+                "event engines)",
+                [],
+                no_slots,
+                0,
+                0.0,
+            )
         if fp_lowered is not None:
             for e, (_, _, reason) in enumerate(fp_lowered[s]):
                 if reason:
@@ -2163,7 +2456,7 @@ def _fastpath_analysis(
         db_dur_max = 0.0
         visits = 1
         needs: set[float] = set()
-        for segs, ram, cache, _llm in compiled[s]:
+        for segs, ram, cache, *_rest in compiled[s]:
             max_ram = max(max_ram, ram)
             if ram > 0:
                 needs.add(ram)

@@ -41,9 +41,11 @@ from asyncflow_tpu.compiler.plan import (
     SEG_CACHE,
     SEG_CPU,
     SEG_DB,
+    SEG_DECODE,
     SEG_LLM,
     SEG_END,
     SEG_IO,
+    SEG_PREFILL,
     TARGET_CLIENT,
     TARGET_LB,
     TARGET_SERVER,
@@ -76,8 +78,11 @@ from asyncflow_tpu.observability.simtrace import (
     FR_ARRIVE_SRV,
     FR_CANCEL,
     FR_COMPLETE,
+    FR_DECODE,
     FR_DROP,
+    FR_EVICT,
     FR_HEDGE,
+    FR_PREFILL,
     FR_REJECT,
     FR_RETRY,
     FR_RUN,
@@ -108,9 +113,11 @@ from asyncflow_tpu.engines.jaxsim.params import (
     EV_RESUME,
     EV_RETRY,
     EV_SEG_END,
+    EV_SV_GRANT,
     EV_WAIT_CPU,
     EV_WAIT_DB,
     EV_WAIT_RAM,
+    EV_WAIT_SV,
     INF,
     NO_TICKET,
     EngineState,
@@ -219,7 +226,13 @@ class Engine:
         self._has_cache = bool(np.any(plan.seg_kind == SEG_CACHE))
         self._has_shed = plan.has_queue_cap
         self._has_conn = plan.has_conn_cap
-        self._has_llm = plan.has_llm
+        # serving decode cost rides the same per-request accumulator as
+        # SEG_LLM token cost, so serving plans compile the llm machinery in
+        self._has_llm = plan.has_llm or plan.has_serving
+        # LLM serving batch gate (SEG_PREFILL/SEG_DECODE pairs) and the
+        # trace-replay arrival table, each statically pruned when absent
+        self._has_serving = plan.has_serving
+        self._has_replay = plan.has_replay
         self._has_rl = plan.has_rate_limit
         self._has_timeout = plan.has_queue_timeout
         self._has_breaker = plan.breaker_threshold > 0
@@ -676,6 +689,14 @@ class Engine:
             )
         if self._has_llm:
             st = st._replace(req_llm=st.req_llm.at[idx].set(0.0, mode="drop"))
+        if self._has_serving:
+            # the re-issue redraws its token budgets from scratch
+            st = st._replace(
+                req_tok_in=st.req_tok_in.at[idx].set(-1.0, mode="drop"),
+                req_tok_out=st.req_tok_out.at[idx].set(-1.0, mode="drop"),
+                req_sv_evict=st.req_sv_evict.at[idx].set(0, mode="drop"),
+                req_sv_hold=st.req_sv_hold.at[idx].set(0.0, mode="drop"),
+            )
         if self.trace is not None:
             # the logical request's record rides its ring row: the orphaned
             # slot stops recording (oracle contract: orphan completions are
@@ -752,6 +773,21 @@ class Engine:
                 jnp.where(pred, NO_TICKET, st.req_ticket[i]),
             ),
         )
+        if self._has_serving:
+            st = st._replace(
+                req_tok_in=st.req_tok_in.at[i].set(
+                    jnp.where(pred, -1.0, st.req_tok_in[i]),
+                ),
+                req_tok_out=st.req_tok_out.at[i].set(
+                    jnp.where(pred, -1.0, st.req_tok_out[i]),
+                ),
+                req_sv_evict=st.req_sv_evict.at[i].set(
+                    jnp.where(pred, 0, st.req_sv_evict[i]),
+                ),
+                req_sv_hold=st.req_sv_hold.at[i].set(
+                    jnp.where(pred, 0.0, st.req_sv_hold[i]),
+                ),
+            )
         # dropped on the entry chain: this attempt failed before arriving
         dead = pred & ~alive
         st = st._replace(
@@ -1026,6 +1062,13 @@ class Engine:
         if self._has_llm:
             st = st._replace(
                 req_llm=st.req_llm.at[idx].set(0.0, mode="drop"),
+            )
+        if self._has_serving:
+            st = st._replace(
+                req_tok_in=st.req_tok_in.at[idx].set(-1.0, mode="drop"),
+                req_tok_out=st.req_tok_out.at[idx].set(-1.0, mode="drop"),
+                req_sv_evict=st.req_sv_evict.at[idx].set(0, mode="drop"),
+                req_sv_hold=st.req_sv_hold.at[idx].set(0.0, mode="drop"),
             )
         if self._crn:
             # the duplicate draws from the logical request's CRN family on
@@ -1429,6 +1472,22 @@ class Engine:
             st = st._replace(
                 req_llm=st.req_llm.at[idx].set(0.0, mode="drop"),
             )
+        if self._has_serving:
+            # token budget: undrawn (-1) unless a replay row presets it
+            tin0 = jnp.float32(-1.0)
+            tout0 = jnp.float32(-1.0)
+            if self._has_replay:
+                ridx = jnp.clip(
+                    st.n_generated - 1, 0, len(plan.replay_times) - 1,
+                )
+                tin0 = self.params.replay_tok_in[ridx]
+                tout0 = self.params.replay_tok_out[ridx]
+            st = st._replace(
+                req_tok_in=st.req_tok_in.at[idx].set(tin0, mode="drop"),
+                req_tok_out=st.req_tok_out.at[idx].set(tout0, mode="drop"),
+                req_sv_evict=st.req_sv_evict.at[idx].set(0, mode="drop"),
+                req_sv_hold=st.req_sv_hold.at[idx].set(0.0, mode="drop"),
+            )
         if self.collect_traces:
             # fresh ring: generator hop (code = generator index), then one
             # NETWORK + CLIENT pair per entry edge (the chain's
@@ -1450,6 +1509,20 @@ class Engine:
                         st = self._hop(
                             st, idx, self.HOP_CLIENT, t_hop, place_gi,
                         )
+        if self._has_replay:
+            # deterministic trace replay: the next arrival is read from the
+            # lowered log table, not sampled (replay plans validate down to
+            # a single generator, so next_arrival is a 1-vector)
+            n_rows = len(plan.replay_times)
+            ridx = jnp.clip(st.n_generated, 0, n_rows - 1)
+            nxt = jnp.where(
+                st.n_generated < n_rows,
+                self.params.replay_times[ridx],
+                jnp.float32(INF),
+            )
+            return st._replace(
+                next_arrival=jnp.where(pred, nxt, st.next_arrival),
+            )
         if self._n_gen > 1:
             for gi in range(self._n_gen):
                 st = self._advance_arrival(
@@ -1602,6 +1675,15 @@ class Engine:
                 st, i, now, jnp.bool_(True), ov, shed,
             )
             st = self._client_fail(st, i, now, key, shed)
+        if self._has_serving:
+            # llm_serve lifecycle: admission gate (SEG_PREFILL) and the
+            # non-blocking decode extension / eviction (SEG_DECODE).  The
+            # admission park sits OUTSIDE the io gauge; the grant event
+            # adds the sleep (mirroring the oracle's serving branch).
+            is_pf = pred & (kind == SEG_PREFILL)
+            is_dc = pred & (kind == SEG_DECODE)
+            st = self._sv_prefill_admit(st, i, s, ep, seg, now, key, is_pf)
+            st = self._sv_decode_start(st, i, s, ep, seg, now, key, ov, is_dc)
         return self._exit_flow(st, i, s, now, key, ov, is_end)
 
     def _release_ram(self, st, i, s, now, pred) -> EngineState:
@@ -1661,6 +1743,255 @@ class Engine:
             req_ticket=req_tk,
             ram_free=st.ram_free.at[s].set(ram_free_s),
             ram_wait_n=st.ram_wait_n.at[s].set(wait_n),
+        )
+
+    # ==================================================================
+    # LLM serving batch gate (statically pruned without llm_serve steps)
+    # ==================================================================
+
+    def _sv_admit(self, st, i, s, now, pred) -> EngineState:
+        """Run the combined slot+KV-token FIFO admission for slot ``i``
+        (prompt size already drawn into ``req_tok_in``).  An immediate
+        grant reserves both resources NOW and schedules EV_SV_GRANT at the
+        current timestamp — the oracle gate decrements inside ``_acquire``
+        and heap-schedules the resume the same way; otherwise the request
+        parks as EV_WAIT_SV (outside the io gauge) with a FIFO ticket."""
+        tin = st.req_tok_in[i]
+        can = (
+            pred
+            & (st.sv_wait_n[s] == 0)
+            & (st.sv_slots_free[s] > 0)
+            & (st.sv_tokens_free[s] >= tin)
+        )
+        park = pred & ~can
+        return st._replace(
+            sv_slots_free=st.sv_slots_free.at[s].add(jnp.where(can, -1, 0)),
+            sv_tokens_free=st.sv_tokens_free.at[s].add(
+                jnp.where(can, -tin, 0.0),
+            ),
+            sv_ticket=st.sv_ticket.at[s].add(jnp.where(park, 1, 0)),
+            sv_wait_n=st.sv_wait_n.at[s].add(jnp.where(park, 1, 0)),
+            req_ev=st.req_ev.at[i].set(
+                jnp.where(
+                    can,
+                    EV_SV_GRANT,
+                    jnp.where(park, EV_WAIT_SV, st.req_ev[i]),
+                ),
+            ),
+            req_t=st.req_t.at[i].set(
+                jnp.where(can, now, jnp.where(park, INF, st.req_t[i])),
+            ),
+            req_ticket=st.req_ticket.at[i].set(
+                jnp.where(park, st.sv_ticket[s], st.req_ticket[i]),
+            ),
+        )
+
+    def _sv_prefill_admit(self, st, i, s, ep, seg, now, key, pred) -> EngineState:
+        """SEG_PREFILL segment start: draw this attempt's token budget once
+        (evictions redo the prefill with the SAME draw; replay presets and
+        the variance-0 deterministic mean skip the normal draw entirely —
+        the clamps mirror the oracle's ``draw_tokens``) and enter the batch
+        admission gate."""
+        p = self.params
+        tin_m = p.sv_tin_mean[s, ep, seg]
+        tin_v = p.sv_tin_var[s, ep, seg]
+        tout_m = p.sv_tout_mean[s, ep, seg]
+        tout_v = p.sv_tout_var[s, ep, seg]
+        z_in = draw_normal(jax.random.fold_in(key, 26))
+        z_out = draw_normal(jax.random.fold_in(key, 27))
+        tin_d = jnp.maximum(
+            1.0, jnp.where(tin_v > 0, tin_m + jnp.sqrt(tin_v) * z_in, tin_m),
+        )
+        tout_d = jnp.maximum(
+            1.0,
+            jnp.where(tout_v > 0, tout_m + jnp.sqrt(tout_v) * z_out, tout_m),
+        )
+        need_in = pred & (st.req_tok_in[i] < 0)
+        need_out = pred & (st.req_tok_out[i] < 0)
+        st = st._replace(
+            req_tok_in=st.req_tok_in.at[i].set(
+                jnp.where(need_in, tin_d, st.req_tok_in[i]),
+            ),
+            req_tok_out=st.req_tok_out.at[i].set(
+                jnp.where(need_out, tout_d, st.req_tok_out[i]),
+            ),
+        )
+        return self._sv_admit(st, i, s, now, pred)
+
+    def _sv_grant_branch(self, st, i, now, key, ov, pred) -> EngineState:
+        """Batch admission granted (resources were reserved at grant time):
+        the prompt's KV tokens become this slot's resident hold and the
+        prefill runs as an io-like sleep."""
+        p = self.params
+        s = st.req_srv[i]
+        ep = st.req_ep[i]
+        seg = st.req_seg[i]
+        tin = st.req_tok_in[i]
+        dur = p.sv_prefill_base[s, ep, seg] + tin * p.sv_prefill_tpt[s, ep, seg]
+        st = st._replace(
+            req_sv_hold=st.req_sv_hold.at[i].set(
+                jnp.where(pred, tin, st.req_sv_hold[i]),
+            ),
+            n_prefill_tok=st.n_prefill_tok + jnp.where(pred, tin, 0.0),
+            req_ev=st.req_ev.at[i].set(
+                jnp.where(pred, EV_SEG_END, st.req_ev[i]),
+            ),
+            req_t=st.req_t.at[i].set(jnp.where(pred, now + dur, st.req_t[i])),
+        )
+        if self.trace is not None:
+            st = self._fr(st, i, FR_PREFILL, s, now, pred)
+        return self._gauge_add(st, now, self._g_io(s), 1.0, pred)
+
+    def _sv_decode_start(self, st, i, s, ep, seg, now, key, ov, pred) -> EngineState:
+        """SEG_DECODE segment start: NON-BLOCKING token extension (running
+        requests outrank queued admissions — continuous batching).  A fit
+        starts generation; a miss is a KV-pressure eviction that releases
+        the slot + prompt hold (cascading queued grants) and re-queues the
+        attempt at the FIFO tail for a full prefill redo — or, past the
+        eviction budget, terminally rejects it (shed accounting)."""
+        p = self.params
+        tin = st.req_tok_in[i]
+        tout = st.req_tok_out[i]
+        fits = pred & (st.sv_tokens_free[s] >= tout)
+        # decode rate: drawn fresh per decode attempt (oracle draw_rate:
+        # exactly the mean at variance 0, clamped to 0.1*mean otherwise)
+        rm = p.sv_rate_mean[s, ep, seg]
+        rv = p.sv_rate_var[s, ep, seg]
+        z = draw_normal(jax.random.fold_in(key, 28))
+        rate = jnp.maximum(
+            0.1 * rm, jnp.where(rv > 0, rm + jnp.sqrt(rv) * z, rm),
+        )
+        rate = rate * ov.decode_rate_scale
+        dur = tout / jnp.maximum(rate, _TINY)
+        st = st._replace(
+            sv_tokens_free=st.sv_tokens_free.at[s].add(
+                jnp.where(fits, -tout, 0.0),
+            ),
+            req_sv_hold=st.req_sv_hold.at[i].add(jnp.where(fits, tout, 0.0)),
+            n_decode_tok=st.n_decode_tok + jnp.where(fits, tout, 0.0),
+            req_llm=st.req_llm.at[i].add(
+                jnp.where(fits, tout * p.sv_cost[s, ep, seg], 0.0),
+            ),
+            req_ev=st.req_ev.at[i].set(
+                jnp.where(fits, EV_SEG_END, st.req_ev[i]),
+            ),
+            req_t=st.req_t.at[i].set(jnp.where(fits, now + dur, st.req_t[i])),
+        )
+        if self.trace is not None:
+            st = self._fr(st, i, FR_DECODE, s, now, fits)
+        st = self._gauge_add(st, now, self._g_io(s), 1.0, fits)
+
+        # KV pressure: evict
+        evict = pred & ~fits
+        ctr = st.req_sv_evict[i] + jnp.where(evict, 1, 0)
+        terminal = evict & (ctr > p.serve_evict_max[s])
+        readmit = evict & ~terminal
+        st = st._replace(
+            n_kv_evict=st.n_kv_evict + jnp.where(evict, 1, 0),
+            req_sv_evict=st.req_sv_evict.at[i].set(ctr),
+        )
+        if self.trace is not None:
+            st = self._fr(st, i, FR_EVICT, s, now, evict)
+        # release the slot + prompt hold; queued admissions cascade first,
+        # THEN the evicted attempt re-queues (oracle: release -> _acquire)
+        st = self._release_sv(st, i, s, now, evict)
+        st = st._replace(
+            req_seg=st.req_seg.at[i].set(
+                jnp.where(readmit, seg - 1, st.req_seg[i]),
+            ),
+        )
+        st = self._sv_admit(st, i, s, now, readmit)
+
+        # eviction budget spent: terminal reject (mirror the shed path)
+        st = self._release_ram(st, i, s, now, terminal)
+        if self._has_conn:
+            st = st._replace(
+                srv_conn=st.srv_conn.at[s].add(jnp.where(terminal, -1, 0)),
+            )
+        st = st._replace(
+            req_ev=st.req_ev.at[i].set(
+                jnp.where(terminal, EV_IDLE, st.req_ev[i]),
+            ),
+            req_t=st.req_t.at[i].set(jnp.where(terminal, INF, st.req_t[i])),
+            req_ram=st.req_ram.at[i].set(
+                jnp.where(terminal, 0.0, st.req_ram[i]),
+            ),
+            req_ticket=st.req_ticket.at[i].set(
+                jnp.where(terminal, NO_TICKET, st.req_ticket[i]),
+            ),
+            n_rejected=st.n_rejected + jnp.where(terminal, 1, 0),
+        )
+        if self.trace is not None:
+            st = self._fr(st, i, FR_REJECT, s, now, terminal)
+        st = self._breaker_server_report(
+            st, i, now, jnp.bool_(True), ov, terminal,
+        )
+        return self._client_fail(st, i, now, key, terminal)
+
+    def _release_sv(self, st, i, s, now, pred) -> EngineState:
+        """Return slot ``i``'s batch slot + resident KV hold to server ``s``
+        and run the strict-FIFO admission grant cascade — the
+        :meth:`_release_ram` discipline lifted to two resources: a grant
+        needs the head waiter to fit BOTH a free batch slot and its prompt
+        tokens (``req_tok_in``)."""
+        if not self._has_serving:
+            return st
+        hold = st.req_sv_hold[i]
+        slots0 = st.sv_slots_free[s] + jnp.where(pred, 1, 0)
+        tokens0 = st.sv_tokens_free[s] + jnp.where(pred, hold, 0.0)
+        st = st._replace(
+            req_sv_hold=st.req_sv_hold.at[i].set(
+                jnp.where(pred, 0.0, hold),
+            ),
+        )
+
+        def gcond(carry):
+            req_ev, _t, req_tk, slots, tokens, _wait_n, go = carry
+            waiting = (req_ev == EV_WAIT_SV) & (st.req_srv == s)
+            tick = jnp.where(waiting, req_tk, NO_TICKET)
+            head = jnp.argmin(tick).astype(jnp.int32)
+            return (
+                go
+                & (tick[head] < NO_TICKET)
+                & (slots > 0)
+                & (st.req_tok_in[head] <= tokens)
+            )
+
+        def gbody(carry):
+            req_ev, req_t, req_tk, slots, tokens, wait_n, go = carry
+            waiting = (req_ev == EV_WAIT_SV) & (st.req_srv == s)
+            tick = jnp.where(waiting, req_tk, NO_TICKET)
+            head = jnp.argmin(tick).astype(jnp.int32)
+            return (
+                req_ev.at[head].set(EV_SV_GRANT),
+                req_t.at[head].set(now),
+                req_tk.at[head].set(NO_TICKET),
+                slots - 1,
+                tokens - st.req_tok_in[head],
+                wait_n - 1,
+                go,
+            )
+
+        req_ev, req_t, req_tk, slots, tokens, wait_n, _ = jax.lax.while_loop(
+            gcond,
+            gbody,
+            (
+                st.req_ev,
+                st.req_t,
+                st.req_ticket,
+                slots0,
+                tokens0,
+                st.sv_wait_n[s],
+                pred,
+            ),
+        )
+        return st._replace(
+            req_ev=req_ev,
+            req_t=req_t,
+            req_ticket=req_tk,
+            sv_slots_free=st.sv_slots_free.at[s].set(slots),
+            sv_tokens_free=st.sv_tokens_free.at[s].set(tokens),
+            sv_wait_n=st.sv_wait_n.at[s].set(wait_n),
         )
 
     def _exit_flow(self, st, i, s, now, key, ov, pred) -> EngineState:
@@ -1784,16 +2115,27 @@ class Engine:
             done,
         )
 
-        free = drop_here | to_client
+        # a final transit that lands past the horizon stays IN FLIGHT as a
+        # parked client arrival (the oracle heap still holds that event at
+        # the horizon): freeing the slot here would make the request vanish
+        # from the conservation identity generated = completed + dropped +
+        # overflow + in-flight.  The parked event never fires — the loop
+        # stops at the horizon — it only keeps the slot accounted for.
+        straddle = to_client & ~done
+        free = drop_here | done
         st = st._replace(
             req_ev=st.req_ev.at[i].set(
                 jnp.where(
                     free,
                     EV_IDLE,
                     jnp.where(
-                        to_server,
-                        EV_ARRIVE_SRV,
-                        jnp.where(to_lb, EV_ARRIVE_LB, st.req_ev[i]),
+                        straddle,
+                        EV_ARRIVE_CLIENT,
+                        jnp.where(
+                            to_server,
+                            EV_ARRIVE_SRV,
+                            jnp.where(to_lb, EV_ARRIVE_LB, st.req_ev[i]),
+                        ),
                     ),
                 ),
             ),
@@ -1801,7 +2143,9 @@ class Engine:
                 jnp.where(
                     free,
                     INF,
-                    jnp.where(to_server | to_lb, arrive, st.req_t[i]),
+                    jnp.where(
+                        to_server | to_lb | straddle, arrive, st.req_t[i],
+                    ),
                 ),
             ),
             req_srv=st.req_srv.at[i].set(
@@ -2328,6 +2672,15 @@ class Engine:
         was_io = pred & (kind == SEG_IO)
         if self._has_cache:
             was_io = was_io | (pred & (kind == SEG_CACHE))
+        if self._has_serving:
+            # the prefill/decode sleeps ride the io gauge between grant
+            # (+1 at EV_SV_GRANT / decode fit) and each phase's end here;
+            # generation's end releases the batch slot + KV hold and
+            # cascades queued admission grants
+            was_pf = pred & (kind == SEG_PREFILL)
+            was_dc = pred & (kind == SEG_DECODE)
+            was_io = was_io | was_pf | was_dc
+            st = self._release_sv(st, i, s, now, was_dc)
 
         st = self._cpu_handoff(st, s, now, was_cpu)
 
@@ -2497,6 +2850,47 @@ class Engine:
             req_llm=jnp.zeros(pool if self._has_llm else 1, jnp.float32),
             llm_sum=jnp.float32(0.0),
             llm_sumsq=jnp.float32(0.0),
+            # serving batch gate: -1 means unlimited — lift to a huge free
+            # count (slots) / level (tokens) so the admit test is branchless
+            sv_slots_free=(
+                jnp.where(
+                    jnp.asarray(plan.serve_slots) >= 0,
+                    jnp.asarray(plan.serve_slots),
+                    jnp.int32(2**30),
+                )
+                if self._has_serving
+                else jnp.zeros(1, jnp.int32)
+            ),
+            sv_tokens_free=(
+                jnp.where(
+                    ov.serve_tokens >= 0,
+                    ov.serve_tokens.astype(jnp.float32),
+                    jnp.float32(1e30),
+                )
+                if self._has_serving
+                else jnp.zeros(1, jnp.float32)
+            ),
+            sv_ticket=jnp.zeros(
+                plan.n_servers if self._has_serving else 1, jnp.int32,
+            ),
+            sv_wait_n=jnp.zeros(
+                plan.n_servers if self._has_serving else 1, jnp.int32,
+            ),
+            req_tok_in=jnp.full(
+                pool if self._has_serving else 1, -1.0, jnp.float32,
+            ),
+            req_tok_out=jnp.full(
+                pool if self._has_serving else 1, -1.0, jnp.float32,
+            ),
+            req_sv_evict=jnp.zeros(
+                pool if self._has_serving else 1, jnp.int32,
+            ),
+            req_sv_hold=jnp.zeros(
+                pool if self._has_serving else 1, jnp.float32,
+            ),
+            n_prefill_tok=jnp.float32(0.0),
+            n_decode_tok=jnp.float32(0.0),
+            n_kv_evict=jnp.int32(0),
             llm_store=jnp.zeros(
                 maxn if (self._has_llm and self.collect_clocks) else 1,
                 jnp.float32,
@@ -2583,6 +2977,9 @@ class Engine:
             ),
             n_degraded=jnp.int32(0),
         )
+        if self._has_replay:
+            # deterministic replay: first arrival straight from the table
+            return st._replace(next_arrival=self.params.replay_times[0])
         # first arrival (gap from t=0), per generator stream
         if self._n_gen > 1:
             for gi in range(self._n_gen):
@@ -2707,6 +3104,10 @@ class Engine:
         )
         st = self._arrive_srv_branch(st, i, now, kit, ov, is_pool & (ev == EV_ARRIVE_SRV))
         st = self._resume_branch(st, i, now, kit, ov, is_pool & (ev == EV_RESUME))
+        if self._has_serving:
+            st = self._sv_grant_branch(
+                st, i, now, kit, ov, is_pool & (ev == EV_SV_GRANT),
+            )
         st = self._seg_end_branch(st, i, now, kit, ov, is_pool & (ev == EV_SEG_END))
         if self._has_timeout:
             st = self._abandon_branch(
@@ -3075,7 +3476,11 @@ def run_single(
             )
 
     llm_cost = None
-    if plan.has_llm and sim_engine.collect_clocks and hasattr(state, "llm_store"):
+    if (
+        (plan.has_llm or plan.has_serving)
+        and sim_engine.collect_clocks
+        and hasattr(state, "llm_store")
+    ):
         llm_cost = state.llm_store[: int(state.clock_n)].astype(np.float64)
 
     # resilience scorecard: pure functions of the sampled tables + the
@@ -3139,6 +3544,21 @@ def run_single(
         degraded_goodput=degraded_goodput,
         hazard_truncated=hazard_truncated,
         time_to_drain=time_to_drain,
+        kv_evictions=(
+            int(state.n_kv_evict)
+            if plan.has_serving and hasattr(state, "n_kv_evict")
+            else None
+        ),
+        prefill_tokens=(
+            float(state.n_prefill_tok)
+            if plan.has_serving and hasattr(state, "n_prefill_tok")
+            else None
+        ),
+        decode_tokens=(
+            float(state.n_decode_tok)
+            if plan.has_serving and hasattr(state, "n_decode_tok")
+            else None
+        ),
     )
 
 
@@ -3243,12 +3663,29 @@ def sweep_results(
         total_dropped=np.asarray(final.n_dropped),
         llm_cost_sum=(
             np.asarray(final.llm_sum)
-            if engine.plan.has_llm and hasattr(final, "llm_sum")
+            if (engine.plan.has_llm or engine.plan.has_serving)
+            and hasattr(final, "llm_sum")
             else None
         ),
         llm_cost_sumsq=(
             np.asarray(final.llm_sumsq)
-            if engine.plan.has_llm and hasattr(final, "llm_sumsq")
+            if (engine.plan.has_llm or engine.plan.has_serving)
+            and hasattr(final, "llm_sumsq")
+            else None
+        ),
+        kv_evictions=(
+            np.asarray(final.n_kv_evict)
+            if engine.plan.has_serving and hasattr(final, "n_kv_evict")
+            else None
+        ),
+        prefill_tokens=(
+            np.asarray(final.n_prefill_tok)
+            if engine.plan.has_serving and hasattr(final, "n_prefill_tok")
+            else None
+        ),
+        decode_tokens=(
+            np.asarray(final.n_decode_tok)
+            if engine.plan.has_serving and hasattr(final, "n_decode_tok")
             else None
         ),
         overflow_dropped=np.asarray(final.n_overflow),

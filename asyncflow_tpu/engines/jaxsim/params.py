@@ -28,6 +28,13 @@ EV_RETRY = 9  # client backoff park: re-issue down the entry chain at t
 # final client delivery as a real event (retry plans only): the client
 # deadline must race the last transit, exactly like the oracle's heap
 EV_ARRIVE_CLIENT = 10
+# LLM serving (asyncflow_tpu/serving): passive park in the continuous-
+# batching admission FIFO (req_t = INF until the grant cascade wakes it)
+# and the grant event itself — scheduled at `now` by the cascade, its
+# dispatch starts the prefill sleep (EV_RESUME is hard-wired to the RAM
+# grant + segment-0 entry, so admission grants need their own code)
+EV_WAIT_SV = 11
+EV_SV_GRANT = 12
 
 
 class PlanParams(NamedTuple):
@@ -74,6 +81,27 @@ class PlanParams(NamedTuple):
     # overrides so brownout A/B sweeps can batch per scenario)
     server_brownout_cpu: jnp.ndarray  # (NS,) f32 CPU-duration scale
     server_brownout_ram: jnp.ndarray  # (NS,) f32 RAM-demand scale
+    # LLM serving tables (SEG_PREFILL/SEG_DECODE dynamics; (0,0,0) / (0,)
+    # placeholders unless the plan has llm_serve steps).  The token-budget
+    # axis (serve_tokens) rides the OVERRIDES so KV-pressure sweeps batch
+    # per scenario; slots and the eviction cap stay plan-static.
+    # (None defaults, not jnp placeholders: creating jnp values at import
+    # time would initialise the backend — see the module header)
+    sv_tin_mean: jnp.ndarray | None = None  # (NS, NEP, NSEG+1) f32
+    sv_tin_var: jnp.ndarray | None = None
+    sv_tout_mean: jnp.ndarray | None = None
+    sv_tout_var: jnp.ndarray | None = None
+    sv_prefill_tpt: jnp.ndarray | None = None  # s per prompt token
+    sv_prefill_base: jnp.ndarray | None = None  # s fixed prefill cost
+    sv_rate_mean: jnp.ndarray | None = None  # decode tokens/s
+    sv_rate_var: jnp.ndarray | None = None
+    sv_cost: jnp.ndarray | None = None  # cost units per output token
+    serve_slots: jnp.ndarray | None = None  # (NS,) i32 (-1 unlimited)
+    serve_evict_max: jnp.ndarray | None = None  # (NS,) i32
+    # trace-replay arrival table (None unless the plan replays a log)
+    replay_times: jnp.ndarray | None = None  # (R,) f32 sorted spawn times
+    replay_tok_in: jnp.ndarray | None = None  # (R,) f32 (-1 = draw)
+    replay_tok_out: jnp.ndarray | None = None  # (R,) f32 (-1 = draw)
 
 
 def params_from_plan(plan: StaticPlan) -> PlanParams:
@@ -116,6 +144,32 @@ def params_from_plan(plan: StaticPlan) -> PlanParams:
         req_rate=jnp.float32(plan.req_per_user_per_sec),
         server_brownout_cpu=jnp.asarray(plan.server_brownout_cpu),
         server_brownout_ram=jnp.asarray(plan.server_brownout_ram),
+        **(
+            {
+                "sv_tin_mean": jnp.asarray(plan.sv_tin_mean),
+                "sv_tin_var": jnp.asarray(plan.sv_tin_var),
+                "sv_tout_mean": jnp.asarray(plan.sv_tout_mean),
+                "sv_tout_var": jnp.asarray(plan.sv_tout_var),
+                "sv_prefill_tpt": jnp.asarray(plan.sv_prefill_tpt),
+                "sv_prefill_base": jnp.asarray(plan.sv_prefill_base),
+                "sv_rate_mean": jnp.asarray(plan.sv_rate_mean),
+                "sv_rate_var": jnp.asarray(plan.sv_rate_var),
+                "sv_cost": jnp.asarray(plan.sv_cost),
+                "serve_slots": jnp.asarray(plan.serve_slots),
+                "serve_evict_max": jnp.asarray(plan.serve_evict_max),
+            }
+            if plan.has_serving
+            else {}
+        ),
+        **(
+            {
+                "replay_times": jnp.asarray(plan.replay_times, jnp.float32),
+                "replay_tok_in": jnp.asarray(plan.replay_tok_in),
+                "replay_tok_out": jnp.asarray(plan.replay_tok_out),
+            }
+            if plan.has_replay
+            else {}
+        ),
     )
 
 
@@ -273,6 +327,24 @@ class EngineState(NamedTuple):
     # per-slot degraded flag, latched at endpoint start
     req_degraded: jnp.ndarray  # (P,) i32
     n_degraded: jnp.ndarray  # scalar i32: degraded completions
+    # LLM serving (size (1,) placeholders unless the plan has llm_serve
+    # steps).  The admission gate is a two-resource FIFO per server —
+    # batch slots + resident KV tokens — run with the ticket discipline of
+    # the RAM gate; ``req_sv_hold`` is the slot's resident token hold
+    # (prompt after prefill admission, prompt+output during decode),
+    # released in full at decode end / eviction.  Token draws are per
+    # attempt (-1 = not drawn; replay presets stamp them at spawn).
+    sv_slots_free: jnp.ndarray  # (NS,) i32
+    sv_tokens_free: jnp.ndarray  # (NS,) f32
+    sv_ticket: jnp.ndarray  # (NS,) i32 FIFO ticket counter
+    sv_wait_n: jnp.ndarray  # (NS,) i32 live admission waiters
+    req_tok_in: jnp.ndarray  # (P,) f32 prompt tokens (-1 undrawn)
+    req_tok_out: jnp.ndarray  # (P,) f32 output tokens (-1 undrawn)
+    req_sv_evict: jnp.ndarray  # (P,) i32 evictions of this attempt
+    req_sv_hold: jnp.ndarray  # (P,) f32 resident KV token hold
+    n_prefill_tok: jnp.ndarray  # scalar f32: prompt tokens prefilled
+    n_decode_tok: jnp.ndarray  # scalar f32: output tokens decoded
+    n_kv_evict: jnp.ndarray  # scalar i32: KV-pressure evictions
 
 
 class ScenarioOverrides(NamedTuple):
@@ -311,6 +383,12 @@ class ScenarioOverrides(NamedTuple):
     fault_edge_drop: jnp.ndarray | None = None  # (M, NE) or (S, M, NE) f32
     hazard_scale: jnp.ndarray | None = None  # scalar or (S,): divides MTBF
     mttr_scale: jnp.ndarray | None = None  # scalar or (S,): multiplies MTTR
+    # serving sweep axes: the per-server resident-token budget (KV
+    # pressure; -1 = unlimited) and a scale on the decode rate (capacity
+    # what-ifs: faster/slower generation).  ``None`` = the base plan's
+    # budget / 1.0 scale.
+    serve_tokens: jnp.ndarray | None = None  # (NS,) or (S, NS)
+    decode_rate_scale: jnp.ndarray | None = None  # scalar or (S,)
 
 
 def base_overrides(plan: StaticPlan) -> ScenarioOverrides:
@@ -343,6 +421,8 @@ def base_overrides(plan: StaticPlan) -> ScenarioOverrides:
         fault_edge_drop=jnp.asarray(plan.fault_edge_drop),
         hazard_scale=jnp.float32(1.0),
         mttr_scale=jnp.float32(1.0),
+        serve_tokens=jnp.asarray(plan.serve_tokens),
+        decode_rate_scale=jnp.float32(1.0),
     )
 
 

@@ -24,6 +24,7 @@ reference runtime (`/root/reference/src/asyncflow/runtime/`):
 
 from __future__ import annotations
 
+import math
 from collections import OrderedDict
 from dataclasses import dataclass, field
 
@@ -39,9 +40,11 @@ from asyncflow_tpu.config.constants import (
 )
 from asyncflow_tpu.engines.oracle.kernel import (
     AcquireAmount,
+    AcquireServe,
     AcquireToken,
     FifoContainer,
     FifoTokens,
+    ServingGate,
     Sim,
     Timeout,
 )
@@ -52,8 +55,11 @@ from asyncflow_tpu.observability.simtrace import (
     FR_ARRIVE_SRV,
     FR_CANCEL,
     FR_COMPLETE,
+    FR_DECODE,
     FR_DROP,
+    FR_EVICT,
     FR_HEDGE,
+    FR_PREFILL,
     FR_REJECT,
     FR_RETRY,
     FR_RUN,
@@ -116,6 +122,12 @@ class Request:
     hg_released: bool = False
     #: True while this attempt runs a server's brownout (cheaper) profile
     degraded: bool = False
+    #: serving token draws of this attempt (-1 = not drawn yet; replay
+    #: presets stamp them at spawn; eviction redo reuses the same draws)
+    tok_in: float = -1.0
+    tok_out: float = -1.0
+    #: evictions this attempt has suffered (terminal reject past the cap)
+    sv_evict: int = 0
 
     def record_hop(self, kind: str, component_id: str, now: float) -> None:
         self.history.append(Hop(kind, component_id, now))
@@ -263,6 +275,38 @@ class _ServerRuntime:
             if cfg.overload is not None
             else 1.0
         )
+        # LLM continuous batching (serving subsystem): the batch is a
+        # two-resource FIFO gate — slots + resident KV tokens — built from
+        # the server's ServingPolicy with the SAME min() collapse the
+        # compiler lowers into StaticPlan.serve_tokens, so oracle and jax
+        # admission decisions agree on identical budgets
+        self.serve: ServingGate | None = None
+        self.serve_evict_max = 3
+        pol = cfg.serving
+        if pol is not None:
+            budget = math.inf
+            if pol.max_batch_tokens is not None:
+                budget = float(pol.max_batch_tokens)
+            if pol.kv_cache_mb is not None:
+                kv_max = max(
+                    (
+                        float(st.kv_mb_per_token)
+                        for ep in cfg.endpoints
+                        for st in ep.steps
+                        if getattr(st, "is_serving", False)
+                    ),
+                    default=0.0,
+                )
+                if kv_max > 0:
+                    budget = min(budget, float(pol.kv_cache_mb) / kv_max)
+            self.serve = ServingGate(
+                engine.sim,
+                int(pol.max_batch_requests)
+                if pol.max_batch_requests is not None
+                else 2**30,
+                budget if budget < math.inf else 1e30,
+            )
+            self.serve_evict_max = int(pol.max_evictions)
         self.residents = 0
         self.ready_queue_len = 0
         self.io_queue_len = 0
@@ -387,7 +431,78 @@ class _ServerRuntime:
         waiting_cpu = False
 
         for step in endpoint.steps:
-            if step.is_cpu:
+            if getattr(step, "is_serving", False):
+                # llm_serve lifecycle: FIFO batch admission (one slot +
+                # prompt's KV tokens) -> prefill -> decode extension or
+                # eviction.  Eviction redoes the prefill from the tail of
+                # the admission queue; past the eviction budget the
+                # request is terminally rejected (shed accounting).  The
+                # admission park sits OUTSIDE the io-sleep gauge, like
+                # the jax engine's EV_WAIT_SV park.
+                if core_locked:
+                    self.cpu.release()
+                    core_locked = False
+                if in_io_queue:
+                    in_io_queue = False
+                    self.io_queue_len -= 1
+                gate = self.serve
+                assert gate is not None  # schema: policy iff serving steps
+                if req.tok_in < 0.0:
+                    req.tok_in = engine.draw_tokens(step.input_tokens)
+                if req.tok_out < 0.0:
+                    req.tok_out = engine.draw_tokens(step.output_tokens)
+                while True:
+                    yield AcquireServe(gate, req.tok_in)
+                    # admitted: prompt tokens resident, prefill runs
+                    # (io-like sleep; redone in full on every re-admission)
+                    in_io_queue = True
+                    self.io_queue_len += 1
+                    engine.prefill_tokens += req.tok_in
+                    if tracing:
+                        engine._fr(req, FR_PREFILL, srv_idx, engine.sim.now)
+                    yield Timeout(
+                        step.prefill_base_s
+                        + req.tok_in * step.prefill_time_per_token_s,
+                    )
+                    if gate.try_extend(req.tok_out):
+                        # decode fits: generation holds prompt + output
+                        # tokens until completion releases both
+                        engine.decode_tokens += req.tok_out
+                        req.llm_cost += req.tok_out * step.cost_per_token
+                        if tracing:
+                            engine._fr(
+                                req, FR_DECODE, srv_idx, engine.sim.now,
+                            )
+                        rate = engine.draw_rate(step.decode_tokens_per_s)
+                        yield Timeout(req.tok_out / rate)
+                        gate.release(1, req.tok_in + req.tok_out)
+                        break
+                    # KV pressure: evict — release the slot and prompt
+                    # hold (cascading queued admissions), then re-queue
+                    engine.kv_evictions += 1
+                    req.sv_evict += 1
+                    if tracing:
+                        engine._fr(req, FR_EVICT, srv_idx, engine.sim.now)
+                    in_io_queue = False
+                    self.io_queue_len -= 1
+                    gate.release(1, req.tok_in)
+                    if req.sv_evict > self.serve_evict_max:
+                        # eviction budget spent: terminal reject
+                        if total_ram:
+                            self.ram_in_use -= total_ram
+                            self.ram.release(total_ram)
+                        req.finish_time = engine.sim.now
+                        req.record_hop(
+                            SystemNodes.SERVER,
+                            f"{self.cfg.id}-evicted",
+                            engine.sim.now,
+                        )
+                        engine.total_rejected += 1
+                        engine._fr(req, FR_REJECT, srv_idx, engine.sim.now)
+                        engine.breaker_failure(req)
+                        engine.client_fail(req)
+                        return
+            elif step.is_cpu:
                 if in_io_queue:
                     in_io_queue = False
                     self.io_queue_len -= 1
@@ -634,11 +749,24 @@ class OracleEngine:
         self._rb_last = 0.0
         self.rqs_clock: list[tuple[float, float]] = []
         self.llm_costs: list[float] = []  # aligned with rqs_clock
+        # serving counters (asyncflow_tpu/serving): prefill tokens accrue
+        # on EVERY admission (eviction redo included); decode tokens only
+        # when the extension fit
+        self.kv_evictions = 0
+        self.prefill_tokens = 0.0
+        self.decode_tokens = 0.0
+        self._has_serving = any(
+            getattr(step, "is_serving", False)
+            for server in payload.topology_graph.nodes.servers
+            for ep in server.endpoints
+            for step in ep.steps
+        )
         # gate the llm_cost OUTPUT on llm presence in the payload (not on
         # observed nonzero costs: cost_per_token=0 is a legal latency-only
         # model and must still report a zeros array, matching the jax
-        # engine's plan-gated output)
-        self._has_llm = any(
+        # engine's plan-gated output).  Serving steps join the gate: their
+        # decode cost accrues into the same per-request cost stream.
+        self._has_llm = self._has_serving or any(
             step.is_llm
             for server in payload.topology_graph.nodes.servers
             for ep in server.endpoints
@@ -712,6 +840,30 @@ class OracleEngine:
             )
 
     # ------------------------------------------------------------------
+    # serving token draws (variance 0 is exactly the mean in BOTH engines
+    # — the variance-0 flight-record parity gate depends on it)
+    # ------------------------------------------------------------------
+
+    def draw_tokens(self, rv) -> float:
+        """One token-count draw (prompt or output length): the mean at
+        variance 0, else normal clamped to at least one token."""
+        if rv.variance <= 0.0:
+            return max(1.0, float(rv.mean))
+        return max(
+            1.0, float(self.rng.normal(rv.mean, math.sqrt(rv.variance))),
+        )
+
+    def draw_rate(self, rv) -> float:
+        """One decode-rate draw, clamped to a 10%-of-mean floor (keeps
+        decode durations finite under wide variance)."""
+        if rv.variance <= 0.0:
+            return float(rv.mean)
+        return max(
+            0.1 * float(rv.mean),
+            float(self.rng.normal(rv.mean, math.sqrt(rv.variance))),
+        )
+
+    # ------------------------------------------------------------------
     # build phase
     # ------------------------------------------------------------------
 
@@ -745,6 +897,31 @@ class OracleEngine:
     # actors
     # ------------------------------------------------------------------
 
+    def _spawn_request(self, workload_id: str, out: _EdgeRuntime, req: Request) -> None:
+        """Shared spawn tail: hop record, trace sampling, client timers,
+        entry transport (identical for stochastic and replay arrivals)."""
+        req.record_hop(
+            SystemNodes.GENERATOR,
+            workload_id,
+            self.sim.now,
+        )
+        if self.trace is not None:
+            # deterministic sampling: the first K spawns are traced
+            seq = self.total_generated - 1
+            if seq < self.trace.sample_requests:
+                req.fr = self.flight.setdefault(seq, FlightRecord(req=seq))
+            self._fr(
+                req, FR_SPAWN, self._gen_fr_idx[workload_id], self.sim.now,
+            )
+        if self.retry.enabled:
+            self.sim.after(
+                self.retry.timeout,
+                lambda r=req: self._on_timeout(r),
+            )
+        if self.hedge.enabled:
+            self._hedge_arm(req)
+        out.transport(req)
+
     def _generator_process(self, workload):
         """One arrival process per generator; multi-generator payloads
         superpose (each with its own workload params and entry edge)."""
@@ -752,6 +929,29 @@ class OracleEngine:
         if self.retry.enabled or self.hedge.enabled:
             self._entry_out = out
             self._entry_gen_id = workload.id
+        if workload.replay is not None:
+            # trace replay: the deterministic arrival table replaces the
+            # stochastic process outright — request r spawns at
+            # replay.times[r] exactly (arrivals past the horizon never
+            # spawn), with optional per-request token presets
+            replay = workload.replay
+            horizon = float(self.settings.total_simulation_time)
+            now = 0.0
+            for r, t in enumerate(replay.times):
+                if t >= horizon:
+                    break
+                yield Timeout(t - now)
+                now = t
+                self.total_generated += 1
+                req = Request(
+                    id=self.total_generated, initial_time=self.sim.now,
+                )
+                if replay.input_tokens is not None:
+                    req.tok_in = float(replay.input_tokens[r])
+                if replay.output_tokens is not None:
+                    req.tok_out = float(replay.output_tokens[r])
+                self._spawn_request(workload.id, out, req)
+            return
         for gap in arrival_gaps(
             workload,
             self.settings,
@@ -760,27 +960,7 @@ class OracleEngine:
             yield Timeout(gap)
             self.total_generated += 1
             req = Request(id=self.total_generated, initial_time=self.sim.now)
-            req.record_hop(
-                SystemNodes.GENERATOR,
-                workload.id,
-                self.sim.now,
-            )
-            if self.trace is not None:
-                # deterministic sampling: the first K spawns are traced
-                seq = self.total_generated - 1
-                if seq < self.trace.sample_requests:
-                    req.fr = self.flight.setdefault(seq, FlightRecord(req=seq))
-                self._fr(
-                    req, FR_SPAWN, self._gen_fr_idx[workload.id], self.sim.now,
-                )
-            if self.retry.enabled:
-                self.sim.after(
-                    self.retry.timeout,
-                    lambda r=req: self._on_timeout(r),
-                )
-            if self.hedge.enabled:
-                self._hedge_arm(req)
-            out.transport(req)
+            self._spawn_request(workload.id, out, req)
 
     def _client_receive(self, req: Request) -> None:
         req.record_hop(SystemNodes.CLIENT, self.client_id, self.sim.now)
@@ -1455,4 +1635,9 @@ class OracleEngine:
             degraded_goodput=degraded_goodput,
             hazard_truncated=hazard_truncated,
             time_to_drain=time_to_drain,
+            kv_evictions=self.kv_evictions if self._has_serving else None,
+            prefill_tokens=(
+                self.prefill_tokens if self._has_serving else None
+            ),
+            decode_tokens=self.decode_tokens if self._has_serving else None,
         )

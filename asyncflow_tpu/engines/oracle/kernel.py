@@ -165,3 +165,71 @@ class AcquireAmount:
 
     def arrange(self, sim: Sim, resume: Callable[[Any], None]) -> None:  # noqa: ARG002
         self.container._acquire(self.amount, resume)
+
+
+class ServingGate:
+    """Two-resource FIFO admission gate for continuous batching.
+
+    The LLM serving batch is bounded along two axes at once: concurrent
+    batch slots (requests) and resident KV tokens.  An admission needs one
+    slot AND ``tokens`` token units; grants are strict-FIFO with
+    head-of-line blocking (the :class:`FifoContainer` discipline lifted to
+    two resources).  Running requests extend their token hold without
+    queueing (:meth:`try_extend`) — the decode-start fast path of
+    continuous batching, where generation extensions outrank queued
+    admissions and a failed extension is an eviction, never a wait.
+    """
+
+    def __init__(self, sim: Sim, slots: int, tokens: float) -> None:
+        self._sim = sim
+        self.slots_free = slots
+        self.tokens_free = tokens
+        self._waiters: deque[tuple[float, Callable[[Any], None]]] = deque()
+
+    @property
+    def would_block(self) -> bool:
+        return bool(self._waiters) or self.slots_free <= 0
+
+    def _acquire(self, tokens: float, resume: Callable[[Any], None]) -> None:
+        if (
+            not self._waiters
+            and self.slots_free > 0
+            and self.tokens_free >= tokens
+        ):
+            self.slots_free -= 1
+            self.tokens_free -= tokens
+            self._sim.at(self._sim.now, resume)
+        else:
+            self._waiters.append((tokens, resume))
+
+    def try_extend(self, tokens: float) -> bool:
+        """Grow a resident request's token hold if it fits, never waiting."""
+        if self.tokens_free >= tokens:
+            self.tokens_free -= tokens
+            return True
+        return False
+
+    def release(self, slots: int, tokens: float) -> None:
+        """Return resources and cascade head-of-line admission grants."""
+        self.slots_free += slots
+        self.tokens_free += tokens
+        while (
+            self._waiters
+            and self.slots_free > 0
+            and self.tokens_free >= self._waiters[0][0]
+        ):
+            head_tokens, resume = self._waiters.popleft()
+            self.slots_free -= 1
+            self.tokens_free -= head_tokens
+            self._sim.at(self._sim.now, resume)
+
+
+@dataclass(frozen=True)
+class AcquireServe:
+    """Awaitable wrapper over :class:`ServingGate` (one slot + tokens)."""
+
+    gate: ServingGate
+    tokens: float
+
+    def arrange(self, sim: Sim, resume: Callable[[Any], None]) -> None:  # noqa: ARG002
+        self.gate._acquire(self.tokens, resume)

@@ -123,6 +123,12 @@ class DeviceCounters:
     dark_lost: int = 0
     degraded_goodput: int = 0
     hazard_truncated: int = 0
+    #: LLM serving counters (0 without llm_serve steps): KV-pressure
+    #: evictions, prompt tokens prefilled (eviction redo counts again),
+    #: and output tokens decoded (docs/guides/serving.md).
+    kv_evictions: int = 0
+    prefill_tokens: int = 0
+    decode_tokens: int = 0
 
     def as_dict(self) -> dict[str, int]:
         return asdict(self)
@@ -200,6 +206,13 @@ class SimulationResults:
     degraded_goodput: float | None = None
     hazard_truncated: int = 0
     time_to_drain: float | None = None
+    #: LLM serving counters (None without llm_serve steps, zeros when the
+    #: plan has them but nothing evicted/served): KV-pressure evictions,
+    #: prompt tokens prefilled (every admission, eviction redo included),
+    #: output tokens decoded (fitting extensions only).
+    kv_evictions: int | None = None
+    prefill_tokens: float | None = None
+    decode_tokens: float | None = None
 
     @property
     def latencies(self) -> np.ndarray:
@@ -233,6 +246,9 @@ class SimulationResults:
             dark_lost=int(self.dark_lost),
             degraded_goodput=int(self.degraded_goodput or 0),
             hazard_truncated=int(self.hazard_truncated),
+            kv_evictions=int(self.kv_evictions or 0),
+            prefill_tokens=int(self.prefill_tokens or 0),
+            decode_tokens=int(self.decode_tokens or 0),
         )
 
 
@@ -329,6 +345,12 @@ class SweepResults:
     degraded_goodput: np.ndarray | None = None
     time_to_drain: np.ndarray | None = None
     hazard_truncated: np.ndarray | None = None
+    #: (S,) LLM serving counters (plans with llm_serve steps; None
+    #: otherwise): KV-pressure evictions, prompt tokens prefilled, output
+    #: tokens decoded per scenario (docs/guides/serving.md).
+    kv_evictions: np.ndarray | None = None
+    prefill_tokens: np.ndarray | None = None
+    decode_tokens: np.ndarray | None = None
     #: (S,) bool host-fault quarantine mask: True rows produced non-finite
     #: metrics (or deterministically crashed the engine) and were masked
     #: out — their metric rows are zeroed, ``quarantine_reason`` names why.
@@ -506,6 +528,21 @@ class SweepResults:
             ),
             flight_t=self.flight_t[idx] if self.flight_t is not None else None,
             flight_n=self.flight_n[idx] if self.flight_n is not None else None,
+            kv_evictions=(
+                self.kv_evictions[idx]
+                if self.kv_evictions is not None
+                else None
+            ),
+            prefill_tokens=(
+                self.prefill_tokens[idx]
+                if self.prefill_tokens is not None
+                else None
+            ),
+            decode_tokens=(
+                self.decode_tokens[idx]
+                if self.decode_tokens is not None
+                else None
+            ),
             quarantined=(
                 self.quarantined[idx] if self.quarantined is not None else None
             ),
@@ -589,6 +626,21 @@ class SweepResults:
             hazard_truncated=(
                 int(np.sum(self.hazard_truncated))
                 if self.hazard_truncated is not None
+                else 0
+            ),
+            kv_evictions=(
+                int(np.sum(self.kv_evictions))
+                if self.kv_evictions is not None
+                else 0
+            ),
+            prefill_tokens=(
+                int(np.sum(self.prefill_tokens))
+                if self.prefill_tokens is not None
+                else 0
+            ),
+            decode_tokens=(
+                int(np.sum(self.decode_tokens))
+                if self.decode_tokens is not None
                 else 0
             ),
         )

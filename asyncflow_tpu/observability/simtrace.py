@@ -57,6 +57,10 @@ FR_COMPLETE = 13  #: delivered back to the client — the request is done
 FR_ABANDON = 14  #: client gave the logical request up (node = last attempt)
 FR_HEDGE = 15  #: hedge timer fired — a duplicate issued (node = hedge ordinal)
 FR_CANCEL = 16  #: attempt cancelled en route (its sibling won the race)
+# serving lifecycle (asyncflow_tpu/serving, docs/guides/serving.md):
+FR_PREFILL = 17  #: admitted to the batch — prefill started (KV += prompt)
+FR_DECODE = 18  #: decode extension fit — generation started (KV += output)
+FR_EVICT = 19  #: KV pressure evicted the request (prefill will be redone)
 
 FR_NAMES: dict[int, str] = {
     FR_SPAWN: "spawn",
@@ -75,13 +79,17 @@ FR_NAMES: dict[int, str] = {
     FR_ABANDON: "abandon",
     FR_HEDGE: "hedge",
     FR_CANCEL: "cancel",
+    FR_PREFILL: "prefill",
+    FR_DECODE: "decode",
+    FR_EVICT: "evict",
 }
 
 #: codes whose ``node`` field is an edge index
 _EDGE_CODES = frozenset({FR_TRANSIT, FR_DROP})
 #: codes whose ``node`` field is a server index
 _SERVER_CODES = frozenset(
-    {FR_ARRIVE_SRV, FR_WAIT_RAM, FR_WAIT_CPU, FR_WAIT_DB, FR_RUN},
+    {FR_ARRIVE_SRV, FR_WAIT_RAM, FR_WAIT_CPU, FR_WAIT_DB, FR_RUN,
+     FR_PREFILL, FR_DECODE, FR_EVICT},
 )
 
 

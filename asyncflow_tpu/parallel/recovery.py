@@ -325,6 +325,9 @@ _ROW_FINITE_FIELDS = (
     "gauge_series",
     "llm_cost_sum",
     "llm_cost_sumsq",
+    "prefill_tokens",
+    "decode_tokens",
+    "kv_evictions",
 )
 
 

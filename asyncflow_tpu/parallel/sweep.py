@@ -81,6 +81,8 @@ def make_overrides(
     ejection_threshold: np.ndarray | None = None,
     hazard_scale: np.ndarray | None = None,
     mttr_scale: np.ndarray | None = None,
+    max_batch_tokens: np.ndarray | None = None,
+    decode_rate_scale: np.ndarray | None = None,
 ) -> ScenarioOverrides:
     """Per-scenario parameter overrides; every scale is (S,) or (S, NE).
 
@@ -104,8 +106,22 @@ def make_overrides(
     ``hazard_scale``: (S,) divides every domain's MTBF mean (higher =
     more chaos); ``mttr_scale``: (S,) multiplies every domain's MTTR
     mean (higher = slower repair).  Both reuse the same lockstep
-    uniforms, so scale sweeps are CRN-paired by construction."""
+    uniforms, so scale sweeps are CRN-paired by construction.
+
+    LLM serving axes (base plan must carry ``llm_serve`` steps):
+    ``max_batch_tokens``: (S,) or (S, NS) per-scenario resident-token
+    budgets (the KV-pressure sweep axis; -1 = unlimited);
+    ``decode_rate_scale``: (S,) multiplies every decode-rate draw (the
+    accelerator speed axis)."""
     base = base_overrides(plan)
+    for name, arr in (("max_batch_tokens", max_batch_tokens),
+                      ("decode_rate_scale", decode_rate_scale)):
+        if arr is not None and not plan.has_serving:
+            msg = (
+                f"{name} overrides need llm_serve steps in the payload: "
+                "the serving batch gate they perturb must exist"
+            )
+            raise ValueError(msg)
     for name, arr in (("hazard_scale", hazard_scale),
                       ("mttr_scale", mttr_scale)):
         if arr is not None and not plan.has_hazards:
@@ -245,6 +261,20 @@ def make_overrides(
             if mttr_scale is None
             else _scenario_axis(mttr_scale, "mttr_scale", n_scenarios)
         ),
+        serve_tokens=(
+            base.serve_tokens
+            if max_batch_tokens is None
+            else _serve_tokens_axis(
+                max_batch_tokens, n_scenarios, base.serve_tokens,
+            )
+        ),
+        decode_rate_scale=(
+            base.decode_rate_scale
+            if decode_rate_scale is None
+            else _scenario_axis(
+                decode_rate_scale, "decode_rate_scale", n_scenarios,
+            )
+        ),
     )
 
 
@@ -252,6 +282,26 @@ def _scenario_axis(arr, name: str, n_scenarios: int) -> jnp.ndarray:
     arr = jnp.asarray(arr, jnp.float32)
     if arr.shape != (n_scenarios,):
         msg = f"{name} must have shape ({n_scenarios},), got {arr.shape}"
+        raise ValueError(msg)
+    return arr
+
+
+def _serve_tokens_axis(
+    arr, n_scenarios: int, base_tokens: jnp.ndarray,
+) -> jnp.ndarray:
+    """(S,) broadcasts one token budget across servers; (S, NS) per-server.
+
+    Servers without llm_serve steps never consult the gate, so the
+    broadcast value is inert for them; -1 keeps a budget unlimited."""
+    arr = jnp.asarray(arr, jnp.float32)
+    ns = base_tokens.shape[0]
+    if arr.ndim == 1:
+        arr = jnp.broadcast_to(arr[:, None], (arr.shape[0], ns))
+    if arr.shape != (n_scenarios, ns):
+        msg = (
+            f"max_batch_tokens must have shape ({n_scenarios},) or "
+            f"({n_scenarios}, {ns}), got {arr.shape}"
+        )
         raise ValueError(msg)
     return arr
 
@@ -639,6 +689,9 @@ class SweepReport:
             # campaigns"): present only on sweeps that carried the fault /
             # hazard machinery, so unconfigured summaries stay unchanged
             **self._scorecard_fields(res),
+            # LLM serving counters (docs/guides/serving.md): present only
+            # on sweeps whose plan carries llm_serve steps
+            **self._serving_fields(res),
             # pooled order-statistic CIs (asyncflow_tpu.analysis): intervals
             # on the POOLED tail quantiles the point fields above report —
             # [lo, hi] at ci_level, NaN-pairs on empty sweeps
@@ -671,6 +724,32 @@ class SweepReport:
             out["time_to_drain_mean_s"] = (
                 float(finite.mean()) if finite.size else None
             )
+        return out
+
+    def _serving_fields(self, res: SweepResults) -> dict:
+        """LLM serving summary keys; empty on non-serving sweeps."""
+        if res.decode_tokens is None:
+            return {}
+        decode = float(res.decode_tokens.sum())
+        out: dict = {
+            "kv_evictions_total": (
+                int(res.kv_evictions.sum())
+                if res.kv_evictions is not None
+                else 0
+            ),
+            "prefill_tokens_total": (
+                float(res.prefill_tokens.sum())
+                if res.prefill_tokens is not None
+                else 0.0
+            ),
+            "decode_tokens_total": decode,
+        }
+        horizon = getattr(self.plan, "horizon", None) if self.plan else None
+        if horizon:
+            # generated tokens per simulated second, pooled over the
+            # effective scenarios — the serving throughput headline
+            n_eff = max(self.n_scenarios - self.n_quarantined, 1)
+            out["tokens_per_s"] = decode / (float(horizon) * n_eff)
         return out
 
     #: confidence level of the summary()'s interval fields
@@ -877,6 +956,14 @@ class SweepRunner:
             raise_fence(f"hazard.{engine}")
         if tail and engine in ("native", "pallas"):
             raise_fence(f"tail_tolerance.{engine}")
+        # LLM serving (llm_serve batch/KV dynamics) is event-only for now:
+        # the continuous-batching admission gate and eviction lifecycle
+        # live in the oracle heap loop and the XLA event engine.
+        serving = getattr(self.plan, "has_serving", False)
+        if serving and engine in ("native", "pallas"):
+            raise_fence(f"llm.{engine}")
+        if serving and engine == "fast":
+            raise_fence("llm.fastpath")
         resilient = self.plan.has_faults or self.plan.has_retry or tail or hazards
         if engine == "native":
             # the single-core C++ oracle, looped over the scenario grid:
@@ -920,6 +1007,7 @@ class SweepRunner:
             # mixtures, LLM dynamics, weighted endpoints, multi-generator
             # workloads) but NOT fault windows / client retries / the
             # tail-tolerance policies — those route to the XLA event engine
+            and not serving
         ):
             from asyncflow_tpu.engines.jaxsim.pallas_engine import PallasEngine
 
@@ -999,8 +1087,9 @@ class SweepRunner:
         # never silently merged (e.g. pre-gauge_means chunks); v6 added
         # the quarantine mask/reason arrays and the digest sidecars; v7 the
         # gauge_hist/gauge_hist_cap band histograms; v8 the dark_lost
-        # availability counter (chaos campaigns)
-        digest.update(b"chunk-schema-v8")
+        # availability counter (chaos campaigns); v9 the LLM serving
+        # counters (kv_evictions / prefill_tokens / decode_tokens)
+        digest.update(b"chunk-schema-v9")
         digest.update(self.payload.model_dump_json().encode())
         # the LOWERED plan arrays, not just the payload: any plan-level
         # field (fault tables, retry scalars, capacity estimates — and
@@ -2084,6 +2173,10 @@ class _SweepCheckpoint:
         if part.llm_cost_sum is not None:
             payload["llm_cost_sum"] = part.llm_cost_sum
             payload["llm_cost_sumsq"] = part.llm_cost_sumsq
+        if part.decode_tokens is not None:
+            payload["kv_evictions"] = part.kv_evictions
+            payload["prefill_tokens"] = part.prefill_tokens
+            payload["decode_tokens"] = part.decode_tokens
         if part.truncated is not None:
             payload["truncated"] = part.truncated
         if part.dark_lost is not None:
@@ -2165,6 +2258,15 @@ class _SweepCheckpoint:
                 llm_cost_sumsq=(
                     data["llm_cost_sumsq"] if "llm_cost_sumsq" in data else None
                 ),
+                kv_evictions=(
+                    data["kv_evictions"] if "kv_evictions" in data else None
+                ),
+                prefill_tokens=(
+                    data["prefill_tokens"] if "prefill_tokens" in data else None
+                ),
+                decode_tokens=(
+                    data["decode_tokens"] if "decode_tokens" in data else None
+                ),
                 truncated=data["truncated"] if "truncated" in data else None,
                 dark_lost=data["dark_lost"] if "dark_lost" in data else None,
                 total_timed_out=(
@@ -2221,6 +2323,8 @@ _FINITE_FIELDS = (
     "gauge_series",
     "llm_cost_sum",
     "llm_cost_sumsq",
+    "prefill_tokens",
+    "decode_tokens",
 )
 
 
@@ -2653,6 +2757,21 @@ def _concat_sweeps(parts: list[SweepResults]) -> SweepResults:
             llm_cost_sumsq=(
                 np.concatenate([p.llm_cost_sumsq for p in parts])
                 if all(p.llm_cost_sumsq is not None for p in parts)
+                else None
+            ),
+            kv_evictions=(
+                np.concatenate([p.kv_evictions for p in parts])
+                if all(p.kv_evictions is not None for p in parts)
+                else None
+            ),
+            prefill_tokens=(
+                np.concatenate([p.prefill_tokens for p in parts])
+                if all(p.prefill_tokens is not None for p in parts)
+                else None
+            ),
+            decode_tokens=(
+                np.concatenate([p.decode_tokens for p in parts])
+                if all(p.decode_tokens is not None for p in parts)
                 else None
             ),
             flight_ev=(

@@ -191,6 +191,21 @@ class SimulationRunner:
                 stacklevel=2,
             )
             backend = Backend.ORACLE
+        if backend == Backend.NATIVE and any(
+            getattr(step, "is_serving", False)
+            for srv in self.simulation_input.topology_graph.nodes.servers
+            for ep in srv.endpoints
+            for step in ep.steps
+        ):
+            import warnings
+
+            warnings.warn(
+                "the native C++ core does not model LLM serving "
+                "(llm_serve batch/KV dynamics) yet; falling back to the "
+                "Python oracle",
+                stacklevel=2,
+            )
+            backend = Backend.ORACLE
         if backend == Backend.NATIVE:
             from asyncflow_tpu.engines.oracle.native import native_available
 

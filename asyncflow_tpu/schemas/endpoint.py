@@ -17,6 +17,7 @@ from asyncflow_tpu.config.constants import (
     EndpointStepRAM,
     StepOperation,
 )
+from asyncflow_tpu.serving.schemas import LlmEndpointStep
 
 StepKind = EndpointStepIO | EndpointStepCPU | EndpointStepRAM
 
@@ -125,6 +126,12 @@ class Step(BaseModel):
     # -- typed accessors used by the compiler / engines --------------------
 
     @property
+    def is_serving(self) -> bool:
+        """LLM serving steps (prefill/decode) live in their own schema —
+        :class:`asyncflow_tpu.serving.schemas.LlmEndpointStep`."""
+        return False
+
+    @property
     def is_llm(self) -> bool:
         return self.llm_tokens_mean is not None
 
@@ -158,10 +165,15 @@ class Endpoint(BaseModel):
     endpoint — traffic splits proportionally to the weights within a
     server.  The default (1.0 everywhere) reproduces the reference's
     uniform pick exactly.
+
+    Steps may be plain :class:`Step` entries or ``llm_serve``
+    :class:`~asyncflow_tpu.serving.schemas.LlmEndpointStep` entries (the
+    ``kind`` literal discriminates); serving steps lower to
+    prefill/decode segment pairs under the server's batch policy.
     """
 
     endpoint_name: str
-    steps: list[Step]
+    steps: list[LlmEndpointStep | Step]
     selection_weight: PositiveFloat = 1.0
 
     @field_validator("endpoint_name", mode="before")

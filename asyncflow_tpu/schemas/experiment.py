@@ -39,6 +39,9 @@ SUPPORTED_METRICS = (
     # needs a sweep that carried the fault/hazard machinery (the
     # estimator raises a named error otherwise)
     "availability_fraction",
+    # LLM serving throughput: decode_tokens / horizon; needs a sweep
+    # whose plan carries llm_serve steps (named error otherwise)
+    "tokens_per_s",
 )
 
 

@@ -28,6 +28,7 @@ from asyncflow_tpu.config.constants import (
 )
 from asyncflow_tpu.schemas.endpoint import Endpoint
 from asyncflow_tpu.schemas.resilience import LbHealthPolicy
+from asyncflow_tpu.serving.schemas import ServingPolicy
 
 
 def _fixed_type(expected: SystemNodes):
@@ -190,8 +191,34 @@ class Server(BaseModel):
     endpoints: list[Endpoint]
     #: optional load-shedding controls (reference roadmap milestone 5)
     overload: OverloadPolicy | None = None
+    #: optional LLM continuous-batching policy (serving subsystem);
+    #: required when any endpoint carries an ``llm_serve`` step so KV
+    #: admission is always explicit.
+    serving: ServingPolicy | None = None
 
     _check_type = field_validator("type", mode="after")(_fixed_type(SystemNodes.SERVER))
+
+    @model_validator(mode="after")
+    def _serving_policy_iff_serving_steps(self) -> Server:
+        has_serving_step = any(
+            getattr(step, "is_serving", False)
+            for ep in self.endpoints
+            for step in ep.steps
+        )
+        if has_serving_step and self.serving is None:
+            msg = (
+                f"server {self.id!r} has llm_serve steps but no serving "
+                "policy — set server.serving (max_batch_tokens / "
+                "max_batch_requests / kv_cache_mb)"
+            )
+            raise ValueError(msg)
+        if self.serving is not None and not has_serving_step:
+            msg = (
+                f"server {self.id!r} has a serving policy but no "
+                "llm_serve endpoint step"
+            )
+            raise ValueError(msg)
+        return self
 
 
 class LoadBalancer(BaseModel):

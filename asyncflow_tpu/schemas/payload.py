@@ -149,6 +149,18 @@ class SimulationPayload(BaseModel):
         return self
 
     @model_validator(mode="after")
+    def _replay_single_generator(self) -> SimulationPayload:
+        has_replay = any(g.replay is not None for g in self.generators)
+        if has_replay and len(self.generators) > 1:
+            msg = (
+                "trace replay with multiple generators is not supported: "
+                "the replay table owns the whole arrival order; merge the "
+                "logs into one trace or drop the extra generators"
+            )
+            raise ValueError(msg)
+        return self
+
+    @model_validator(mode="after")
     def _fault_targets_exist_and_match_kind(self) -> SimulationPayload:
         if self.fault_timeline is None:
             return self

@@ -12,10 +12,19 @@ from pydantic import BaseModel, Field, field_validator
 
 from asyncflow_tpu.config.constants import Distribution, SystemNodes, TimeDefaults
 from asyncflow_tpu.schemas.random_variables import RVConfig
+from asyncflow_tpu.serving.schemas import ReplayArrivals
 
 
 class RqsGenerator(BaseModel):
-    """Compound stochastic arrival process: users x per-user request rate."""
+    """Compound stochastic arrival process: users x per-user request rate.
+
+    With a ``replay`` table (serving trace-replay front door,
+    ``asyncflow_tpu.serving.trace_replay.load_trace``) the stochastic
+    process is bypassed entirely: request r spawns at ``replay.times[r]``
+    exactly, with optional per-request token presets.  The nominal RV
+    fields remain required — capacity estimation reads them as the
+    offered-load model.
+    """
 
     id: str
     type: SystemNodes = SystemNodes.GENERATOR
@@ -27,6 +36,9 @@ class RqsGenerator(BaseModel):
         le=int(TimeDefaults.MAX_USER_SAMPLING_WINDOW),
         description="Seconds between re-draws of the active-user count.",
     )
+    #: deterministic arrival table replacing the stochastic process
+    #: (single-generator payloads only — enforced by SimulationPayload).
+    replay: ReplayArrivals | None = None
 
     @field_validator("avg_request_per_minute_per_user", mode="after")
     @classmethod

@@ -1,0 +1,34 @@
+"""LLM-inference serving subsystem (ROADMAP open item 2).
+
+Schemas for prefill/decode endpoint steps, KV-cache-aware batch policies,
+and trace-replay arrival tables — plus the trace-replay front door.  See
+``docs/guides/serving.md``.
+"""
+
+from asyncflow_tpu.serving.schemas import (
+    LlmEndpointStep,
+    ReplayArrivals,
+    ServingPolicy,
+    TokenRV,
+)
+
+__all__ = [
+    "LlmEndpointStep",
+    "ReplayArrivals",
+    "ServingPolicy",
+    "TokenRV",
+    "TraceFormatError",
+    "load_replay",
+    "load_trace",
+]
+
+
+def __getattr__(name: str):
+    # trace_replay imports the workload schema; loading it lazily keeps
+    # `schemas.endpoint -> serving.schemas` cycle-free.
+    if name in ("load_trace", "load_replay", "TraceFormatError"):
+        from asyncflow_tpu.serving import trace_replay
+
+        return getattr(trace_replay, name)
+    msg = f"module {__name__!r} has no attribute {name!r}"
+    raise AttributeError(msg)

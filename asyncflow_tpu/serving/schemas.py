@@ -1,0 +1,267 @@
+"""LLM-serving workload schemas: prefill/decode endpoint steps, KV-cache
+batch policies, and trace-replay arrival tables.
+
+The serving subsystem activates ROADMAP open item 2 (the largest unbuilt
+capability): the pallas engine's internal ``seg_llm_*`` cost sketch becomes
+a first-class workload family — validated here, lowered by the compiler to
+``SEG_PREFILL``/``SEG_DECODE`` segment pairs plus per-server batch budgets,
+and executed with identical semantics by the oracle heap loop and the
+vmapped JAX event engine (oracle<->JAX parity gates pin the lifecycle).
+
+Model (LLMServingSim / Revati -style, see PAPERS.md):
+
+- A request arriving at an ``llm_serve`` step draws ``input_tokens`` and
+  ``output_tokens`` once (deterministic when variance is 0; replay traces
+  preset them per request).
+- **Prefill** runs after batch admission and costs
+  ``prefill_base_s + input_tokens * prefill_time_per_token_s``; its KV
+  footprint is ``input_tokens`` tokens.
+- **Decode** generates ``output_tokens`` tokens at ``decode_tokens_per_s``
+  (a per-attempt rate draw), growing the KV footprint by the generated
+  sequence length.  If the KV budget cannot hold the decode extension the
+  request is **evicted**: its KV pages and batch slot are freed (waiting
+  prefills admit immediately — continuous batching) and it re-queues at
+  the FIFO tail with its prefill redone.
+- Completion / eviction / abandonment release the KV container.
+
+These schemas deliberately import nothing from ``asyncflow_tpu.schemas``
+so the endpoint schema can embed :class:`LlmEndpointStep` without an
+import cycle.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Literal
+
+from pydantic import (
+    BaseModel,
+    ConfigDict,
+    Field,
+    NonNegativeFloat,
+    PositiveFloat,
+    PositiveInt,
+    model_validator,
+)
+
+#: 99th percentile z-score — the checker's "p99 input length" heuristic
+#: (AF702) and the capacity planner's long-request bound share it.
+Z_P99 = 2.326
+
+
+class TokenRV(BaseModel):
+    """A token-count (or token-rate) random variable.
+
+    ``variance == 0`` (the default) makes the draw deterministic — the
+    variance-0 parity gates rely on this.  Positive variance draws a
+    normal clamped to at least one token (rates clamp to a small positive
+    floor), identically in the oracle and the JAX engine so the two stay
+    draw-for-draw comparable.
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    mean: PositiveFloat
+    variance: NonNegativeFloat = 0.0
+
+    @property
+    def sigma(self) -> float:
+        return math.sqrt(float(self.variance))
+
+    @property
+    def p99(self) -> float:
+        """The ~99th-percentile draw (mean for deterministic RVs)."""
+        return float(self.mean) + Z_P99 * self.sigma
+
+
+class LlmEndpointStep(BaseModel):
+    """One LLM inference call inside an endpoint (kind ``llm_serve``).
+
+    Duck-type compatible with :class:`asyncflow_tpu.schemas.endpoint.Step`
+    everywhere the compiler and checker walk endpoint steps: it is an
+    IO-like step (no core held — the accelerator is modeled as the
+    server's serving batch, not its CPU), whose nominal ``quantity`` is
+    the expected end-to-end duration.
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    kind: Literal["llm_serve"]
+    #: prompt length per request (KV footprint of the prefill).
+    input_tokens: TokenRV
+    #: generated sequence length per request (drawn once, redone evictions
+    #: reuse the draw; replay traces preset it).
+    output_tokens: TokenRV
+    #: prefill compute cost per prompt token (seconds/token).
+    prefill_time_per_token_s: PositiveFloat
+    #: fixed prefill overhead (scheduling, batch formation).
+    prefill_base_s: NonNegativeFloat = 0.0
+    #: decode throughput for this request's stream (tokens/second).
+    decode_tokens_per_s: TokenRV
+    #: KV-cache footprint per resident token (MB); combined with the
+    #: server's ``ServingPolicy.kv_cache_mb`` it caps resident tokens.
+    kv_mb_per_token: NonNegativeFloat = 0.0
+    #: accounting cost per generated token (``llm_cost`` units).
+    cost_per_token: NonNegativeFloat = 0.0
+
+    # -- Step duck-typing used by the compiler / checker -------------------
+
+    @property
+    def is_serving(self) -> bool:
+        return True
+
+    @property
+    def is_cpu(self) -> bool:
+        return False
+
+    @property
+    def is_io(self) -> bool:
+        return True
+
+    @property
+    def is_ram(self) -> bool:
+        return False
+
+    @property
+    def is_llm(self) -> bool:
+        return False
+
+    @property
+    def is_stochastic_cache(self) -> bool:
+        return False
+
+    @property
+    def cache_hit_probability(self) -> None:
+        return None
+
+    @property
+    def llm_tokens_mean(self) -> None:
+        return None
+
+    @property
+    def expected_prefill_s(self) -> float:
+        return float(self.prefill_base_s) + float(self.input_tokens.mean) * float(
+            self.prefill_time_per_token_s,
+        )
+
+    @property
+    def expected_decode_s(self) -> float:
+        return float(self.output_tokens.mean) / float(self.decode_tokens_per_s.mean)
+
+    @property
+    def quantity(self) -> float:
+        """Expected end-to-end duration — the nominal seconds the rest of
+        the pipeline (capacity bounds, checker service floors) sees."""
+        return self.expected_prefill_s + self.expected_decode_s
+
+    @property
+    def worst_duration(self) -> float:
+        """A 6-sigma long request (capacity bounds; mirrors the SEG_LLM
+        worst-case treatment in ``_estimate_capacity``)."""
+        tin = float(self.input_tokens.mean) + 6.0 * self.input_tokens.sigma
+        tout = float(self.output_tokens.mean) + 6.0 * self.output_tokens.sigma
+        rate = max(
+            float(self.decode_tokens_per_s.mean)
+            - 6.0 * self.decode_tokens_per_s.sigma,
+            0.1 * float(self.decode_tokens_per_s.mean),
+        )
+        return (
+            float(self.prefill_base_s)
+            + tin * float(self.prefill_time_per_token_s)
+            + tout / rate
+        )
+
+    @property
+    def kv_tokens_max_p99(self) -> float:
+        """~p99 resident-token footprint of one request (prompt + full
+        generated sequence) — the AF701/AF702 livelock heuristics."""
+        return self.input_tokens.p99 + self.output_tokens.p99
+
+
+class ServingPolicy(BaseModel):
+    """Continuous-batching policy of one server's LLM serving runtime.
+
+    The admission gate is a single FIFO: a waiting request is admitted
+    when a batch slot is free AND the token budget fits its prompt
+    (head-of-line blocking — no reordering, matching vLLM-style FCFS
+    admission).  Admission re-runs at every completion and eviction,
+    which is the continuous-time limit of iteration-level (continuous)
+    batching: decode iterations admit waiting prefills between token
+    steps.
+
+    The token budget is ``min(max_batch_tokens, kv_cache_mb /
+    kv_mb_per_token)`` — the KV-cache container.  A decode extension that
+    does not fit **evicts** the request (KV pages freed, prefill redone
+    from the FIFO tail); ``max_evictions`` bounds the thrash before the
+    request is rejected outright.
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    #: resident-token budget of the batch (None = unlimited).
+    max_batch_tokens: PositiveInt | None = None
+    #: concurrent-request cap of the batch (None = unlimited).
+    max_batch_requests: PositiveInt | None = None
+    #: KV-cache capacity in MB (None = unlimited); divides by the step's
+    #: ``kv_mb_per_token`` into a token budget.
+    kv_cache_mb: PositiveFloat | None = None
+    #: evictions tolerated per request before it is rejected.
+    max_evictions: int = Field(default=3, ge=0)
+
+    @model_validator(mode="after")
+    def _some_budget(self) -> ServingPolicy:
+        if (
+            self.max_batch_tokens is None
+            and self.max_batch_requests is None
+            and self.kv_cache_mb is None
+        ):
+            msg = (
+                "ServingPolicy needs at least one of max_batch_tokens, "
+                "max_batch_requests or kv_cache_mb (otherwise omit it)"
+            )
+            raise ValueError(msg)
+        return self
+
+
+class ReplayArrivals(BaseModel):
+    """A deterministic arrival table distilled from a request log.
+
+    Lowered into the plan verbatim (sorted times + optional per-request
+    token presets), it replaces the generator's stochastic arrival
+    process: scenario i spawns request r at ``times[r]`` exactly, so a
+    replayed run reproduces the log's arrival count bit-identically
+    across chunking and checkpoint resume (the same prefix-stable
+    contract every other plan table obeys).  Restricted to
+    single-generator payloads.
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    #: arrival timestamps in seconds from scenario start (sorted, >= 0).
+    times: list[NonNegativeFloat]
+    #: optional per-request prompt lengths (len == len(times)).
+    input_tokens: list[PositiveFloat] | None = None
+    #: optional per-request generated lengths (len == len(times)).
+    output_tokens: list[PositiveFloat] | None = None
+
+    @model_validator(mode="after")
+    def _coherent(self) -> ReplayArrivals:
+        if not self.times:
+            msg = "ReplayArrivals.times cannot be empty"
+            raise ValueError(msg)
+        if any(b < a for a, b in zip(self.times, self.times[1:])):
+            msg = "ReplayArrivals.times must be sorted ascending"
+            raise ValueError(msg)
+        for name in ("input_tokens", "output_tokens"):
+            vals = getattr(self, name)
+            if vals is not None and len(vals) != len(self.times):
+                msg = f"ReplayArrivals.{name} must match len(times)"
+                raise ValueError(msg)
+        return self
+
+    @property
+    def mean_rate(self) -> float:
+        """Nominal requests/second over the trace span (feeds the
+        capacity estimator's fluid model)."""
+        span = max(float(self.times[-1]), 1e-9)
+        return len(self.times) / span

@@ -1,0 +1,151 @@
+"""Trace-replay front door: ingest a production request log into a
+replay-backed :class:`~asyncflow_tpu.schemas.workload.RqsGenerator`.
+
+Accepted formats (Revati-style request logs):
+
+- **CSV** with a header naming at least a timestamp column; token columns
+  are optional.  Recognized names (case-insensitive):
+  ``timestamp``/``arrival_time``/``time``/``ts`` (seconds),
+  ``input_tokens``/``prompt_tokens``/``input_length``,
+  ``output_tokens``/``generated_tokens``/``output_length``.
+- **JSONL**: one JSON object per line with the same keys.
+
+``load_trace`` validates and normalizes the log (sorts by timestamp,
+rebases to t=0 by default) and returns an ``RqsGenerator`` whose
+``replay`` table carries the arrivals verbatim; the generator's nominal
+Poisson rate fields are derived from the trace so capacity estimation
+(``_estimate_capacity``) sees the real offered load.  Engines detect the
+replay table and spawn request r at ``times[r]`` exactly — prefix-stable
+under chunking and checkpoint resume like every other plan table.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import math
+from pathlib import Path
+
+from asyncflow_tpu.config.constants import Distribution
+from asyncflow_tpu.schemas.random_variables import RVConfig
+from asyncflow_tpu.schemas.workload import RqsGenerator
+from asyncflow_tpu.serving.schemas import ReplayArrivals
+
+_TIME_KEYS = ("timestamp", "arrival_time", "time", "ts")
+_TIN_KEYS = ("input_tokens", "prompt_tokens", "input_length")
+_TOUT_KEYS = ("output_tokens", "generated_tokens", "output_length")
+
+
+class TraceFormatError(ValueError):
+    """The request log cannot be parsed into a replay table."""
+
+
+def _pick(row: dict, keys: tuple[str, ...]) -> float | None:
+    for k in keys:
+        if k in row and row[k] not in (None, ""):
+            try:
+                return float(row[k])
+            except (TypeError, ValueError) as exc:
+                msg = f"non-numeric value {row[k]!r} for column {k!r}"
+                raise TraceFormatError(msg) from exc
+    return None
+
+
+def _parse_rows(path: Path) -> list[dict]:
+    text = path.read_text()
+    if path.suffix.lower() in (".jsonl", ".ndjson", ".json"):
+        rows = []
+        for ln, line in enumerate(text.splitlines(), 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as exc:
+                msg = f"{path.name}:{ln}: invalid JSON ({exc.msg})"
+                raise TraceFormatError(msg) from exc
+            if not isinstance(obj, dict):
+                msg = f"{path.name}:{ln}: expected a JSON object per line"
+                raise TraceFormatError(msg)
+            rows.append({str(k).lower(): v for k, v in obj.items()})
+        return rows
+    # CSV (the default)
+    reader = csv.DictReader(text.splitlines())
+    if reader.fieldnames is None:
+        msg = f"{path.name}: empty trace"
+        raise TraceFormatError(msg)
+    return [
+        {str(k).strip().lower(): v for k, v in row.items() if k is not None}
+        for row in reader
+    ]
+
+
+def load_replay(path: str | Path, *, rebase: bool = True) -> ReplayArrivals:
+    """Parse a CSV/JSONL request log into a :class:`ReplayArrivals` table."""
+    path = Path(path)
+    rows = _parse_rows(path)
+    if not rows:
+        msg = f"{path.name}: trace has no request rows"
+        raise TraceFormatError(msg)
+    parsed: list[tuple[float, float | None, float | None]] = []
+    for i, row in enumerate(rows, 1):
+        t = _pick(row, _TIME_KEYS)
+        if t is None:
+            msg = (
+                f"{path.name}: row {i} has no timestamp column "
+                f"(expected one of {list(_TIME_KEYS)})"
+            )
+            raise TraceFormatError(msg)
+        if not math.isfinite(t):
+            msg = f"{path.name}: row {i} has a non-finite timestamp"
+            raise TraceFormatError(msg)
+        parsed.append((t, _pick(row, _TIN_KEYS), _pick(row, _TOUT_KEYS)))
+    parsed.sort(key=lambda r: r[0])
+    t0 = parsed[0][0] if rebase else 0.0
+    if parsed[0][0] - t0 < 0:
+        msg = f"{path.name}: negative timestamps (pass rebase=True)"
+        raise TraceFormatError(msg)
+    times = [t - t0 for t, _, _ in parsed]
+    tins = [tin for _, tin, _ in parsed]
+    touts = [tout for _, _, tout in parsed]
+    has_tin = any(v is not None for v in tins)
+    has_tout = any(v is not None for v in touts)
+    if has_tin and not all(v is not None and v > 0 for v in tins):
+        msg = f"{path.name}: input_tokens must be positive on every row or absent"
+        raise TraceFormatError(msg)
+    if has_tout and not all(v is not None and v > 0 for v in touts):
+        msg = f"{path.name}: output_tokens must be positive on every row or absent"
+        raise TraceFormatError(msg)
+    return ReplayArrivals(
+        times=times,
+        input_tokens=tins if has_tin else None,
+        output_tokens=touts if has_tout else None,
+    )
+
+
+def load_trace(
+    path: str | Path,
+    *,
+    generator_id: str = "trace-replay",
+    rebase: bool = True,
+) -> RqsGenerator:
+    """Load a request log and wrap it as a replay-backed generator.
+
+    The nominal ``avg_active_users`` / ``avg_request_per_minute_per_user``
+    fields are derived from the trace's mean rate (capacity estimation
+    reads them); the actual arrival PROCESS is the replay table, consumed
+    verbatim by both engines.
+    """
+    replay = load_replay(path, rebase=rebase)
+    rate = replay.mean_rate  # requests / second
+    users = max(1.0, math.ceil(rate))
+    return RqsGenerator(
+        id=generator_id,
+        avg_active_users=RVConfig(
+            mean=users, distribution=Distribution.POISSON,
+        ),
+        avg_request_per_minute_per_user=RVConfig(
+            mean=60.0 * rate / users, distribution=Distribution.POISSON,
+        ),
+        replay=replay,
+    )

@@ -75,6 +75,12 @@ def _parse_args(argv: list[str]) -> dict:
     (must route to the scan fast path) vs the same sweep forced onto the
     event engine, recorded under ``detail.chaos``.
 
+    ``--serving``: run the LLM serving arm — the shipped chat-burst
+    scenario (continuous batching + KV eviction) swept on the event
+    engine, reporting scen/s AND simulated tokens/s, asserting dispatch
+    and ``predict_routing`` agree on the routed engine, under
+    ``detail.serving``.
+
     ``--checkpoint-dir DIR``: checkpoint the measured sweep's chunks under
     ``DIR`` so a preempted/killed benchmark is resumable.  A SIGTERM/SIGINT
     during the measured sweep drains the in-flight chunk, writes a resume
@@ -94,6 +100,7 @@ def _parse_args(argv: list[str]) -> dict:
         "gauge_guard": False,
         "resilient": False,
         "chaos": False,
+        "serving": False,
         "checkpoint_dir": None,
         "resume": False,
     }
@@ -107,6 +114,8 @@ def _parse_args(argv: list[str]) -> dict:
             opts["resilient"] = True
         elif arg == "--chaos":
             opts["chaos"] = True
+        elif arg == "--serving":
+            opts["serving"] = True
         elif arg == "--resume":
             opts["resume"] = True
         elif arg == "--checkpoint-dir":
@@ -601,6 +610,72 @@ def _chaos_arm() -> dict:
     }
 
 
+def _serving_arm() -> dict:
+    """LLM serving arm (BENCH_SERVING=1 / --serving).
+
+    Sweeps the shipped chat-burst scenario (continuous batching + KV
+    eviction, docs/guides/serving.md) on the event engine — the only
+    engine that models the admission gate — and reports BOTH rates that
+    matter for serving studies: scenarios/s of the sweep and simulated
+    generated-tokens/s inside it.  Dispatch and ``predict_routing`` must
+    agree on the routed engine (the llm.* fences price the fastpath gap).
+    """
+    import yaml as _yaml
+
+    from asyncflow_tpu.checker.fences import predict_routing
+    from asyncflow_tpu.parallel.sweep import SweepRunner
+    from asyncflow_tpu.schemas.payload import SimulationPayload
+
+    horizon = int(os.environ.get("BENCH_SERVING_HORIZON", "120"))
+    n = int(os.environ.get("BENCH_SERVING_SCENARIOS", "64"))
+    data = _yaml.safe_load(
+        open(
+            os.path.join(
+                REPO, "examples", "yaml_input", "data",
+                "serving_chat_burst.yml",
+            ),
+        ).read(),
+    )
+    data["sim_settings"]["total_simulation_time"] = horizon
+    data["sim_settings"]["enabled_sample_metrics"] = []
+    payload = SimulationPayload.model_validate(data)
+    runner = SweepRunner(payload, engine="auto", use_mesh=False)
+    pred = predict_routing(runner.plan, engine="auto")
+    if runner.engine_kind != "event" or pred.engine != runner.engine_kind:
+        msg = (
+            "serving arm FAILED: the chat-burst sweep must route to the "
+            f"event engine (dispatched {runner.engine_kind!r}, predicted "
+            f"{pred.engine!r})"
+        )
+        raise AssertionError(msg)
+    runner.run(n, seed=SEED, chunk_size=n)  # warm the compiled shape
+    t0 = time.time()
+    rep = runner.run(n, seed=SEED + 1, chunk_size=n)
+    wall = time.time() - t0
+    summary = rep.summary()
+    if not summary["decode_tokens_total"] > 0:
+        msg = "serving arm FAILED: the sweep generated no decode tokens"
+        raise AssertionError(msg)
+    scen_rate = n / max(wall, 1e-9)
+    return {
+        "n_scenarios": n,
+        "horizon_s": horizon,
+        "engine_kind": runner.engine_kind,
+        "predicted_engine": pred.engine,
+        "completed_total": summary["completed_total"],
+        "kv_evictions_total": summary["kv_evictions_total"],
+        "decode_tokens_total": round(summary["decode_tokens_total"], 1),
+        # simulated serving throughput (per scenario), the headline
+        # compare() uses for batching-policy studies
+        "sim_tokens_per_s": round(summary["tokens_per_s"], 3),
+        "event_scen_s": round(scen_rate, 3),
+        # wall-clock token throughput of the benchmark itself
+        "bench_tokens_per_s": round(
+            summary["decode_tokens_total"] / max(wall, 1e-9), 1,
+        ),
+    }
+
+
 def _result_json(
     *,
     value: float,
@@ -909,6 +984,16 @@ def run_measurement() -> None:
             f"{hz['availability_fraction']:.4f}",
             file=sys.stderr,
         )
+    if os.environ.get("BENCH_SERVING") == "1":
+        detail["serving"] = _serving_arm()
+        sv = detail["serving"]
+        print(
+            f"serving: event {sv['event_scen_s']:.1f} scen/s, "
+            f"{sv['sim_tokens_per_s']:.1f} simulated tok/s per scenario "
+            f"({sv['bench_tokens_per_s']:.0f} tok/s wall), auto-dispatch "
+            f"-> {sv['engine_kind']}",
+            file=sys.stderr,
+        )
     if on_accel:
         # Device-time breakdown.  One blocking dispatch costs
         # warm_chunk_wall_s = kernel time + tunnel round trip, and the RTT
@@ -1099,6 +1184,8 @@ def main() -> None:
         os.environ["BENCH_RESILIENT"] = "1"
     if opts["chaos"]:
         os.environ["BENCH_CHAOS"] = "1"
+    if opts["serving"]:
+        os.environ["BENCH_SERVING"] = "1"
     if opts["checkpoint_dir"]:
         os.environ["BENCH_CHECKPOINT_DIR"] = opts["checkpoint_dir"]
     if opts["resume"]:

@@ -270,6 +270,56 @@ if [ "$rc" -ne 2 ]; then
   echo "checker exit $rc on the zero-availability fixture (expected 2: AF602)" >&2
   exit 1
 fi
+# LLM-serving slice: a tiny continuous-batching sweep must route to the
+# event engine (predict_routing agreeing), generate tokens, and surface
+# the serving counters + tokens_per_s headline; the checker must bless
+# the shipped chat burst (exit 0) and reject the eviction-livelock
+# fixture (exit 2: AF701); the divergence CLI must report zero
+# divergence on the variance-0 serving parity scenario —
+# docs/guides/serving.md
+python - <<'PY'
+import yaml
+from asyncflow_tpu.checker.fences import predict_routing
+from asyncflow_tpu.parallel.sweep import SweepRunner
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+data = yaml.safe_load(
+    open("examples/yaml_input/data/serving_chat_burst.yml").read())
+data["sim_settings"]["total_simulation_time"] = 30
+data["sim_settings"]["enabled_sample_metrics"] = []
+payload = SimulationPayload.model_validate(data)
+runner = SweepRunner(payload, engine="auto", use_mesh=False)
+pred = predict_routing(runner.plan, engine="auto")
+if runner.engine_kind != "event" or pred.engine != runner.engine_kind:
+    raise SystemExit(
+        "serving routing regressed: llm_serve sweep dispatched "
+        f"{runner.engine_kind!r}, predicted {pred.engine!r} (expected 'event')"
+    )
+rep = runner.run(4, seed=7, chunk_size=2)
+res = rep.results
+assert res.decode_tokens is not None, "serving counters must surface"
+assert float(res.decode_tokens.sum()) > 0.0, "the batch must generate tokens"
+assert float(res.prefill_tokens.sum()) > 0.0
+summ = rep.summary()
+for key in ("decode_tokens_total", "prefill_tokens_total",
+            "kv_evictions_total", "tokens_per_s"):
+    assert key in summ, f"summary is missing {key!r}"
+assert summ["tokens_per_s"] > 0.0, summ
+print("llm_serve sweep on the event engine OK "
+      f"(engine={runner.engine_kind}, predicted={pred.engine}, "
+      f"tokens_per_s={summ['tokens_per_s']:.1f})")
+PY
+python -m asyncflow_tpu.checker examples/yaml_input/data/serving_chat_burst.yml \
+  --backend cpu
+rc=0
+python -m asyncflow_tpu.checker tests/integration/data/serving_livelock.yml \
+  --backend cpu > /dev/null || rc=$?
+if [ "$rc" -ne 2 ]; then
+  echo "checker exit $rc on the serving livelock fixture (expected 2: AF701)" >&2
+  exit 1
+fi
+python -m asyncflow_tpu.observability.diverge \
+  examples/yaml_input/data/serving_parity.yml --mode flight --seed 0
 # static-checker slice: the repo must lint clean under the invariant AST
 # rules, the preflight CLI must pass a shipped example (exit 0) and call
 # a deliberately saturated scenario (exit 2) — docs/guides/diagnostics.md

@@ -1,0 +1,323 @@
+"""LLM serving dynamics: oracle <-> JAX event-engine parity gates.
+
+Semantics under test (docs/guides/serving.md): an ``llm_serve`` step
+enters its server's continuous-batching admission FIFO (slots + resident
+tokens), prefills at ``prefill_base_s + input_tokens *
+prefill_time_per_token_s``, then extends its residency by the drawn
+output tokens — an extension that does not fit the budget EVICTS the
+request (prefill redone, ``max_evictions`` thrash bound before outright
+rejection).  The oracle heap loop and the vmapped XLA event engine lower
+from the same plan scalars and must agree:
+
+- bitwise on the variance-0 parity scenario (canonical FR spans, token
+  counters, llm_cost) even though their arrival RNG families differ;
+- on the per-request FATE under deterministic KV pressure (every request
+  evicts exactly max_evictions+1 times, then rejects);
+- exactly on replayed arrival counts and preset token totals;
+- within PR-8 ensemble tolerances (frac_tol=0.04, lat_tol=0.08) on
+  stochastic workloads.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import yaml
+from pydantic import ValidationError
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.engines.jaxsim.engine import run_single
+from asyncflow_tpu.engines.oracle.engine import OracleEngine
+from asyncflow_tpu.observability.diverge import find_first_divergence
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+pytestmark = pytest.mark.integration
+
+PARITY = "examples/yaml_input/data/serving_parity.yml"
+CHAT = "examples/yaml_input/data/serving_chat_burst.yml"
+FRAC_TOL, LAT_TOL = 0.04, 0.08
+SEEDS = 4
+
+
+def _payload(base: str = PARITY, mut=None) -> SimulationPayload:
+    data = yaml.safe_load(open(base).read())
+    if mut is not None:
+        mut(data)
+    return SimulationPayload.model_validate(data)
+
+
+def _serving_step(data):
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    return srv["endpoints"][0]["steps"][-1]
+
+
+# ---------------------------------------------------------------------------
+# schema gates
+# ---------------------------------------------------------------------------
+
+
+class TestSchema:
+    def test_policy_needs_some_budget(self) -> None:
+        def strip(data):
+            data["topology_graph"]["nodes"]["servers"][0]["serving"] = {}
+
+        with pytest.raises(ValidationError, match="at least one"):
+            _payload(mut=strip)
+
+    def test_serving_steps_need_a_policy(self) -> None:
+        def unpoliced(data):
+            del data["topology_graph"]["nodes"]["servers"][0]["serving"]
+
+        with pytest.raises(ValidationError, match="serving"):
+            _payload(mut=unpoliced)
+
+    def test_replay_times_must_be_sorted(self) -> None:
+        def unsorted(data):
+            data["rqs_input"]["replay"] = {"times": [2.0, 1.0]}
+
+        with pytest.raises(ValidationError, match="sorted"):
+            _payload(mut=unsorted)
+
+    def test_token_rv_p99(self) -> None:
+        from asyncflow_tpu.serving.schemas import TokenRV
+
+        assert TokenRV(mean=100.0).p99 == pytest.approx(100.0)
+        assert TokenRV(mean=100.0, variance=400.0).p99 == pytest.approx(
+            100.0 + 2.326 * 20.0,
+        )
+
+
+# ---------------------------------------------------------------------------
+# compiler lowering
+# ---------------------------------------------------------------------------
+
+
+def test_compiler_lowering_and_fastpath_decline() -> None:
+    plan = compile_payload(_payload())
+    assert plan.has_serving
+    assert float(plan.serve_tokens[0]) == pytest.approx(4000.0)
+    assert int(plan.serve_slots[0]) == 8
+    assert not plan.fastpath_ok
+    assert "serving" in plan.fastpath_reason
+
+    from asyncflow_tpu.parallel import SweepRunner
+
+    assert SweepRunner(_payload(), use_mesh=False).engine_kind == "event"
+
+
+def test_kv_cache_collapses_into_the_token_budget() -> None:
+    def kv(data):
+        srv = data["topology_graph"]["nodes"]["servers"][0]
+        srv["serving"] = {"max_batch_tokens": 4000, "kv_cache_mb": 100.0}
+        _serving_step(data)["kv_mb_per_token"] = 0.5
+
+    plan = compile_payload(_payload(mut=kv))
+    # min(4000, 100 MB / 0.5 MB/token) = 200 resident tokens
+    assert float(plan.serve_tokens[0]) == pytest.approx(200.0)
+
+
+# ---------------------------------------------------------------------------
+# variance-0 bitwise parity
+# ---------------------------------------------------------------------------
+
+
+def test_variance0_span_and_counter_parity() -> None:
+    """Both engines replay the same deterministic lifecycle: identical
+    canonical spans, token counters per request, and llm_cost."""
+    payload = _payload()
+    rep = find_first_divergence(payload, seed=3)
+    assert rep.equal, rep.divergence
+
+    ro = OracleEngine(payload, seed=3).run()
+    rj = run_single(payload, seed=3, engine="event")
+    # per-request token budgets are degenerate, so the PER-REQUEST rates
+    # agree exactly even though arrival counts may differ by RNG family
+    for r in (ro, rj):
+        n = max(r.total_generated, 1)
+        assert r.kv_evictions == 0
+        assert r.prefill_tokens / n == pytest.approx(100.0)
+        assert r.decode_tokens / n == pytest.approx(50.0)
+        # 0.004 cpu + 0.01 + 100*0.0001 prefill + 50/500 decode + 0.01 edges
+        assert float(np.mean(r.latencies)) == pytest.approx(0.134, abs=1e-5)
+        assert float(np.mean(r.llm_cost)) == pytest.approx(0.05, abs=1e-9)
+
+
+def test_eviction_fate_is_deterministic_on_both_engines() -> None:
+    """Budget 120 < footprint 150 makes every admission a guaranteed
+    eviction: each request thrashes max_evictions+1 times, then rejects.
+    The FATE is engine-independent even though arrival counts differ."""
+
+    def tighten(data):
+        srv = data["topology_graph"]["nodes"]["servers"][0]
+        srv["serving"] = {
+            "max_batch_tokens": 120,
+            "max_batch_requests": 2,
+            "max_evictions": 2,
+        }
+
+    payload = _payload(mut=tighten)
+    rep = find_first_divergence(payload, seed=3)
+    assert rep.equal, rep.divergence
+
+    for res in (
+        OracleEngine(payload, seed=3).run(),
+        run_single(payload, seed=3, engine="event"),
+    ):
+        rejected = res.total_rejected
+        assert rejected > 0
+        assert res.kv_evictions == 3 * rejected  # max_evictions + 1 each
+        assert res.decode_tokens == 0.0  # nothing ever decoded
+        assert res.prefill_tokens == pytest.approx(100.0 * res.kv_evictions)
+        assert len(res.latencies) == 0
+
+
+# ---------------------------------------------------------------------------
+# trace replay
+# ---------------------------------------------------------------------------
+
+
+def test_replay_reproduces_the_log_exactly() -> None:
+    """40 logged arrivals with per-request token presets: both engines
+    spawn EXACTLY the log's request count and consume the preset token
+    totals to the bit."""
+    times = [round(0.5 * i, 4) for i in range(40)]
+
+    def replay(data):
+        data["rqs_input"]["replay"] = {
+            "times": times,
+            "input_tokens": [100.0 + i for i in range(40)],
+            "output_tokens": [20.0 + (i % 5) for i in range(40)],
+        }
+        data["sim_settings"]["total_simulation_time"] = 30
+
+    payload = _payload(mut=replay)
+    rep = find_first_divergence(payload, seed=3)
+    assert rep.equal, rep.divergence
+
+    ro = OracleEngine(payload, seed=3).run()
+    rj = run_single(payload, seed=3, engine="event")
+    for r in (ro, rj):
+        assert r.total_generated == len(times)
+    assert ro.prefill_tokens == pytest.approx(rj.prefill_tokens, abs=1e-3)
+    assert ro.decode_tokens == pytest.approx(rj.decode_tokens, abs=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# stochastic ensemble parity (PR-8 tolerances)
+# ---------------------------------------------------------------------------
+
+
+def test_statistical_parity_on_the_chat_burst() -> None:
+    """Completion fraction within frac_tol, mean latency within lat_tol
+    across a seed ensemble of the shipped chat-burst scenario."""
+
+    def shorten(data):
+        data["sim_settings"]["total_simulation_time"] = 30
+
+    payload = _payload(CHAT, mut=shorten)
+    frac, lat = {}, {}
+    for name, run in (
+        ("oracle", lambda s: OracleEngine(payload, seed=s).run()),
+        ("event", lambda s: run_single(payload, seed=s, engine="event")),
+    ):
+        gen = comp = 0
+        lats = []
+        for s in range(SEEDS):
+            r = run(s)
+            gen += r.total_generated
+            comp += len(r.latencies)
+            lats.append(np.asarray(r.latencies))
+        frac[name] = comp / max(gen, 1)
+        lat[name] = float(np.mean(np.concatenate(lats)))
+    assert abs(frac["oracle"] - frac["event"]) <= FRAC_TOL, frac
+    assert abs(lat["oracle"] - lat["event"]) <= LAT_TOL * max(
+        lat["oracle"], lat["event"],
+    ), lat
+
+
+# ---------------------------------------------------------------------------
+# routing + sweep integration
+# ---------------------------------------------------------------------------
+
+
+def test_routing_prediction_mirrors_dispatch() -> None:
+    from asyncflow_tpu.checker.fences import predict_routing
+    from asyncflow_tpu.parallel import SweepRunner
+
+    payload = _payload()
+    plan = compile_payload(payload)
+    for requested in ("auto", "event"):
+        assert predict_routing(plan, engine=requested).engine == "event"
+    for requested, fence in (
+        ("fast", "llm.fastpath"),
+        ("pallas", "llm.pallas"),
+        ("native", "llm.native"),
+    ):
+        pred = predict_routing(plan, engine=requested)
+        assert pred.engine is None
+        assert pred.refusal is not None
+        assert pred.refusal.fence_id == fence
+        with pytest.raises(Exception, match="serving"):
+            SweepRunner(payload, engine=requested, use_mesh=False)
+    tripped = {f.fence_id for f in predict_routing(plan).fences}
+    assert {"llm.fastpath", "llm.pallas", "llm.native"} <= tripped
+
+
+def test_sweep_summary_and_serving_axes() -> None:
+    """summary() grows the serving block; the max_batch_tokens axis
+    applies KV pressure per scenario and decode_rate_scale stretches the
+    decode phase."""
+    from asyncflow_tpu.parallel import SweepRunner
+    from asyncflow_tpu.parallel.sweep import make_overrides
+
+    def stoch(data):
+        step = _serving_step(data)
+        step["input_tokens"] = {"mean": 100.0, "variance": 400.0}
+        step["output_tokens"] = {"mean": 50.0, "variance": 100.0}
+        data["sim_settings"]["total_simulation_time"] = 60
+
+    payload = _payload(mut=stoch)
+    runner = SweepRunner(payload, use_mesh=False)
+    summ = runner.run(4, seed=7).summary()
+    assert summ["decode_tokens_total"] > 0
+    assert summ["prefill_tokens_total"] > 0
+    assert summ["kv_evictions_total"] == 0
+    assert summ["tokens_per_s"] > 0
+
+    ov = make_overrides(
+        runner.plan, 4, max_batch_tokens=np.array([150.0, 150.0, -1.0, -1.0]),
+    )
+    res = runner.run(4, seed=7, overrides=ov).results
+    ev = np.asarray(res.kv_evictions)
+    assert ev[:2].sum() > 0  # squeezed scenarios thrash
+    assert ev[2:].sum() == 0  # unlimited scenarios never evict
+
+    ov2 = make_overrides(
+        runner.plan, 4, decode_rate_scale=np.array([1.0, 1.0, 0.25, 0.25]),
+    )
+    res2 = runner.run(4, seed=7, overrides=ov2).results
+    lats = np.asarray(res2.latency_sum) / np.maximum(
+        np.asarray(res2.completed), 1,
+    )
+    assert lats[2:].mean() > lats[:2].mean()
+
+    with pytest.raises(ValueError, match="llm_serve"):
+        make_overrides(
+            compile_payload(_payload("tests/integration/data/single_server.yml")),
+            2,
+            max_batch_tokens=np.array([100.0, 100.0]),
+        )
+
+
+def test_non_serving_results_stay_unchanged() -> None:
+    """Counters stay None (not zero) without llm_serve steps — the
+    serving plumbing must be invisible to every existing scenario."""
+    res = OracleEngine(
+        _payload("tests/integration/data/single_server.yml"), seed=1,
+    ).run()
+    assert res.kv_evictions is None
+    assert res.prefill_tokens is None
+    assert res.decode_tokens is None
+    assert "kv_evictions" not in res.counters().as_dict() or (
+        res.counters().kv_evictions == 0
+    )

@@ -1,0 +1,123 @@
+"""AF701-AF703 LLM serving sanity: the semantic traps that validate fine
+(every field individually legal) but make a serving scenario meaningless
+must be refused by name, and the CLI exit codes on the shipped fixtures
+are the contract the CI serving slice pins (docs/guides/serving.md)."""
+
+from __future__ import annotations
+
+import yaml
+
+from asyncflow_tpu.checker.__main__ import main
+from asyncflow_tpu.checker.passes import check_payload, serving_pass
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+CHAT = "examples/yaml_input/data/serving_chat_burst.yml"
+PARITY = "examples/yaml_input/data/serving_parity.yml"
+LIVELOCK = "tests/integration/data/serving_livelock.yml"
+
+
+def _load(path: str, mut=None) -> SimulationPayload:
+    data = yaml.safe_load(open(path).read())
+    if mut:
+        mut(data)
+    return SimulationPayload.model_validate(data)
+
+
+def _serving_codes(payload) -> dict[str, str]:
+    out: list = []
+    serving_pass(payload, out)
+    return {d.code: d.severity.value for d in out}
+
+
+def _policy(data) -> dict:
+    return data["topology_graph"]["nodes"]["servers"][0]["serving"]
+
+
+def _step(data) -> dict:
+    srv = data["topology_graph"]["nodes"]["servers"][0]
+    return srv["endpoints"][0]["steps"][-1]
+
+
+# ---------------------------------------------------------------------------
+# pass-level findings
+# ---------------------------------------------------------------------------
+
+
+def test_shipped_examples_raise_no_serving_findings() -> None:
+    assert _serving_codes(_load(CHAT)) == {}
+    assert _serving_codes(_load(PARITY)) == {}
+
+
+def test_payloads_without_serving_are_ignored() -> None:
+    assert _serving_codes(
+        _load("tests/integration/data/single_server.yml"),
+    ) == {}
+
+
+def test_af701_livelock_budget_is_an_error() -> None:
+    codes = _serving_codes(_load(LIVELOCK))
+    assert codes.get("AF701") == "error"
+    # AF702 is strictly weaker than AF701 — never double-reported
+    assert "AF702" not in codes
+
+
+def test_af701_via_kv_cache_collapse() -> None:
+    """The budget the pass checks is min(max_batch_tokens, kv_cache_mb /
+    kv_mb_per_token) — a generous batch cap with a tiny KV cache still
+    livelocks."""
+
+    def kv(data):
+        _policy(data).update({"max_batch_tokens": 100000, "kv_cache_mb": 50})
+        _step(data)["kv_mb_per_token"] = 0.5  # 100 resident tokens
+
+    assert _serving_codes(_load(CHAT, kv)).get("AF701") == "error"
+
+
+def test_af702_p99_starvation_is_a_warning() -> None:
+    def tighten(data):
+        # budget 310 holds the mean footprint 180 + 100 = 280 (no AF701)
+        # but not the ~p99 prompt 180 + 2.326 * 60 = 319.6 (AF702)
+        _policy(data).update({"max_batch_tokens": 310})
+        _step(data)["output_tokens"] = {"mean": 100.0}
+
+    codes = _serving_codes(_load(CHAT, tighten))
+    assert codes.get("AF702") == "warning"
+    assert "AF701" not in codes
+
+
+def test_af703_replay_past_horizon_is_a_warning() -> None:
+    def replay(data):
+        data["rqs_input"]["replay"] = {
+            "times": [float(t) for t in range(0, 200, 10)],
+        }
+
+    codes = _serving_codes(_load(PARITY, replay))
+    assert codes.get("AF703") == "warning"
+
+
+def test_af703_silent_when_trace_fits() -> None:
+    def replay(data):
+        data["rqs_input"]["replay"] = {"times": [0.0, 5.0, 10.0]}
+
+    assert _serving_codes(_load(PARITY, replay)) == {}
+
+
+def test_check_payload_runs_the_serving_pass() -> None:
+    report = check_payload(_load(LIVELOCK), backend="cpu")
+    assert any(d.code == "AF701" for d in report)
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes on the shipped fixtures (mirrors the CI serving slice)
+# ---------------------------------------------------------------------------
+
+
+def test_cli_blesses_the_chat_burst(capsys) -> None:
+    assert main([CHAT, "--backend", "cpu"]) == 0
+    capsys.readouterr()
+
+
+def test_cli_rejects_the_livelock_fixture(capsys) -> None:
+    assert main([LIVELOCK, "--backend", "cpu"]) == 2
+    out = capsys.readouterr().out
+    assert "AF701" in out
