@@ -34,6 +34,7 @@ from asyncflow_tpu.schemas.experiment import (
     SUPPORTED_METRICS,
     ExperimentConfig,
     VarianceReduction,
+    metric_supported,
 )
 
 #: default metric set of a comparison (every SUPPORTED_METRICS entry the
@@ -135,11 +136,11 @@ def compare(
     """
     from asyncflow_tpu.parallel.sweep import SweepRunner, make_overrides
 
-    unknown = [m for m in metrics if m not in SUPPORTED_METRICS]
+    unknown = [m for m in metrics if not metric_supported(m)]
     if unknown:
         msg = (
             f"unknown comparison metrics {unknown}; supported: "
-            f"{', '.join(SUPPORTED_METRICS)}"
+            f"{', '.join(SUPPORTED_METRICS)}, blame_share:<phase>"
         )
         raise ValueError(msg)
     if experiment is None:
@@ -152,6 +153,9 @@ def compare(
         use_mesh=use_mesh,
         experiment=experiment,
         telemetry=telemetry,
+        # asking for a blame_share:<phase> delta implies attribution: both
+        # arms need the recorded blame rows the estimator pools over
+        blame=any(m.startswith("blame_share:") for m in metrics),
     )
 
     def _arm_overrides(spec):

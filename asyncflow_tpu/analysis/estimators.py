@@ -421,6 +421,32 @@ def _ratio_components(results, metric: str) -> tuple[np.ndarray, np.ndarray]:
         decode = np.asarray(results.decode_tokens, np.float64)
         horizon = max(float(results.settings.total_simulation_time), 1e-300)
         return decode, np.full_like(decode, horizon)
+    if metric.startswith("blame_share:"):
+        # attributed seconds in one phase over total attributed seconds
+        # (docs/guides/observability.md "Where does the tail come from"):
+        # the ratio-of-sums pools across scenarios so a PrecisionTarget or
+        # compare() arm can gate on where latency is spent, not just how
+        # much of it there is
+        from asyncflow_tpu.observability.blame import N_PHASES, PHASE_NAMES
+
+        phase = metric.split(":", 1)[1]
+        if phase not in PHASE_NAMES:
+            msg = (
+                f"unknown blame phase {phase!r}; supported: "
+                f"{', '.join(PHASE_NAMES)}"
+            )
+            raise ValueError(msg)
+        if getattr(results, "blame_rows", None) is None:
+            msg = (
+                f"{metric!r} needs an attributed sweep (results.blame_rows "
+                "is None): construct SweepRunner(..., blame=True)"
+            )
+            raise ValueError(msg)
+        rows = np.asarray(results.blame_rows, np.float64)
+        grid = rows.reshape(rows.shape[0], -1, N_PHASES, rows.shape[-1])
+        num = grid[:, :, PHASE_NAMES.index(phase), :].sum(axis=(1, 2))
+        den = rows.sum(axis=(1, 2))
+        return num, np.maximum(den, 1e-300)
     msg = f"unknown ratio metric {metric!r}"
     raise ValueError(msg)
 

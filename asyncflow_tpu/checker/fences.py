@@ -205,6 +205,29 @@ FENCES: dict[str, Fence] = {
                 "to the event engine)"
             ),
         ),
+        # -- latency attribution plane (blame=True) --------------------------
+        Fence(
+            id="blame.pallas",
+            feature="latency attribution (blame=True)",
+            engine="pallas",
+            message=(
+                "engine='pallas' does not record latency attribution "
+                "(blame=True): the per-(component, phase) blame grids "
+                "ride the jaxsim scatter path the VMEM kernel does not "
+                "carry; use engine='fast' or 'event' (or 'auto', which "
+                "routes attributed sweeps off the pallas kernel)"
+            ),
+        ),
+        Fence(
+            id="blame.native",
+            feature="latency attribution (blame=True)",
+            engine="native",
+            message=(
+                "engine='native' does not record latency attribution "
+                "(blame=True): the blame grids are not wired through the "
+                "native core's C ABI; use engine='fast' or 'event'"
+            ),
+        ),
         # -- fast-path eligibility -----------------------------------------
         Fence(
             id="fastpath.ineligible",
@@ -337,6 +360,7 @@ def tripped_fences(
     crn: bool = False,
     antithetic: bool = False,
     gauge_series: bool = False,
+    blame: bool = False,
 ) -> tuple[TrippedFence, ...]:
     """Every fence this (plan, features) combination trips.
 
@@ -352,6 +376,8 @@ def tripped_fences(
         out += [_trip("vr.pallas"), _trip("vr.native")]
     if gauge_series:
         out += [_trip("gauge_series.pallas"), _trip("gauge_series.native")]
+    if blame:
+        out += [_trip("blame.pallas"), _trip("blame.native")]
     if plan.has_faults or plan.has_retry:
         out += [_trip("resilience.pallas"), _trip("resilience.native")]
     if getattr(plan, "has_hazards", False):
@@ -383,6 +409,7 @@ def predict_routing(
     crn: bool = False,
     antithetic: bool = False,
     gauge_series: bool = False,
+    blame: bool = False,
     native_ok: bool | None = None,
 ) -> RoutingPrediction:
     """Predict the engine :class:`SweepRunner` dispatch will pick.
@@ -420,6 +447,7 @@ def predict_routing(
         crn=crn,
         antithetic=antithetic,
         gauge_series=gauge_series,
+        blame=blame,
     )
 
     def refused(fence_id: str, **fmt: object) -> RoutingPrediction:
@@ -439,6 +467,8 @@ def predict_routing(
         return refused(f"vr.{engine}")
     if gauge_series and engine in ("pallas", "native"):
         return refused(f"gauge_series.{engine}")
+    if blame and engine in ("pallas", "native"):
+        return refused(f"blame.{engine}")
     if (plan.has_faults or plan.has_retry) and engine in ("pallas", "native"):
         return refused(f"resilience.{engine}")
     if hazards and engine in ("pallas", "native"):
@@ -474,10 +504,14 @@ def predict_routing(
             and not vr_coupled
             and not trace
             and not gauge_series
+            and not blame
             and not serving
         ):
             kind = "pallas"
-            why = "TPU backend, no resilience/VR/trace/gauge-series fences tripped"
+            why = (
+                "TPU backend, no resilience/VR/trace/gauge-series/blame "
+                "fences tripped"
+            )
         else:
             kind = "event"
             blockers = [f.feature for f in fences if f.engine == "fast"]

@@ -72,6 +72,7 @@ from asyncflow_tpu.engines.jaxsim.sampling import (
     truncated_normal,
 )
 from asyncflow_tpu.engines.jaxsim.sortutil import searchsorted_small
+from asyncflow_tpu.observability import blame as _bl
 from asyncflow_tpu.observability.simtrace import (
     FR_ABANDON,
     FR_ARRIVE_LB,
@@ -97,7 +98,11 @@ from asyncflow_tpu.observability.simtrace import (
     decode_flight,
 )
 from asyncflow_tpu.observability.telemetry import instrument_jit
-from asyncflow_tpu.engines.results import SimulationResults, SweepResults
+from asyncflow_tpu.engines.results import (
+    SimulationResults,
+    SweepResults,
+    build_blame_hist,
+)
 from asyncflow_tpu.schemas.payload import SimulationPayload
 from asyncflow_tpu.engines.jaxsim.rotation import (
     rotation_advance,
@@ -151,6 +156,7 @@ class Engine:
         max_requests: int | None = None,
         crn: bool = False,
         trace: TraceConfig | None = None,
+        blame: bool = False,
     ) -> None:
         """``crn``: common-random-numbers keying — every draw is keyed by
         the REQUEST's identity (spawn sequence + per-request event counter)
@@ -283,6 +289,21 @@ class Engine:
         self._fr_k = trace.sample_requests if trace is not None else 1
         self._fr_slots = trace.event_slots if trace is not None else 1
         self._bk_cap = trace.breaker_slots if trace is not None else 1
+        #: latency attribution plane (observability/blame.py).  False =
+        #: statically pruned — the compiled program is bit-identical to
+        #: pre-blame builds (pinned by tests/parity/test_flight_recorder.py).
+        self.blame = bool(blame)
+        from asyncflow_tpu.observability.blame import (
+            blame_stride,
+            n_blame_bins,
+            n_cells,
+        )
+
+        self._bl_cells = (
+            n_cells(plan.n_servers, plan.n_edges) if self.blame else 1
+        )
+        self._bl_bins = n_blame_bins(n_hist_bins) if self.blame else 1
+        self._bl_stride = blame_stride(n_hist_bins)
         self._compiled: dict = {}
 
     # hop codes (decoded by run_single against the payload's ids)
@@ -365,6 +386,111 @@ class Engine:
                 jnp.where(write, jnp.int32(state), st.bk_state[jj]),
             ),
             bk_n=st.bk_n + jnp.where(write, 1, 0),
+        )
+
+    # ==================================================================
+    # latency attribution (no-ops unless ``blame=True``; recording never
+    # consumes a draw, so the event stream is identical with it on or off)
+    # ==================================================================
+    #
+    # Cursor model: ``bl_t[i]`` is the time up to which slot ``i``'s
+    # in-flight attempt is fully attributed and ``bl_cell[i]`` the cell
+    # accruing since then.  Every event handler flushes the open span up to
+    # ``now`` before repointing the cursor; flushing twice at the same
+    # timestamp adds zero, so flushing liberally is safe.  Spans whose
+    # duration is known up front (edge transits, hedge waits) skip the
+    # cursor and credit directly.  Conservation — the row summing to the
+    # attempt's end-to-end latency — holds by construction; a
+    # mis-enumerated site can only misattribute, never leak time.
+
+    def _bl_cs(self, s, phase):
+        """Cell of (server ``s``, ``phase``) — works for traced ``s``."""
+        return s * _bl.N_PHASES + phase
+
+    def _bl_ce(self, e, phase):
+        """Cell of (edge ``e``, ``phase``) — works for traced ``e``."""
+        return (self.plan.n_servers + e) * _bl.N_PHASES + phase
+
+    def _bl_cc(self, phase):
+        """Cell of (virtual client, ``phase``)."""
+        return _bl.cell(
+            _bl.comp_client(self.plan.n_servers, self.plan.n_edges), phase,
+        )
+
+    def _bl_span(self, st: EngineState, i, c, secs, pred) -> EngineState:
+        """Credit ``secs`` directly to cell ``c`` of slot ``i``'s attempt."""
+        if not self.blame:
+            return st
+        v = jnp.where(pred, jnp.maximum(secs, 0.0), 0.0)
+        return st._replace(req_bl=st.req_bl.at[i, c].add(v, mode="drop"))
+
+    def _bl_set(self, st: EngineState, i, t, c, pred) -> EngineState:
+        """Repoint the open cell WITHOUT flushing (cursor jump)."""
+        if not self.blame:
+            return st
+        return st._replace(
+            bl_t=st.bl_t.at[i].set(
+                jnp.where(pred, jnp.float32(t), st.bl_t[i]), mode="drop",
+            ),
+            bl_cell=st.bl_cell.at[i].set(
+                jnp.where(pred, jnp.int32(c), st.bl_cell[i]), mode="drop",
+            ),
+        )
+
+    def _bl_flush(self, st: EngineState, i, t, pred) -> EngineState:
+        """Credit the open span up to ``t`` and advance the cursor."""
+        if not self.blame:
+            return st
+        dt = jnp.where(pred, jnp.maximum(jnp.float32(t) - st.bl_t[i], 0.0), 0.0)
+        st = st._replace(
+            req_bl=st.req_bl.at[i, st.bl_cell[i]].add(dt, mode="drop"),
+        )
+        return st._replace(
+            bl_t=st.bl_t.at[i].set(
+                jnp.where(pred, jnp.float32(t), st.bl_t[i]), mode="drop",
+            ),
+        )
+
+    def _bl_zero(self, st: EngineState, i, t, c, pred) -> EngineState:
+        """Fresh attempt in slot ``i``: clean row, cursor at ``t`` on ``c``."""
+        if not self.blame:
+            return st
+        st = st._replace(
+            req_bl=st.req_bl.at[i].set(
+                jnp.where(pred, 0.0, st.req_bl[i]), mode="drop",
+            ),
+        )
+        return self._bl_set(st, i, t, c, pred)
+
+    def _bl_complete(self, st: EngineState, i, finish, latency, pred) -> EngineState:
+        """Scatter slot ``i``'s row into the pooled grid at the attempt's
+        coarse latency bin, add the latency to the conservation channel,
+        and zero the row for slot reuse."""
+        if not self.blame:
+            return st
+        st = self._bl_flush(st, i, finish, pred)
+        b = jnp.clip(
+            latency_bin(latency, self.hist_lo, self.hist_scale, self.n_hist_bins)
+            // self._bl_stride,
+            0,
+            self._bl_bins - 1,
+        )
+        row = jnp.where(pred, st.req_bl[i], 0.0)
+        st = st._replace(
+            bl_grid=st.bl_grid.at[:, b].add(row),
+            bl_lat=st.bl_lat.at[b].add(jnp.where(pred, latency, 0.0)),
+        )
+        if self.collect_clocks:
+            # per-request row aligned with the clock row ``_complete`` is
+            # about to claim (the conservation property test's witness)
+            ridx = jnp.where(pred, st.clock_n, jnp.int32(st.bl_store.shape[0]))
+            st = st._replace(
+                bl_store=st.bl_store.at[ridx].set(st.req_bl[i], mode="drop"),
+            )
+        return st._replace(
+            req_bl=st.req_bl.at[i].set(
+                jnp.where(pred, 0.0, st.req_bl[i]), mode="drop",
+            ),
         )
 
     # ==================================================================
@@ -729,6 +855,8 @@ class Engine:
         t_cur = now
         if self.trace is not None:
             st = self._fr(st, i, FR_SPAWN, 0, now, pred)
+        # fresh attempt: the attribution clock restarts with the re-issue
+        st = self._bl_zero(st, i, now, self._bl_cc(_bl.PH_TRANSIT), pred)
         for j, eidx in enumerate(plan.entry_edges.tolist()):
             e = jnp.int32(eidx)
             dropped, delay = self._sample_edge(
@@ -744,8 +872,12 @@ class Engine:
                 st = self._fr(
                     st, i, FR_TRANSIT, e, t_cur + delay, survives,
                 )
+            st = self._bl_span(
+                st, i, self._bl_ce(eidx, _bl.PH_TRANSIT), delay, survives,
+            )
             t_cur = jnp.where(survives, t_cur + delay, t_cur)
             alive = survives
+        st = self._bl_set(st, i, t_cur, self._bl_cc(_bl.PH_TRANSIT), alive)
         ev0 = (
             EV_ARRIVE_LB
             if plan.entry_target_kind == TARGET_LB
@@ -867,6 +999,7 @@ class Engine:
             # the logical request's record rides the ANCHOR's ring row (a
             # winning duplicate completes the primary's record)
             st = self._fr_row(st, st.req_fr[anchor], FR_COMPLETE, -1, now, done)
+        st = self._bl_complete(st, i, now, now - st.req_start[i], done)
         st = self._complete(st, st.req_start[i], now, done)
         st = st._replace(
             req_ev=st.req_ev.at[i].set(jnp.where(pred, EV_IDLE, st.req_ev[i])),
@@ -1007,6 +1140,7 @@ class Engine:
             st = self._fr_row(st, st.req_fr[i], FR_HEDGE, ordinal, now, fire)
         alive = fire
         t_cur = now
+        bl_hops = []  # (eidx, delay, survives) — replayed onto the dup slot
         for j, eidx in enumerate(plan.entry_edges.tolist()):
             e = jnp.int32(eidx)
             dropped, delay = self._sample_edge(
@@ -1017,6 +1151,7 @@ class Engine:
             st = st._replace(
                 n_dropped=st.n_dropped + jnp.where(alive & dropped, 1, 0),
             )
+            bl_hops.append((eidx, delay, survives))
             t_cur = jnp.where(survives, t_cur + delay, t_cur)
             alive = survives
         free_mask = (st.req_ev == EV_IDLE) & (st.hg_live == 0)
@@ -1047,6 +1182,29 @@ class Engine:
             hg_live=st.hg_live.at[i].add(jnp.where(place, 1, 0)),
             n_overflow=st.n_overflow + jnp.where(overflow, 1, 0),
         )
+        if self.blame:
+            # the duplicate inherits the anchor's start time, so a winning
+            # duplicate's latency CONTAINS the hedge wait [anchor start,
+            # fire): credit it to the virtual client, then replay the
+            # duplicate's own entry chain
+            st = self._bl_zero(
+                st, idx, t_cur, self._bl_cc(_bl.PH_TRANSIT), place,
+            )
+            st = self._bl_span(
+                st,
+                idx,
+                self._bl_cc(_bl.PH_HEDGE),
+                now - st.req_start[i],
+                place,
+            )
+            for eidx2, delay2, survives2 in bl_hops:
+                st = self._bl_span(
+                    st,
+                    idx,
+                    self._bl_ce(eidx2, _bl.PH_TRANSIT),
+                    delay2,
+                    place & survives2,
+                )
         if self._has_retry:
             st = st._replace(
                 req_deadline=st.req_deadline.at[idx].set(INF, mode="drop"),
@@ -1431,6 +1589,27 @@ class Engine:
                     st, fr_row, FR_ABANDON, 1, now, failed & ~place_retry,
                 )
             st = self._fr_row(st, fr_row, FR_REJECT, -1, now, overflow)
+        if self.blame:
+            # fresh attribution row for the placed slot; entry-chain edge
+            # delays are credited directly (the walk's timestamps are all
+            # known here), leaving the cursor at the target-arrival time.
+            # EV_RETRY parks skip the spans — the attempt clock restarts
+            # at the backoff re-issue, which rebuilds its own chain.
+            st = self._bl_zero(
+                st, idx, t_cur, self._bl_cc(_bl.PH_TRANSIT), place,
+            )
+            for gi2, chain2 in enumerate(chains):
+                pl_gi = place & ~place_retry & (g == gi2)
+                t_prev = now
+                for _, eidx2, t_hop in (h for h in hop_chain if h[0] == gi2):
+                    st = self._bl_span(
+                        st,
+                        idx,
+                        self._bl_ce(eidx2, _bl.PH_TRANSIT),
+                        t_hop - t_prev,
+                        pl_gi,
+                    )
+                    t_prev = t_hop
         if self._crn:
             # the slot's request identity: the arrival counter at spawn
             # (already incremented for this iteration, so values are >= 1)
@@ -1611,6 +1790,24 @@ class Engine:
                     jnp.where(cpu_wait, now, st.req_wait_t[i]),
                 ),
             )
+        if self.blame:
+            # segment boundary: close the open span, then point the cursor
+            # at what happens next — queue wait (core / db pool) or the
+            # segment's own sleep/burst (service).  Serving segments and
+            # SEG_END repoint inside their own handlers below.
+            st = self._bl_flush(st, i, now, pred)
+            blc = jnp.where(
+                cpu_wait,
+                self._bl_cs(s, _bl.PH_Q_CPU),
+                jnp.where(
+                    db_wait,
+                    self._bl_cs(s, _bl.PH_Q_DB),
+                    self._bl_cs(s, _bl.PH_SERVICE),
+                ),
+            )
+            st = self._bl_set(
+                st, i, now, blc, run_now | cpu_wait | db_wait,
+            )
         st = st._replace(
             cores_free=st.cores_free.at[s].add(jnp.where(cpu_run, -1, 0)),
             cpu_ticket=st.cpu_ticket.at[s].add(jnp.where(cpu_wait, 1, 0)),
@@ -1764,6 +1961,9 @@ class Engine:
             & (st.sv_tokens_free[s] >= tin)
         )
         park = pred & ~can
+        # admission wait opens here; the EV_SV_GRANT handler flushes it
+        # (zero seconds for immediate grants — the event fires at ``now``)
+        st = self._bl_set(st, i, now, self._bl_cs(s, _bl.PH_Q_ADMIT), pred)
         return st._replace(
             sv_slots_free=st.sv_slots_free.at[s].add(jnp.where(can, -1, 0)),
             sv_tokens_free=st.sv_tokens_free.at[s].add(
@@ -1828,6 +2028,21 @@ class Engine:
         seg = st.req_seg[i]
         tin = st.req_tok_in[i]
         dur = p.sv_prefill_base[s, ep, seg] + tin * p.sv_prefill_tpt[s, ep, seg]
+        if self.blame:
+            # close the admission wait; the prefill sleep opens — a
+            # re-admission after eviction redoes it as KV_REDO blame
+            st = self._bl_flush(st, i, now, pred)
+            st = self._bl_set(
+                st,
+                i,
+                now,
+                jnp.where(
+                    st.req_sv_evict[i] > 0,
+                    self._bl_cs(s, _bl.PH_KV_REDO),
+                    self._bl_cs(s, _bl.PH_PREFILL),
+                ),
+                pred,
+            )
         st = st._replace(
             req_sv_hold=st.req_sv_hold.at[i].set(
                 jnp.where(pred, tin, st.req_sv_hold[i]),
@@ -1863,6 +2078,8 @@ class Engine:
         )
         rate = rate * ov.decode_rate_scale
         dur = tout / jnp.maximum(rate, _TINY)
+        # _seg_start already flushed at ``now``; the decode sleep opens here
+        st = self._bl_set(st, i, now, self._bl_cs(s, _bl.PH_DECODE), fits)
         st = st._replace(
             sv_tokens_free=st.sv_tokens_free.at[s].add(
                 jnp.where(fits, -tout, 0.0),
@@ -2013,6 +2230,22 @@ class Engine:
         kind = p.exit_kind[s]
         dropped, delay = self._sample_edge(e, now, jax.random.fold_in(key, 48), ov)
         arrive = now + delay
+        if self.blame:
+            # close the final service span, credit the exit transit
+            # directly (its duration is known here), and park the cursor
+            # at the arrival — the next arrival branch (or completion)
+            # picks it up with a zero-length flush
+            st = self._bl_flush(st, i, now, pred)
+            st = self._bl_span(
+                st,
+                i,
+                self._bl_ce(e, _bl.PH_TRANSIT),
+                delay,
+                pred & ~dropped,
+            )
+            st = self._bl_set(
+                st, i, arrive, self._bl_cc(_bl.PH_TRANSIT), pred & ~dropped,
+            )
         to_server = pred & (kind == TARGET_SERVER) & ~dropped
         to_lb = pred & (kind == TARGET_LB) & ~dropped
         to_client = pred & (kind == TARGET_CLIENT) & ~dropped
@@ -2108,6 +2341,7 @@ class Engine:
             st = self._fr(st, i, FR_TRANSIT, e, arrive, pred & ~dropped)
             st = self._fr(st, i, FR_DROP, e, now, drop_here)
             st = self._fr(st, i, FR_COMPLETE, -1, arrive, done)
+        st = self._bl_complete(st, i, arrive, arrive - st.req_start[i], done)
         st = self._complete(
             st,
             st.req_start[i],
@@ -2378,6 +2612,14 @@ class Engine:
         st = self._hop(st, i, self.HOP_LB, now, pred)
         st = self._hop(st, i, self.HOP_EDGE + p.lb_edge_index[slot], arrive, ok)
         st = self._edge_interval(st, e, now, arrive, ok)
+        if self.blame:
+            # LB routing is instantaneous; the routed edge's transit is
+            # credited directly and the cursor parks at the server arrival
+            st = self._bl_flush(st, i, now, pred)
+            st = self._bl_span(st, i, self._bl_ce(e, _bl.PH_TRANSIT), delay, ok)
+            st = self._bl_set(
+                st, i, arrive, self._bl_cc(_bl.PH_TRANSIT), ok,
+            )
         if self.trace is not None:
             st = self._fr(st, i, FR_ARRIVE_LB, -1, now, pred)
             if self._has_report:
@@ -2575,6 +2817,12 @@ class Engine:
         )
         if self.trace is not None:
             st = self._fr(st, i, FR_WAIT_RAM, s, now, blocked)
+        if self.blame:
+            # park the attribution cursor on the RAM-admission queue; the
+            # grant (EV_RESUME) wakes the slot at grant time and
+            # _seg_start's flush credits the whole wait to this cell
+            st = self._bl_flush(st, i, now, blocked)
+            st = self._bl_set(st, i, now, self._bl_cs(s, _bl.PH_Q_RAM), blocked)
         st = self._gauge_add(st, now, self._g_ram(s), need, granted & (need > 0))
         return self._seg_start(st, i, s, ep, jnp.int32(0), now, key, ov, granted)
 
@@ -2635,6 +2883,12 @@ class Engine:
         )
         if self.trace is not None:
             st = self._fr(st, j, FR_RUN, s, now, grant)
+        if self.blame:
+            # the grantee is re-armed directly (EV_SEG_END at now + jdur,
+            # no event fires at grant time), so close its ready-queue wait
+            # and open its service span here rather than in a branch
+            st = self._bl_flush(st, j, now, grant)
+            st = self._bl_set(st, j, now, self._bl_cs(s, _bl.PH_SERVICE), grant)
         return self._gauge_add(st, now, self._g_ready(s), -1.0, grant)
 
     def _abandon_branch(self, st, i, now, key, ov, pred) -> EngineState:
@@ -2704,6 +2958,13 @@ class Engine:
             )
             if self.trace is not None:
                 st = self._fr(st, dj, FR_RUN, s, now, dgrant)
+            if self.blame:
+                # DB grantee is re-armed directly like the CPU handoff:
+                # close its pool wait, open its query (service) span
+                st = self._bl_flush(st, dj, now, dgrant)
+                st = self._bl_set(
+                    st, dj, now, self._bl_cs(s, _bl.PH_SERVICE), dgrant,
+                )
 
         # leave the IO queue
         st = self._gauge_add(st, now, self._g_io(s), -1.0, was_io)
@@ -2954,6 +3215,23 @@ class Engine:
                 self._bk_cap if self.trace is not None else 1, jnp.int32,
             ),
             bk_n=jnp.int32(0),
+            req_bl=jnp.zeros(
+                (pool, self._bl_cells) if self.blame else (1, 1),
+                jnp.float32,
+            ),
+            bl_t=jnp.zeros(pool if self.blame else 1, jnp.float32),
+            bl_cell=jnp.zeros(pool if self.blame else 1, jnp.int32),
+            bl_grid=jnp.zeros(
+                (self._bl_cells, self._bl_bins) if self.blame else (1, 1),
+                jnp.float32,
+            ),
+            bl_lat=jnp.zeros(self._bl_bins if self.blame else 1, jnp.float32),
+            bl_store=jnp.zeros(
+                (maxn, self._bl_cells)
+                if (self.blame and self.collect_clocks)
+                else (1, 1),
+                jnp.float32,
+            ),
             req_prime=jnp.zeros(pool if self._has_hedge else 1, jnp.int32),
             req_is_hedge=jnp.zeros(
                 pool if self._has_hedge else 1, jnp.int32,
@@ -3512,6 +3790,16 @@ def run_single(
             )[0]
             time_to_drain = None if np.isnan(drain) else float(drain)
 
+    blame_grid = None
+    blame_lat = None
+    blame_req = None
+    if getattr(sim_engine, "blame", False):
+        blame_grid = np.asarray(state.bl_grid, np.float64)
+        blame_lat = np.asarray(state.bl_lat, np.float64)
+        if sim_engine.collect_clocks:
+            n_bl = min(int(state.clock_n), state.bl_store.shape[0])
+            blame_req = np.asarray(state.bl_store[:n_bl], np.float64)
+
     return SimulationResults(
         settings=payload.sim_settings,
         rqs_clock=clock,
@@ -3559,6 +3847,9 @@ def run_single(
             if plan.has_serving and hasattr(state, "n_decode_tok")
             else None
         ),
+        blame=blame_grid,
+        blame_lat=blame_lat,
+        blame_req=blame_req,
     )
 
 
@@ -3769,6 +4060,26 @@ def sweep_results(
         flight_n=(
             np.asarray(final.fr_n)
             if getattr(engine, "trace", None) is not None
+            else None
+        ),
+        blame_rows=(
+            np.asarray(final.bl_grid, np.float32)
+            if getattr(engine, "blame", False)
+            else None
+        ),
+        blame_lat_rows=(
+            np.asarray(final.bl_lat, np.float32)
+            if getattr(engine, "blame", False)
+            else None
+        ),
+        blame_hist=(
+            build_blame_hist(np.asarray(final.bl_grid, np.float32))
+            if getattr(engine, "blame", False)
+            else None
+        ),
+        blame_lat_hist=(
+            build_blame_hist(np.asarray(final.bl_lat, np.float32))
+            if getattr(engine, "blame", False)
             else None
         ),
     )

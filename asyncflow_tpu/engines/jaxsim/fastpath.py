@@ -101,6 +101,7 @@ from asyncflow_tpu.engines.jaxsim.rotation import (
     rotation_remove,
 )
 from asyncflow_tpu.engines.jaxsim.sortutil import searchsorted_small, time_rank
+from asyncflow_tpu.observability import blame as _blm
 from asyncflow_tpu.observability.simtrace import (
     FR_ARRIVE_LB,
     FR_ARRIVE_SRV,
@@ -182,6 +183,16 @@ class FastState(NamedTuple):
     fr_node: jnp.ndarray
     fr_t: jnp.ndarray
     fr_n: jnp.ndarray
+    #: latency attribution grids (observability/blame.py), identical layout
+    #: to the event engine's: (n_cells, n_blame_bins) seconds per
+    #: (component, phase) keyed by the attempt's coarse latency bin, the
+    #: (n_blame_bins,) end-to-end conservation denominator, and — with
+    #: collect_clocks — (N, n_cells) per-request rows compacted in clock
+    #: order.  (1, 1)/(1,) placeholders when attribution is off so
+    #: unattributed programs stay bit-identical to pre-blame builds.
+    bl_grid: jnp.ndarray
+    bl_lat: jnp.ndarray
+    bl_store: jnp.ndarray
 
 
 def _kw_waits(
@@ -539,6 +550,47 @@ def _flight_rings(cands, K: int, slots: int, *, lanes=None, blocks=None):
     return fr_ev, fr_node, fr_t, fr_n
 
 
+class _BlameTape:
+    """Per-lane latency-attribution CANDIDATE stream (analytic recorder).
+
+    The event engine scatters blame as its heap advances each request's
+    attribution cursor; the fast path has no loop, but the journey already
+    computes every wait and every realized time advance in closed form.  So
+    attribution reduces to: collect ``(cell, seconds, predicate)`` credit
+    candidates along the pipeline, then scatter each into the pooled grid
+    keyed by the lane's final coarse latency bin (``_run_one``).  Transit
+    credits use the REALIZED float32 time advance (``(t + delay) - t``) and
+    each server's service credit is the exact remainder ``departure -
+    arrival - waits``, so a lane's credits telescope to its end-to-end
+    latency to within a few float32 ulps (blame.py "Conservation
+    precision").  Attribution consumes ZERO draws.
+    """
+
+    __slots__ = ("n", "cands")
+
+    def __init__(self, n: int) -> None:
+        self.n = n
+        self.cands: list[tuple] = []
+
+    def credit(self, cell, secs, pred) -> None:
+        n = self.n
+        self.cands.append((
+            jnp.broadcast_to(jnp.asarray(cell, jnp.int32), (n,)),
+            jnp.broadcast_to(jnp.asarray(secs, jnp.float32), (n,)),
+            jnp.broadcast_to(jnp.asarray(pred, bool), (n,)),
+        ))
+
+    def credit_slice(self, cell, secs, pred, off: int, n_g: int) -> None:
+        """Credit lanes on one generator's static slot slice (entry-chain
+        hops); lanes outside the slice get a False predicate."""
+        z = jnp.zeros(self.n, jnp.float32)
+        self.credit(
+            cell,
+            z.at[off : off + n_g].set(jnp.broadcast_to(secs, (n_g,))),
+            jnp.zeros(self.n, bool).at[off : off + n_g].set(pred),
+        )
+
+
 class FastEngine:
     """Batched scan engine for one eligible :class:`StaticPlan`."""
 
@@ -554,6 +606,7 @@ class FastEngine:
         relax_damping: float = 0.0,
         gauge_series_stride: int = 0,
         trace=None,
+        blame: bool = False,
     ) -> None:
         """``gauge_series_stride``: with ``collect_gauges=False``, a stride
         k > 0 collects every gauge on a grid coarsened k-fold
@@ -592,6 +645,15 @@ class FastEngine:
         self._collect_gauge_grid = collect_gauges or gauge_series_stride > 0
         self.gauge_series_stride = 0 if collect_gauges else gauge_series_stride
         self.n_hist_bins = n_hist_bins
+        #: latency attribution plane (observability/blame.py).  False =
+        #: statically pruned: unattributed programs stay bit-identical to
+        #: pre-blame builds (pinned by tests/parity/test_flight_recorder.py).
+        self.blame = bool(blame)
+        self._bl_cells = (
+            _blm.n_cells(plan.n_servers, plan.n_edges) if self.blame else 1
+        )
+        self._bl_bins = _blm.n_blame_bins(n_hist_bins) if self.blame else 1
+        self._bl_stride = _blm.blame_stride(n_hist_bins)
         self.relax_sweeps = relax_sweeps
         self.relax_damping = relax_damping
         #: "zero" (default) or "visit1": start the multi-burst relaxation
@@ -1096,6 +1158,7 @@ class FastEngine:
         *,
         record: bool = True,
         tape: _FlightTape | None = None,
+        btape: _BlameTape | None = None,
     ):
         """One full pass of the post-arrival pipeline: entry chain ->
         routing -> server topo loop -> completion.
@@ -1112,7 +1175,10 @@ class FastEngine:
         gauge/counter accumulation: the retry driver's relaxation passes
         only need the outcome times.  ``tape`` collects flight-record
         candidates (code, node, record time, processing time, predicate) in
-        per-lane event-processing order — the caller assembles the rings."""
+        per-lane event-processing order — the caller assembles the rings.
+        ``btape`` collects latency-attribution credit candidates (cell,
+        seconds, predicate) — the caller scatters them into the pooled
+        blame grid keyed by each lane's final latency bin."""
         plan = self.plan
         n = t.shape[0]
         n_dropped = jnp.int32(0)
@@ -1175,6 +1241,17 @@ class FastEngine:
                     )
                     tape.emit_slice(
                         FR_TRANSIT, eidx, t_g + delay, t0_g, ok, off, n_g,
+                    )
+                if btape is not None:
+                    # credit the REALIZED float32 advance so a lane's
+                    # credits telescope to its end-to-end latency exactly
+                    btape.credit_slice(
+                        (plan.n_servers + eidx) * _blm.N_PHASES
+                        + _blm.PH_TRANSIT,
+                        (t_g + delay) - t_g,
+                        ok,
+                        off,
+                        n_g,
                     )
                 f_g = jnp.where(alive_g & dropped, t0_g, f_g)
                 t_g = jnp.where(ok, t_g + delay, t_g)
@@ -1260,6 +1337,13 @@ class FastEngine:
             if tape is not None:
                 tape.emit(FR_DROP, eidx_arr, t, t, alive & dropped)
                 tape.emit(FR_TRANSIT, eidx_arr, t + delay, t, ok)
+            if btape is not None:
+                btape.credit(
+                    (plan.n_servers + eidx_arr) * _blm.N_PHASES
+                    + _blm.PH_TRANSIT,
+                    (t + delay) - t,
+                    ok,
+                )
             if record:
                 gauge = self._gauge_intervals(
                     gauge, eidx_arr, t, t + delay, 1.0, ok,
@@ -1416,6 +1500,10 @@ class FastEngine:
             # admission queue with k concurrency slots; <= 0 never queues
             ram_k = int(plan.ram_slots[s]) if len(plan.ram_slots) else 0
             W_ram = jnp.zeros(n, jnp.float32)
+            # per-lane queue waits at THIS server (blame attribution; dead
+            # code without a blame tape — XLA prunes the unused arrays)
+            bl_cpu = jnp.zeros(n, jnp.float32)
+            bl_db = jnp.zeros(n, jnp.float32)
 
             cap_s = (
                 int(plan.server_queue_cap[s])
@@ -1514,6 +1602,7 @@ class FastEngine:
                         span(t, rej_end, rej_ram, amount=ram),
                     )
                 mine = served
+                bl_cpu = jnp.where(mine, W_c, 0.0)
             elif kb == 0 and ram_k <= 0:
                 # pure-IO server: no queues, departure is deterministic
                 dep = t + post
@@ -1578,6 +1667,7 @@ class FastEngine:
                 validb = part[:, None]
                 dep = t + pre0 + W_c + dur0 + post
                 mine = served
+                bl_cpu = jnp.where(mine, W_c, 0.0)
             elif ram_k > 0:
                 # Binding RAM (eligibility guarantees at most one burst and a
                 # uniform need): admission + core settled jointly in one
@@ -1616,6 +1706,7 @@ class FastEngine:
                 pre = pre0[:, None]
                 validb = mine[:, None] & (jnp.int32(0) < nb[:, None])
                 dep = t + W_ram + pre0 + w_cpu + dur0 + post
+                bl_cpu = w_cpu
             else:
                 nb = n_bursts_t[s, ep]  # (n,)
                 ks = jnp.arange(kb, dtype=jnp.int32)
@@ -1705,6 +1796,7 @@ class FastEngine:
                 E = t[:, None] + pre_cum + busy_prev
                 busy = jnp.sum(jnp.where(validb, pre + W + dur, 0.0), axis=1)
                 dep = t + busy + post
+                bl_cpu = jnp.sum(jnp.where(validb, W, 0.0), axis=1)
                 if tape is not None:
                     for k in range(kb):
                         qwait = validb[:, k] & (W[:, k] > 0)
@@ -1782,6 +1874,7 @@ class FastEngine:
                         FR_RUN, s, enq_db + w_db, enq_db + w_db, dwait,
                     )
                 dep = dep + jnp.where(use_db, w_db, 0.0)
+                bl_db = jnp.where(use_db, w_db, 0.0)
 
             # trailing IO sleep (including any DB pool wait: the reference
             # parks connection waiters in the event loop, counted by the
@@ -1810,6 +1903,25 @@ class FastEngine:
                     span(t + W_ram, dep, mine, amount=ram),
                 )
 
+            if btape is not None:
+                # queue waits to their phases, then SERVICE as the exact
+                # remainder of the server's occupancy — the lane's credits
+                # at this server telescope to ``dep - t`` by construction
+                base_c = s * _blm.N_PHASES
+                btape.credit(
+                    base_c + _blm.PH_Q_CPU, bl_cpu, mine & (bl_cpu > 0),
+                )
+                if ram_k > 0:
+                    btape.credit(
+                        base_c + _blm.PH_Q_RAM, W_ram, mine & (W_ram > 0),
+                    )
+                if server_has_db:
+                    btape.credit(
+                        base_c + _blm.PH_Q_DB, bl_db, mine & (bl_db > 0),
+                    )
+                svc = jnp.maximum((dep - t) - bl_cpu - W_ram - bl_db, 0.0)
+                btape.credit(base_c + _blm.PH_SERVICE, svc, mine)
+
             # exit edge: the send only happens while the clock is running
             sendable = mine & (dep < plan.horizon)
             eidx = int(plan.exit_edge[s])
@@ -1821,6 +1933,13 @@ class FastEngine:
             if tape is not None:
                 tape.emit(FR_DROP, eidx, dep, dep, sendable & dropped)
                 tape.emit(FR_TRANSIT, eidx, dep + delay, dep, ok)
+            if btape is not None:
+                btape.credit(
+                    (plan.n_servers + eidx) * _blm.N_PHASES
+                    + _blm.PH_TRANSIT,
+                    (dep + delay) - dep,
+                    ok,
+                )
             if record:
                 gauge = self._gauge_intervals(
                     gauge, eidx, dep, dep + delay, 1.0, ok,
@@ -1893,6 +2012,7 @@ class FastEngine:
             t, alive, overflow = self._arrivals(jax.random.fold_in(key, 0), ov)
             n_generated = jnp.sum(alive)
             tape = None
+            btape = _BlameTape(n) if self.blame else None
             if trace_on:
                 tape = _FlightTape(n)
                 if plan.n_generators > 1:
@@ -1912,7 +2032,9 @@ class FastEngine:
                 n_dropped,
                 n_rejected,
                 n_dark_lost,
-            ) = self._journey(key, ov, t, alive, gauge, gauge_means, tape=tape)
+            ) = self._journey(
+                key, ov, t, alive, gauge, gauge_means, tape=tape, btape=btape,
+            )
             if trace_on:
                 K = int(self.trace.sample_requests)
                 slots = int(self.trace.event_slots)
@@ -1988,10 +2110,15 @@ class FastEngine:
             can_retry = blk < (A - 1)
             cap_b = float(plan.retry_budget_tokens)
             tape = None
+            btape = None
             for p in range(A):
                 last = p == A - 1
                 if trace_on and last:
                     tape = _FlightTape(n)
+                if self.blame and last:
+                    # only the recording pass attributes: the relaxation
+                    # passes' outcomes are superseded lane by lane
+                    btape = _BlameTape(n)
                 issued = T < INF
                 (
                     finish,
@@ -2004,7 +2131,7 @@ class FastEngine:
                     n_dark_lost,
                 ) = self._journey(
                     key, ov, T, issued, gauge, gauge_means, record=last,
-                    tape=tape,
+                    tape=tape, btape=btape,
                 )
                 # per-attempt resolution: the client notices completion at
                 # C, failure at fail_t, or its deadline at D — deadline
@@ -2121,6 +2248,41 @@ class FastEngine:
             clock = jnp.zeros((1, 2), jnp.float32)
             clock_n = jnp.sum(one)
 
+        # latency attribution: scatter every credit candidate into the
+        # pooled (cell, coarse latency bin) grid — non-successful lanes
+        # target the out-of-range bin and drop, which also erases earlier
+        # attempts of retried requests (attempt-scoped latency) and
+        # orphaned completions past a fired client deadline
+        bl_grid = jnp.zeros((1, 1), jnp.float32)
+        bl_lat = jnp.zeros(1, jnp.float32)
+        bl_store = jnp.zeros((1, 1), jnp.float32)
+        if self.blame:
+            nbb = self._bl_bins
+            cb = jnp.clip(lbin // self._bl_stride, 0, nbb - 1)
+            target = jnp.where(success, cb, nbb)
+            bl_grid = jnp.zeros((self._bl_cells, nbb), jnp.float32)
+            for cell_a, secs, pred in btape.cands:
+                bl_grid = bl_grid.at[cell_a, target].add(
+                    jnp.where(pred, secs, 0.0), mode="drop",
+                )
+            bl_lat = (
+                jnp.zeros(nbb, jnp.float32)
+                .at[target]
+                .add(latency, mode="drop")
+            )
+            if self.collect_clocks:
+                # per-request rows compacted in clock order (the
+                # conservation property test's witness)
+                rows = jnp.zeros((self.n, self._bl_cells), jnp.float32)
+                lanes_r = jnp.arange(self.n, dtype=jnp.int32)
+                for cell_a, secs, pred in btape.cands:
+                    rows = rows.at[lanes_r, cell_a].add(
+                        jnp.where(pred & success, secs, 0.0),
+                    )
+                bl_store = (
+                    jnp.zeros_like(rows).at[idx].set(rows, mode="drop")
+                )
+
         return FastState(
             hist=hist,
             lat_count=jnp.sum(one),
@@ -2146,6 +2308,9 @@ class FastEngine:
             fr_node=fr_node,
             fr_t=fr_t,
             fr_n=fr_n,
+            bl_grid=bl_grid,
+            bl_lat=bl_lat,
+            bl_store=bl_store,
         )
 
     def run_batch(

@@ -299,6 +299,20 @@ class EngineState(NamedTuple):
     bk_slot: jnp.ndarray  # (C,) i32 LB rotation slot
     bk_state: jnp.ndarray  # (C,) i32 new state (0/1/2)
     bk_n: jnp.ndarray  # scalar i32
+    # latency attribution plane (observability/blame.py) — size (1,)/(1, 1)
+    # placeholders unless the engine was built with ``blame=True``.  Each
+    # pool slot carries an open attribution cursor: ``bl_t`` the time up to
+    # which the slot's in-flight attempt is fully attributed, ``bl_cell``
+    # the (component, phase) cell accruing since then, ``req_bl`` the
+    # attempt's per-cell seconds so far.  Completion scatters the row into
+    # ``bl_grid`` at the attempt's coarse latency bin and adds the
+    # end-to-end latency to ``bl_lat`` (the conservation denominator).
+    req_bl: jnp.ndarray  # (P, n_cells) f32 per-attempt phase seconds
+    bl_t: jnp.ndarray  # (P,) f32 attribution cursor
+    bl_cell: jnp.ndarray  # (P,) i32 open cell
+    bl_grid: jnp.ndarray  # (n_cells, B) f32 pooled seconds by latency bin
+    bl_lat: jnp.ndarray  # (B,) f32 total latency seconds by latency bin
+    bl_store: jnp.ndarray  # (N, n_cells) f32 per-request rows (clock-aligned)
     # hedged-request machinery (size (1,) unless the plan has a hedge
     # policy).  ``req_prime`` is the slot index of the logical request's
     # ANCHOR (the primary attempt's spawn slot; the primary points at

@@ -49,6 +49,7 @@ from asyncflow_tpu.engines.oracle.kernel import (
     Timeout,
 )
 from asyncflow_tpu.engines.results import SimulationResults
+from asyncflow_tpu.observability import blame as _blm
 from asyncflow_tpu.observability.simtrace import (
     FR_ABANDON,
     FR_ARRIVE_LB,
@@ -128,6 +129,10 @@ class Request:
     tok_out: float = -1.0
     #: evictions this attempt has suffered (terminal reject past the cap)
     sv_evict: int = 0
+    #: latency-attribution row of this attempt (observability/blame.py):
+    #: (n_cells,) seconds per (component, phase), lazily allocated on the
+    #: first credit; None when attribution is off
+    blame: np.ndarray | None = None
 
     def record_hop(self, kind: str, component_id: str, now: float) -> None:
         self.history.append(Hop(kind, component_id, now))
@@ -197,6 +202,7 @@ class _EdgeRuntime:
         self.total_sent += 1
         transit = sample_rv(self.cfg.latency, engine.rng) * lat_factor
         transit += engine.edge_spike.get(self.cfg.id, 0.0)
+        t_sent = engine.sim.now
 
         def deliver() -> None:
             req.record_hop(
@@ -212,6 +218,14 @@ class _EdgeRuntime:
                     engine.sim.now,
                 )
             self.concurrent -= 1
+            engine._bl(
+                req,
+                _blm.comp_edge(
+                    engine._bl_nsrv, engine._edge_idx[self.cfg.id],
+                ),
+                _blm.PH_TRANSIT,
+                engine.sim.now - t_sent,
+            )
             assert self.deliver_to is not None
             self.deliver_to(req)
 
@@ -391,7 +405,7 @@ class _ServerRuntime:
         engine = self.engine
         req.record_hop(SystemNodes.SERVER, self.cfg.id, engine.sim.now)
         tracing = engine.trace is not None
-        srv_idx = engine._server_idx[self.cfg.id] if tracing else -1
+        srv_idx = engine._server_idx[self.cfg.id]
         if tracing:
             engine._fr(req, FR_ARRIVE_SRV, srv_idx, engine.sim.now)
 
@@ -421,7 +435,9 @@ class _ServerRuntime:
             )
             if ram_waits:
                 engine._fr(req, FR_WAIT_RAM, srv_idx, engine.sim.now)
+            t_ram = engine.sim.now
             yield AcquireAmount(self.ram, total_ram)
+            engine._bl(req, srv_idx, _blm.PH_Q_RAM, engine.sim.now - t_ram)
             if ram_waits:
                 engine._fr(req, FR_RUN, srv_idx, engine.sim.now)
             self.ram_in_use += total_ram
@@ -452,7 +468,12 @@ class _ServerRuntime:
                 if req.tok_out < 0.0:
                     req.tok_out = engine.draw_tokens(step.output_tokens)
                 while True:
+                    t_adm = engine.sim.now
                     yield AcquireServe(gate, req.tok_in)
+                    engine._bl(
+                        req, srv_idx, _blm.PH_Q_ADMIT,
+                        engine.sim.now - t_adm,
+                    )
                     # admitted: prompt tokens resident, prefill runs
                     # (io-like sleep; redone in full on every re-admission)
                     in_io_queue = True
@@ -460,9 +481,15 @@ class _ServerRuntime:
                     engine.prefill_tokens += req.tok_in
                     if tracing:
                         engine._fr(req, FR_PREFILL, srv_idx, engine.sim.now)
+                    t_pf = engine.sim.now
                     yield Timeout(
                         step.prefill_base_s
                         + req.tok_in * step.prefill_time_per_token_s,
+                    )
+                    engine._bl(
+                        req, srv_idx,
+                        _blm.PH_KV_REDO if req.sv_evict else _blm.PH_PREFILL,
+                        engine.sim.now - t_pf,
                     )
                     if gate.try_extend(req.tok_out):
                         # decode fits: generation holds prompt + output
@@ -474,7 +501,12 @@ class _ServerRuntime:
                                 req, FR_DECODE, srv_idx, engine.sim.now,
                             )
                         rate = engine.draw_rate(step.decode_tokens_per_s)
+                        t_dc = engine.sim.now
                         yield Timeout(req.tok_out / rate)
+                        engine._bl(
+                            req, srv_idx, _blm.PH_DECODE,
+                            engine.sim.now - t_dc,
+                        )
                         gate.release(1, req.tok_in + req.tok_out)
                         break
                     # KV pressure: evict — release the slot and prompt
@@ -538,6 +570,10 @@ class _ServerRuntime:
                             )
                     wait_started = engine.sim.now
                     yield AcquireToken(self.cpu)
+                    engine._bl(
+                        req, srv_idx, _blm.PH_Q_CPU,
+                        engine.sim.now - wait_started,
+                    )
                     if waiting_cpu:
                         waiting_cpu = False
                         self.ready_queue_len -= 1
@@ -568,10 +604,14 @@ class _ServerRuntime:
                             engine.client_fail(req)
                             return
                     core_locked = True
+                t_cpu = engine.sim.now
                 yield Timeout(
                     step.quantity * self.brownout_cpu
                     if req.degraded
                     else step.quantity,
+                )
+                engine._bl(
+                    req, srv_idx, _blm.PH_SERVICE, engine.sim.now - t_cpu,
                 )
             elif step.is_io:
                 if core_locked:
@@ -589,17 +629,29 @@ class _ServerRuntime:
                     db_waits = tracing and self.db.would_block
                     if db_waits:
                         engine._fr(req, FR_WAIT_DB, srv_idx, engine.sim.now)
+                    t_db = engine.sim.now
                     yield AcquireToken(self.db)
+                    engine._bl(
+                        req, srv_idx, _blm.PH_Q_DB, engine.sim.now - t_db,
+                    )
                     if db_waits:
                         engine._fr(req, FR_RUN, srv_idx, engine.sim.now)
+                    t_io = engine.sim.now
                     yield Timeout(step.quantity)
+                    engine._bl(
+                        req, srv_idx, _blm.PH_SERVICE, engine.sim.now - t_io,
+                    )
                     self.db.release()
                 elif step.is_stochastic_cache:
                     # per-request hit/miss mixture: hit latency with
                     # probability p, else the backing store's miss latency
                     hit = engine.rng.uniform() < step.cache_hit_probability
+                    t_io = engine.sim.now
                     yield Timeout(
                         step.quantity if hit else step.cache_miss_time,
+                    )
+                    engine._bl(
+                        req, srv_idx, _blm.PH_SERVICE, engine.sim.now - t_io,
                     )
                 elif step.is_llm:
                     # reserved io_llm kind, activated: output tokens ~
@@ -607,11 +659,19 @@ class _ServerRuntime:
                     # the request accrues tokens * cost/token
                     tokens = float(engine.rng.poisson(step.llm_tokens_mean))
                     req.llm_cost += tokens * step.llm_cost_per_token
+                    t_io = engine.sim.now
                     yield Timeout(
                         step.quantity + tokens * step.llm_time_per_token,
                     )
+                    engine._bl(
+                        req, srv_idx, _blm.PH_SERVICE, engine.sim.now - t_io,
+                    )
                 else:
+                    t_io = engine.sim.now
                     yield Timeout(step.quantity)
+                    engine._bl(
+                        req, srv_idx, _blm.PH_SERVICE, engine.sim.now - t_io,
+                    )
 
         if core_locked:
             self.cpu.release()
@@ -636,6 +696,8 @@ class OracleEngine:
         seed: int | None = None,
         collect_traces: bool = False,
         trace: TraceConfig | None = None,
+        blame: bool = False,
+        n_hist_bins: int = 1024,
     ) -> None:
         self.payload = payload
         self.settings = payload.sim_settings
@@ -773,6 +835,28 @@ class OracleEngine:
             for step in ep.steps
         )
         self.edge_spike: dict[str, float] = {}
+        #: latency attribution plane (observability/blame.py): one float64
+        #: row per in-flight attempt, scattered into the pooled grid at
+        #: completion keyed by the attempt's latency bin.  Recording
+        #: consumes no draws, so results are identical with it on or off.
+        self.blame = bool(blame)
+        self.n_hist_bins = int(n_hist_bins)
+        _n_srv = len(payload.topology_graph.nodes.servers)
+        _n_edg = len(payload.topology_graph.edges)
+        self._bl_nsrv = _n_srv
+        self._bl_client = _blm.comp_client(_n_srv, _n_edg)
+        self._bl_cells = _blm.n_cells(_n_srv, _n_edg)
+        self._bl_nb = _blm.n_blame_bins(self.n_hist_bins)
+        self._bl_stride = _blm.blame_stride(self.n_hist_bins)
+        self.bl_grid = (
+            np.zeros((self._bl_cells, self._bl_nb), np.float64)
+            if self.blame
+            else None
+        )
+        self.bl_lat = (
+            np.zeros(self._bl_nb, np.float64) if self.blame else None
+        )
+        self.blame_rows: list[np.ndarray] = []
 
         graph = payload.topology_graph
         self.servers = {
@@ -829,6 +913,49 @@ class OracleEngine:
     def _fr(self, req: Request, code: int, node: int, t: float) -> None:
         if self.trace is not None:
             self._fr_rec(req.fr, code, node, t)
+
+    # ------------------------------------------------------------------
+    # latency attribution (no-ops unless ``blame`` was requested;
+    # identical cell layout to the jax engines — observability/blame.py)
+    # ------------------------------------------------------------------
+
+    def _bl(self, req: Request, comp: int, phase: int, secs: float) -> None:
+        """Credit ``secs`` of ``req``'s latency to ``(component, phase)``."""
+        if not self.blame or secs <= 0.0:
+            return
+        if req.blame is None:
+            req.blame = np.zeros(self._bl_cells, np.float64)
+        req.blame[comp * _blm.N_PHASES + phase] += secs
+
+    def _bl_complete(self, req: Request) -> None:
+        """Scatter the completed attempt's row, keyed by its latency bin."""
+        if not self.blame:
+            return
+        lat = req.finish_time - req.initial_time
+        # host replica of jaxsim.sampling.latency_bin / hist_constants
+        # (HIST_LO_S=1e-4, HIST_HI_S=1e3), run in float32 so bin choices
+        # agree with the device engines at bin edges
+        lo = np.float32(np.log(1e-4))
+        scale = np.float32(self.n_hist_bins / (np.log(1e3) - np.log(1e-4)))
+        fine = int(
+            np.clip(
+                np.int32(
+                    (np.log(np.maximum(np.float32(lat), np.float32(1e-6))) - lo)
+                    * scale,
+                ),
+                0,
+                self.n_hist_bins - 1,
+            ),
+        )
+        b = min(fine // self._bl_stride, self._bl_nb - 1)
+        row = (
+            req.blame
+            if req.blame is not None
+            else np.zeros(self._bl_cells, np.float64)
+        )
+        self.bl_grid[:, b] += row
+        self.bl_lat[b] += lat
+        self.blame_rows.append(row)
 
     def _bk_rec(self, edge_id: str, state: int, t: float) -> None:
         """One circuit-breaker state transition (bounded like the ring)."""
@@ -1001,6 +1128,7 @@ class OracleEngine:
                 self.degraded_completions += 1
             self.rqs_clock.append((req.initial_time, req.finish_time))
             self.llm_costs.append(req.llm_cost)
+            self._bl_complete(req)
             if self.collect_traces:
                 self.traces[req.id] = [
                     (hop.component_type, hop.component_id, hop.timestamp)
@@ -1388,6 +1516,12 @@ class OracleEngine:
             is_hedge=1,
         )
         group.live += 1
+        # a winning duplicate's clock starts at the ANCHOR's spawn: the
+        # gap until this fire is hedge wait, blamed on the client
+        self._bl(
+            dup, self._bl_client, _blm.PH_HEDGE,
+            self.sim.now - anchor.initial_time,
+        )
         if self._entry_gen_id is not None:
             dup.record_hop(
                 SystemNodes.GENERATOR, self._entry_gen_id, self.sim.now,
@@ -1635,6 +1769,17 @@ class OracleEngine:
             degraded_goodput=degraded_goodput,
             hazard_truncated=hazard_truncated,
             time_to_drain=time_to_drain,
+            blame=self.bl_grid if self.blame else None,
+            blame_lat=self.bl_lat if self.blame else None,
+            blame_req=(
+                (
+                    np.stack(self.blame_rows)
+                    if self.blame_rows
+                    else np.empty((0, self._bl_cells), np.float64)
+                )
+                if self.blame
+                else None
+            ),
             kv_evictions=self.kv_evictions if self._has_serving else None,
             prefill_tokens=(
                 self.prefill_tokens if self._has_serving else None

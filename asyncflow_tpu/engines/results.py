@@ -73,6 +73,25 @@ def build_gauge_hist(
     return hist
 
 
+def build_blame_hist(
+    rows: np.ndarray,
+    *,
+    quarantined: np.ndarray | None = None,
+) -> np.ndarray:
+    """Pool per-scenario blame grids into one float64 grid.
+
+    ``rows`` is ``(S, ...)`` — ``(S, n_cells, B)`` seconds grids or
+    ``(S, B)`` latency totals.  The single pooling rule every build/rebuild
+    site shares (initial chunk reduction, quarantine edits, scenario-axis
+    slicing): float64 sum over the scenario axis, quarantined rows excluded
+    so the pooled decomposition reflects ``effective_n``.
+    """
+    rows = np.asarray(rows)
+    if quarantined is not None and np.any(quarantined):
+        rows = rows[~np.asarray(quarantined, bool)]
+    return rows.astype(np.float64).sum(axis=0)
+
+
 @dataclass(frozen=True)
 class DeviceCounters:
     """Unified request-accounting counters, identical across every engine.
@@ -213,6 +232,15 @@ class SimulationResults:
     kv_evictions: int | None = None
     prefill_tokens: float | None = None
     decode_tokens: float | None = None
+    #: latency attribution plane (``blame=True``; None otherwise):
+    #: ``(n_cells, B)`` float64 seconds spent per (component, phase) cell by
+    #: requests whose end-to-end latency fell in coarse latency bin b, the
+    #: ``(B,)`` float64 total latency seconds per bin (the conservation
+    #: denominator), and — oracle only — the ``(N, n_cells)`` per-request
+    #: decomposition aligned with ``rqs_clock`` rows (observability/blame.py).
+    blame: np.ndarray | None = None
+    blame_lat: np.ndarray | None = None
+    blame_req: np.ndarray | None = None
 
     @property
     def latencies(self) -> np.ndarray:
@@ -351,6 +379,17 @@ class SweepResults:
     kv_evictions: np.ndarray | None = None
     prefill_tokens: np.ndarray | None = None
     decode_tokens: np.ndarray | None = None
+    #: latency attribution plane (``blame=True`` sweeps; None otherwise):
+    #: ``(S, n_cells, B)`` float32 per-scenario seconds grids and ``(S, B)``
+    #: float32 per-scenario latency totals straight off the device, plus
+    #: their pooled float64 reductions over the effective (non-quarantined)
+    #: scenario axis — built per chunk by :func:`build_blame_hist`, summed
+    #: across chunks, rebuilt from the rows on quarantine splice and
+    #: scenario-axis slicing (observability/blame.py has the cell layout).
+    blame_rows: np.ndarray | None = None
+    blame_lat_rows: np.ndarray | None = None
+    blame_hist: np.ndarray | None = None
+    blame_lat_hist: np.ndarray | None = None
     #: (S,) bool host-fault quarantine mask: True rows produced non-finite
     #: metrics (or deterministically crashed the engine) and were masked
     #: out — their metric rows are zeroed, ``quarantine_reason`` names why.
@@ -541,6 +580,40 @@ class SweepResults:
             decode_tokens=(
                 self.decode_tokens[idx]
                 if self.decode_tokens is not None
+                else None
+            ),
+            blame_rows=(
+                self.blame_rows[idx] if self.blame_rows is not None else None
+            ),
+            blame_lat_rows=(
+                self.blame_lat_rows[idx]
+                if self.blame_lat_rows is not None
+                else None
+            ),
+            # pooled grids span the scenario axis: rebuild from the kept
+            # rows (minus any still-quarantined ones) instead of slicing
+            blame_hist=(
+                build_blame_hist(
+                    self.blame_rows[idx],
+                    quarantined=(
+                        self.quarantined[idx]
+                        if self.quarantined is not None
+                        else None
+                    ),
+                )
+                if self.blame_rows is not None
+                else None
+            ),
+            blame_lat_hist=(
+                build_blame_hist(
+                    self.blame_lat_rows[idx],
+                    quarantined=(
+                        self.quarantined[idx]
+                        if self.quarantined is not None
+                        else None
+                    ),
+                )
+                if self.blame_lat_rows is not None
                 else None
             ),
             quarantined=(

@@ -1,0 +1,271 @@
+"""Latency attribution plane: per-phase, per-component tail blame.
+
+Every completed request's end-to-end latency is decomposed on-device into
+additive **phase** buckets attributed to the **component** (server, edge, or
+the virtual client) where the time was spent, then scattered into pooled
+fixed-bin histograms keyed by the request's final latency bin.  The layout is
+identical across all three engines (oracle heap loop, vmapped XLA event
+engine, scan fast path), so blame grids are directly diffable and poolable in
+the ``gauge_hist`` mold: float64 host aggregation, summed across sweep
+chunks, persisted in checkpoint chunks, rebuilt on quarantine splice.
+
+Grid layout
+-----------
+``blame``      — ``(n_cells, n_blame_bins)`` float: seconds spent in cell
+                 ``comp * N_PHASES + phase`` by requests whose end-to-end
+                 latency fell in coarse latency bin ``b``.
+``blame_lat``  — ``(n_blame_bins,)`` float: total end-to-end latency seconds
+                 of those requests (the conservation denominator: for every
+                 bin, ``blame[:, b].sum() == blame_lat[b]`` within float32
+                 tolerance).
+
+Conservation precision
+----------------------
+Per request, the phase row sums to the attempt's end-to-end latency to
+within ±1 ulp of float32 (the row is built from exact realized-timestamp
+differences; ``SimulationResults.blame_req`` is the witness).  The POOLED
+device grids accumulate in float32 — near-constant increments (a
+deterministic service time scattered thousands of times into one cell)
+round the same direction for long stretches, so pooled sums drift by up to
+~1e-4 relative while the stochastic ``blame_lat`` side drifts differently.
+Gate pooled conservation at ``rtol=1e-3`` and per-request conservation
+tightly; cross-chunk pooling is float64 on host and adds nothing.
+
+Coarse bins are a stride-decimation of the engines' shared log-spaced
+latency histogram (:func:`asyncflow_tpu.engines.jaxsim.params.hist_edges`),
+so per-bin request counts need no extra array — they fall out of the fine
+histogram by summing stride groups (:func:`coarse_counts`).
+
+Phase taxonomy
+--------------
+Queue waits are split by the resource waited on (CPU ready queue, RAM
+admission, DB connection pool, serving batch admission).  Service covers CPU
+bursts and plain/cache/LLM IO sleeps; serving splits out prefill, decode,
+and KV-eviction redo (a re-admission's repeated prefill).  Transit is edge
+time; hedge is a winning duplicate's wait from the anchor's start to its own
+fire time.  ``backoff`` and ``dark`` are reserved: under attempt-scoped
+latency (every engine restarts the clock at re-issue) a COMPLETED attempt
+never contains client backoff or dark-window loss — those buckets exist so
+the layout can absorb logical-request-scoped attribution later without a
+schema bump, and are structurally zero today.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# layout constants
+# ---------------------------------------------------------------------------
+
+PH_Q_CPU = 0  # CPU ready-queue wait (core contention)
+PH_Q_RAM = 1  # RAM admission wait
+PH_Q_DB = 2  # DB connection-pool wait
+PH_Q_ADMIT = 3  # serving batch-admission wait (slot/KV gate)
+PH_SERVICE = 4  # CPU bursts + plain/cache/LLM IO sleeps
+PH_PREFILL = 5  # serving prefill sleep (first admission)
+PH_DECODE = 6  # serving decode sleep
+PH_KV_REDO = 7  # repeated prefill after a KV eviction re-admission
+PH_TRANSIT = 8  # edge traversal (network latency + spikes + fault factors)
+PH_BACKOFF = 9  # reserved: client retry backoff (structurally zero today)
+PH_HEDGE = 10  # winning duplicate's wait from anchor start to hedge fire
+PH_DARK = 11  # reserved: dark-window loss/reissue (structurally zero today)
+
+N_PHASES = 12
+
+PHASE_NAMES = (
+    "q_cpu",
+    "q_ram",
+    "q_db",
+    "q_admit",
+    "service",
+    "prefill",
+    "decode",
+    "kv_redo",
+    "transit",
+    "backoff",
+    "hedge",
+    "dark",
+)
+
+#: target coarse-bin count; the actual count divides the fine histogram
+BLAME_BINS = 64
+
+
+def blame_stride(n_hist_bins: int) -> int:
+    """Fine-bins-per-coarse-bin decimation stride."""
+    return max(1, n_hist_bins // BLAME_BINS)
+
+
+def n_blame_bins(n_hist_bins: int) -> int:
+    """Coarse latency-bin count for an ``n_hist_bins``-bin fine histogram."""
+    stride = blame_stride(n_hist_bins)
+    return -(-n_hist_bins // stride)  # ceil
+
+
+def n_components(n_servers: int, n_edges: int) -> int:
+    """Servers, then edges, then the virtual client (retry/hedge waits)."""
+    return n_servers + n_edges + 1
+
+
+def comp_server(s: int) -> int:
+    return s
+
+
+def comp_edge(n_servers: int, e: int) -> int:
+    return n_servers + e
+
+
+def comp_client(n_servers: int, n_edges: int) -> int:
+    return n_servers + n_edges
+
+
+def n_cells(n_servers: int, n_edges: int) -> int:
+    return n_components(n_servers, n_edges) * N_PHASES
+
+
+def cell(comp: int, phase: int) -> int:
+    """Flat grid row of ``(component, phase)``."""
+    return comp * N_PHASES + phase
+
+
+def component_names(server_ids, edge_ids) -> list[str]:
+    """Component labels in canonical index order (client last)."""
+    return [*server_ids, *edge_ids, "client"]
+
+
+def blame_edges(n_hist_bins: int) -> np.ndarray:
+    """Coarse latency-bin edges (seconds): every ``stride``-th fine edge."""
+    from asyncflow_tpu.engines.jaxsim.params import hist_edges
+
+    fine = hist_edges(n_hist_bins)
+    stride = blame_stride(n_hist_bins)
+    idx = np.arange(0, n_hist_bins, stride)
+    return np.append(fine[idx], fine[-1])
+
+
+def coarse_counts(hist: np.ndarray) -> np.ndarray:
+    """Per-coarse-bin completion counts from the fine latency histogram."""
+    hist = np.asarray(hist, dtype=np.float64)
+    n = hist.shape[-1]
+    stride = blame_stride(n)
+    nb = n_blame_bins(n)
+    pad = nb * stride - n
+    if pad:
+        hist = np.concatenate(
+            [hist, np.zeros((*hist.shape[:-1], pad), np.float64)], axis=-1,
+        )
+    return hist.reshape(*hist.shape[:-1], nb, stride).sum(axis=-1)
+
+
+def phase_grid(blame: np.ndarray, n_servers: int, n_edges: int) -> np.ndarray:
+    """Reshape a flat ``(n_cells, B)`` grid to ``(n_comp, N_PHASES, B)``."""
+    blame = np.asarray(blame, dtype=np.float64)
+    return blame.reshape(n_components(n_servers, n_edges), N_PHASES, -1)
+
+
+def _shares(totals: np.ndarray) -> np.ndarray:
+    denom = float(totals.sum())
+    if denom <= 0.0:
+        return np.zeros_like(totals, dtype=np.float64)
+    return np.asarray(totals, dtype=np.float64) / denom
+
+
+# ---------------------------------------------------------------------------
+# host-side breakdowns (SweepReport.latency_blame / summary shares)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BlameReport:
+    """One quantile's (or tail's) latency decomposition.
+
+    Shares are fractions of total attributed seconds in the selected bin
+    range and sum to 1 when any time was attributed.  ``bin_lo_s`` /
+    ``bin_hi_s`` bound the selected coarse latency bins — a point quantile
+    is exact to one coarse bin; a tail (``tail_of=q``) covers every bin at
+    or above the quantile's bin.
+    """
+
+    q: float
+    tail: bool
+    bin_lo_s: float
+    bin_hi_s: float
+    n_requests: float
+    total_s: float
+    phase_shares: dict[str, float]
+    component_shares: dict[str, float]
+    cells: list[tuple[str, str, float]]  # (component, phase, share) desc
+
+    def top(self, k: int = 5) -> list[tuple[str, str, float]]:
+        return self.cells[:k]
+
+
+def quantile_coarse_bin(hist: np.ndarray, q: float) -> int:
+    """Coarse bin holding the pooled ``q``-quantile of the fine histogram."""
+    counts = coarse_counts(np.asarray(hist, dtype=np.float64))
+    total = counts.sum()
+    if total <= 0:
+        return 0
+    cum = np.cumsum(counts)
+    rank = q * total
+    return int(np.searchsorted(cum, rank, side="left").clip(0, len(counts) - 1))
+
+
+def blame_breakdown(
+    blame: np.ndarray,
+    hist: np.ndarray,
+    *,
+    n_servers: int,
+    n_edges: int,
+    server_ids,
+    edge_ids,
+    q: float = 0.95,
+    tail: bool = False,
+    min_share: float = 1e-4,
+) -> BlameReport:
+    """Decompose latency at (or above) the pooled ``q``-quantile.
+
+    ``tail=False`` blames the single coarse bin containing the quantile
+    ("what does a p95 request spend its time on"); ``tail=True`` pools every
+    bin at or above it ("among requests above the p95...").
+    """
+    grid = phase_grid(blame, n_servers, n_edges)  # (C, P, B)
+    nb = grid.shape[-1]
+    fine_n = np.asarray(hist).shape[-1]
+    edges = blame_edges(fine_n)
+    b = quantile_coarse_bin(hist, q)
+    sel = slice(b, nb) if tail else slice(b, b + 1)
+    cell_s = grid[:, :, sel].sum(axis=-1)  # (C, P)
+    counts = coarse_counts(hist)[sel].sum()
+    names = component_names(server_ids, edge_ids)
+    phase_shares = dict(zip(PHASE_NAMES, _shares(cell_s.sum(axis=0))))
+    comp_shares = dict(zip(names, _shares(cell_s.sum(axis=1))))
+    flat = _shares(cell_s).ravel()
+    order = np.argsort(flat)[::-1]
+    cells = [
+        (names[k // N_PHASES], PHASE_NAMES[k % N_PHASES], float(flat[k]))
+        for k in order
+        if flat[k] >= min_share
+    ]
+    return BlameReport(
+        q=q,
+        tail=tail,
+        bin_lo_s=float(edges[b]),
+        bin_hi_s=float(edges[-1] if tail else edges[b + 1]),
+        n_requests=float(counts),
+        total_s=float(cell_s.sum()),
+        phase_shares=phase_shares,
+        component_shares=comp_shares,
+        cells=cells,
+    )
+
+
+def blame_shares(blame: np.ndarray) -> dict[str, float]:
+    """Whole-run phase shares (``summary()`` keys ``blame_share_<phase>``)."""
+    grid = np.asarray(blame, dtype=np.float64)
+    ncomp = grid.shape[0] // N_PHASES
+    totals = grid.reshape(ncomp, N_PHASES, -1).sum(axis=(0, 2))
+    return dict(zip(PHASE_NAMES, _shares(totals)))

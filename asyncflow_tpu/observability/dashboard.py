@@ -6,6 +6,7 @@ Renders a telemetry JSONL (and optionally the finished
 - run summary with confidence intervals (when a report is given),
 - live progress (scenarios done / EWMA throughput over elapsed time),
 - cross-scenario gauge quantile bands over simulated time,
+- latency blame waterfall (attributed ``SweepRunner(..., blame=True)`` runs),
 - recovery / quarantine timeline,
 - phase timers and the compile ledger's warm/cold verdicts.
 
@@ -98,7 +99,8 @@ def _kv_table(pairs) -> str:
 # ---------------------------------------------------------------------------
 
 
-def _summary_section(sweep: dict | None, report) -> str:
+def _summary_section(sweep: dict | None, report,
+                     progress: list[dict] | None = None) -> str:
     out = ["<h2>Summary</h2>"]
     if sweep is not None:
         meta = sweep.get("meta", {})
@@ -123,7 +125,42 @@ def _summary_section(sweep: dict | None, report) -> str:
             ("effective scenarios",
              report.n_scenarios - report.n_quarantined),
         ]))
+    serving = _serving_rows(progress, report)
+    if serving:
+        out.append("<h3>Serving</h3>")
+        out.append(_kv_table(serving))
     return "\n".join(out)
+
+
+def _serving_rows(progress: list[dict] | None,
+                  report) -> list[tuple[str, object]]:
+    """LLM serving counters for the summary (docs/guides/serving.md):
+    from the finished report when available, else the last heartbeat."""
+    res = getattr(report, "results", None)
+    if res is not None and getattr(res, "decode_tokens", None) is not None:
+        import numpy as np
+
+        decode = int(np.asarray(res.decode_tokens).sum())
+        horizon = float(res.settings.total_simulation_time)
+        n_scen = int(np.asarray(res.decode_tokens).shape[0])
+        rows = [
+            ("prefill tokens", int(np.asarray(res.prefill_tokens).sum())),
+            ("decode tokens", decode),
+            ("tokens/s (per simulated second, pooled)",
+             f"{decode / max(horizon * n_scen, 1e-300):.2f}"),
+        ]
+        if getattr(res, "kv_evictions", None) is not None:
+            rows.append(
+                ("KV evictions", int(np.asarray(res.kv_evictions).sum())),
+            )
+        return rows
+    meta = (progress or [{}])[-1].get("meta", {})
+    return [
+        (key.replace("_", " "), meta[key])
+        for key in ("prefill_tokens", "decode_tokens", "tokens_per_s",
+                    "kv_evictions")
+        if key in meta
+    ]
 
 
 def _progress_section(progress: list[dict]) -> str:
@@ -227,6 +264,53 @@ def _scorecard_section(sweep: dict | None, report) -> str:
     )
 
 
+def _blame_section(report) -> str:
+    """Latency blame waterfall (docs/guides/observability.md, "Where does
+    the tail come from"): horizontal bars of the (component, phase) cells
+    that make up the p95 request's latency, with the tail-conditional
+    decomposition beside it — rendered only for attributed sweeps."""
+    res = getattr(report, "results", None)
+    if res is None or getattr(res, "blame_hist", None) is None:
+        return ""
+    out = ["<h2>Latency blame waterfall</h2>",
+           '<p class="note">additive decomposition of where requests near '
+           "each quantile spent their time (pooled per-phase histograms; "
+           "docs/guides/observability.md).</p>"]
+    for tail, label in ((False, "p95 bin"), (True, "tail above p95")):
+        br = report.latency_blame(q=0.95, tail=tail)
+        top = br.top(12)
+        if not top:
+            continue
+        total = sum(s for _, _, s in top) or 1.0
+        bar_w = _W - 280
+        rows = []
+        offset = 0.0
+        for i, (comp, phase, secs) in enumerate(top):
+            y = 14 + i * 22
+            x = 180 + offset / total * bar_w
+            w = max(secs / total * bar_w, 1.0)
+            offset += secs
+            rows.append(
+                f'<text x="4" y="{y + 12}" font-size="11">'
+                f"{_esc(comp)} / {_esc(phase)}</text>"
+                f'<rect x="{x:.1f}" y="{y}" width="{w:.1f}" height="16" '
+                'fill="#542788"/>'
+                f'<text x="{min(x + w + 4, _W - 72):.1f}" y="{y + 12}" '
+                f'font-size="11">'
+                f"{secs / max(br.n_requests, 1):.4f}s/req</text>",
+            )
+        height = 22 * len(top) + 20
+        svg = (f'<svg viewBox="0 0 {_W} {height}" width="{_W}" '
+               f'height="{height}">{"".join(rows)}</svg>')
+        out.append(
+            f"<h3>{_esc(label)} <span class='note'>"
+            f"({br.n_requests} requests, "
+            f"[{br.bin_lo_s:.4f}s, {br.bin_hi_s:.4f}s))</span></h3>",
+        )
+        out.append(svg)
+    return "\n".join(out)
+
+
 def _recovery_section(progress: list[dict], recovery: list[dict]) -> str:
     actions = [a for r in recovery for a in r.get("meta", {}).get("actions", [])]
     if not actions and not any(
@@ -324,9 +408,10 @@ def build_dashboard(
     sweeps = [r for r in records if r.get("kind") == "sweep"]
     sweep = sweeps[-1] if sweeps else None
     sections = [
-        _summary_section(sweep, report),
+        _summary_section(sweep, report, progress),
         _progress_section(progress),
         _bands_section(report),
+        _blame_section(report),
         _scorecard_section(sweep, report),
         _recovery_section(progress, recovery),
         _phases_section(sweep),

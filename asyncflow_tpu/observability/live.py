@@ -109,6 +109,12 @@ def format_progress(record: dict) -> str:
         line += f"  quarantined={m['n_quarantined']}"
     if m.get("recovery_actions"):
         line += f"  recovery={m['recovery_actions']}"
+    # LLM serving heartbeat counters (present only when the plan carries
+    # llm_serve steps — docs/guides/serving.md)
+    if "tokens_per_s" in m:
+        line += f"  {m['tokens_per_s']:.1f} tok/s"
+    if m.get("kv_evictions"):
+        line += f"  kv_evict={m['kv_evictions']}"
     return line
 
 

@@ -370,6 +370,11 @@ _NON_ROW_FIELDS = (
     "gauge_series_period",
     "gauge_hist",
     "gauge_hist_cap",
+    # pooled blame grids are (n_cells, B)/(B,) — either leading axis could
+    # alias a chunk's row count by coincidence, so they are rebuilt from
+    # the per-scenario rows, never row-masked
+    "blame_hist",
+    "blame_lat_hist",
 )
 
 
@@ -384,6 +389,22 @@ def _rebuild_gauge_hist(part) -> None:
         part.gauge_series,
         part.gauge_hist_cap,
         quarantined=part.quarantined,
+    )
+
+
+def _rebuild_blame_hist(part) -> None:
+    """Re-derive the pooled latency-attribution grids after a row edit so
+    the decomposition keeps excluding quarantined rows
+    (observability/blame.py)."""
+    if part.blame_rows is None:
+        return
+    from asyncflow_tpu.engines.results import build_blame_hist
+
+    part.blame_hist = build_blame_hist(
+        part.blame_rows, quarantined=part.quarantined,
+    )
+    part.blame_lat_hist = build_blame_hist(
+        part.blame_lat_rows, quarantined=part.quarantined,
     )
 
 
@@ -425,6 +446,7 @@ def _zero_rows(part, rows: list[int], reasons: list[str]):
     part.quarantined = mask
     part.quarantine_reason = np.asarray(reason, dtype=np.str_)
     _rebuild_gauge_hist(part)
+    _rebuild_blame_hist(part)
     return part
 
 
@@ -484,6 +506,7 @@ def splice_row(part, row: int, single) -> None:
         dst_arr[row] = src_arr[0]
         setattr(part, f.name, dst_arr)
     _rebuild_gauge_hist(part)
+    _rebuild_blame_hist(part)
 
 
 # ---------------------------------------------------------------------------

@@ -25,7 +25,7 @@ from asyncflow_tpu.engines.jaxsim.params import (
     base_overrides,
     fill_overrides,
 )
-from asyncflow_tpu.engines.results import SweepResults
+from asyncflow_tpu.engines.results import SweepResults, build_blame_hist
 from asyncflow_tpu.observability.simtrace import TraceConfig, decode_flight
 from asyncflow_tpu.observability.telemetry import (
     TelemetryConfig,
@@ -499,6 +499,36 @@ class SweepReport:
         times = (np.arange(1, n + 1) * period).astype(np.float64)
         return times, self.results.gauge_series[:, :, col]
 
+    def latency_blame(self, q: float = 0.95, *, tail: bool = False):
+        """Decompose the pooled ``q``-quantile's latency into per-phase,
+        per-component shares (:class:`~asyncflow_tpu.observability.blame.BlameReport`).
+
+        Requires a ``SweepRunner(..., blame=True)`` sweep.  ``tail=False``
+        blames the single coarse latency bin containing the pooled
+        quantile — "what does a p95 request spend its time on" — exact to
+        one bin; ``tail=True`` pools every bin at or above it.
+        """
+        from asyncflow_tpu.observability.blame import blame_breakdown
+
+        if self.results.blame_hist is None or self.plan is None:
+            msg = (
+                "no latency attribution was collected: construct "
+                "SweepRunner(..., blame=True) — the blame plane runs on "
+                "the fast and event engines"
+            )
+            raise ValueError(msg)
+        res = self.results.effective()
+        return blame_breakdown(
+            self.results.blame_hist,
+            res.latency_hist.sum(axis=0),
+            n_servers=self.plan.n_servers,
+            n_edges=self.plan.n_edges,
+            server_ids=self.plan.server_ids,
+            edge_ids=self.plan.edge_ids,
+            q=q / 100.0 if q > 1.0 else q,
+            tail=tail,
+        )
+
     @property
     def scenarios_per_second(self) -> float:
         return self.n_scenarios / max(self.wall_seconds, 1e-9)
@@ -692,6 +722,12 @@ class SweepReport:
             # LLM serving counters (docs/guides/serving.md): present only
             # on sweeps whose plan carries llm_serve steps
             **self._serving_fields(res),
+            # latency attribution shares (docs/guides/observability.md,
+            # "Where does the tail come from"): present only on blame=True
+            # sweeps — whole-run fraction of attributed seconds per phase,
+            # usable as PrecisionTarget/compare metrics
+            # (``blame_share:<phase>``)
+            **self._blame_fields(res),
             # pooled order-statistic CIs (asyncflow_tpu.analysis): intervals
             # on the POOLED tail quantiles the point fields above report —
             # [lo, hi] at ci_level, NaN-pairs on empty sweeps
@@ -725,6 +761,17 @@ class SweepReport:
                 float(finite.mean()) if finite.size else None
             )
         return out
+
+    def _blame_fields(self, res: SweepResults) -> dict:
+        """Whole-run attribution shares; empty on unattributed sweeps."""
+        if res.blame_hist is None:
+            return {}
+        from asyncflow_tpu.observability.blame import blame_shares
+
+        return {
+            f"blame_share_{phase}": float(share)
+            for phase, share in blame_shares(res.blame_hist).items()
+        }
 
     def _serving_fields(self, res: SweepResults) -> dict:
         """LLM serving summary keys; empty on non-serving sweeps."""
@@ -790,6 +837,7 @@ class SweepRunner:
         telemetry: TelemetryConfig | None = None,
         experiment: ExperimentConfig | None = None,
         trace: TraceConfig | None = None,
+        blame: bool = False,
         recovery: RecoveryPolicy | None = DEFAULT_RECOVERY,
         preflight: str = "warn",
     ) -> None:
@@ -858,6 +906,18 @@ class SweepRunner:
         Tracing consumes no draws: every non-trace output is bit-identical
         with it on or off.
 
+        ``blame``: the latency attribution plane
+        (:mod:`asyncflow_tpu.observability.blame`): every completed
+        request's end-to-end latency is decomposed on device into additive
+        per-(component, phase) seconds and pooled into fixed-bin grids
+        keyed by the request's final latency bin, surfaced via
+        :meth:`SweepReport.latency_blame` and ``summary()``
+        ``blame_share_<phase>`` keys.  Rides the scan fast path and the
+        XLA event engine with identical cell layout; forcing
+        ``pallas``/``native`` is an explicit error.  Attribution consumes
+        no draws: every non-blame output is bit-identical with it on or
+        off.
+
         ``recovery``: host-fault recovery policy
         (:class:`asyncflow_tpu.parallel.recovery.RecoveryPolicy`;
         docs/guides/fault-tolerance.md), default ON.  Governs scenario
@@ -904,6 +964,11 @@ class SweepRunner:
         vr_coupled = self._crn or self._antithetic
         if vr_coupled and engine in ("pallas", "native"):
             raise_fence(f"vr.{engine}")
+        #: latency attribution plane (observability/blame.py) — the grids
+        #: live in the jaxsim scatter path (fast + event engines)
+        self.blame = bool(blame)
+        if self.blame and engine in ("pallas", "native"):
+            raise_fence(f"blame.{engine}")
         import time as _time
 
         t0 = _time.perf_counter()
@@ -988,6 +1053,7 @@ class SweepRunner:
                 n_hist_bins=n_hist_bins,
                 gauge_series_stride=gauge_stride,
                 trace=self.trace,
+                blame=self.blame,
             )
             self.engine_kind = "fast"
         elif engine == "pallas" or (
@@ -999,6 +1065,8 @@ class SweepRunner:
             and not vr_coupled
             # the flight recorder's rings live in the XLA event engine
             and self.trace is None
+            # the blame scatter path likewise (fast + event engines)
+            and not self.blame
             # streaming gauge series ride the jaxsim gauge grid: auto
             # routes gauge-series sweeps off the pallas kernel
             and self._gauge_sel is None
@@ -1027,6 +1095,7 @@ class SweepRunner:
                 n_hist_bins=n_hist_bins,
                 crn=self._crn,
                 trace=self.trace,
+                blame=self.blame,
             )
             self.engine_kind = "event"
         # scan_inner is a fast-path-only execution knob: decide it ONCE,
@@ -1088,8 +1157,9 @@ class SweepRunner:
         # the quarantine mask/reason arrays and the digest sidecars; v7 the
         # gauge_hist/gauge_hist_cap band histograms; v8 the dark_lost
         # availability counter (chaos campaigns); v9 the LLM serving
-        # counters (kv_evictions / prefill_tokens / decode_tokens)
-        digest.update(b"chunk-schema-v9")
+        # counters (kv_evictions / prefill_tokens / decode_tokens); v10 the
+        # latency-attribution blame grids (blame_rows / blame_lat_rows)
+        digest.update(b"chunk-schema-v10")
         digest.update(self.payload.model_dump_json().encode())
         # the LOWERED plan arrays, not just the payload: any plan-level
         # field (fault tables, retry scalars, capacity estimates — and
@@ -1107,6 +1177,10 @@ class SweepRunner:
         # are different result streams and must never be merged
         if self._crn:
             digest.update(b"crn")
+        # blame chunks carry the attribution grids: toggling the plane
+        # changes the chunk contents, so the streams must never be merged
+        if self.blame:
+            digest.update(b"blame")
         # traced chunks carry flight arrays in their npz; budget changes
         # change the array shapes
         if self.trace is not None:
@@ -1830,6 +1904,34 @@ class SweepRunner:
                 inst if not ewma_rate[0] else 0.3 * inst + 0.7 * ewma_rate[0]
             )
             remaining = max(n_scenarios - beat[1], 0)
+            # serving heartbeat (docs/guides/serving.md): running token /
+            # eviction totals over the merged chunks so far, so a live
+            # follower sees serving throughput without waiting for the
+            # final summary (empty on non-serving sweeps)
+            serving_meta: dict = {}
+            srv_parts = [
+                p
+                for p in partials
+                if p is not None and p.decode_tokens is not None
+            ]
+            if srv_parts:
+                decode = float(
+                    np.sum([p.decode_tokens.sum() for p in srv_parts]),
+                )
+                serving_meta = {
+                    "kv_evictions": int(
+                        np.sum([p.kv_evictions.sum() for p in srv_parts]),
+                    ),
+                    "prefill_tokens": float(
+                        np.sum([p.prefill_tokens.sum() for p in srv_parts]),
+                    ),
+                    "decode_tokens": decode,
+                }
+                horizon = getattr(self.plan, "horizon", None)
+                if horizon:
+                    serving_meta["tokens_per_s"] = round(
+                        decode / (float(horizon) * max(beat[1], 1)), 3,
+                    )
             emit_event_record(
                 cfg,
                 kind="progress",
@@ -1846,6 +1948,7 @@ class SweepRunner:
                 eta_s=round(remaining / max(ewma_rate[0], 1e-9), 3),
                 n_quarantined=quarantined_total,
                 recovery_actions=len(rlog.actions),
+                **serving_meta,
             )
 
         partials: list[SweepResults] = []
@@ -2187,6 +2290,9 @@ class _SweepCheckpoint:
             payload["retry_budget_exhausted"] = part.retry_budget_exhausted
         if part.attempts_hist is not None:
             payload["attempts_hist"] = part.attempts_hist
+        if part.blame_rows is not None:
+            payload["blame_rows"] = part.blame_rows
+            payload["blame_lat_rows"] = part.blame_lat_rows
         if part.flight_ev is not None:
             payload["flight_ev"] = part.flight_ev
             payload["flight_node"] = part.flight_node
@@ -2284,6 +2390,40 @@ class _SweepCheckpoint:
                 ),
                 attempts_hist=(
                     data["attempts_hist"] if "attempts_hist" in data else None
+                ),
+                blame_rows=(
+                    data["blame_rows"] if "blame_rows" in data else None
+                ),
+                blame_lat_rows=(
+                    data["blame_lat_rows"]
+                    if "blame_lat_rows" in data
+                    else None
+                ),
+                # pooled grids rebuild from the rows at load (same rule as
+                # quarantine splice), so the npz carries no redundant copy
+                blame_hist=(
+                    build_blame_hist(
+                        data["blame_rows"],
+                        quarantined=(
+                            data["quarantined"]
+                            if "quarantined" in data
+                            else None
+                        ),
+                    )
+                    if "blame_rows" in data
+                    else None
+                ),
+                blame_lat_hist=(
+                    build_blame_hist(
+                        data["blame_lat_rows"],
+                        quarantined=(
+                            data["quarantined"]
+                            if "quarantined" in data
+                            else None
+                        ),
+                    )
+                    if "blame_lat_rows" in data
+                    else None
                 ),
                 flight_ev=data["flight_ev"] if "flight_ev" in data else None,
                 flight_node=(
@@ -2772,6 +2912,28 @@ def _concat_sweeps(parts: list[SweepResults]) -> SweepResults:
             decode_tokens=(
                 np.concatenate([p.decode_tokens for p in parts])
                 if all(p.decode_tokens is not None for p in parts)
+                else None
+            ),
+            blame_rows=(
+                np.concatenate([p.blame_rows for p in parts])
+                if all(p.blame_rows is not None for p in parts)
+                else None
+            ),
+            blame_lat_rows=(
+                np.concatenate([p.blame_lat_rows for p in parts])
+                if all(p.blame_lat_rows is not None for p in parts)
+                else None
+            ),
+            # pooled blame grids span the scenario axis: chunks SUM in
+            # float64 (each part already excluded its quarantined rows)
+            blame_hist=(
+                np.sum([p.blame_hist for p in parts], axis=0)
+                if all(p.blame_hist is not None for p in parts)
+                else None
+            ),
+            blame_lat_hist=(
+                np.sum([p.blame_lat_hist for p in parts], axis=0)
+                if all(p.blame_lat_hist is not None for p in parts)
                 else None
             ),
             flight_ev=(

@@ -44,6 +44,26 @@ SUPPORTED_METRICS = (
     "tokens_per_s",
 )
 
+#: metric FAMILIES: prefixed names validated by suffix rather than listed
+#: exhaustively.  ``blame_share:<phase>`` is the share of attributed
+#: latency spent in one phase (docs/guides/observability.md); it needs a
+#: ``SweepRunner(..., blame=True)`` sweep and a phase name from
+#: ``asyncflow_tpu.observability.blame.PHASE_NAMES``.
+BLAME_SHARE_PREFIX = "blame_share:"
+
+
+def metric_supported(metric: str) -> bool:
+    """Is ``metric`` a known estimator target (exact name or family)?"""
+    if metric in SUPPORTED_METRICS:
+        return True
+    if metric.startswith(BLAME_SHARE_PREFIX):
+        # lazy import: schemas stay importable without the observability
+        # package initialised
+        from asyncflow_tpu.observability.blame import PHASE_NAMES
+
+        return metric[len(BLAME_SHARE_PREFIX):] in PHASE_NAMES
+    return False
+
 
 class VarianceReduction(BaseModel):
     """Engine-coupling switches for variance reduction.
@@ -82,10 +102,11 @@ class PrecisionTarget(BaseModel):
 
     @model_validator(mode="after")
     def _known_metric(self) -> PrecisionTarget:
-        if self.metric not in SUPPORTED_METRICS:
+        if not metric_supported(self.metric):
             msg = (
                 f"unknown precision metric {self.metric!r}; supported: "
-                f"{', '.join(SUPPORTED_METRICS)}"
+                f"{', '.join(SUPPORTED_METRICS)}, "
+                f"{BLAME_SHARE_PREFIX}<phase>"
             )
             raise ValueError(msg)
         return self

@@ -65,6 +65,12 @@ def _parse_args(argv: list[str]) -> dict:
     seeds) and report the per-engine scen/s delta under
     ``detail.gauge_guard.fast`` / ``detail.gauge_guard.event``.
 
+    ``--blame-guard``: run the latency-attribution overhead guard on both
+    recording engines — assert each engine's non-blame outputs with the
+    per-phase blame grids ENABLED are bit-identical / 1-ulp-equal to the
+    plain program (same seeds) and report the per-engine scen/s delta
+    under ``detail.blame_guard.fast`` / ``detail.blame_guard.event``.
+
     ``--resilient``: run the fence burn-down arm — a small faulted +
     retrying + CRN sweep of the bench topology, auto-dispatched (must
     route to the scan fast path) vs the same sweep forced onto the event
@@ -98,6 +104,7 @@ def _parse_args(argv: list[str]) -> dict:
         "repeats": None,
         "trace_guard": False,
         "gauge_guard": False,
+        "blame_guard": False,
         "resilient": False,
         "chaos": False,
         "serving": False,
@@ -110,6 +117,8 @@ def _parse_args(argv: list[str]) -> dict:
             opts["trace_guard"] = True
         elif arg == "--gauge-guard":
             opts["gauge_guard"] = True
+        elif arg == "--blame-guard":
+            opts["blame_guard"] = True
         elif arg == "--resilient":
             opts["resilient"] = True
         elif arg == "--chaos":
@@ -421,6 +430,103 @@ def _gauge_guard_for(engine: str) -> dict:
         "bit_identical_outputs": True,
         "scen_per_s_gauges_off": round(off_rate, 3),
         "scen_per_s_gauges_on": round(on_rate, 3),
+        "overhead_pct": round((off_rate / max(on_rate, 1e-9) - 1) * 100, 2),
+    }
+
+
+def _blame_guard() -> dict:
+    """Latency-attribution overhead guard (BENCH_BLAME_GUARD=1 /
+    --blame-guard).
+
+    Same two contracts as the trace/gauge guards, for the per-phase blame
+    grids both recording engines carry (docs/guides/observability.md):
+
+    1. **bit-identity**: every non-blame result array with attribution
+       ENABLED byte-compares equal to the plain engine's across the same
+       seeds — the phase scatters consume no draws and mutate no
+       simulation state.  The float32 running SUMS get the same 1-ulp
+       allowance as the other guards (a different XLA compilation may
+       move fusion boundaries).
+    2. **measured overhead**: scen/s with attribution enabled vs
+       disabled, reported per engine (not gated — the number this detail
+       tracks).
+    """
+    from asyncflow_tpu.compiler import compile_payload  # numpy-only
+
+    out = {"event": _blame_guard_for("event")}
+    if compile_payload(_payload()).fastpath_ok:
+        out["fast"] = _blame_guard_for("fast")
+    return out
+
+
+def _blame_guard_for(engine: str) -> dict:
+    import numpy as np
+
+    from asyncflow_tpu.parallel.sweep import SweepRunner
+
+    guard_payload = _payload()
+    guard_payload.sim_settings.total_simulation_time = int(
+        os.environ.get("BENCH_BLAME_GUARD_HORIZON", "60"),
+    )
+    n = int(os.environ.get("BENCH_BLAME_GUARD_SCENARIOS", "32"))
+    base = SweepRunner(guard_payload, engine=engine, use_mesh=False)
+    blamed = SweepRunner(
+        guard_payload, engine=engine, use_mesh=False, blame=True,
+    )
+    base.run(n, seed=SEED, chunk_size=n)
+    blamed.run(n, seed=SEED, chunk_size=n)
+    t0 = time.time()
+    rep_off = base.run(n, seed=SEED + 1, chunk_size=n)
+    wall_off = time.time() - t0
+    t0 = time.time()
+    rep_on = blamed.run(n, seed=SEED + 1, chunk_size=n)
+    wall_on = time.time() - t0
+
+    grid = rep_on.results.blame_hist
+    if grid is None or not float(np.asarray(grid).sum()) > 0.0:
+        msg = (
+            f"blame guard FAILED on the {engine} engine: no attributed "
+            "seconds were recorded (the phase scatters never landed)"
+        )
+        raise AssertionError(msg)
+    mismatched = [
+        name
+        for name in (
+            "completed",
+            "latency_hist",
+            "latency_min",
+            "latency_max",
+            "throughput",
+            "total_generated",
+            "total_dropped",
+            "overflow_dropped",
+        )
+        if not np.array_equal(
+            np.asarray(getattr(rep_off.results, name)),
+            np.asarray(getattr(rep_on.results, name)),
+        )
+    ]
+    for name in ("latency_sum", "latency_sumsq"):
+        a = np.asarray(getattr(rep_off.results, name))
+        b = np.asarray(getattr(rep_on.results, name))
+        if not np.allclose(a, b, rtol=1e-6, atol=0.0):
+            mismatched.append(name)
+    if mismatched:
+        msg = (
+            f"blame guard FAILED on the {engine} engine: enabling "
+            f"attribution changed non-blame outputs {mismatched} — "
+            "recording must never consume a draw or mutate simulation state"
+        )
+        raise AssertionError(msg)
+    off_rate = n / max(wall_off, 1e-9)
+    on_rate = n / max(wall_on, 1e-9)
+    return {
+        "engine": engine,
+        "n_scenarios": n,
+        "horizon_s": int(guard_payload.sim_settings.total_simulation_time),
+        "bit_identical_outputs": True,
+        "scen_per_s_blame_off": round(off_rate, 3),
+        "scen_per_s_blame_on": round(on_rate, 3),
         "overhead_pct": round((off_rate / max(on_rate, 1e-9) - 1) * 100, 2),
     }
 
@@ -965,6 +1071,16 @@ def run_measurement() -> None:
                 f"{gg['scen_per_s_gauges_off']:.1f} scen/s)",
                 file=sys.stderr,
             )
+    if os.environ.get("BENCH_BLAME_GUARD") == "1":
+        detail["blame_guard"] = _blame_guard()
+        for eng, bg in detail["blame_guard"].items():
+            print(
+                f"blame guard [{eng}]: outputs bit-identical; overhead "
+                f"{bg['overhead_pct']:+.1f}% "
+                f"({bg['scen_per_s_blame_on']:.1f} vs "
+                f"{bg['scen_per_s_blame_off']:.1f} scen/s)",
+                file=sys.stderr,
+            )
     if os.environ.get("BENCH_RESILIENT") == "1":
         detail["resilient"] = _resilient_arm()
         res = detail["resilient"]
@@ -1180,6 +1296,8 @@ def main() -> None:
         os.environ["BENCH_TRACE_GUARD"] = "1"
     if opts["gauge_guard"]:
         os.environ["BENCH_GAUGE_GUARD"] = "1"
+    if opts["blame_guard"]:
+        os.environ["BENCH_BLAME_GUARD"] = "1"
     if opts["resilient"]:
         os.environ["BENCH_RESILIENT"] = "1"
     if opts["chaos"]:

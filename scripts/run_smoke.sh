@@ -320,6 +320,56 @@ if [ "$rc" -ne 2 ]; then
 fi
 python -m asyncflow_tpu.observability.diverge \
   examples/yaml_input/data/serving_parity.yml --mode flight --seed 0
+# latency-attribution slice: a tiny attributed sweep must dispatch with
+# predict_routing agreeing, decompose the p95 into non-empty blame shares
+# that sum to 1, and render the dashboard waterfall; the blame-off golden
+# digests are re-verified bit-identical (attribution off must compile the
+# exact pre-blame program) — docs/guides/observability.md §"Where does
+# the tail come from"
+python - <<'PY'
+import yaml
+from asyncflow_tpu.checker.fences import predict_routing
+from asyncflow_tpu.observability import TelemetryConfig
+from asyncflow_tpu.observability.dashboard import write_dashboard
+from asyncflow_tpu.parallel.sweep import SweepRunner
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+data = yaml.safe_load(open("tests/integration/data/single_server.yml").read())
+data["sim_settings"]["total_simulation_time"] = 20
+data["sim_settings"]["enabled_sample_metrics"] = []
+payload = SimulationPayload.model_validate(data)
+runner = SweepRunner(payload, engine="auto", use_mesh=False, blame=True)
+pred = predict_routing(runner.plan, engine="auto", blame=True)
+if runner.engine_kind != "fast" or pred.engine != runner.engine_kind:
+    raise SystemExit(
+        "blame routing regressed: attributed sweep dispatched "
+        f"{runner.engine_kind!r}, predicted {pred.engine!r} (expected 'fast')"
+    )
+tel = "/tmp/asyncflow_smoke_blame.jsonl"
+open(tel, "w").close()
+rep = runner.run(8, seed=3, chunk_size=4,
+                 telemetry=TelemetryConfig(jsonl_path=tel))
+for tail in (False, True):
+    br = rep.latency_blame(q=0.95, tail=tail)
+    assert br.n_requests > 0 and br.top(1), br
+    share_sum = sum(br.phase_shares.values())
+    assert abs(share_sum - 1.0) < 1e-6, share_sum
+summ = rep.summary()
+shares = {k: v for k, v in summ.items() if k.startswith("blame_share_")}
+assert shares and abs(sum(shares.values()) - 1.0) < 1e-6, shares
+page = write_dashboard(tel, "/tmp/asyncflow_smoke_blame.html",
+                       report=rep).read_text()
+for token in ("Latency blame waterfall", "p95 bin", "tail above p95"):
+    assert token in page, f"dashboard is missing {token!r}"
+top = rep.latency_blame(q=0.95).top(1)[0]
+print("attributed sweep + waterfall OK "
+      f"(engine={runner.engine_kind}, predicted={pred.engine}, "
+      f"p95 top cell={top[0]}/{top[1]})")
+PY
+python -m pytest \
+  "tests/parity/test_flight_recorder.py::TestDisabledBitIdentity" \
+  tests/parity/test_blame.py::TestCrossEngineParity \
+  -q -p no:cacheprovider
 # static-checker slice: the repo must lint clean under the invariant AST
 # rules, the preflight CLI must pass a shipped example (exit 0) and call
 # a deliberately saturated scenario (exit 2) — docs/guides/diagnostics.md

@@ -1,0 +1,299 @@
+"""Latency attribution contracts across engines.
+
+Four load-bearing guarantees of the blame plane
+(docs/guides/observability.md §"Where does the tail come from"):
+
+1. **neutrality**: enabling attribution changes NO non-blame output —
+   the phase scatters consume no draws and mutate no simulation state
+   (the blame-off engines being bit-identical to pre-blame builds is
+   pinned by tests/parity/test_flight_recorder.py's golden digests);
+2. **conservation**: every completed request's phase buckets sum to its
+   end-to-end latency — exactly on the oracle (float64 realized
+   timestamps telescope), within float32 tolerance on the jax engines;
+3. **cross-engine parity**: on the variance-0 parity scenario the
+   oracle, the XLA event engine, and the scan fast path attribute the
+   SAME per-completion mean cell vector (their RNG families differ, so
+   absolute totals are incomparable — the deterministic per-request
+   journey is not);
+4. **pooled invariance**: the pooled (component, phase) histograms are
+   identical across chunking, checkpoint resume, and host-fault
+   quarantine splices, and the analysis surfaces read them coherently.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import yaml
+
+from asyncflow_tpu.compiler import compile_payload
+from asyncflow_tpu.engines.jaxsim.engine import Engine, run_single, scenario_keys
+from asyncflow_tpu.engines.oracle.engine import OracleEngine
+from asyncflow_tpu.observability import blame as blm
+from asyncflow_tpu.schemas.payload import SimulationPayload
+
+pytestmark = pytest.mark.integration
+
+BASE = "tests/integration/data/single_server.yml"
+PARITY = "examples/yaml_input/data/trace_parity.yml"
+
+
+def _payload(path: str = BASE, horizon: int = 30) -> SimulationPayload:
+    data = yaml.safe_load(open(path).read())
+    data["sim_settings"]["total_simulation_time"] = horizon
+    return SimulationPayload.model_validate(data)
+
+
+def _mean_cells(res) -> np.ndarray:
+    """Per-completion mean seconds per (component, phase) cell from the
+    pooled grid — arrival-realization-independent on a variance-0 plan."""
+    grid = np.asarray(res.blame, np.float64)
+    n = max(len(res.rqs_clock), 1)
+    return grid.sum(axis=1) / n
+
+
+# ---------------------------------------------------------------------------
+# 1. attribution enabled changes no non-blame output
+# ---------------------------------------------------------------------------
+
+
+class TestNeutrality:
+    def test_oracle_outputs_identical_with_blame(self) -> None:
+        payload = _payload()
+        plain = OracleEngine(payload, seed=7).run()
+        blamed = OracleEngine(payload, seed=7, blame=True).run()
+        np.testing.assert_array_equal(plain.rqs_clock, blamed.rqs_clock)
+        assert plain.total_generated == blamed.total_generated
+        assert plain.total_dropped == blamed.total_dropped
+        assert plain.blame is None
+        assert blamed.blame is not None
+
+    def test_event_engine_outputs_identical_with_blame(self) -> None:
+        plan = compile_payload(_payload())
+        keys = scenario_keys(7, 2)
+        plain = Engine(plan, collect_clocks=True).run_batch(keys)
+        blamed = Engine(plan, collect_clocks=True, blame=True).run_batch(keys)
+        for name in ("hist", "clock", "clock_n", "n_generated", "n_dropped"):
+            assert np.array_equal(
+                np.asarray(getattr(plain, name)),
+                np.asarray(getattr(blamed, name)),
+            ), name
+
+    def test_fast_path_outputs_identical_with_blame(self) -> None:
+        from asyncflow_tpu.engines.jaxsim.fastpath import FastEngine
+
+        plan = compile_payload(_payload())
+        keys = scenario_keys(7, 2)
+        plain = FastEngine(plan, collect_clocks=True).run_batch(keys)
+        blamed = FastEngine(
+            plan, collect_clocks=True, blame=True,
+        ).run_batch(keys)
+        for name in ("hist", "clock", "clock_n", "n_generated"):
+            assert np.array_equal(
+                np.asarray(getattr(plain, name)),
+                np.asarray(getattr(blamed, name)),
+            ), name
+
+
+# ---------------------------------------------------------------------------
+# 2. phase buckets sum to end-to-end latency per request
+# ---------------------------------------------------------------------------
+
+
+class TestConservation:
+    def test_oracle_rows_telescope_exactly(self) -> None:
+        res = OracleEngine(_payload(), seed=7, blame=True).run()
+        e2e = res.rqs_clock[:, 1] - res.rqs_clock[:, 0]
+        rows = res.blame_req
+        assert rows.shape[0] == e2e.shape[0]
+        # realized float64 timestamp diffs telescope to zero error
+        assert np.max(np.abs(rows.sum(axis=1) - e2e)) < 1e-9
+        # pooled grid, pooled latency, and per-request totals all agree
+        assert res.blame.sum() == pytest.approx(e2e.sum(), rel=1e-9)
+        assert res.blame_lat.sum() == pytest.approx(e2e.sum(), rel=1e-9)
+        # per-bin conservation: each coarse bin's cells sum to its latency
+        assert np.max(np.abs(res.blame.sum(axis=0) - res.blame_lat)) < 1e-9
+
+    @pytest.mark.parametrize("engine", ["fast", "event"])
+    def test_jax_rows_conserve_within_f32(self, engine: str) -> None:
+        res = run_single(_payload(), seed=7, engine=engine, blame=True)
+        e2e = (res.rqs_clock[:, 1] - res.rqs_clock[:, 0]).astype(np.float64)
+        rows = res.blame_req
+        assert rows.shape[0] == e2e.shape[0]
+        # float32 phase credits accumulate ulp-scale error per request
+        np.testing.assert_allclose(
+            rows.sum(axis=1), e2e, rtol=1e-5, atol=1e-5,
+        )
+        # pooled totals drift further (constant-increment f32 accumulation
+        # bias — see observability/blame.py) but stay within 1e-3 relative
+        total = float(e2e.sum())
+        assert res.blame.sum() == pytest.approx(total, rel=1e-3)
+        assert res.blame_lat.sum() == pytest.approx(total, rel=1e-3)
+
+    @pytest.mark.parametrize("engine", ["oracle", "fast", "event"])
+    def test_reserved_phases_structurally_zero(self, engine: str) -> None:
+        payload = _payload()
+        if engine == "oracle":
+            res = OracleEngine(payload, seed=7, blame=True).run()
+        else:
+            res = run_single(payload, seed=7, engine=engine, blame=True)
+        grid = np.asarray(res.blame).reshape(-1, blm.N_PHASES,
+                                             res.blame.shape[-1])
+        assert grid[:, blm.PH_BACKOFF].sum() == 0.0
+        assert grid[:, blm.PH_DARK].sum() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# 3. the engines blame the same places (variance-0 parity scenario)
+# ---------------------------------------------------------------------------
+
+
+class TestCrossEngineParity:
+    """The CI parity gate: every engine attributes the deterministic
+    request journey identically — transit to each edge, service/IO to the
+    server — so the per-completion mean cell vectors match across RNG
+    families."""
+
+    @pytest.fixture(scope="class")
+    def means(self) -> dict[str, np.ndarray]:
+        payload = _payload(PARITY, horizon=60)
+        out = {
+            "oracle": _mean_cells(
+                OracleEngine(payload, seed=11, blame=True).run(),
+            ),
+            "event": _mean_cells(
+                run_single(payload, seed=11, engine="event", blame=True),
+            ),
+            "fast": _mean_cells(
+                run_single(payload, seed=11, engine="fast", blame=True),
+            ),
+        }
+        assert all(v.sum() > 0 for v in out.values())
+        return out
+
+    def test_mean_cell_vectors_agree(self, means) -> None:
+        for name in ("event", "fast"):
+            # float32 phase credits carry ~1e-4 relative rounding on the
+            # jax engines; 1e-3 still pins the journey to the right cells
+            np.testing.assert_allclose(
+                means[name], means["oracle"], rtol=1e-3, atol=5e-6,
+                err_msg=f"{name} vs oracle",
+            )
+
+    def test_phase_sums_agree(self, means) -> None:
+        by_phase = {
+            name: v.reshape(-1, blm.N_PHASES).sum(axis=0)
+            for name, v in means.items()
+        }
+        for name in ("event", "fast"):
+            np.testing.assert_allclose(
+                by_phase[name], by_phase["oracle"], rtol=1e-3, atol=5e-6,
+                err_msg=f"{name} vs oracle",
+            )
+
+    def test_deterministic_journey_is_attributed_verbatim(self, means) -> None:
+        # the fixture's per-request timeline: 0.003 + 0.002 + 0.005 transit,
+        # 0.004 cpu service, 0.012 io wait — uncontended, so queueing is 0
+        phases = means["oracle"].reshape(-1, blm.N_PHASES).sum(axis=0)
+        assert phases[blm.PH_TRANSIT] == pytest.approx(0.010, rel=1e-3)
+        assert phases[blm.PH_SERVICE] == pytest.approx(0.016, rel=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# 4. pooled histograms are chunking/resume/quarantine invariant
+# ---------------------------------------------------------------------------
+
+
+class TestSweepInvariance:
+    def test_chunks_sum_to_single_chunk_grid(self) -> None:
+        from asyncflow_tpu.parallel import SweepRunner
+
+        payload = _payload()
+        chunked = SweepRunner(payload, use_mesh=False, blame=True).run(
+            8, seed=3, chunk_size=2,
+        )
+        whole = SweepRunner(payload, use_mesh=False, blame=True).run(
+            8, seed=3, chunk_size=8,
+        )
+        np.testing.assert_array_equal(
+            chunked.results.blame_hist, whole.results.blame_hist,
+        )
+        np.testing.assert_array_equal(
+            chunked.results.blame_lat_hist, whole.results.blame_lat_hist,
+        )
+
+    def test_grid_survives_checkpoint_resume(self, tmp_path) -> None:
+        from asyncflow_tpu.parallel import SweepRunner
+
+        runner = SweepRunner(_payload(), use_mesh=False, blame=True)
+        first = runner.run(8, seed=9, chunk_size=4,
+                           checkpoint_dir=str(tmp_path))
+        resumed = runner.run(8, seed=9, chunk_size=4,
+                             checkpoint_dir=str(tmp_path))
+        np.testing.assert_array_equal(
+            first.results.blame_rows, resumed.results.blame_rows,
+        )
+        np.testing.assert_array_equal(
+            first.results.blame_hist, resumed.results.blame_hist,
+        )
+
+    def test_quarantined_rows_leave_the_grid(self) -> None:
+        from asyncflow_tpu.engines.results import build_blame_hist
+        from asyncflow_tpu.parallel import SweepRunner
+        from asyncflow_tpu.parallel.recovery import _zero_rows
+
+        rep = SweepRunner(_payload(), use_mesh=False, blame=True).run(
+            8, seed=9, chunk_size=8,
+        )
+        part = rep.results[:8]  # detached copy
+        part = _zero_rows(part, [1, 5], ["host fault", "host fault"])
+        survivors = np.delete(rep.results.blame_rows, [1, 5], axis=0)
+        np.testing.assert_array_equal(
+            part.blame_hist, survivors.astype(np.float64).sum(axis=0),
+        )
+        np.testing.assert_array_equal(
+            part.blame_hist,
+            build_blame_hist(part.blame_rows, quarantined=part.quarantined),
+        )
+
+    def test_report_surfaces_read_the_grid(self) -> None:
+        from asyncflow_tpu.analysis.estimators import interval_for_metric
+        from asyncflow_tpu.parallel import SweepRunner
+
+        rep = SweepRunner(_payload(), use_mesh=False, blame=True).run(
+            8, seed=3, chunk_size=8,
+        )
+        summary = rep.summary()
+        shares = {k: v for k, v in summary.items()
+                  if k.startswith("blame_share_")}
+        assert shares
+        assert sum(shares.values()) == pytest.approx(1.0, abs=1e-6)
+
+        report = rep.latency_blame(q=0.95)
+        assert report.n_requests > 0
+        assert sum(report.phase_shares.values()) == pytest.approx(
+            1.0, abs=1e-6,
+        )
+        assert report.top(3)[0][2] > 0.0
+
+        est = interval_for_metric(rep.results, "blame_share:service")
+        assert 0.0 <= est.lo <= est.point <= est.hi <= 1.0
+
+    def test_unattributed_sweep_refuses_coherently(self) -> None:
+        from asyncflow_tpu.analysis.estimators import interval_for_metric
+        from asyncflow_tpu.parallel import SweepRunner
+        from asyncflow_tpu.schemas.experiment import PrecisionTarget
+
+        rep = SweepRunner(_payload(), use_mesh=False).run(
+            2, seed=3, chunk_size=2,
+        )
+        assert rep.results.blame_hist is None
+        assert not any(k.startswith("blame_share_") for k in rep.summary())
+        with pytest.raises(ValueError, match="blame=True"):
+            rep.latency_blame()
+        with pytest.raises(ValueError, match="blame=True"):
+            interval_for_metric(rep.results, "blame_share:service")
+        # the metric family validates its phase suffix up front
+        PrecisionTarget(metric="blame_share:decode", half_width=0.05)
+        with pytest.raises(ValueError, match="unknown precision metric"):
+            PrecisionTarget(metric="blame_share:nope", half_width=0.05)

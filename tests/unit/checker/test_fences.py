@@ -55,6 +55,7 @@ def test_registry_covers_every_known_fence() -> None:
         "fastpath.ineligible", "fastpath.poisson_edge",
         "native.unavailable",
         "gauge_series.pallas", "gauge_series.native",
+        "blame.pallas", "blame.native",
     }
     for fence in FENCES.values():
         assert fence.message and fence.feature and fence.engine
@@ -118,6 +119,29 @@ def test_sweep_gauge_series_refusals_match_registry() -> None:
     pred = predict_routing(runner.plan, engine="event", backend="cpu",
                            gauge_series=True)
     assert pred.ok and pred.engine == "event"
+
+
+def test_sweep_blame_refusals_match_registry() -> None:
+    payload = build_payload()
+    for engine in ("pallas", "native"):
+        with pytest.raises(ValueError) as err:
+            SweepRunner(payload, engine=engine, use_mesh=False,
+                        blame=True, preflight="off")
+        assert str(err.value) == fence_message(f"blame.{engine}")
+    # fast and event both carry the blame plane
+    runner = SweepRunner(payload, engine="fast", use_mesh=False,
+                         blame=True, preflight="off")
+    assert runner.engine_kind == "fast"
+    for engine in ("pallas", "native"):
+        pred = predict_routing(runner.plan, engine=engine,
+                               backend="cpu", blame=True)
+        assert not pred.ok
+        assert pred.refusal.fence_id == f"blame.{engine}"
+        assert pred.refusal.message == fence_message(f"blame.{engine}")
+    # auto on TPU must route an attributed eligible plan OFF the kernel
+    pred = predict_routing(runner.plan, engine="auto", backend="tpu",
+                           blame=True)
+    assert pred.ok and pred.engine == "fast"
 
 
 def test_sweep_resilience_refusals_match_registry() -> None:
